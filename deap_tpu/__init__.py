@@ -1,0 +1,21 @@
+"""deap_tpu — a TPU-native evolutionary-computation framework.
+
+Same capabilities as DEAP (the reference at /root/reference: GA over
+arbitrary representations, GP, ES/CMA-ES/MO-CMA-ES, PSO, DE, EDA,
+NSGA-II/III, SPEA2, co-evolution, islands, archives, statistics,
+checkpointing, benchmark library) — designed for JAX/XLA rather than ported:
+
+* populations are ``jnp.ndarray`` pytrees, fitness a ``(pop, nobj)`` array;
+* operators are pure vectorized kernels vmapped over whole populations;
+* the generational loop is one ``lax.scan`` compiled once per run;
+* distribution is ``jax.sharding`` over a device mesh — pop-axis sharding
+  for fitness parallelism, island-axis sharding with ppermute migration —
+  behind the same toolbox ``map``/``register`` plugin boundary the reference
+  uses for multiprocessing/SCOOP.
+"""
+
+__version__ = "0.1.0"
+__revision__ = "0.1.0"
+
+from . import base, creator, tools, algorithms, cma, benchmarks, ops, utils, parallel  # noqa: F401
+from .base import Toolbox, Fitness, Population  # noqa: F401

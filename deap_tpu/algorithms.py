@@ -1,0 +1,320 @@
+"""Canonical evolutionary loops — array-native equivalents of
+``deap/algorithms.py``.
+
+The reference's loops (``eaSimple`` algorithms.py:85-189, ``eaMuPlusLambda``
+248-337, ``eaMuCommaLambda`` 340-437, ``eaGenerateUpdate`` 440-503) do, per
+generation: select → clone → mate/mutate per individual → evaluate the
+invalidated ones through ``toolbox.map`` → update hall-of-fame, stats,
+logbook.  Here the *entire generation body is one traced function* run under
+``lax.scan`` over generations: selection is a gather, variation is vmapped
+over the population, evaluation is a masked vmap, and the hall-of-fame /
+statistics updates are functional kernels threaded through the scan carry.
+One dispatch for the whole run; per-generation records come back as stacked
+arrays and are unpacked into the host :class:`~deap_tpu.utils.support.Logbook`.
+
+Toolbox protocol (array tier):
+
+* ``toolbox.evaluate(genome) -> (nobj,) array or tuple of scalars`` — per
+  individual, vmapped by the loop.
+* ``toolbox.mate(key, g1, g2) -> (g1', g2')`` — per pair, vmapped.
+* ``toolbox.mutate(key, g) -> g'`` — per individual, vmapped.
+* ``toolbox.select(key, fitness, k) -> (k,) indices``.
+* ``toolbox.generate(state, key) -> genome batch`` and
+  ``toolbox.update(state, population) -> state`` for ask/tell strategies.
+
+Like the reference's eval pattern (algorithms.py:149-152), only individuals
+whose fitness was invalidated by variation get *assigned* new values;
+``nevals`` counts them.  (Under SIMD everything is computed and the mask
+selects — the count preserves the reference's bookkeeping.)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import Population, Fitness
+from .utils.support import (Logbook, HallOfFame, ParetoFront,
+                            hof_update, pareto_update)
+
+__all__ = ["var_and", "var_or", "ea_simple", "ea_mu_plus_lambda",
+           "ea_mu_comma_lambda", "ea_generate_update", "evaluate_population"]
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _where_rows(mask, new, old):
+    """Per-row select over a genome pytree; mask is (n,)."""
+    def w(a, b):
+        m = mask.reshape(mask.shape + (1,) * (a.ndim - 1))
+        return jnp.where(m, a, b)
+    return jax.tree_util.tree_map(w, new, old)
+
+
+def _norm_eval(evaluate):
+    """Wrap a per-individual evaluate so it returns a flat (nobj,) array
+    whether the user returns a tuple of scalars (reference convention) or an
+    array."""
+    def one(g):
+        out = evaluate(g)
+        if isinstance(out, (tuple, list)):
+            return jnp.stack([jnp.asarray(o, jnp.float32).reshape(()) for o in out])
+        out = jnp.asarray(out, jnp.float32)
+        return out.reshape((-1,)) if out.ndim else out.reshape((1,))
+    return one
+
+
+def evaluate_population(toolbox, population: Population):
+    """Evaluate invalid individuals (reference pattern algorithms.py:149-152):
+    vmap ``toolbox.evaluate`` over all genomes, assign where invalid.
+    Returns ``(population, nevals)``."""
+    if hasattr(toolbox, "evaluate_population"):
+        values = toolbox.evaluate_population(population.genome)
+        if values.ndim == 1:
+            values = values[:, None]
+    else:
+        values = jax.vmap(_norm_eval(toolbox.evaluate))(population.genome)
+    invalid = ~population.fitness.valid
+    nevals = jnp.sum(invalid)
+    return population.evaluated(values, where=invalid), nevals
+
+
+def var_and(key, population: Population, toolbox, cxpb: float, mutpb: float) -> Population:
+    """Vectorized varAnd (reference algorithms.py:33-82): adjacent pairs mate
+    w.p. ``cxpb``, every individual mutates w.p. ``mutpb``; any touched
+    individual's fitness is invalidated.  No clone step — operators are
+    functional."""
+    n = population.size
+    n2 = n // 2
+    g = population.genome
+    k_cx, k_cxkeys, k_mut, k_mutkeys = jax.random.split(key, 4)
+
+    # --- crossover on adjacent pairs (reference algorithms.py:70-76) ---
+    ga = jax.tree_util.tree_map(lambda x: x[0:2 * n2:2], g)
+    gb = jax.tree_util.tree_map(lambda x: x[1:2 * n2:2], g)
+    do_cx = jax.random.bernoulli(k_cx, cxpb, (n2,))
+    cx_keys = jax.random.split(k_cxkeys, n2)
+    ca, cb = jax.vmap(toolbox.mate)(cx_keys, ga, gb)
+    ga = _where_rows(do_cx, ca, ga)
+    gb = _where_rows(do_cx, cb, gb)
+    paired = jax.tree_util.tree_map(
+        lambda a, b: jnp.stack([a, b], 1).reshape((2 * n2,) + a.shape[1:]), ga, gb)
+    if n % 2:
+        g = jax.tree_util.tree_map(
+            lambda p, orig: jnp.concatenate([p, orig[2 * n2:]], 0), paired, g)
+    else:
+        g = paired
+    touched = jnp.repeat(do_cx, 2, total_repeat_length=2 * n2)
+    if n % 2:
+        touched = jnp.concatenate([touched, jnp.zeros((n - 2 * n2,), bool)])
+
+    # --- mutation (reference algorithms.py:78-82) ---
+    do_mut = jax.random.bernoulli(k_mut, mutpb, (n,))
+    mut_keys = jax.random.split(k_mutkeys, n)
+    mutated = jax.vmap(toolbox.mutate)(mut_keys, g)
+    g = _where_rows(do_mut, mutated, g)
+    touched = touched | do_mut
+
+    return population.with_genome(g, invalidate_where=touched)
+
+
+def var_or(key, population: Population, toolbox, lambda_: int,
+           cxpb: float, mutpb: float) -> Population:
+    """Vectorized varOr (reference algorithms.py:192-245): each of
+    ``lambda_`` children comes from crossover (p=cxpb, keeping the first
+    child of two random distinct parents), mutation (p=mutpb, on a random
+    parent) or reproduction.  All children are returned unevaluated."""
+    assert cxpb + mutpb <= 1.0, (
+        "The sum of the crossover and mutation probabilities must be smaller "
+        "or equal to 1.0.")
+    n = population.size
+    g = population.genome
+    k_choice, k_p1, k_p2, k_cx, k_pm, k_mut, k_pr = jax.random.split(key, 7)
+
+    u = jax.random.uniform(k_choice, (lambda_,))
+    use_cx = u < cxpb
+    use_mut = (u >= cxpb) & (u < cxpb + mutpb)
+
+    i1 = jax.random.randint(k_p1, (lambda_,), 0, n)
+    off = jax.random.randint(k_p2, (lambda_,), 1, n)
+    i2 = (i1 + off) % n                                  # distinct partner
+    cx_keys = jax.random.split(k_cx, lambda_)
+    p1 = jax.tree_util.tree_map(lambda x: x[i1], g)
+    p2 = jax.tree_util.tree_map(lambda x: x[i2], g)
+    child_cx, _ = jax.vmap(toolbox.mate)(cx_keys, p1, p2)
+
+    im = jax.random.randint(k_pm, (lambda_,), 0, n)
+    mut_keys = jax.random.split(k_mut, lambda_)
+    pm = jax.tree_util.tree_map(lambda x: x[im], g)
+    child_mut = jax.vmap(toolbox.mutate)(mut_keys, pm)
+
+    ir = jax.random.randint(k_pr, (lambda_,), 0, n)
+    child_rep = jax.tree_util.tree_map(lambda x: x[ir], g)
+
+    child = _where_rows(use_cx, child_cx,
+                        _where_rows(use_mut, child_mut, child_rep))
+    fit = Fitness.empty(lambda_, population.fitness.weights,
+                        population.fitness.values.dtype)
+    return Population(genome=child, fitness=fit)
+
+
+# ---------------------------------------------------------------------------
+# loop machinery
+# ---------------------------------------------------------------------------
+
+
+def _hof_setup(halloffame, sample_population):
+    if halloffame is None:
+        return None, None
+    state = halloffame.init_state(sample_population)
+    if isinstance(halloffame, ParetoFront):
+        upd = pareto_update
+    else:
+        upd = partial(hof_update, dedup=halloffame.similar is not None)
+    return state, upd
+
+
+def _record(stats, population, nevals):
+    rec = stats.compile(population) if stats is not None else {}
+    rec = dict(rec)
+    rec["nevals"] = nevals
+    return rec
+
+
+def _finish(key, population, hof_state, halloffame, stats, rec0, stacked,
+            ngen, verbose):
+    logbook = Logbook()
+    logbook.header = ["gen", "nevals"] + (stats.fields if stats else [])
+    logbook.record(gen=0, **{k: (v.item() if hasattr(v, "item") and jnp.ndim(v) == 0
+                                 else v) for k, v in rec0.items()})
+    if ngen > 0:
+        logbook.record_stacked(
+            gen=jnp.arange(1, ngen + 1), **stacked)
+    if halloffame is not None:
+        halloffame.state = hof_state
+    if verbose:
+        print(logbook.stream)
+    return logbook
+
+
+def ea_simple(key, population: Population, toolbox, cxpb: float, mutpb: float,
+              ngen: int, stats=None, halloffame=None, verbose=False):
+    """The simplest GA (reference eaSimple, algorithms.py:85-189): per
+    generation select ``n`` parents, apply :func:`var_and`, evaluate, update
+    the hall of fame.  Runs as one ``lax.scan``; returns
+    ``(population, logbook)``."""
+    key, k0 = jax.random.split(key)
+    population, nevals0 = evaluate_population(toolbox, population)
+    hof_state, hof_upd = _hof_setup(halloffame, population)
+    if hof_state is not None:
+        hof_state = hof_upd(hof_state, population)
+    rec0 = _record(stats, population, nevals0)
+
+    def gen_step(carry, _):
+        key, pop, hof = carry
+        key, k_sel, k_var = jax.random.split(key, 3)
+        idx = toolbox.select(k_sel, pop.fitness, pop.size)
+        off = pop.take(idx)
+        off = var_and(k_var, off, toolbox, cxpb, mutpb)
+        off, nevals = evaluate_population(toolbox, off)
+        if hof is not None:
+            hof = hof_upd(hof, off)
+        return (key, off, hof), _record(stats, off, nevals)
+
+    (key, population, hof_state), stacked = lax.scan(
+        gen_step, (key, population, hof_state), None, length=ngen)
+    logbook = _finish(key, population, hof_state, halloffame, stats, rec0,
+                      stacked, ngen, verbose)
+    return population, logbook
+
+
+def _ea_mu_lambda(key, population, toolbox, mu, lambda_, cxpb, mutpb, ngen,
+                  stats, halloffame, verbose, plus: bool):
+    key, k0 = jax.random.split(key)
+    population, nevals0 = evaluate_population(toolbox, population)
+    hof_state, hof_upd = _hof_setup(halloffame, population)
+    if hof_state is not None:
+        hof_state = hof_upd(hof_state, population)
+    rec0 = _record(stats, population, nevals0)
+
+    def gen_step(carry, _):
+        key, pop, hof = carry
+        key, k_var, k_sel = jax.random.split(key, 3)
+        off = var_or(k_var, pop, toolbox, lambda_, cxpb, mutpb)
+        off, nevals = evaluate_population(toolbox, off)
+        if hof is not None:
+            hof = hof_upd(hof, off)
+        pool = pop.concat(off) if plus else off
+        idx = toolbox.select(k_sel, pool.fitness, mu)
+        new_pop = pool.take(idx)
+        return (key, new_pop, hof), _record(stats, new_pop, nevals)
+
+    (key, population, hof_state), stacked = lax.scan(
+        gen_step, (key, population, hof_state), None, length=ngen)
+    logbook = _finish(key, population, hof_state, halloffame, stats, rec0,
+                      stacked, ngen, verbose)
+    return population, logbook
+
+
+def ea_mu_plus_lambda(key, population, toolbox, mu, lambda_, cxpb, mutpb,
+                      ngen, stats=None, halloffame=None, verbose=False):
+    """(μ + λ) strategy (reference eaMuPlusLambda, algorithms.py:248-337):
+    offspring by :func:`var_or`, next generation selected from parents ∪
+    offspring."""
+    return _ea_mu_lambda(key, population, toolbox, mu, lambda_, cxpb, mutpb,
+                         ngen, stats, halloffame, verbose, plus=True)
+
+
+def ea_mu_comma_lambda(key, population, toolbox, mu, lambda_, cxpb, mutpb,
+                       ngen, stats=None, halloffame=None, verbose=False):
+    """(μ , λ) strategy (reference eaMuCommaLambda, algorithms.py:340-437):
+    next generation selected from offspring only (λ ≥ μ required)."""
+    assert lambda_ >= mu, ("lambda must be greater or equal to mu.")
+    return _ea_mu_lambda(key, population, toolbox, mu, lambda_, cxpb, mutpb,
+                         ngen, stats, halloffame, verbose, plus=False)
+
+
+def ea_generate_update(key, toolbox, state, ngen: int, weights=(-1.0,),
+                       stats=None, halloffame=None, verbose=False):
+    """Ask-tell loop (reference eaGenerateUpdate, algorithms.py:440-503):
+    ``toolbox.generate(state, key) -> genome batch`` then
+    ``toolbox.update(state, population) -> state`` — the functional form of
+    the reference's strategy objects (used by CMA-ES, EDA, PSO).
+
+    Returns ``(population, state, logbook)``."""
+    weights = tuple(weights)
+
+    sample = toolbox.generate(state, jax.random.fold_in(key, 0))
+    n = jax.tree_util.tree_leaves(sample)[0].shape[0]
+    sample_pop = Population(sample, Fitness.empty(n, weights))
+    hof_state, hof_upd = _hof_setup(halloffame, sample_pop)
+
+    def gen_step(carry, _):
+        key, state, hof, _ = carry
+        key, k_gen = jax.random.split(key)
+        genome = toolbox.generate(state, k_gen)
+        pop = Population(genome, Fitness.empty(n, weights))
+        pop, nevals = evaluate_population(toolbox, pop)
+        state = toolbox.update(state, pop)
+        if hof is not None:
+            hof = hof_upd(hof, pop)
+        return (key, state, hof, pop), _record(stats, pop, nevals)
+
+    (key, state, hof_state, last_pop), stacked = lax.scan(
+        gen_step, (key, state, hof_state, sample_pop), None, length=ngen)
+
+    logbook = Logbook()
+    logbook.header = ["gen", "nevals"] + (stats.fields if stats else [])
+    logbook.record_stacked(gen=jnp.arange(1, ngen + 1), **stacked)
+    if halloffame is not None:
+        halloffame.state = hof_state
+    if verbose:
+        print(logbook.stream)
+    return last_pop, state, logbook

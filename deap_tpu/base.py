@@ -1,0 +1,288 @@
+"""Core containers: :class:`Toolbox`, :class:`Fitness`, :class:`Population`.
+
+TPU-native re-design of the reference's ``deap/base.py`` (Toolbox at
+base.py:33-122, Fitness at base.py:125-270).  The semantics are preserved —
+weighted multi-objective fitness with lexicographic comparison and Pareto
+dominance, and a named plugin registry of operators — but the data model is
+array-native:
+
+* A whole population's fitness is one ``(pop, nobj)`` array plus a ``(pop,)``
+  validity mask (replacing one ``Fitness`` object per individual).  As in the
+  reference, internal storage is *weighted* values (``wvalues``), so every
+  comparison is a maximization regardless of the user's weights
+  (reference base.py:187-198).
+* Comparisons (`<`, `>`, dominance) become vectorized kernels over wvalues
+  (reference base.py:209-250).
+* Validity ("has this individual been evaluated since last variation?") is a
+  boolean mask channel instead of an empty-tuple sentinel (reference
+  base.py:226-229), making "evaluate only the invalid" a masked ``where``
+  instead of a dynamic-shape filter (reference algorithms.py:149-152).
+
+The Toolbox keeps the exact duck-typed ergonomics of the reference — it is a
+plain-Python object holding named partials — because it lives *outside* jit:
+registered functions are traced into the compiled generation step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Toolbox",
+    "Fitness",
+    "Population",
+    "wvalues_of",
+    "dominates",
+    "dominance_matrix",
+    "lex_cmp_matrix",
+    "lex_argmax",
+    "lex_sort_indices",
+]
+
+
+class Toolbox:
+    """Named operator registry (reference ``base.Toolbox``, base.py:33-122).
+
+    ``register`` freezes positional/keyword defaults into a
+    ``functools.partial`` and copies ``__name__``/``__doc__`` so registered
+    tools introspect like the original function.  ``decorate`` re-wraps the
+    underlying function of an existing partial with decorators, preserving
+    the frozen arguments (reference base.py:100-122).
+
+    Two default slots mirror the reference (base.py:48-50):
+
+    * ``clone`` — identity here.  JAX arrays are immutable and every operator
+      is functional, so the per-individual ``copy.deepcopy`` of the reference
+      (the #1 CPU hot spot, see SURVEY §3.1) is unnecessary.
+    * ``map`` — builtin ``map``.  Replacing this slot is still the
+      parallelization boundary: :func:`deap_tpu.parallel.tpu_map` is the
+      sharded vmap equivalent of registering ``multiprocessing.Pool.map``.
+    """
+
+    def __init__(self):
+        self.register("clone", lambda x: x)
+        self.register("map", map)
+
+    def register(self, alias: str, function: Callable, *args, **kargs) -> None:
+        pfunc = partial(function, *args, **kargs)
+        pfunc.__name__ = alias
+        pfunc.__doc__ = function.__doc__
+        if hasattr(function, "__dict__") and not isinstance(function, type):
+            try:
+                pfunc.__dict__.update(function.__dict__.copy())
+            except (AttributeError, TypeError):
+                pass
+        setattr(self, alias, pfunc)
+
+    def unregister(self, alias: str) -> None:
+        delattr(self, alias)
+
+    def decorate(self, alias: str, *decorators: Callable) -> None:
+        pfunc = getattr(self, alias)
+        function, args, kargs = pfunc.func, pfunc.args, pfunc.keywords
+        for decorator in decorators:
+            function = decorator(function)
+        self.register(alias, function, *args, **kargs)
+
+
+# ---------------------------------------------------------------------------
+# Fitness: (pop, nobj) weighted-value arrays + validity mask
+# ---------------------------------------------------------------------------
+
+
+def _as_weights(weights: Sequence[float]) -> tuple:
+    ws = tuple(float(w) for w in weights)
+    if not ws:
+        raise TypeError("weights must be a non-empty sequence of numbers")
+    return ws
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Fitness:
+    """Population-level multi-objective fitness.
+
+    ``values`` holds *raw* objective values, shape ``(pop, nobj)``; ``valid``
+    marks which rows are current, shape ``(pop,)``.  ``weights`` is a static
+    tuple — sign encodes minimize/maximize exactly like the reference's class
+    attribute (base.py:148-161) — and ``wvalues = values * weights`` is
+    derived on demand (base.py:187-198).  All comparisons maximize wvalues.
+    """
+
+    values: jax.Array                       # (pop, nobj) float
+    valid: jax.Array                        # (pop,) bool
+    weights: tuple = dataclasses.field(metadata=dict(static=True))
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def empty(pop_size: int, weights: Sequence[float], dtype=jnp.float32) -> "Fitness":
+        weights = _as_weights(weights)
+        return Fitness(
+            values=jnp.zeros((pop_size, len(weights)), dtype),
+            valid=jnp.zeros((pop_size,), bool),
+            weights=weights,
+        )
+
+    # -- derived ------------------------------------------------------------
+    @property
+    def nobj(self) -> int:
+        return len(self.weights)
+
+    @property
+    def wvalues(self) -> jax.Array:
+        return self.values * jnp.asarray(self.weights, self.values.dtype)
+
+    def masked_wvalues(self, fill: float = -jnp.inf) -> jax.Array:
+        """wvalues with invalid rows replaced by ``fill`` (default ``-inf``)
+        so unevaluated individuals lose every maximizing comparison."""
+        return jnp.where(self.valid[:, None], self.wvalues, fill)
+
+    # -- functional updates -------------------------------------------------
+    def with_values(self, values: jax.Array, where: jax.Array | None = None) -> "Fitness":
+        """Assign objective values; ``where`` (bool ``(pop,)``) restricts the
+        assignment to a subset (the "invalid individuals" of the reference's
+        eval pattern, algorithms.py:149-152)."""
+        values = jnp.asarray(values)
+        if values.ndim == 1:
+            values = values[:, None]
+        if where is None:
+            return dataclasses.replace(
+                self, values=values, valid=jnp.ones_like(self.valid))
+        where = jnp.asarray(where, bool)
+        return dataclasses.replace(
+            self,
+            values=jnp.where(where[:, None], values, self.values),
+            valid=self.valid | where,
+        )
+
+    def invalidate(self, where: jax.Array | None = None) -> "Fitness":
+        """``del ind.fitness.values`` for the masked rows (reference
+        algorithms.py:75,80)."""
+        if where is None:
+            return dataclasses.replace(self, valid=jnp.zeros_like(self.valid))
+        return dataclasses.replace(self, valid=self.valid & ~jnp.asarray(where, bool))
+
+    def take(self, idx: jax.Array) -> "Fitness":
+        return dataclasses.replace(
+            self, values=self.values[idx], valid=self.valid[idx])
+
+
+# ---------------------------------------------------------------------------
+# Comparison kernels over wvalues
+# ---------------------------------------------------------------------------
+
+
+def wvalues_of(values: jax.Array, weights: Sequence[float]) -> jax.Array:
+    return jnp.asarray(values) * jnp.asarray(tuple(weights), jnp.asarray(values).dtype)
+
+
+def dominates(wa: jax.Array, wb: jax.Array) -> jax.Array:
+    """Pareto dominance on weighted values (reference base.py:209-224):
+    ``a`` dominates ``b`` iff every objective is >= and at least one is >.
+
+    Accepts ``(..., nobj)``; broadcasts; returns bool ``(...,)``.
+    """
+    return jnp.all(wa >= wb, -1) & jnp.any(wa > wb, -1)
+
+
+def dominance_matrix(w: jax.Array) -> jax.Array:
+    """``(n, n)`` bool matrix, ``[i, j] = i dominates j``."""
+    return dominates(w[:, None, :], w[None, :, :])
+
+
+def lex_cmp_matrix(w: jax.Array) -> jax.Array:
+    """``(n, n)`` int8 matrix of lexicographic comparison on wvalues
+    (+1 if row i > row j, -1 if <, 0 if equal) — the sequence comparison
+    the reference uses for ``Fitness.__gt__`` (base.py:234-250)."""
+    neq = w[:, None, :] != w[None, :, :]
+    first = jnp.argmax(neq, axis=-1)              # first differing objective
+    any_neq = jnp.any(neq, axis=-1)
+    n = w.shape[0]
+    gathered_i = jnp.take_along_axis(
+        jnp.broadcast_to(w[:, None, :], (n, n, w.shape[-1])), first[..., None], -1
+    )[..., 0]
+    gathered_j = jnp.take_along_axis(
+        jnp.broadcast_to(w[None, :, :], (n, n, w.shape[-1])), first[..., None], -1
+    )[..., 0]
+    sign = jnp.sign(gathered_i - gathered_j).astype(jnp.int8)
+    return jnp.where(any_neq, sign, jnp.int8(0))
+
+
+def lex_argmax(w: jax.Array, axis: int = 0) -> jax.Array:
+    """Index of the lexicographically largest row along ``axis``.
+
+    ``w`` has shape ``(..., k, nobj)`` with ``axis`` indexing k.  nobj is
+    static and small, so we peel objectives in a Python loop: keep a
+    still-tied mask, narrowing on each objective.
+    """
+    w = jnp.moveaxis(w, axis, -2)                 # (..., k, nobj)
+    alive = jnp.ones(w.shape[:-1], bool)          # (..., k)
+    for j in range(w.shape[-1]):
+        col = jnp.where(alive, w[..., j], -jnp.inf)
+        best = jnp.max(col, axis=-1, keepdims=True)
+        alive = alive & (col >= best)
+    return jnp.argmax(alive, axis=-1)
+
+
+def lex_sort_indices(w: jax.Array, descending: bool = True) -> jax.Array:
+    """Stable lexicographic sort order of ``(n, nobj)`` wvalues — first
+    objective is the primary key, as in tuple comparison (base.py:234-250)."""
+    keys = [w[:, j] for j in range(w.shape[1] - 1, -1, -1)]  # last key = primary
+    idx = jnp.lexsort(keys)
+    if descending:
+        idx = idx[::-1]
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# Population: genome pytree + Fitness
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Population:
+    """A population is a genome pytree whose leaves share a leading ``pop``
+    axis, plus a :class:`Fitness`.  This is the array-native stand-in for the
+    reference's ``list`` of creator-built individuals (creator.py:96-171):
+    the "type" of an individual is the pytree structure + per-leaf dtype and
+    trailing shape, and attributes attached by ``creator.create`` (e.g. PSO's
+    ``speed``/``best``) become sibling genome leaves.
+    """
+
+    genome: Any                               # pytree, leaves (pop, ...)
+    fitness: Fitness
+
+    @property
+    def size(self) -> int:
+        return jax.tree_util.tree_leaves(self.genome)[0].shape[0]
+
+    def take(self, idx: jax.Array) -> "Population":
+        return Population(
+            genome=jax.tree_util.tree_map(lambda g: g[idx], self.genome),
+            fitness=self.fitness.take(idx),
+        )
+
+    def concat(self, other: "Population") -> "Population":
+        return Population(
+            genome=jax.tree_util.tree_map(
+                lambda a, b: jnp.concatenate([a, b], 0), self.genome, other.genome),
+            fitness=Fitness(
+                values=jnp.concatenate([self.fitness.values, other.fitness.values], 0),
+                valid=jnp.concatenate([self.fitness.valid, other.fitness.valid], 0),
+                weights=self.fitness.weights,
+            ),
+        )
+
+    def with_genome(self, genome: Any, invalidate_where: jax.Array | None = None) -> "Population":
+        fit = self.fitness.invalidate(invalidate_where)
+        return Population(genome=genome, fitness=fit)
+
+    def evaluated(self, values: jax.Array, where: jax.Array | None = None) -> "Population":
+        return Population(genome=self.genome, fitness=self.fitness.with_values(values, where))

@@ -1,0 +1,325 @@
+"""Benchmark objective functions — array-native equivalents of
+``deap/benchmarks/__init__.py`` (all ~34 continuous single- and
+multi-objective functions, same formulas, same tuple-returning convention).
+
+Each function maps one individual (a 1-D jnp array) to a tuple of objective
+scalars, exactly like the reference's generator-sum implementations (e.g.
+rastrigin at benchmarks/__init__.py:220-241); the framework vmaps them over
+the population, so every formula below compiles to a handful of fused
+elementwise + reduction kernels over a ``(pop, dim)`` array.
+
+Multi-objective families: Kursawe, Schaffer, ZDT1-4/6, DTLZ1-7, Fonseca,
+Poloni, Dent (reference benchmarks/__init__.py:364-688).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import binary, gp, movingpeaks, tools  # noqa: F401  (subpackages)
+
+pi = jnp.pi
+
+__all__ = [
+    "rand", "plane", "sphere", "cigar", "rosenbrock", "h1", "ackley",
+    "bohachevsky", "griewank", "rastrigin", "rastrigin_scaled",
+    "rastrigin_skew", "schaffer", "schwefel", "himmelblau", "shekel",
+    "kursawe", "schaffer_mo", "zdt1", "zdt2", "zdt3", "zdt4", "zdt6",
+    "dtlz1", "dtlz2", "dtlz3", "dtlz4", "dtlz5", "dtlz6", "dtlz7",
+    "fonseca", "poloni", "dent",
+]
+
+
+# --- unimodal (reference benchmarks/__init__.py:26-117) --------------------
+
+def rand(individual, key):
+    """Random test objective (reference :26-42).  Unlike the reference's
+    global-``random`` draw, takes an explicit PRNG key."""
+    return jax.random.uniform(key, ()),
+
+
+def plane(individual):
+    """Plane test objective (reference :44-60)."""
+    return individual[0],
+
+
+def sphere(individual):
+    """Sphere: sum x_i^2 (reference :62-78)."""
+    return jnp.sum(individual * individual),
+
+
+def cigar(individual):
+    """Cigar: x_0^2 + 1e6 * sum x_i^2 (reference :80-96)."""
+    return individual[0] ** 2 + 1e6 * jnp.sum(individual * individual),
+
+
+def rosenbrock(individual):
+    """Rosenbrock (reference :98-118)."""
+    x = individual[:-1]
+    y = individual[1:]
+    return jnp.sum(100.0 * (x * x - y) ** 2 + (1.0 - x) ** 2),
+
+
+def h1(individual):
+    """H1 2-D maximization landscape (reference :120-146)."""
+    x0, x1 = individual[0], individual[1]
+    num = jnp.sin(x0 - x1 / 8.0) ** 2 + jnp.sin(x1 + x0 / 8.0) ** 2
+    denum = jnp.sqrt((x0 - 8.6998) ** 2 + (x1 - 6.7665) ** 2) + 1.0
+    return num / denum,
+
+
+# --- multimodal (reference :150-361) ---------------------------------------
+
+def ackley(individual):
+    """Ackley (reference :150-171)."""
+    n = individual.shape[-1]
+    return (20.0 - 20.0 * jnp.exp(-0.2 * jnp.sqrt(jnp.mean(individual ** 2)))
+            + jnp.e - jnp.exp(jnp.mean(jnp.cos(2.0 * pi * individual)))),
+
+
+def bohachevsky(individual):
+    """Bohachevsky (reference :174-194)."""
+    x = individual[:-1]
+    x1 = individual[1:]
+    return jnp.sum(x ** 2 + 2.0 * x1 ** 2 - 0.3 * jnp.cos(3.0 * pi * x)
+                   - 0.4 * jnp.cos(4.0 * pi * x1) + 0.7),
+
+
+def griewank(individual):
+    """Griewank (reference :197-217)."""
+    i = jnp.arange(1, individual.shape[-1] + 1, dtype=individual.dtype)
+    return (jnp.sum(individual ** 2) / 4000.0
+            - jnp.prod(jnp.cos(individual / jnp.sqrt(i))) + 1.0),
+
+
+def rastrigin(individual):
+    """Rastrigin (reference :220-241) — the flagship GA benchmark config."""
+    n = individual.shape[-1]
+    return 10.0 * n + jnp.sum(individual ** 2
+                              - 10.0 * jnp.cos(2.0 * pi * individual)),
+
+
+def rastrigin_scaled(individual):
+    """Scaled Rastrigin (reference :242-251)."""
+    n = individual.shape[-1]
+    i = jnp.arange(n, dtype=individual.dtype)
+    s = 10.0 ** (i / (n - 1)) * individual
+    return 10.0 * n + jnp.sum(s ** 2 - 10.0 * jnp.cos(2.0 * pi * s)),
+
+
+def rastrigin_skew(individual):
+    """Skewed Rastrigin (reference :253-265)."""
+    n = individual.shape[-1]
+    s = jnp.where(individual > 0, 10.0 * individual, individual)
+    return 10.0 * n + jnp.sum(s ** 2 - 10.0 * jnp.cos(2.0 * pi * s)),
+
+
+def schaffer(individual):
+    """Schaffer (reference :267-288)."""
+    x = individual[:-1]
+    x1 = individual[1:]
+    s = x ** 2 + x1 ** 2
+    return jnp.sum(s ** 0.25 * (jnp.sin(50.0 * s ** 0.1) ** 2 + 1.0)),
+
+
+def schwefel(individual):
+    """Schwefel (reference :291-313)."""
+    n = individual.shape[-1]
+    return (418.9828872724339 * n
+            - jnp.sum(individual * jnp.sin(jnp.sqrt(jnp.abs(individual))))),
+
+
+def himmelblau(individual):
+    """Himmelblau 2-D (reference :315-338)."""
+    x0, x1 = individual[0], individual[1]
+    return ((x0 * x0 + x1 - 11.0) ** 2 + (x0 + x1 * x1 - 7.0) ** 2),
+
+
+def shekel(individual, a, c):
+    """Shekel multimodal family (reference :341-361); ``a`` (m, dim) peak
+    locations, ``c`` (m,) widths."""
+    a = jnp.asarray(a)
+    c = jnp.asarray(c)
+    d2 = jnp.sum((individual[None, :] - a) ** 2, axis=1)
+    return jnp.sum(1.0 / (c + d2)),
+
+
+# --- multi-objective (reference :364-688) ----------------------------------
+
+def kursawe(individual):
+    """Kursawe (reference :364-376)."""
+    x = individual[:-1]
+    y = individual[1:]
+    f1 = jnp.sum(-10.0 * jnp.exp(-0.2 * jnp.sqrt(x * x + y * y)))
+    f2 = jnp.sum(jnp.abs(individual) ** 0.8
+                 + 5.0 * jnp.sin(individual ** 3))
+    return f1, f2
+
+
+def schaffer_mo(individual):
+    """Schaffer bi-objective on one attribute (reference :379-389)."""
+    return individual[0] ** 2, (individual[0] - 2.0) ** 2
+
+
+def _zdt_g(individual):
+    n = individual.shape[-1]
+    return 1.0 + 9.0 * jnp.sum(individual[1:]) / (n - 1)
+
+
+def zdt1(individual):
+    """ZDT1 (reference :391-403) — the NSGA-II CI benchmark."""
+    g = _zdt_g(individual)
+    f1 = individual[0]
+    f2 = g * (1.0 - jnp.sqrt(f1 / g))
+    return f1, f2
+
+
+def zdt2(individual):
+    """ZDT2 (reference :405-419)."""
+    g = _zdt_g(individual)
+    f1 = individual[0]
+    f2 = g * (1.0 - (f1 / g) ** 2)
+    return f1, f2
+
+
+def zdt3(individual):
+    """ZDT3 (reference :421-435)."""
+    g = _zdt_g(individual)
+    f1 = individual[0]
+    f2 = g * (1.0 - jnp.sqrt(f1 / g) - f1 / g * jnp.sin(10.0 * pi * f1))
+    return f1, f2
+
+
+def zdt4(individual):
+    """ZDT4 (reference :437-450)."""
+    n = individual.shape[-1]
+    tail = individual[1:]
+    g = 1.0 + 10.0 * (n - 1) + jnp.sum(tail ** 2
+                                       - 10.0 * jnp.cos(4.0 * pi * tail))
+    f1 = individual[0]
+    f2 = g * (1.0 - jnp.sqrt(f1 / g))
+    return f1, f2
+
+
+def zdt6(individual):
+    """ZDT6 (reference :452-465)."""
+    n = individual.shape[-1]
+    g = 1.0 + 9.0 * (jnp.sum(individual[1:]) / (n - 1)) ** 0.25
+    f1 = 1.0 - jnp.exp(-4.0 * individual[0]) * jnp.sin(6.0 * pi * individual[0]) ** 6
+    f2 = g * (1.0 - (f1 / g) ** 2)
+    return f1, f2
+
+
+def dtlz1(individual, obj):
+    """DTLZ1 (reference :467-493); ``obj`` objectives, linear front."""
+    xm = individual[obj - 1:]
+    g = 100.0 * (xm.shape[-1] + jnp.sum((xm - 0.5) ** 2
+                                        - jnp.cos(20.0 * pi * (xm - 0.5))))
+    f = [0.5 * jnp.prod(individual[:obj - 1]) * (1.0 + g)]
+    for m in range(obj - 2, -1, -1):
+        f.append(0.5 * jnp.prod(individual[:m]) * (1.0 - individual[m]) * (1.0 + g))
+    return tuple(f)
+
+
+def _dtlz_spherical(individual, obj, g, transform=lambda x: x):
+    xc = transform(individual[:obj - 1])
+    cos_t = jnp.cos(0.5 * pi * xc)
+    f = [(1.0 + g) * jnp.prod(cos_t)]
+    for m in range(obj - 2, -1, -1):
+        f.append((1.0 + g) * jnp.prod(cos_t[:m]) * jnp.sin(0.5 * pi * xc[m]))
+    return tuple(f)
+
+
+def dtlz2(individual, obj):
+    """DTLZ2 (reference :495-521); spherical front."""
+    xm = individual[obj - 1:]
+    g = jnp.sum((xm - 0.5) ** 2)
+    return _dtlz_spherical(individual, obj, g)
+
+
+def dtlz3(individual, obj):
+    """DTLZ3 (reference :523-548); spherical front, Rastrigin-like g."""
+    xm = individual[obj - 1:]
+    g = 100.0 * (xm.shape[-1] + jnp.sum((xm - 0.5) ** 2
+                                        - jnp.cos(20.0 * pi * (xm - 0.5))))
+    return _dtlz_spherical(individual, obj, g)
+
+
+def dtlz4(individual, obj, alpha):
+    """DTLZ4 (reference :550-577); meta-variable mapping x -> x^alpha."""
+    xm = individual[obj - 1:]
+    g = jnp.sum((xm - 0.5) ** 2)
+    return _dtlz_spherical(individual, obj, g, transform=lambda x: x ** alpha)
+
+
+def dtlz5(ind, n_objs):
+    """DTLZ5 (reference :579-597); degenerate curve front.  Reproduces the
+    reference's exact index conventions (theta over ``ind[1:]`` in f_0)."""
+    gval = jnp.sum((ind[n_objs - 1:] - 0.5) ** 2)
+    theta = lambda x: pi / (4.0 * (1.0 + gval)) * (1.0 + 2.0 * gval * x)
+    fit = [(1.0 + gval) * jnp.cos(pi / 2.0 * ind[0]) * jnp.prod(jnp.cos(theta(ind[1:])))]
+    for m in range(n_objs - 1, 0, -1):
+        if m == 1:
+            fit.append((1.0 + gval) * jnp.sin(pi / 2.0 * ind[0]))
+        else:
+            fit.append((1.0 + gval) * jnp.cos(pi / 2.0 * ind[0])
+                       * jnp.prod(jnp.cos(theta(ind[1:m - 1])))
+                       * jnp.sin(theta(ind[m - 1])))
+    return tuple(fit)
+
+
+def dtlz6(ind, n_objs):
+    """DTLZ6 (reference :599-617); like DTLZ5 with g = sum x^0.1."""
+    gval = jnp.sum(ind[n_objs - 1:] ** 0.1)
+    theta = lambda x: pi / (4.0 * (1.0 + gval)) * (1.0 + 2.0 * gval * x)
+    fit = [(1.0 + gval) * jnp.cos(pi / 2.0 * ind[0]) * jnp.prod(jnp.cos(theta(ind[1:])))]
+    for m in range(n_objs - 1, 0, -1):
+        if m == 1:
+            fit.append((1.0 + gval) * jnp.sin(pi / 2.0 * ind[0]))
+        else:
+            fit.append((1.0 + gval) * jnp.cos(pi / 2.0 * ind[0])
+                       * jnp.prod(jnp.cos(theta(ind[1:m - 1])))
+                       * jnp.sin(theta(ind[m - 1])))
+    return tuple(fit)
+
+
+def dtlz7(ind, n_objs):
+    """DTLZ7 (reference :619-628); disconnected front."""
+    tail = ind[n_objs - 1:]
+    gval = 1.0 + 9.0 / tail.shape[-1] * jnp.sum(tail)
+    head = ind[:n_objs - 1]
+    fit = [ind[i] for i in range(n_objs - 1)]
+    fit.append((1.0 + gval) * (n_objs - jnp.sum(
+        head / (1.0 + gval) * (1.0 + jnp.sin(3.0 * pi * head)))))
+    return tuple(fit)
+
+
+def fonseca(individual):
+    """Fonseca & Fleming (reference :630-643)."""
+    x = individual[:3]
+    f1 = 1.0 - jnp.exp(-jnp.sum((x - 1.0 / jnp.sqrt(3.0)) ** 2))
+    f2 = 1.0 - jnp.exp(-jnp.sum((x + 1.0 / jnp.sqrt(3.0)) ** 2))
+    return f1, f2
+
+
+def poloni(individual):
+    """Poloni (reference :645-668)."""
+    x1, x2 = individual[0], individual[1]
+    a1 = 0.5 * jnp.sin(1.0) - 2.0 * jnp.cos(1.0) + jnp.sin(2.0) - 1.5 * jnp.cos(2.0)
+    a2 = 1.5 * jnp.sin(1.0) - jnp.cos(1.0) + 2.0 * jnp.sin(2.0) - 0.5 * jnp.cos(2.0)
+    b1 = 0.5 * jnp.sin(x1) - 2.0 * jnp.cos(x1) + jnp.sin(x2) - 1.5 * jnp.cos(x2)
+    b2 = 1.5 * jnp.sin(x1) - jnp.cos(x1) + 2.0 * jnp.sin(x2) - 0.5 * jnp.cos(x2)
+    return (1.0 + (a1 - b1) ** 2 + (a2 - b2) ** 2,
+            (x1 + 3.0) ** 2 + (x2 + 1.0) ** 2)
+
+
+def dent(individual, lambda_=0.85):
+    """Dent (reference :670-687)."""
+    x1, x2 = individual[0], individual[1]
+    d = lambda_ * jnp.exp(-(x1 - x2) ** 2)
+    s = jnp.sqrt(1.0 + (x1 + x2) ** 2)
+    t = jnp.sqrt(1.0 + (x1 - x2) ** 2)
+    f1 = 0.5 * (s + t + x1 - x2) + d
+    f2 = 0.5 * (s + t - x1 + x2) + d
+    return f1, f2

@@ -1,0 +1,119 @@
+"""Binary benchmark functions — array-native equivalents of
+``deap/benchmarks/binary.py``: the ``bin2float`` decoding decorator and the
+deceptive trap / Chuang / Royal Road functions (reference binary.py:20-143).
+
+Individuals are 1-D 0/1 integer arrays; string-parsing of the reference
+(``int("".join(...), 2)``) becomes a dot product with a power-of-two basis.
+"""
+
+from __future__ import annotations
+
+from functools import wraps
+
+import jax.numpy as jnp
+
+__all__ = ["bin2float", "trap", "inv_trap", "chuang_f1", "chuang_f2",
+           "chuang_f3", "royal_road1", "royal_road2"]
+
+
+def _bits_to_int(bits):
+    """Big-endian bit vector -> integer value (float to allow >53-bit safely
+    in f32/f64 scaled use)."""
+    n = bits.shape[-1]
+    basis = 2.0 ** jnp.arange(n - 1, -1, -1)
+    return jnp.sum(bits * basis, axis=-1)
+
+
+def bin2float(min_, max_, nbits):
+    """Decorator decoding a binary genome into ``len//nbits`` floats in
+    [min_, max_] before calling the wrapped function (reference
+    binary.py:20-42)."""
+    def wrap(function):
+        @wraps(function)
+        def wrapped_function(individual, *args, **kargs):
+            nelem = individual.shape[-1] // nbits
+            genes = individual[: nelem * nbits].reshape(nelem, nbits)
+            div = 2.0 ** nbits - 1.0
+            decoded = min_ + (_bits_to_int(genes) / div) * (max_ - min_)
+            return function(decoded, *args, **kargs)
+        return wrapped_function
+    return wrap
+
+
+def trap(individual):
+    """Deceptive trap: k if all ones, else k-1-u (reference binary.py:44-51)."""
+    u = jnp.sum(individual)
+    k = individual.shape[-1]
+    return jnp.where(u == k, float(k), k - 1.0 - u)
+
+
+def inv_trap(individual):
+    """Inverted trap: k if all zeros, else u-1 (reference binary.py:54-60)."""
+    u = jnp.sum(individual)
+    k = individual.shape[-1]
+    return jnp.where(u == 0, float(k), u - 1.0)
+
+
+def _blocks(x, start, stop, size):
+    return x[start:stop].reshape(-1, size)
+
+
+def chuang_f1(individual):
+    """Chuang & Hsu deceptive f1: 40+1 bits, traps switched by the last bit
+    (reference binary.py:62-77)."""
+    blocks = _blocks(individual, 0, individual.shape[-1] - 1, 4)
+    inv = jnp.sum(jnp.vectorize(inv_trap, signature="(k)->()")(blocks))
+    reg = jnp.sum(jnp.vectorize(trap, signature="(k)->()")(blocks))
+    return jnp.where(individual[-1] == 0, inv, reg),
+
+
+def chuang_f2(individual):
+    """Chuang & Hsu deceptive f2: 40+2 bits, four optima selected by the two
+    last bits (reference binary.py:80-100)."""
+    n = individual.shape[-1]
+    pairs = individual[: n - 2].reshape(-1, 8)
+    first = pairs[:, :4]
+    second = pairs[:, 4:]
+    ti = jnp.sum(jnp.vectorize(trap, signature="(k)->()")(first))
+    ii = jnp.sum(jnp.vectorize(inv_trap, signature="(k)->()")(first))
+    tj = jnp.sum(jnp.vectorize(trap, signature="(k)->()")(second))
+    ij = jnp.sum(jnp.vectorize(inv_trap, signature="(k)->()")(second))
+    b0, b1 = individual[-2], individual[-1]
+    total = jnp.where((b0 == 0) & (b1 == 0), ii + ij,
+             jnp.where((b0 == 0) & (b1 == 1), ii + tj,
+              jnp.where((b0 == 1) & (b1 == 0), ti + ij, ti + tj)))
+    return total,
+
+
+def chuang_f3(individual):
+    """Chuang & Hsu deceptive f3: 40+1 bits with a wrapped trap block
+    (reference binary.py:103-118)."""
+    n = individual.shape[-1]
+    blocks0 = individual[: n - 1].reshape(-1, 4)
+    inv0 = jnp.sum(jnp.vectorize(inv_trap, signature="(k)->()")(blocks0))
+    shifted = individual[2: n - 3].reshape(-1, 4)
+    inv1 = jnp.sum(jnp.vectorize(inv_trap, signature="(k)->()")(shifted))
+    wrapped = jnp.concatenate([individual[-2:], individual[:2]])
+    alt = inv1 + trap(wrapped)
+    return jnp.where(individual[-1] == 0, inv0, alt),
+
+
+def royal_road1(individual, order):
+    """Royal Road R1 (reference binary.py:121-131): ``order`` points per
+    complete all-ones block of length ``order``."""
+    nelem = individual.shape[-1] // order
+    blocks = individual[: nelem * order].reshape(nelem, order)
+    value = _bits_to_int(blocks)
+    max_value = 2.0 ** order - 1.0
+    return jnp.sum(order * jnp.floor(value / max_value)),
+
+
+def royal_road2(individual, order):
+    """Royal Road R2 (reference binary.py:134-142): sum of R1 at doubling
+    block sizes up to order**2."""
+    total = 0.0
+    norder = order
+    while norder < order ** 2:
+        total = total + royal_road1(individual, norder)[0]
+        norder *= 2
+    return total,

@@ -1,0 +1,60 @@
+"""Symbolic-regression target functions — array-native equivalents of
+``deap/benchmarks/gp.py`` (reference gp.py:18-130).  ``data`` is a 1-D array
+of input variables; every function is jnp math, vmappable over sample
+points."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["kotanchek", "salustowicz_1d", "salustowicz_2d", "unwrapped_ball",
+           "rational_polynomial", "rational_polynomial2", "sin_cos", "ripple"]
+
+
+def kotanchek(data):
+    """Kotanchek (reference gp.py:18-31)."""
+    return jnp.exp(-(data[0] - 1.0) ** 2) / (3.2 + (data[1] - 2.5) ** 2)
+
+
+def salustowicz_1d(data):
+    """Salustowicz 1-D (reference gp.py:33-45)."""
+    x = data[0]
+    return (jnp.exp(-x) * x ** 3 * jnp.cos(x) * jnp.sin(x)
+            * (jnp.cos(x) * jnp.sin(x) ** 2 - 1.0))
+
+
+def salustowicz_2d(data):
+    """Salustowicz 2-D (reference gp.py:47-59)."""
+    x = data[0]
+    return (jnp.exp(-x) * x ** 3 * jnp.cos(x) * jnp.sin(x)
+            * (jnp.cos(x) * jnp.sin(x) ** 2 - 1.0) * (data[1] - 5.0))
+
+
+def unwrapped_ball(data):
+    """Unwrapped ball (reference gp.py:60-73)."""
+    return 10.0 / (5.0 + jnp.sum((data - 3.0) ** 2))
+
+
+def rational_polynomial(data):
+    """3-D rational polynomial (reference gp.py:74-87)."""
+    return (30.0 * (data[0] - 1.0) * (data[2] - 1.0)
+            / (data[1] ** 2 * (data[0] - 10.0)))
+
+
+def rational_polynomial2(data):
+    """2-D rational polynomial (reference gp.py:116-130)."""
+    return (((data[0] - 3.0) ** 4 + (data[1] - 3.0) ** 3 - (data[1] - 3.0))
+            / ((data[1] - 2.0) ** 4 + 10.0))
+
+
+def sin_cos(data):
+    """sin·cos product (reference gp.py:88-101; the reference body is
+    missing its ``return`` — a py2-era bug — the documented formula is
+    implemented here)."""
+    return 6.0 * jnp.sin(data[0]) * jnp.cos(data[1])
+
+
+def ripple(data):
+    """Ripple (reference gp.py:102-115)."""
+    return ((data[0] - 3.0) * (data[1] - 3.0)
+            + 2.0 * jnp.sin((data[0] - 4.0) * (data[1] - 4.0)))

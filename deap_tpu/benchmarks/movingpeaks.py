@@ -1,0 +1,274 @@
+"""Moving Peaks dynamic benchmark — array-native equivalent of
+``deap/benchmarks/movingpeaks.py`` (Branke 1999; fluctuating peak count per
+du Plessis & Engelbrecht 2013).
+
+The reference keeps peaks as Python lists mutated in place
+(movingpeaks.py:61-332).  Here the landscape is a pytree of arrays —
+positions ``(maxpeaks, dim)``, heights/widths ``(maxpeaks,)``, an ``active``
+mask for the fluctuating-peak-count mode — so evaluation is a peak×individual
+broadcast reducible on device, and :meth:`change_peaks` is a pure functional
+update driven by a PRNG key.  A thin stateful wrapper preserves the
+reference's ``__call__`` / offline-error bookkeeping API
+(movingpeaks.py:209-260).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["cone", "sphere", "function1", "MovingPeaks",
+           "SCENARIO_1", "SCENARIO_2", "SCENARIO_3"]
+
+
+def cone(individual, position, height, width):
+    """h - w·||x - p|| (reference movingpeaks.py:33-43)."""
+    d = jnp.sqrt(jnp.sum((individual - position) ** 2, axis=-1))
+    return height - width * d
+
+
+def sphere(individual, position, height, width):
+    """h·||x - p||² (reference movingpeaks.py:45-50)."""
+    return height * jnp.sum((individual - position) ** 2, axis=-1)
+
+
+def function1(individual, position, height, width):
+    """h / (1 + w·||x - p||²) (reference movingpeaks.py:52-59)."""
+    return height / (1.0 + width * jnp.sum((individual - position) ** 2, axis=-1))
+
+
+SCENARIO_1 = {"pfunc": function1, "npeaks": 5, "bfunc": None,
+              "min_coord": 0.0, "max_coord": 100.0,
+              "min_height": 30.0, "max_height": 70.0, "uniform_height": 50.0,
+              "min_width": 0.0001, "max_width": 0.2, "uniform_width": 0.1,
+              "lambda_": 0.0, "move_severity": 1.0, "height_severity": 7.0,
+              "width_severity": 0.01, "period": 5000}
+
+SCENARIO_2 = {"pfunc": cone, "npeaks": 10, "bfunc": None,
+              "min_coord": 0.0, "max_coord": 100.0,
+              "min_height": 30.0, "max_height": 70.0, "uniform_height": 50.0,
+              "min_width": 1.0, "max_width": 12.0, "uniform_width": 0.0,
+              "lambda_": 0.5, "move_severity": 1.5, "height_severity": 7.0,
+              "width_severity": 1.0, "period": 5000}
+
+SCENARIO_3 = {"pfunc": cone, "npeaks": 50, "bfunc": lambda x: 10,
+              "min_coord": 0.0, "max_coord": 100.0,
+              "min_height": 30.0, "max_height": 70.0, "uniform_height": 0.0,
+              "min_width": 1.0, "max_width": 12.0, "uniform_width": 0.0,
+              "lambda_": 0.5, "move_severity": 1.0, "height_severity": 1.0,
+              "width_severity": 0.5, "period": 1000}
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PeaksState:
+    position: jax.Array        # (maxpeaks, dim)
+    height: jax.Array          # (maxpeaks,)
+    width: jax.Array           # (maxpeaks,)
+    last_change: jax.Array     # (maxpeaks, dim)
+    active: jax.Array          # (maxpeaks,) bool
+
+
+class MovingPeaks:
+    """Dynamic multimodal landscape (reference MovingPeaks,
+    movingpeaks.py:61-332).
+
+    :param dim: search-space dimensionality.
+    :param key: jax PRNG key (replaces the reference's injected ``random``
+        module, movingpeaks.py:129).
+    Scenario keyword args as in the reference table (docstring table at
+    movingpeaks.py:82-104); ``npeaks`` may be an int or a
+    ``[min, initial, max]`` triple with ``number_severity`` for the
+    fluctuating-count mode.
+    """
+
+    def __init__(self, dim, key=None, **kargs):
+        sc = dict(SCENARIO_1)
+        sc.update(kargs)
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        self.key = key
+        self.dim = dim
+        self.pfunc = sc["pfunc"]
+        self.basis_function = sc["bfunc"]
+        npeaks = sc["npeaks"]
+        self.minpeaks = self.maxpeaks_n = None
+        if hasattr(npeaks, "__getitem__"):
+            self.minpeaks, npeaks, self.maxpeaks_n = npeaks
+            self.number_severity = sc["number_severity"]
+            cap = self.maxpeaks_n
+        else:
+            cap = npeaks
+        self.cap = cap
+        for name in ("min_coord", "max_coord", "min_height", "max_height",
+                     "min_width", "max_width", "lambda_", "move_severity",
+                     "height_severity", "width_severity", "period"):
+            setattr(self, name, sc[name])
+
+        k1, k2, k3, k4, self.key = jax.random.split(self.key, 5)
+        position = jax.random.uniform(k1, (cap, dim), minval=self.min_coord,
+                                      maxval=self.max_coord)
+        if sc["uniform_height"] != 0:
+            height = jnp.full((cap,), sc["uniform_height"])
+        else:
+            height = jax.random.uniform(k2, (cap,), minval=self.min_height,
+                                        maxval=self.max_height)
+        if sc["uniform_width"] != 0:
+            width = jnp.full((cap,), sc["uniform_width"])
+        else:
+            width = jax.random.uniform(k3, (cap,), minval=self.min_width,
+                                       maxval=self.max_width)
+        last_change = jax.random.uniform(k4, (cap, dim)) - 0.5
+        active = jnp.arange(cap) < npeaks
+        self.state = PeaksState(position, height, width, last_change, active)
+
+        self._optimum = None
+        self._error = None
+        self._offline_error = 0.0
+        self.nevals = 0
+
+    # -- evaluation ---------------------------------------------------------
+
+    def peak_values(self, individual, state: PeaksState | None = None):
+        """All peak responses for one individual, inactive peaks -> -inf."""
+        s = state if state is not None else self.state
+        vals = self.pfunc(individual[None, :], s.position, s.height, s.width)
+        vals = jnp.where(s.active, vals, -jnp.inf)
+        if self.basis_function is not None:
+            vals = jnp.concatenate(
+                [vals, jnp.asarray(self.basis_function(individual)).reshape(1)])
+        return vals
+
+    def evaluate(self, individual, state: PeaksState | None = None):
+        """Pure evaluation (max over peaks) — vmap/jit-safe, no offline-error
+        bookkeeping."""
+        return jnp.max(self.peak_values(individual, state)),
+
+    def __call__(self, individual, count=True):
+        """Stateful evaluation with offline-error tracking (reference
+        movingpeaks.py:209-260)."""
+        fitness = float(self.evaluate(jnp.asarray(individual))[0])
+        if count:
+            self.nevals += 1
+            if self._optimum is None:
+                self._optimum = self.globalMaximum()[0]
+                self._error = abs(fitness - self._optimum)
+            self._error = min(self._error, abs(fitness - self._optimum))
+            self._offline_error += self._error
+            if self.period > 0 and self.nevals % self.period == 0:
+                self.changePeaks()
+        return fitness,
+
+    def globalMaximum(self):
+        """Value and position of the highest peak (reference
+        movingpeaks.py:183-192)."""
+        s = self.state
+        at_center = self.pfunc(s.position, s.position, s.height, s.width)
+        at_center = jnp.where(s.active, at_center, -jnp.inf)
+        i = int(jnp.argmax(at_center))
+        return float(at_center[i]), np.asarray(s.position[i])
+
+    def maximums(self):
+        """All visible local maxima, sorted best-first (reference
+        movingpeaks.py:194-207)."""
+        s = self.state
+        at_center = self.pfunc(s.position, s.position, s.height, s.width)
+        out = []
+        for i in range(self.cap):
+            if not bool(s.active[i]):
+                continue
+            val = float(at_center[i])
+            if val >= float(self.evaluate(s.position[i])[0]):
+                out.append((val, np.asarray(s.position[i])))
+        return sorted(out, key=lambda t: t[0], reverse=True)
+
+    def offlineError(self):
+        return self._offline_error / self.nevals if self.nevals else 0.0
+
+    def currentError(self):
+        return self._error
+
+    # -- dynamics -----------------------------------------------------------
+
+    def change_peaks_state(self, key, state: PeaksState) -> PeaksState:
+        """Functional peak update (reference changePeaks,
+        movingpeaks.py:262-332): correlated position shift with boundary
+        reflection, Gaussian height/width change with reflection, optional
+        birth/death of peaks in fluctuating mode."""
+        k_num, k_shift, k_h, k_w, k_new = jax.random.split(key, 5)
+        cap, dim = state.position.shape
+        active = state.active
+
+        if self.minpeaks is not None:
+            ku1, ku2, kpick = jax.random.split(k_num, 3)
+            npeaks = jnp.sum(active)
+            r = self.maxpeaks_n - self.minpeaks
+            u = jax.random.uniform(ku1, ())
+            amount = jnp.round(r * jax.random.uniform(ku2, ())
+                               * self.number_severity).astype(jnp.int32)
+            shrink = u < 0.5
+            n_del = jnp.minimum(npeaks - self.minpeaks, amount)
+            n_add = jnp.minimum(self.maxpeaks_n - npeaks, amount)
+            # random priority over slots: deactivate n_del active ones, or
+            # activate n_add inactive ones
+            prio = jax.random.uniform(kpick, (cap,))
+            act_rank = jnp.argsort(jnp.argsort(jnp.where(active, prio, jnp.inf)))
+            inact_rank = jnp.argsort(jnp.argsort(jnp.where(active, jnp.inf, prio)))
+            deactivate = active & (act_rank < n_del)
+            activate = ~active & (inact_rank < n_add)
+            new_active = jnp.where(shrink, active & ~deactivate,
+                                   active | activate)
+            born = new_active & ~active
+            kp, kh, kw, kc = jax.random.split(k_new, 4)
+            pos_new = jax.random.uniform(kp, (cap, dim), minval=self.min_coord,
+                                         maxval=self.max_coord)
+            h_new = jax.random.uniform(kh, (cap,), minval=self.min_height,
+                                       maxval=self.max_height)
+            w_new = jax.random.uniform(kw, (cap,), minval=self.min_width,
+                                       maxval=self.max_width)
+            c_new = jax.random.uniform(kc, (cap, dim)) - 0.5
+            state = PeaksState(
+                position=jnp.where(born[:, None], pos_new, state.position),
+                height=jnp.where(born, h_new, state.height),
+                width=jnp.where(born, w_new, state.width),
+                last_change=jnp.where(born[:, None], c_new, state.last_change),
+                active=new_active)
+            active = new_active
+
+        # correlated shift, normalized to move_severity
+        shift = jax.random.uniform(k_shift, (cap, dim)) - 0.5
+        norm = jnp.sqrt(jnp.sum(shift ** 2, axis=1, keepdims=True))
+        shift = jnp.where(norm > 0, self.move_severity * shift / norm, 0.0)
+        shift = shift * (1.0 - self.lambda_) + self.lambda_ * state.last_change
+        norm = jnp.sqrt(jnp.sum(shift ** 2, axis=1, keepdims=True))
+        shift = jnp.where(norm > 0, self.move_severity * shift / norm, 0.0)
+        new_pos = state.position + shift
+        low, high = self.min_coord, self.max_coord
+        reflect = (new_pos < low) | (new_pos > high)
+        reflected = jnp.where(new_pos < low, 2.0 * low - new_pos,
+                              jnp.where(new_pos > high, 2.0 * high - new_pos,
+                                        new_pos))
+        final_shift = jnp.where(reflect, -shift, shift)
+
+        def bounce(value, change, lo, hi):
+            new = value + change
+            return jnp.where(new < lo, 2.0 * lo - value - change,
+                             jnp.where(new > hi, 2.0 * hi - value - change, new))
+
+        dh = jax.random.normal(k_h, (cap,)) * self.height_severity
+        dw = jax.random.normal(k_w, (cap,)) * self.width_severity
+        return PeaksState(
+            position=reflected,
+            height=bounce(state.height, dh, self.min_height, self.max_height),
+            width=bounce(state.width, dw, self.min_width, self.max_width),
+            last_change=final_shift,
+            active=active)
+
+    def changePeaks(self):
+        key, self.key = jax.random.split(self.key)
+        self.state = self.change_peaks_state(key, self.state)
+        self._optimum = None

@@ -1,0 +1,213 @@
+"""Benchmark utilities — array-native equivalents of
+``deap/benchmarks/tools.py``: evaluation-transform decorators
+(``translate``/``rotate``/``noise``/``scale``/``bound``, reference
+tools.py:25-255) and multi-objective quality metrics
+(``diversity``/``convergence``/``hypervolume``/``igd``, tools.py:256-331).
+
+The decorators wrap per-individual array evaluation functions, so they
+compose with vmap: the transform becomes part of the traced evaluation
+kernel.  Each decorated function carries a re-configuration method of the
+same name, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from functools import wraps
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import Fitness
+from ..ops import hv as _hv_mod
+
+__all__ = ["translate", "rotate", "noise", "scale", "bound",
+           "diversity", "convergence", "hypervolume", "igd"]
+
+
+class translate:
+    """Apply the inverse translation to the individual before evaluating
+    (reference tools.py:25-62)."""
+
+    def __init__(self, vector):
+        self.vector = jnp.asarray(vector)
+
+    def __call__(self, func):
+        @wraps(func)
+        def wrapper(individual, *args, **kargs):
+            return func(individual - self.vector, *args, **kargs)
+        wrapper.translate = self.translate
+        return wrapper
+
+    def translate(self, vector):
+        self.vector = jnp.asarray(vector)
+
+
+class rotate:
+    """Apply the inverse rotation matrix before evaluating (reference
+    tools.py:64-115)."""
+
+    def __init__(self, matrix):
+        self.matrix = jnp.linalg.inv(jnp.asarray(matrix))
+
+    def __call__(self, func):
+        @wraps(func)
+        def wrapper(individual, *args, **kargs):
+            return func(self.matrix @ individual, *args, **kargs)
+        wrapper.rotate = self.rotate
+        return wrapper
+
+    def rotate(self, matrix):
+        self.matrix = jnp.linalg.inv(jnp.asarray(matrix))
+
+
+class noise:
+    """Add random noise to each objective (reference tools.py:117-169).
+    Noise functions take a PRNG key (``f(key) -> scalar``) — the explicit-key
+    analogue of the reference's zero-arg ``random.gauss`` partials.  The
+    decorated evaluate gains a ``key`` keyword argument."""
+
+    def __init__(self, noise):
+        if callable(noise) or noise is None:
+            self.rand_funcs = (noise,)
+            self._broadcast = True
+        else:
+            self.rand_funcs = tuple(noise)
+            self._broadcast = False
+
+    def __call__(self, func):
+        @wraps(func)
+        def wrapper(individual, *args, key=None, **kargs):
+            result = func(individual, *args, **kargs)
+            if key is None:
+                return result
+            result = tuple(jnp.asarray(r) for r in result)
+            funcs = self.rand_funcs * len(result) if self._broadcast else self.rand_funcs
+            keys = jax.random.split(key, len(result))
+            return tuple(
+                r if f is None else r + f(k)
+                for r, f, k in zip(result, funcs, keys))
+        wrapper.noise = self.noise
+        return wrapper
+
+    def noise(self, noise):
+        self.__init__(noise)
+
+
+class scale:
+    """Apply the inverse scaling factor before evaluating (reference
+    tools.py:171-210)."""
+
+    def __init__(self, factor):
+        self.factor = 1.0 / jnp.asarray(factor)
+
+    def __call__(self, func):
+        @wraps(func)
+        def wrapper(individual, *args, **kargs):
+            return func(individual * self.factor, *args, **kargs)
+        wrapper.scale = self.scale
+        return wrapper
+
+    def scale(self, factor):
+        self.factor = 1.0 / jnp.asarray(factor)
+
+
+class bound:
+    """Bring operator outputs back into [low, up] by clipping, wrapping or
+    mirroring (reference tools.py:212-255; the reference's body is a py2-era
+    no-op stub — the documented semantics are implemented here)."""
+
+    def __init__(self, bounds, type="clip"):
+        self.low = jnp.asarray(bounds[0])
+        self.up = jnp.asarray(bounds[1])
+        if type == "mirror":
+            self.bound = self._mirror
+        elif type == "wrap":
+            self.bound = self._wrap
+        elif type == "clip":
+            self.bound = self._clip
+        else:
+            raise ValueError(f"unknown bound type {type!r}")
+
+    def _clip(self, individual):
+        return jnp.clip(individual, self.low, self.up)
+
+    def _wrap(self, individual):
+        span = self.up - self.low
+        return self.low + jnp.mod(individual - self.low, span)
+
+    def _mirror(self, individual):
+        span = self.up - self.low
+        t = jnp.mod(individual - self.low, 2 * span)
+        return self.low + jnp.where(t > span, 2 * span - t, t)
+
+    def __call__(self, func):
+        @wraps(func)
+        def wrapper(*args, **kargs):
+            out = func(*args, **kargs)
+            if isinstance(out, tuple):
+                return tuple(self.bound(o) for o in out)
+            return self.bound(out)
+        wrapper.bound = self.bound
+        return wrapper
+
+
+# ---------------------------------------------------------------------------
+# Multi-objective quality metrics (reference tools.py:256-331)
+# ---------------------------------------------------------------------------
+
+
+def _front_values(front):
+    """Accept a Fitness, a (n, nobj) raw-objective array, or a Population."""
+    if isinstance(front, Fitness):
+        return np.asarray(front.values)
+    if hasattr(front, "fitness"):
+        return np.asarray(front.fitness.values)
+    return np.asarray(front)
+
+
+def diversity(first_front, first, last):
+    """Deb's NSGA-II diversity (spread) metric on a biobjective front
+    (reference tools.py:256-277); lower is better.  ``first_front`` must be
+    ordered along the front."""
+    vals = _front_values(first_front)
+    df = np.hypot(vals[0, 0] - first[0], vals[0, 1] - first[1])
+    dl = np.hypot(vals[-1, 0] - last[0], vals[-1, 1] - last[1])
+    dt = np.hypot(np.diff(vals[:, 0]), np.diff(vals[:, 1]))
+    if len(dt) == 0:
+        return float(df + dl)
+    dm = np.mean(dt)
+    return float((df + dl + np.sum(np.abs(dt - dm)))
+                 / (df + dl + len(dt) * dm))
+
+
+def convergence(first_front, optimal_front):
+    """Mean distance from front members to the nearest optimal point
+    (reference tools.py:278-296); lower is better."""
+    vals = _front_values(first_front)
+    opt = np.asarray(optimal_front)
+    d = np.sqrt(((vals[:, None, :] - opt[None, :, :]) ** 2).sum(-1))
+    return float(np.mean(np.min(d, axis=1)))
+
+
+def hypervolume(front, ref=None):
+    """Absolute hypervolume of a front (reference tools.py:299-312): computed
+    on ``-wvalues`` (implicit minimization); default reference point is the
+    worst value + 1 per objective."""
+    if isinstance(front, Fitness):
+        wobj = -np.asarray(front.wvalues)
+    elif hasattr(front, "fitness"):
+        wobj = -np.asarray(front.fitness.wvalues)
+    else:
+        wobj = np.asarray(front)
+    if ref is None:
+        ref = np.max(wobj, axis=0) + 1
+    return float(_hv_mod.hypervolume(wobj, ref))
+
+
+def igd(A, Z):
+    """Inverse generational distance (reference tools.py:314-321)."""
+    A = np.asarray(A)
+    Z = np.asarray(Z)
+    d = np.sqrt(((A[:, None, :] - Z[None, :, :]) ** 2).sum(-1))
+    return float(np.mean(np.min(d, axis=0)))

@@ -1,0 +1,492 @@
+"""Covariance Matrix Adaptation ES — array-native equivalent of ``deap/cma.py``.
+
+Three strategies, same math as the reference:
+
+* :class:`Strategy` — full (μ/μ_w, λ) CMA-ES (Hansen & Ostermeier 2001;
+  reference cma.py:30-205).  Functional: hyper-parameters are static Python
+  floats computed at construction (``computeParams``, cma.py:173-205), the
+  evolving state is a :class:`CMAState` pytree, and ``generate``/``update``
+  are pure functions — so the whole ask-eval-tell generation runs inside one
+  jitted ``lax.scan`` (``deap_tpu.algorithms.ea_generate_update``).  The
+  per-generation ``numpy.linalg.eigh`` of the reference (cma.py:164) becomes
+  ``jnp.linalg.eigh`` on device.
+* :class:`StrategyOnePlusLambda` — (1+λ) with success-rule step size and
+  Cholesky update (Igel 2007; reference cma.py:208-325), same functional
+  shape.
+* :class:`StrategyMultiObjective` — MO-CMA-ES (Voss, Hansen & Igel 2010;
+  reference cma.py:328-547) with per-parent step sizes/Cholesky factors and
+  indicator-based (hypervolume) environmental selection.  Selection walks
+  Pareto fronts and peels least hypervolume contributors — inherently
+  sequential and tiny (μ individuals), so it runs host-side on numpy while
+  the sampling stays vectorized.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import Population, Fitness, lex_sort_indices
+from .ops import indicator as _indicator
+from .ops.emo import nondominated_ranks
+
+__all__ = ["Strategy", "StrategyOnePlusLambda", "StrategyMultiObjective",
+           "CMAState", "OnePlusLambdaState"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class CMAState:
+    centroid: jax.Array        # (dim,)
+    sigma: jax.Array           # ()
+    C: jax.Array               # (dim, dim)
+    ps: jax.Array              # (dim,)
+    pc: jax.Array              # (dim,)
+    B: jax.Array               # (dim, dim) eigenvectors
+    diagD: jax.Array           # (dim,) sqrt eigenvalues
+    update_count: jax.Array    # () int32
+
+
+class Strategy:
+    """(μ/μ_w, λ) CMA-ES (reference cma.py:30-205)."""
+
+    def __init__(self, centroid, sigma: float, **kargs):
+        self.centroid0 = jnp.asarray(centroid, jnp.float32)
+        self.dim = int(self.centroid0.shape[0])
+        self.sigma0 = float(sigma)
+        self.cmatrix0 = jnp.asarray(
+            kargs.get("cmatrix", np.identity(self.dim)), jnp.float32)
+        self.lambda_ = int(kargs.get("lambda_", 4 + 3 * math.log(self.dim)))
+        self.chiN = math.sqrt(self.dim) * (
+            1 - 1.0 / (4.0 * self.dim) + 1.0 / (21.0 * self.dim ** 2))
+        self.params = kargs
+        self.computeParams(kargs)
+
+    def computeParams(self, params):
+        """Static hyper-parameters from λ (reference cma.py:173-205)."""
+        self.mu = int(params.get("mu", self.lambda_ / 2))
+        rweights = params.get("weights", "superlinear")
+        if rweights == "superlinear":
+            w = math.log(self.mu + 0.5) - np.log(np.arange(1, self.mu + 1))
+        elif rweights == "linear":
+            w = self.mu + 0.5 - np.arange(1, self.mu + 1)
+        elif rweights == "equal":
+            w = np.ones(self.mu)
+        else:
+            raise RuntimeError(f"Unknown weights : {rweights}")
+        w = w / np.sum(w)
+        self.weights = jnp.asarray(w, jnp.float32)
+        self.mueff = float(1.0 / np.sum(w ** 2))
+        self.cc = params.get("ccum", 4.0 / (self.dim + 4.0))
+        self.cs = params.get(
+            "cs", (self.mueff + 2.0) / (self.dim + self.mueff + 3.0))
+        self.ccov1 = params.get(
+            "ccov1", 2.0 / ((self.dim + 1.3) ** 2 + self.mueff))
+        ccovmu = params.get(
+            "ccovmu", 2.0 * (self.mueff - 2.0 + 1.0 / self.mueff)
+            / ((self.dim + 2.0) ** 2 + self.mueff))
+        self.ccovmu = min(1 - self.ccov1, ccovmu)
+        damps = (1.0 + 2.0 * max(0.0, math.sqrt((self.mueff - 1.0)
+                                                / (self.dim + 1.0)) - 1.0)
+                 + self.cs)
+        self.damps = params.get("damps", damps)
+
+    def init(self) -> CMAState:
+        diagD, B = jnp.linalg.eigh(self.cmatrix0)
+        return CMAState(
+            centroid=self.centroid0,
+            sigma=jnp.asarray(self.sigma0, jnp.float32),
+            C=self.cmatrix0,
+            ps=jnp.zeros(self.dim, jnp.float32),
+            pc=jnp.zeros(self.dim, jnp.float32),
+            B=B.astype(jnp.float32),
+            diagD=jnp.sqrt(diagD).astype(jnp.float32),
+            update_count=jnp.asarray(0, jnp.int32),
+        )
+
+    def generate(self, state: CMAState, key) -> jax.Array:
+        """Sample λ candidates: centroid + σ·z·BDᵀ (reference cma.py:111-121)."""
+        arz = jax.random.normal(key, (self.lambda_, self.dim), jnp.float32)
+        BD = state.B * state.diagD
+        return state.centroid + state.sigma * arz @ BD.T
+
+    def update(self, state: CMAState, population: Population) -> CMAState:
+        """Evolution-path + rank-1/rank-μ covariance + σ update (reference
+        cma.py:123-171)."""
+        w = population.fitness.masked_wvalues()
+        order = lex_sort_indices(w, descending=True)
+        genomes = population.genome[order[: self.mu]]          # (mu, dim)
+
+        old_centroid = state.centroid
+        centroid = self.weights @ genomes
+        c_diff = centroid - old_centroid
+
+        inv_D = 1.0 / state.diagD
+        ps = ((1 - self.cs) * state.ps
+              + jnp.sqrt(self.cs * (2 - self.cs) * self.mueff) / state.sigma
+              * (state.B @ (inv_D * (state.B.T @ c_diff))))
+
+        update_count = state.update_count + 1
+        hsig = (jnp.linalg.norm(ps)
+                / jnp.sqrt(1.0 - (1.0 - self.cs)
+                           ** (2.0 * update_count.astype(jnp.float32)))
+                / self.chiN < (1.4 + 2.0 / (self.dim + 1.0))).astype(jnp.float32)
+
+        pc = ((1 - self.cc) * state.pc
+              + hsig * jnp.sqrt(self.cc * (2 - self.cc) * self.mueff)
+              / state.sigma * c_diff)
+
+        artmp = genomes - old_centroid
+        C = ((1 - self.ccov1 - self.ccovmu
+              + (1 - hsig) * self.ccov1 * self.cc * (2 - self.cc)) * state.C
+             + self.ccov1 * jnp.outer(pc, pc)
+             + self.ccovmu * (self.weights * artmp.T) @ artmp
+             / state.sigma ** 2)
+
+        sigma = state.sigma * jnp.exp(
+            (jnp.linalg.norm(ps) / self.chiN - 1.0) * self.cs / self.damps)
+
+        diagD2, B = jnp.linalg.eigh(C)
+        diagD = jnp.sqrt(jnp.maximum(diagD2, 1e-30))
+        return CMAState(centroid=centroid, sigma=sigma, C=C, ps=ps, pc=pc,
+                        B=B, diagD=diagD, update_count=update_count)
+
+
+# ---------------------------------------------------------------------------
+# (1 + λ)
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class OnePlusLambdaState:
+    parent: jax.Array          # (dim,)
+    parent_wvalues: jax.Array  # (nobj,)
+    parent_valid: jax.Array    # () bool
+    sigma: jax.Array           # ()
+    C: jax.Array               # (dim, dim)
+    A: jax.Array               # (dim, dim) Cholesky factor
+    pc: jax.Array              # (dim,)
+    psucc: jax.Array           # ()
+
+
+def _lex_leq(wa, wb):
+    """Lexicographic a <= b on weighted-value vectors (the reference's
+    ``Fitness.__le__`` tuple compare, base.py:234-250)."""
+    nobj = wa.shape[-1]
+    result = jnp.asarray(True)
+    decided = jnp.asarray(False)
+    for j in range(nobj):
+        lt = wa[..., j] < wb[..., j]
+        gt = wa[..., j] > wb[..., j]
+        result = jnp.where(~decided & lt, True,
+                           jnp.where(~decided & gt, False, result))
+        decided = decided | lt | gt
+    return result
+
+
+class StrategyOnePlusLambda:
+    """(1+λ) CMA-ES with success-rule step-size control (reference
+    cma.py:208-325)."""
+
+    def __init__(self, parent, sigma: float, weights: Sequence[float] = (-1.0,),
+                 **kargs):
+        self.parent0 = jnp.asarray(parent, jnp.float32)
+        self.dim = int(self.parent0.shape[0])
+        self.sigma0 = float(sigma)
+        self.fitness_weights = tuple(weights)
+        self.computeParams(kargs)
+
+    def computeParams(self, params):
+        """Reference cma.py:250-264."""
+        self.lambda_ = int(params.get("lambda_", 1))
+        self.d = params.get("d", 1.0 + self.dim / (2.0 * self.lambda_))
+        self.ptarg = params.get("ptarg", 1.0 / (5 + math.sqrt(self.lambda_) / 2.0))
+        self.cp = params.get(
+            "cp", self.ptarg * self.lambda_ / (2 + self.ptarg * self.lambda_))
+        self.cc = params.get("cc", 2.0 / (self.dim + 2.0))
+        self.ccov = params.get("ccov", 2.0 / (self.dim ** 2 + 6.0))
+        self.pthresh = params.get("pthresh", 0.44)
+
+    def init(self) -> OnePlusLambdaState:
+        nobj = len(self.fitness_weights)
+        return OnePlusLambdaState(
+            parent=self.parent0,
+            parent_wvalues=jnp.full((nobj,), -jnp.inf, jnp.float32),
+            parent_valid=jnp.asarray(False),
+            sigma=jnp.asarray(self.sigma0, jnp.float32),
+            C=jnp.eye(self.dim, dtype=jnp.float32),
+            A=jnp.eye(self.dim, dtype=jnp.float32),
+            pc=jnp.zeros(self.dim, jnp.float32),
+            psucc=jnp.asarray(self.ptarg, jnp.float32),
+        )
+
+    def generate(self, state: OnePlusLambdaState, key) -> jax.Array:
+        """parent + σ·z·Aᵀ (reference cma.py:266-277)."""
+        arz = jax.random.normal(key, (self.lambda_, self.dim), jnp.float32)
+        return state.parent + state.sigma * arz @ state.A.T
+
+    def update(self, state: OnePlusLambdaState, population: Population
+               ) -> OnePlusLambdaState:
+        """Success-rate accumulation, conditional parent replacement,
+        pc/C/σ update + Cholesky refresh (reference cma.py:279-325)."""
+        w = population.fitness.masked_wvalues()
+        order = lex_sort_indices(w, descending=True)
+        best_idx = order[0]
+        best_w = w[best_idx]
+        best_genome = population.genome[best_idx]
+
+        # λ_succ = number of offspring at least as good as the parent
+        succ = jax.vmap(lambda wi: _lex_leq(state.parent_wvalues, wi))(w)
+        p_succ = jnp.mean(succ.astype(jnp.float32))
+        psucc = (1 - self.cp) * state.psucc + self.cp * p_succ
+
+        improved = _lex_leq(state.parent_wvalues, best_w)
+        x_step = (best_genome - state.parent) / state.sigma
+        parent = jnp.where(improved, best_genome, state.parent)
+        parent_w = jnp.where(improved, best_w, state.parent_wvalues)
+
+        pc_low = (1 - self.cc) * state.pc + jnp.sqrt(
+            self.cc * (2 - self.cc)) * x_step
+        C_low = (1 - self.ccov) * state.C + self.ccov * jnp.outer(pc_low, pc_low)
+        pc_high = (1 - self.cc) * state.pc
+        C_high = ((1 - self.ccov) * state.C
+                  + self.ccov * (jnp.outer(pc_high, pc_high)
+                                 + self.cc * (2 - self.cc) * state.C))
+        use_low = psucc < self.pthresh
+        pc_new = jnp.where(use_low, pc_low, pc_high)
+        C_new = jnp.where(use_low, C_low, C_high)
+        pc = jnp.where(improved, pc_new, state.pc)
+        C = jnp.where(improved, C_new, state.C)
+
+        sigma = state.sigma * jnp.exp(
+            1.0 / self.d * (psucc - self.ptarg) / (1.0 - self.ptarg))
+        A = jnp.linalg.cholesky(C + 1e-12 * jnp.eye(self.dim))
+        return OnePlusLambdaState(
+            parent=parent, parent_wvalues=parent_w,
+            parent_valid=jnp.asarray(True), sigma=sigma, C=C, A=A, pc=pc,
+            psucc=psucc)
+
+
+# ---------------------------------------------------------------------------
+# MO-CMA-ES
+# ---------------------------------------------------------------------------
+
+
+class StrategyMultiObjective:
+    """MO-CMA-ES (reference cma.py:328-547).  Host-stateful like the
+    reference's strategy object; sampling is vectorized on device, the
+    indicator-based environmental selection (tiny: μ+λ individuals) runs on
+    host numpy with the exact front-walking + least-contributor peeling of
+    reference ``_select`` (cma.py:430-469)."""
+
+    def __init__(self, population_genomes, fitness_weights, sigma: float,
+                 values=None, **params):
+        self.parents = np.asarray(population_genomes, np.float64)
+        self.fitness_weights = tuple(fitness_weights)
+        # (n, nobj) raw objective values of the parents; may be supplied
+        # later via ``set_parent_values`` but must be set before the first
+        # ``update`` (the reference receives evaluated individuals)
+        self.parent_values = None if values is None else np.asarray(values, np.float64)
+        self.dim = self.parents.shape[1]
+        n = self.parents.shape[0]
+        self.mu = int(params.get("mu", n))
+        self.lambda_ = int(params.get("lambda_", 1))
+        self.d = params.get("d", 1.0 + self.dim / 2.0)
+        self.ptarg = params.get("ptarg", 1.0 / (5.0 + 0.5))
+        self.cp = params.get("cp", self.ptarg / (2.0 + self.ptarg))
+        self.cc = params.get("cc", 2.0 / (self.dim + 2.0))
+        self.ccov = params.get("ccov", 2.0 / (self.dim ** 2 + 6.0))
+        self.pthresh = params.get("pthresh", 0.44)
+        self.indicator = params.get("indicator", _indicator.hypervolume)
+
+        self.sigmas = np.full(n, sigma, np.float64)
+        self.A = np.stack([np.identity(self.dim) for _ in range(n)])
+        self.invCholesky = np.stack([np.identity(self.dim) for _ in range(n)])
+        self.pc = np.zeros((n, self.dim))
+        self.psucc = np.full(n, self.ptarg)
+        self._last_offspring_parent = None
+
+    # -- ask ----------------------------------------------------------------
+    def generate(self, key) -> np.ndarray:
+        """Sample λ offspring, each from a parent's own Gaussian (reference
+        cma.py:394-428).  Records the parent index of each offspring."""
+        k_z, k_pick = jax.random.split(jax.random.PRNGKey(int(key)) if
+                                       np.isscalar(key) else key)
+        arz = np.asarray(jax.random.normal(k_z, (self.lambda_, self.dim)))
+        n = len(self.parents)
+        if self.lambda_ == self.mu and n == self.lambda_:
+            p_idx = np.arange(self.lambda_)
+        else:
+            # sample uniformly among first-front parents
+            if self.parent_values is not None:
+                w = np.asarray(self.parent_values) * np.asarray(self.fitness_weights)
+                ranks = np.asarray(nondominated_ranks(jnp.asarray(w))[0])
+                front = np.nonzero(ranks == 0)[0]
+            else:
+                front = np.arange(n)
+            picks = np.asarray(jax.random.randint(
+                k_pick, (self.lambda_,), 0, len(front)))
+            p_idx = front[picks]
+        offspring = np.stack([
+            self.parents[p] + self.sigmas[p] * (self.A[p] @ arz[i])
+            for i, p in enumerate(p_idx)])
+        self._last_offspring_parent = p_idx
+        return offspring
+
+    # -- selection helpers --------------------------------------------------
+    def _select(self, genomes, values, tags):
+        """Front-filling + hypervolume-contributor peeling (reference
+        cma.py:430-469).  Returns (chosen indices, not-chosen indices)."""
+        n = len(genomes)
+        if n <= self.mu:
+            return list(range(n)), []
+        w = values * np.asarray(self.fitness_weights)
+        ranks = np.asarray(nondominated_ranks(jnp.asarray(w))[0])
+        order_fronts = [np.nonzero(ranks == r)[0]
+                        for r in range(int(ranks.max()) + 1)]
+        chosen, not_chosen = [], []
+        mid_front = None
+        full = False
+        for front in order_fronts:
+            front = list(front)
+            if len(chosen) + len(front) <= self.mu and not full:
+                chosen += front
+            elif mid_front is None and len(chosen) < self.mu:
+                mid_front = front
+                full = True
+            else:
+                not_chosen += front
+        k = self.mu - len(chosen)
+        if k > 0 and mid_front is not None:
+            ref = np.max(-w, axis=0) + 1
+            while len(mid_front) > k:
+                idx = self.indicator(jnp.asarray(w[mid_front]), ref=ref)
+                not_chosen.append(mid_front.pop(idx))
+            chosen += mid_front
+        return chosen, not_chosen
+
+    @staticmethod
+    def _rank_one_update(invCholesky, A, alpha, beta, v):
+        """Reference _rankOneUpdate (cma.py:471-485)."""
+        w = invCholesky @ v
+        if w.max() > 1e-20:
+            w_inv = w @ invCholesky
+            norm_w2 = np.sum(w ** 2)
+            a = math.sqrt(alpha)
+            root = np.sqrt(1 + beta / alpha * norm_w2)
+            b = a / norm_w2 * (root - 1)
+            A = a * A + b * np.outer(v, w)
+            invCholesky = (1.0 / a * invCholesky
+                           - b / (a ** 2 + a * b * norm_w2) * np.outer(w, w_inv))
+        return invCholesky, A
+
+    # -- tell ---------------------------------------------------------------
+    def set_parent_values(self, values):
+        """Attach the parents' evaluated objective values (the reference
+        receives parents with ``fitness`` already set)."""
+        self.parent_values = np.asarray(values, np.float64)
+
+    def update(self, offspring_genomes, offspring_values):
+        """Indicator-based selection over parents ∪ offspring, then per-slot
+        success-rate / step-size / Cholesky updates (reference
+        cma.py:487-547)."""
+        if self.parent_values is None:
+            raise RuntimeError(
+                "StrategyMultiObjective.update called before the parents were "
+                "evaluated: pass values= to the constructor or call "
+                "set_parent_values(values) with the (n, nobj) objective "
+                "values of the initial population.")
+        off_g = np.asarray(offspring_genomes, np.float64)
+        off_v = np.asarray(offspring_values, np.float64)
+        par_g = self.parents
+        par_v = np.asarray(self.parent_values, np.float64)
+        genomes = np.concatenate([off_g, par_g])
+        values = np.concatenate([off_v, par_v])
+        nlam = len(off_g)
+        # tag: (is_offspring, parent index)
+        tags = ([("o", int(self._last_offspring_parent[i])) for i in range(nlam)]
+                + [("p", i) for i in range(len(par_g))])
+
+        chosen, not_chosen = self._select(genomes, values, tags)
+
+        cp, cc, ccov = self.cp, self.cc, self.ccov
+        d, ptarg, pthresh = self.d, self.ptarg, self.pthresh
+
+        # snapshots: offspring copies derive from pre-update parent state
+        # (reference captures last_steps/sigmas/... before the loop,
+        # cma.py:495-501)
+        sig0 = self.sigmas.copy()
+        psucc0 = self.psucc.copy()
+
+        # first pass: per-offspring parameter-set copies + parent-slot
+        # success credits (reference loop cma.py:504-530)
+        off_params = {}
+        for i in chosen:
+            t, p_idx = tags[i]
+            if t != "o":
+                continue
+            last_step = sig0[p_idx]
+            psucc = (1.0 - cp) * psucc0[p_idx] + cp
+            sigma = sig0[p_idx] * math.exp(
+                (psucc - ptarg) / (d * (1.0 - ptarg)))
+            inv = self.invCholesky[p_idx].copy()
+            A = self.A[p_idx].copy()
+            pc = self.pc[p_idx].copy()
+            if psucc < pthresh:
+                xp = genomes[i]
+                x = self.parents[p_idx]
+                pc = (1.0 - cc) * pc + math.sqrt(cc * (2.0 - cc)) * (
+                    xp - x) / last_step
+                inv, A = self._rank_one_update(inv, A, 1 - ccov, ccov, pc)
+            else:
+                pc = (1.0 - cc) * pc
+                pc_weight = cc * (2.0 - cc)
+                inv, A = self._rank_one_update(
+                    inv, A, 1 - ccov + pc_weight, ccov, pc)
+            # parent slot also gets credited with the success
+            self.psucc[p_idx] = (1.0 - cp) * self.psucc[p_idx] + cp
+            self.sigmas[p_idx] = self.sigmas[p_idx] * math.exp(
+                (self.psucc[p_idx] - ptarg) / (d * (1.0 - ptarg)))
+            off_params[i] = (sigma, inv, A, pc, psucc)
+
+        # unsuccessful offspring only decay their parent slot
+        # (reference cma.py:532-540)
+        for i in not_chosen:
+            t, p_idx = tags[i]
+            if t == "o":
+                self.psucc[p_idx] = (1.0 - cp) * self.psucc[p_idx]
+                self.sigmas[p_idx] = self.sigmas[p_idx] * math.exp(
+                    (self.psucc[p_idx] - ptarg) / (d * (1.0 - ptarg)))
+
+        # final assembly: offspring use their copies, surviving parents the
+        # (possibly credited) original slots (reference cma.py:542-547)
+        new_sigmas, new_inv, new_A, new_pc, new_psucc = [], [], [], [], []
+        for i in chosen:
+            t, p_idx = tags[i]
+            if t == "o":
+                sigma, inv, A, pc, psucc = off_params[i]
+            else:
+                sigma = self.sigmas[p_idx]
+                inv = self.invCholesky[p_idx]
+                A = self.A[p_idx]
+                pc = self.pc[p_idx]
+                psucc = self.psucc[p_idx]
+            new_sigmas.append(sigma)
+            new_inv.append(inv)
+            new_A.append(A)
+            new_pc.append(pc)
+            new_psucc.append(psucc)
+
+        self.parents = genomes[chosen]
+        self.parent_values = values[chosen]
+        self.sigmas = np.asarray(new_sigmas)
+        self.invCholesky = np.stack(new_inv)
+        self.A = np.stack(new_A)
+        self.pc = np.stack(new_pc)
+        self.psucc = np.asarray(new_psucc)

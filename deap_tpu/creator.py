@@ -1,0 +1,135 @@
+"""Runtime spec factory — the TPU-native stand-in for ``deap.creator``.
+
+The reference's ``creator.create(name, base, **attrs)`` manufactures Python
+classes at runtime (reference creator.py:96-171): individuals are lists/arrays
+with a ``fitness`` attribute, and class-valued kwargs become per-instance
+attributes.  In an array-native framework an "individual type" is not a class
+but a *population schema*: the fitness weights plus the pytree structure of
+the genome (per-leaf dtype / trailing shape, and extra per-individual leaves
+like PSO's ``speed``/``best``).
+
+``create`` keeps the reference's ergonomics: it installs the produced spec
+into this module's namespace under ``name`` and warns when overwriting an
+existing name (reference creator.py:137-141).  Class-valued kwargs become
+per-individual leaves of the genome pytree (the analogue of per-instance
+attributes, reference creator.py:143-149,160-167); other kwargs become static
+metadata on the spec (the analogue of class attributes).
+"""
+
+from __future__ import annotations
+
+import sys
+import warnings
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .base import Fitness, Population
+
+__all__ = ["create", "FitnessSpec", "IndividualSpec"]
+
+
+class FitnessSpec:
+    """Schema for a fitness: just the weights tuple (sign = min/max,
+    reference base.py:148-161).  Instantiating the reference's Fitness class
+    corresponds here to allocating an empty ``(pop, nobj)`` array."""
+
+    def __init__(self, weights: Sequence[float]):
+        self.weights = tuple(float(w) for w in weights)
+
+    @property
+    def nobj(self) -> int:
+        return len(self.weights)
+
+    def empty(self, pop_size: int, dtype=jnp.float32) -> Fitness:
+        return Fitness.empty(pop_size, self.weights, dtype)
+
+    def __repr__(self):
+        return f"FitnessSpec(weights={self.weights})"
+
+
+class IndividualSpec:
+    """Schema for individuals: fitness spec + named per-individual leaves.
+
+    ``leaves`` maps attribute names to initializer callables
+    ``f(key, n) -> (n, ...) array`` (or to ``None`` for the primary genome,
+    which the user supplies).  ``static`` holds schema-level constants (the
+    reference's class attributes).
+    """
+
+    def __init__(self, fitness: FitnessSpec, leaves: dict | None = None,
+                 static: dict | None = None):
+        self.fitness = fitness
+        self.leaves = dict(leaves or {})
+        self.static = dict(static or {})
+
+    @property
+    def weights(self):
+        return self.fitness.weights
+
+    def population(self, genome: Any, **extra_leaves) -> Population:
+        """Wrap an initialized genome (pytree with leading pop axis) into a
+        :class:`Population` with empty fitness.  Extra per-individual leaves
+        (``speed=...``) are grouped into a dict genome."""
+        if extra_leaves:
+            genome = dict(genome=genome, **extra_leaves)
+        n = jax.tree_util.tree_leaves(genome)[0].shape[0]
+        return Population(genome=genome, fitness=self.fitness.empty(n))
+
+    def init_population(self, key: jax.Array, n: int, attr: Callable, **extra_leaves) -> Population:
+        """Initialize ``n`` individuals by vmapping the per-individual
+        initializer ``attr(key) -> genome`` — the array-native
+        ``tools.initRepeat(list, toolbox.individual, n)`` (reference
+        init.py:3-25)."""
+        keys = jax.random.split(key, n)
+        genome = jax.vmap(attr)(keys)
+        extras = {}
+        for name, fn in self.leaves.items():
+            if name in extra_leaves or fn is None:
+                continue
+            key, sub = jax.random.split(key)
+            extras[name] = fn(sub, n)
+        extras.update(extra_leaves)
+        return self.population(genome, **extras)
+
+    def __repr__(self):
+        return (f"IndividualSpec(weights={self.fitness.weights}, "
+                f"leaves={list(self.leaves)}, static={self.static})")
+
+
+def create(name: str, base: Any = None, **kargs) -> Any:
+    """Create a named spec and install it as ``deap_tpu.creator.<name>``.
+
+    * ``create("FitnessMax", weights=(1.0,))`` (or with ``base=Fitness``)
+      → :class:`FitnessSpec`.
+    * ``create("Individual", fitness=creator.FitnessMax, speed=init_fn)``
+      → :class:`IndividualSpec`; callable kwargs become per-individual
+      leaves, everything else static metadata.
+
+    Mirrors the redefinition warning of reference creator.py:137-141.
+    """
+    module = sys.modules[__name__]
+    if hasattr(module, name):
+        warnings.warn(
+            f"A class named '{name}' has already been created and it will be "
+            "overwritten. Consider deleting previous creation of that class "
+            "or rename it.", RuntimeWarning)
+
+    if "weights" in kargs and "fitness" not in kargs:
+        spec = FitnessSpec(kargs.pop("weights"))
+        spec.static = kargs
+    else:
+        fitness = kargs.pop("fitness", None)
+        if fitness is None:
+            raise TypeError(
+                "create() needs either weights=... (fitness spec) or "
+                "fitness=<FitnessSpec> (individual spec)")
+        if isinstance(fitness, Sequence):
+            fitness = FitnessSpec(fitness)
+        leaves = {k: v for k, v in kargs.items() if callable(v) or v is None}
+        static = {k: v for k, v in kargs.items() if k not in leaves}
+        spec = IndividualSpec(fitness, leaves=leaves, static=static)
+
+    setattr(module, name, spec)
+    return spec

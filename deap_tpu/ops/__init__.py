@@ -1,0 +1,15 @@
+"""Operator library — the ``deap/tools/`` equivalent, flat namespace
+(reference tools/__init__.py:23-31 star-exports the same way)."""
+
+from .init import *            # noqa: F401,F403
+from .crossover import *       # noqa: F401,F403
+from .mutation import *        # noqa: F401,F403
+from .selection import *       # noqa: F401,F403
+from .emo import *             # noqa: F401,F403
+from .migration import *       # noqa: F401,F403
+from .constraint import *      # noqa: F401,F403
+from .indicator import *      # noqa: F401,F403
+from . import hv               # noqa: F401
+
+from . import (init, crossover, mutation, selection, emo, migration,
+               constraint, indicator)  # noqa: F401

@@ -1,0 +1,87 @@
+"""Constraint handling — array-native equivalent of ``deap/tools/constraint.py``.
+
+The reference wraps the ``evaluate`` function in penalty decorators
+(``DeltaPenalty`` constraint.py:10-64, ``ClosestValidPenalty``
+constraint.py:68-132).  Here the decorators wrap per-individual *array*
+evaluation functions; feasible/infeasible branches are both computed and
+merged with ``where`` (branchless, jit-friendly), which is exactly what a
+vectorized population evaluation wants.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+
+__all__ = ["DeltaPenalty", "ClosestValidPenalty", "DeltaPenality", "ClosestValidPenality"]
+
+
+def _signs(weights):
+    return jnp.asarray([1.0 if w >= 0 else -1.0 for w in weights])
+
+
+class DeltaPenalty:
+    """Constant-offset penalty (reference DeltaPenalty, constraint.py:10-64):
+    infeasible individuals get ``delta_i - sign(w_i) * distance(ind)`` per
+    objective, so the penalty always worsens the weighted fitness.
+
+    :param feasibility: ``f(genome) -> bool scalar``.
+    :param delta: scalar or per-objective sequence.
+    :param weights: the fitness weights (the reference reads them off the
+        individual's fitness object; array individuals carry none).
+    :param distance: optional ``f(genome) -> scalar or (nobj,)``.
+    """
+
+    def __init__(self, feasibility: Callable, delta, weights: Sequence[float],
+                 distance: Callable | None = None):
+        self.fbty_fct = feasibility
+        self.delta = jnp.atleast_1d(jnp.asarray(delta, jnp.float32))
+        self.signs = _signs(weights)
+        self.dist_fct = distance
+
+    def __call__(self, func: Callable) -> Callable:
+        def wrapper(genome, *args, **kwargs):
+            vals = jnp.atleast_1d(jnp.asarray(func(genome, *args, **kwargs)))
+            feasible = self.fbty_fct(genome)
+            dist = 0.0
+            if self.dist_fct is not None:
+                dist = jnp.asarray(self.dist_fct(genome))
+            penalty = self.delta - self.signs * dist
+            return jnp.where(feasible, vals, jnp.broadcast_to(penalty, vals.shape))
+        return wrapper
+
+
+class ClosestValidPenalty:
+    """Projection penalty (reference ClosestValidPenalty, constraint.py:68-132):
+    infeasible individuals are scored at their projection onto the feasible
+    region (``feasible_fct``), minus ``sign(w_i) * alpha * distance(valid,
+    original)``."""
+
+    def __init__(self, feasibility: Callable, feasible_fct: Callable,
+                 alpha: float, weights: Sequence[float],
+                 distance: Callable | None = None):
+        self.fbty_fct = feasibility
+        self.fbl_fct = feasible_fct
+        self.alpha = alpha
+        self.signs = _signs(weights)
+        self.dist_fct = distance
+
+    def __call__(self, func: Callable) -> Callable:
+        def wrapper(genome, *args, **kwargs):
+            vals = jnp.atleast_1d(jnp.asarray(func(genome, *args, **kwargs)))
+            feasible = self.fbty_fct(genome)
+            f_ind = self.fbl_fct(genome)
+            f_vals = jnp.atleast_1d(jnp.asarray(func(f_ind, *args, **kwargs)))
+            if self.dist_fct is not None:
+                dist = jnp.asarray(self.dist_fct(f_ind, genome))
+            else:
+                dist = jnp.sqrt(jnp.sum((jnp.ravel(f_ind) - jnp.ravel(genome)) ** 2))
+            penal = f_vals - self.signs * self.alpha * dist
+            return jnp.where(feasible, vals, penal)
+        return wrapper
+
+
+# reference keeps the misspelled aliases for backward compatibility
+DeltaPenality = DeltaPenalty
+ClosestValidPenality = ClosestValidPenalty

@@ -1,0 +1,285 @@
+"""Crossover operators — array-native equivalents of ``deap/tools/crossover.py``.
+
+Every operator is a pure per-pair function ``cx(key, ind1, ind2, ...) ->
+(child1, child2)`` over fixed-length 1-D genome arrays; algorithms vmap them
+over the mated half of the population (``varAnd`` applies them pairwise,
+reference algorithms.py:68-82).  In-place list slicing of the reference
+becomes masked ``where``/gather index arithmetic, which XLA fuses into a
+couple of elementwise kernels per population.
+
+Permutation operators (PMX, OX) reproduce the reference's algorithms
+(crossover.py:94-240) with position-array bookkeeping; the inherently
+sequential swap chain of PMX runs in a ``lax.fori_loop`` over the genome
+axis (genome length is the short axis; the population axis is the wide,
+vmapped one).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "cx_one_point", "cx_two_point", "cx_uniform",
+    "cx_partialy_matched", "cx_uniform_partialy_matched", "cx_ordered",
+    "cx_blend", "cx_simulated_binary", "cx_simulated_binary_bounded",
+    "cx_messy_one_point", "cx_es_blend", "cx_es_two_point",
+]
+
+
+def _two_cut_points(key, size, low=1):
+    """Two distinct crossover points with the reference's distribution
+    (crossover.py:45-52 for cxTwoPoint, low=1; crossover.py:115-119 for PMX,
+    low=0): cxpoint1 ∈ [low, size] inclusive, cxpoint2 ∈ [low, size-1]
+    inclusive, bumped past cxpoint1 and ordered.  (``random.randint`` bounds
+    are inclusive; jax's upper bound is exclusive, hence the +1s.)"""
+    k1, k2 = jax.random.split(key)
+    c1 = jax.random.randint(k1, (), low, size + 1)    # [low, size]
+    c2 = jax.random.randint(k2, (), low, size)        # [low, size-1]
+    c2 = jnp.where(c2 >= c1, c2 + 1, c2)
+    lo = jnp.minimum(c1, c2)
+    hi = jnp.maximum(c1, c2)
+    return lo, hi
+
+
+def cx_one_point(key, ind1, ind2):
+    """Swap tails after one random point (reference crossover.py:18-34)."""
+    size = ind1.shape[-1]
+    point = jax.random.randint(key, (), 1, size)
+    mask = jnp.arange(size) >= point
+    c1 = jnp.where(mask, ind2, ind1)
+    c2 = jnp.where(mask, ind1, ind2)
+    return c1, c2
+
+
+def cx_two_point(key, ind1, ind2):
+    """Swap the slice between two random points (reference crossover.py:37-60)."""
+    size = ind1.shape[-1]
+    lo, hi = _two_cut_points(key, size)
+    idx = jnp.arange(size)
+    mask = (idx >= lo) & (idx < hi)
+    c1 = jnp.where(mask, ind2, ind1)
+    c2 = jnp.where(mask, ind1, ind2)
+    return c1, c2
+
+
+def cx_uniform(key, ind1, ind2, indpb):
+    """Swap each attribute independently w.p. ``indpb`` (reference
+    crossover.py:73-91)."""
+    mask = jax.random.bernoulli(key, indpb, ind1.shape)
+    c1 = jnp.where(mask, ind2, ind1)
+    c2 = jnp.where(mask, ind1, ind2)
+    return c1, c2
+
+
+def _pmx_swap_chain(ind1, ind2, p1, p2, active_mask):
+    """The PMX swap chain of reference crossover.py:120-136: for each active
+    position, swap the matched values in both children and update the
+    position lookup tables.  Sequential by construction (each swap depends on
+    the updated position tables), so a fori_loop over the genome axis."""
+    size = ind1.shape[-1]
+
+    def body(i, carry):
+        i1, i2, p1, p2 = carry
+        t1, t2 = i1[i], i2[i]
+        n1 = i1.at[i].set(t2).at[p1[t2]].set(t1)
+        n2 = i2.at[i].set(t1).at[p2[t1]].set(t2)
+        np1 = p1.at[t1].set(p1[t2]).at[t2].set(p1[t1])
+        np2 = p2.at[t2].set(p2[t1]).at[t1].set(p2[t2])
+        act = active_mask[i]
+        return (jnp.where(act, n1, i1), jnp.where(act, n2, i2),
+                jnp.where(act, np1, p1), jnp.where(act, np2, p2))
+
+    i1, i2, _, _ = lax.fori_loop(0, size, body, (ind1, ind2, p1, p2))
+    return i1, i2
+
+
+def _positions(perm):
+    """p[v] = index of value v in the permutation."""
+    size = perm.shape[-1]
+    return jnp.zeros(size, perm.dtype).at[perm].set(jnp.arange(size, dtype=perm.dtype))
+
+
+def cx_partialy_matched(key, ind1, ind2):
+    """PMX on integer permutations (reference crossover.py:94-141)."""
+    size = ind1.shape[-1]
+    lo, hi = _two_cut_points(key, size, low=0)
+    idx = jnp.arange(size)
+    active = (idx >= lo) & (idx < hi)
+    return _pmx_swap_chain(ind1, ind2, _positions(ind1), _positions(ind2), active)
+
+
+def cx_uniform_partialy_matched(key, ind1, ind2, indpb):
+    """UPMX: PMX swaps at independently-chosen positions (reference
+    crossover.py:144-185, Cicirello & Smith 2000)."""
+    active = jax.random.bernoulli(key, indpb, ind1.shape)
+    return _pmx_swap_chain(ind1, ind2, _positions(ind1), _positions(ind2), active)
+
+
+def _ox_child(keep_seg_of, fill_from, lo, hi):
+    """Build one ordered-crossover child: keep ``keep_seg_of``'s [lo,hi]
+    segment; fill remaining positions, scanning cyclically from hi+1, with
+    ``fill_from``'s values (also scanned cyclically from hi+1) that are not
+    in the kept segment (reference crossover.py:188-238)."""
+    size = keep_seg_of.shape[-1]
+    idx = jnp.arange(size)
+    seg = (idx >= lo) & (idx <= hi)
+    # membership[v] = 1 iff value v occurs in the kept segment
+    membership = jnp.zeros(size, bool).at[keep_seg_of].set(seg)
+    # donor values in cyclic order starting at hi+1
+    rot = jnp.roll(fill_from, -(hi + 1))
+    donor_keep = ~membership[rot]
+    donor_order = jnp.argsort(~donor_keep, stable=True)   # kept ones first, in order
+    donor_vals = rot[donor_order]                          # first (size-seglen) valid
+    # target positions in cyclic order starting at hi+1, excluding segment
+    pos_rot = jnp.roll(idx, -(hi + 1))
+    pos_keep = ~((pos_rot >= lo) & (pos_rot <= hi))
+    pos_order = jnp.argsort(~pos_keep, stable=True)
+    pos_vals = pos_rot[pos_order]
+    # scatter: positions beyond the fill count collide harmlessly onto the
+    # segment slots, which we overwrite right after.
+    nfill = size - (hi - lo + 1)
+    j = jnp.arange(size)
+    safe_pos = jnp.where(j < nfill, pos_vals, size)       # size = dropped slot
+    buf = jnp.zeros(size + 1, keep_seg_of.dtype).at[safe_pos].set(donor_vals)
+    child = jnp.where(seg, keep_seg_of, buf[:size])
+    return child
+
+
+def cx_ordered(key, ind1, ind2):
+    """Ordered crossover (OX) on permutations (reference crossover.py:188-238,
+    Goldberg 1989)."""
+    size = ind1.shape[-1]
+    k1, k2 = jax.random.split(key)
+    a = jax.random.randint(k1, (), 0, size)
+    b = jax.random.randint(k2, (), 0, size - 1)
+    b = jnp.where(b >= a, b + 1, b)
+    lo = jnp.minimum(a, b)
+    hi = jnp.maximum(a, b)
+    c1 = _ox_child(ind1, ind2, lo, hi)
+    c2 = _ox_child(ind2, ind1, lo, hi)
+    return c1, c2
+
+
+def cx_blend(key, ind1, ind2, alpha):
+    """BLX-alpha blend (reference crossover.py:241-260): per-gene
+    gamma = (1+2a)·u − a; children are the two symmetric blends."""
+    u = jax.random.uniform(key, ind1.shape)
+    gamma = (1.0 + 2.0 * alpha) * u - alpha
+    c1 = (1.0 - gamma) * ind1 + gamma * ind2
+    c2 = gamma * ind1 + (1.0 - gamma) * ind2
+    return c1, c2
+
+
+def cx_simulated_binary(key, ind1, ind2, eta):
+    """SBX (reference crossover.py:263-288): spread factor beta from the
+    polynomial distribution with index ``eta``."""
+    u = jax.random.uniform(key, ind1.shape)
+    beta = jnp.where(
+        u <= 0.5,
+        (2.0 * u) ** (1.0 / (eta + 1.0)),
+        (1.0 / (2.0 * (1.0 - u))) ** (1.0 / (eta + 1.0)),
+    )
+    c1 = 0.5 * ((1 + beta) * ind1 + (1 - beta) * ind2)
+    c2 = 0.5 * ((1 - beta) * ind1 + (1 + beta) * ind2)
+    return c1, c2
+
+
+def cx_simulated_binary_bounded(key, ind1, ind2, eta, low, up):
+    """Bounded SBX as used by NSGA-II (reference crossover.py:291-364):
+    per-gene applied w.p. 0.5 when parents differ; the spread factor is
+    corrected for the bounds; children are clipped and randomly swapped."""
+    size = ind1.shape[-1]
+    low = jnp.broadcast_to(jnp.asarray(low, ind1.dtype), (size,))
+    up = jnp.broadcast_to(jnp.asarray(up, ind1.dtype), (size,))
+    k_apply, k_rand, k_swap = jax.random.split(key, 3)
+    apply_ = jax.random.bernoulli(k_apply, 0.5, (size,)) & (
+        jnp.abs(ind1 - ind2) > 1e-14)
+    x1 = jnp.minimum(ind1, ind2)
+    x2 = jnp.maximum(ind1, ind2)
+    rand = jax.random.uniform(k_rand, (size,))
+    diff = jnp.where(x2 - x1 > 1e-14, x2 - x1, 1.0)   # guarded denominator
+
+    def beta_q(beta):
+        alpha = 2.0 - beta ** (-(eta + 1.0))
+        return jnp.where(
+            rand <= 1.0 / alpha,
+            (rand * alpha) ** (1.0 / (eta + 1.0)),
+            (1.0 / (2.0 - rand * alpha)) ** (1.0 / (eta + 1.0)),
+        )
+
+    beta1 = 1.0 + (2.0 * (x1 - low) / diff)
+    c1 = 0.5 * (x1 + x2 - beta_q(beta1) * diff)
+    beta2 = 1.0 + (2.0 * (up - x2) / diff)
+    c2 = 0.5 * (x1 + x2 + beta_q(beta2) * diff)
+    c1 = jnp.clip(c1, low, up)
+    c2 = jnp.clip(c2, low, up)
+    swap = jax.random.bernoulli(k_swap, 0.5, (size,))
+    o1 = jnp.where(swap, c2, c1)
+    o2 = jnp.where(swap, c1, c2)
+    return (jnp.where(apply_, o1, ind1), jnp.where(apply_, o2, ind2))
+
+
+def cx_messy_one_point(key, ind1, ind2):
+    """Messy one-point crossover (reference crossover.py:367-387): cut each
+    parent at an independent point and splice head₁+tail₂ / head₂+tail₁.
+
+    Children have *different lengths* than their parents, so variable-length
+    individuals are represented as ``(genome, length)`` pairs over a
+    fixed-capacity array.  Plain arrays are accepted (full length valid) but
+    the children are still returned as ``(genome, length)`` pairs — slots at
+    ``length`` and beyond are padding and must be masked by the consumer."""
+    if isinstance(ind1, tuple):
+        g1, l1 = ind1
+        g2, l2 = ind2
+    else:
+        g1, g2 = ind1, ind2
+        l1 = jnp.asarray(g1.shape[-1])
+        l2 = jnp.asarray(g2.shape[-1])
+    cap = g1.shape[-1]
+    k1, k2 = jax.random.split(key)
+    cut1 = jax.random.randint(k1, (), 0, l1 + 1)
+    cut2 = jax.random.randint(k2, (), 0, l2 + 1)
+    idx = jnp.arange(cap)
+
+    def splice(head, lh, tail, ct, lt):
+        # child[j] = head[j] for j < lh else tail[ct + (j - lh)]
+        src = jnp.clip(ct + (idx - lh), 0, cap - 1)
+        child = jnp.where(idx < lh, head, tail[src])
+        length = jnp.minimum(lh + (lt - ct), cap)
+        child = jnp.where(idx < length, child, jnp.zeros_like(child))
+        return child, length
+
+    return splice(g1, cut1, g2, cut2, l2), splice(g2, cut2, g1, cut1, l1)
+
+
+def cx_es_blend(key, ind1, ind2, alpha):
+    """ES blend crossover on (x, strategy) pairs (reference
+    crossover.py:390-416): blends both the values and the mutation
+    strategies with the same per-gene gamma."""
+    (x1, s1), (x2, s2) = ind1, ind2
+    u = jax.random.uniform(key, x1.shape)
+    gamma = (1.0 + 2.0 * alpha) * u - alpha
+    nx1 = (1.0 - gamma) * x1 + gamma * x2
+    nx2 = gamma * x1 + (1.0 - gamma) * x2
+    ns1 = (1.0 - gamma) * s1 + gamma * s2
+    ns2 = gamma * s1 + (1.0 - gamma) * s2
+    return (nx1, ns1), (nx2, ns2)
+
+
+def cx_es_two_point(key, ind1, ind2):
+    """ES two-point crossover (reference crossover.py:419-446): the same two
+    cut points swap both values and strategies."""
+    (x1, s1), (x2, s2) = ind1, ind2
+    size = x1.shape[-1]
+    lo, hi = _two_cut_points(key, size)
+    idx = jnp.arange(size)
+    mask = (idx >= lo) & (idx < hi)
+    swap = lambda a, b: (jnp.where(mask, b, a), jnp.where(mask, a, b))
+    nx1, nx2 = swap(x1, x2)
+    ns1, ns2 = swap(s1, s2)
+    return (nx1, ns1), (nx2, ns2)

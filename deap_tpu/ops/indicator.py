@@ -1,0 +1,63 @@
+"""Quality indicators — array-native equivalent of ``deap/tools/indicator.py``.
+
+Each indicator returns the index of the *least-contributing* individual of a
+non-dominated front, for indicator-based selection (MO-CMA-ES, reference
+cma.py:392).  Fronts are :class:`deap_tpu.base.Fitness` objects or raw
+``(n, nobj)`` weighted-values arrays; like the reference, the internal
+objective space is ``-wvalues`` (implicit minimization, indicator.py:32-35).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..base import Fitness
+from .hv import hypervolume as _hv
+
+__all__ = ["hypervolume", "additive_epsilon", "multiplicative_epsilon"]
+
+
+def _wobj(front):
+    if isinstance(front, Fitness):
+        w = np.asarray(front.wvalues)
+    else:
+        w = np.asarray(front)
+    return -w
+
+
+def hypervolume(front, **kargs) -> int:
+    """Index of the individual with the least hypervolume contribution
+    (reference indicator.py:26-47): the point whose removal leaves the
+    largest remaining hypervolume."""
+    wobj = _wobj(front)
+    ref = kargs.get("ref", None)
+    if ref is None:
+        ref = np.max(wobj, axis=0) + 1
+    contrib = [
+        _hv(np.concatenate((wobj[:i], wobj[i + 1:])), ref)
+        for i in range(len(wobj))
+    ]
+    return int(np.argmax(contrib))
+
+
+def additive_epsilon(front, **kargs) -> int:
+    """Least additive-epsilon contributor (reference indicator.py:49-68)."""
+    wobj = _wobj(front)
+    n = len(wobj)
+    diff = wobj[:, None, :] - wobj[None, :, :]          # i - j
+    worst = np.max(diff, axis=2)                        # eps(i, j)
+    np.fill_diagonal(worst, np.inf)
+    contrib = np.min(worst, axis=1)
+    return int(np.argmin(contrib))
+
+
+def multiplicative_epsilon(front, **kargs) -> int:
+    """Least multiplicative-epsilon contributor (reference
+    indicator.py:71-90)."""
+    wobj = _wobj(front)
+    ratio = wobj[:, None, :] / wobj[None, :, :]
+    worst = np.max(ratio, axis=2)
+    np.fill_diagonal(worst, np.inf)
+    contrib = np.min(worst, axis=1)
+    return int(np.argmin(contrib))

@@ -1,0 +1,77 @@
+"""Initializers — array-native equivalents of ``deap/tools/init.py``.
+
+The reference composes per-individual attribute generators into containers
+(``initRepeat`` init.py:3-25, ``initIterate`` init.py:27-52, ``initCycle``
+init.py:54-75).  Here the same combinators build *arrays*: a per-element
+attribute function ``attr(key) -> scalar/array`` is fanned out over split
+PRNG keys, replacing sequential global-``random`` draws with a key tree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["init_repeat", "init_iterate", "init_cycle",
+           "uniform", "bernoulli", "randint", "permutation"]
+
+
+def init_repeat(key: jax.Array, func: Callable, n: int) -> Any:
+    """Call ``func(subkey)`` ``n`` times, stacking results on a new leading
+    axis (reference ``initRepeat``, init.py:3-25).  Used both for genomes
+    (n = genome length) and populations (n = pop size)."""
+    keys = jax.random.split(key, n)
+    return jax.vmap(func)(keys)
+
+
+def init_iterate(key: jax.Array, container: Callable, generator: Callable) -> Any:
+    """``container(generator(key))`` (reference ``initIterate``,
+    init.py:27-52) — ``generator`` produces the full genome in one shot."""
+    return container(generator(key))
+
+
+def init_cycle(key: jax.Array, seq_of_funcs: Sequence[Callable], n: int = 1) -> Any:
+    """Cycle through attribute generators ``n`` times (reference
+    ``initCycle``, init.py:54-75).  Returns a tuple pytree of the produced
+    attributes, cycled ``n`` times (stacked when n > 1)."""
+    outs = []
+    for i in range(n):
+        row = []
+        for func in seq_of_funcs:
+            key, sub = jax.random.split(key)
+            row.append(func(sub))
+        outs.append(tuple(row))
+    if n == 1:
+        return outs[0]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *outs)
+
+
+# -- common attribute generators (the `random.random`/`randint` lambdas of
+#    reference examples, e.g. examples/ga/onemax.py:46-48) -----------------
+
+def uniform(low=0.0, high=1.0, shape=()):
+    def attr(key):
+        return jax.random.uniform(key, shape, minval=low, maxval=high)
+    return attr
+
+
+def bernoulli(p=0.5, shape=(), dtype=jnp.int32):
+    def attr(key):
+        return jax.random.bernoulli(key, p, shape).astype(dtype)
+    return attr
+
+
+def randint(low, high, shape=(), dtype=jnp.int32):
+    """Inclusive bounds, matching ``random.randint`` semantics used across
+    the reference examples."""
+    def attr(key):
+        return jax.random.randint(key, shape, low, high + 1, dtype=dtype)
+    return attr
+
+
+def permutation(n):
+    def attr(key):
+        return jax.random.permutation(key, n)
+    return attr

@@ -1,0 +1,99 @@
+"""Island migration — array-native equivalent of ``deap/tools/migration.py``.
+
+The reference's ``migRing`` exchanges pickled individuals between in-process
+population lists (migration.py:4-51).  Here islands are a *stacked* leading
+axis of the population arrays, and migration is pure index arithmetic:
+
+* :func:`mig_ring_stacked` — islands stacked on axis 0 of one device array;
+  the destination mapping is a static permutation, so the exchange is a
+  single gather.  This is what runs **inside** a jitted multi-device island
+  model, where XLA lowers the stacked roll to ``ppermute`` over ICI when the
+  island axis is sharded over a mesh (see ``deap_tpu.parallel.islands``).
+* :func:`mig_ring` — host-level convenience over a list of
+  :class:`Population` objects, mirroring the reference signature.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..base import Population
+
+__all__ = ["mig_ring_stacked", "mig_ring"]
+
+
+def mig_ring_stacked(key, genomes, fitness_w, k, selection: Callable,
+                     replacement: Callable | None = None,
+                     migarray: Sequence[int] | None = None):
+    """Ring migration over stacked islands.
+
+    ``genomes``: pytree with leaves ``(n_islands, pop, ...)``; ``fitness_w``:
+    ``(n_islands, pop, nobj)`` weighted values.  ``selection(key, w, k)``
+    picks emigrant indices per island (any ``deap_tpu.ops.selection``
+    function).  Emigrants from island ``i`` replace, in island
+    ``migarray[i]``, either that island's own emigrants (``replacement is
+    None``, as reference migration.py:44-46) or the individuals chosen by
+    ``replacement``.
+
+    Returns the updated genome pytree and a ``(n_islands, k)`` array of the
+    replaced slots (for fitness bookkeeping by the caller).
+    """
+    n_isl = fitness_w.shape[0]
+    if migarray is None:
+        migarray = list(range(1, n_isl)) + [0]
+    migarray = list(migarray)
+    # inverse: source[j] = island whose emigrants arrive at island j
+    source = [0] * n_isl
+    for frm, to in enumerate(migarray):
+        source[to] = frm
+    src = jnp.asarray(source)
+
+    keys = jax.random.split(key, 2 * n_isl).reshape(n_isl, 2, -1)
+    emig_idx = jax.vmap(lambda kk, w: selection(kk, w, k))(keys[:, 0], fitness_w)
+    if replacement is None:
+        repl_idx = emig_idx
+    else:
+        repl_idx = jax.vmap(lambda kk, w: replacement(kk, w, k))(keys[:, 1], fitness_w)
+
+    def exchange(leaf):
+        emigrants = jax.vmap(lambda g, i: g[i])(leaf, emig_idx)      # (isl, k, ...)
+        incoming = emigrants[src]                                     # ring gather
+        return jax.vmap(lambda g, i, v: g.at[i].set(v))(leaf, repl_idx, incoming)
+
+    new_genomes = jax.tree_util.tree_map(exchange, genomes)
+    return new_genomes, repl_idx
+
+
+def mig_ring(key, populations, k, selection, replacement=None, migarray=None):
+    """Host-level ring migration over a list of :class:`Population`
+    (reference migRing signature, migration.py:4-51).  Replaced individuals
+    keep the immigrants' fitness (they were evaluated on their home island)."""
+    n_isl = len(populations)
+    if migarray is None:
+        migarray = list(range(1, n_isl)) + [0]
+    keys = jax.random.split(key, 2 * n_isl)
+    emig_idx = [selection(keys[2 * i], populations[i].fitness, k)
+                for i in range(n_isl)]
+    if replacement is None:
+        repl_idx = emig_idx
+    else:
+        repl_idx = [replacement(keys[2 * i + 1], populations[i].fitness, k)
+                    for i in range(n_isl)]
+    emigrants = [populations[i].take(emig_idx[i]) for i in range(n_isl)]
+    out = list(populations)
+    for frm, to in enumerate(migarray):
+        dst = out[to]
+        mig = emigrants[frm]
+        idx = repl_idx[to]
+        genome = jax.tree_util.tree_map(
+            lambda g, v: g.at[idx].set(v), dst.genome, mig.genome)
+        values = dst.fitness.values.at[idx].set(mig.fitness.values)
+        valid = dst.fitness.valid.at[idx].set(mig.fitness.valid)
+        out[to] = Population(
+            genome=genome,
+            fitness=dst.fitness.__class__(values=values, valid=valid,
+                                          weights=dst.fitness.weights))
+    return out

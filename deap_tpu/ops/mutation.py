@@ -1,0 +1,105 @@
+"""Mutation operators — array-native equivalents of ``deap/tools/mutation.py``.
+
+Per-individual pure functions ``mut(key, ind, ...) -> ind``; algorithms vmap
+them over the population.  Per-gene ``if random.random() < indpb`` loops of
+the reference become Bernoulli masks fused into one elementwise kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "mut_gaussian", "mut_polynomial_bounded", "mut_shuffle_indexes",
+    "mut_flip_bit", "mut_uniform_int", "mut_es_log_normal",
+]
+
+
+def mut_gaussian(key, ind, mu, sigma, indpb):
+    """Add N(mu, sigma) noise to each gene w.p. ``indpb`` (reference
+    mutation.py:17-48).  ``mu``/``sigma`` may be scalars or per-gene arrays
+    (the reference accepts sequences)."""
+    k_mask, k_noise = jax.random.split(key)
+    mask = jax.random.bernoulli(k_mask, indpb, ind.shape)
+    noise = mu + sigma * jax.random.normal(k_noise, ind.shape, ind.dtype)
+    return jnp.where(mask, ind + noise, ind)
+
+
+def mut_polynomial_bounded(key, ind, eta, low, up, indpb):
+    """Deb's polynomial bounded mutation, as in NSGA-II (reference
+    mutation.py:51-95)."""
+    size = ind.shape[-1]
+    low = jnp.broadcast_to(jnp.asarray(low, ind.dtype), (size,))
+    up = jnp.broadcast_to(jnp.asarray(up, ind.dtype), (size,))
+    k_mask, k_rand = jax.random.split(key)
+    mask = jax.random.bernoulli(k_mask, indpb, ind.shape)
+    rand = jax.random.uniform(k_rand, ind.shape)
+    span = jnp.where(up > low, up - low, 1.0)
+    delta_1 = (ind - low) / span
+    delta_2 = (up - ind) / span
+    mut_pow = 1.0 / (eta + 1.0)
+    xy1 = 1.0 - delta_1
+    val1 = 2.0 * rand + (1.0 - 2.0 * rand) * xy1 ** (eta + 1.0)
+    dq1 = val1 ** mut_pow - 1.0
+    xy2 = 1.0 - delta_2
+    val2 = 2.0 * (1.0 - rand) + 2.0 * (rand - 0.5) * xy2 ** (eta + 1.0)
+    dq2 = 1.0 - val2 ** mut_pow
+    delta_q = jnp.where(rand < 0.5, dq1, dq2)
+    x = jnp.clip(ind + delta_q * span, low, up)
+    return jnp.where(mask, x, ind)
+
+
+def mut_shuffle_indexes(key, ind, indpb):
+    """Swap each gene w.p. ``indpb`` with another uniformly-chosen position
+    (reference mutation.py:98-121).  The reference's sequential swap chain is
+    reproduced with a fori_loop over the genome axis (population axis is the
+    vmapped wide axis)."""
+    size = ind.shape[-1]
+    k_mask, k_idx = jax.random.split(key)
+    mask = jax.random.bernoulli(k_mask, indpb, (size,))
+    # reference draws swap_indx in [0, size-2] then bumps past i
+    raw = jax.random.randint(k_idx, (size,), 0, size - 1)
+    swap_to = jnp.where(raw >= jnp.arange(size), raw + 1, raw)
+
+    def body(i, x):
+        j = swap_to[i]
+        xi, xj = x[i], x[j]
+        swapped = x.at[i].set(xj).at[j].set(xi)
+        return jnp.where(mask[i], swapped, x)
+
+    return lax.fori_loop(0, size, body, ind)
+
+
+def mut_flip_bit(key, ind, indpb):
+    """Flip each bit w.p. ``indpb`` (reference mutation.py:124-142)."""
+    mask = jax.random.bernoulli(key, indpb, ind.shape)
+    return jnp.where(mask, 1 - ind, ind)
+
+
+def mut_uniform_int(key, ind, low, up, indpb):
+    """Replace each gene w.p. ``indpb`` with a uniform integer in
+    [low, up] inclusive (reference mutation.py:145-177)."""
+    k_mask, k_val = jax.random.split(key)
+    mask = jax.random.bernoulli(k_mask, indpb, ind.shape)
+    vals = jax.random.randint(k_val, ind.shape, low, up + 1, dtype=ind.dtype)
+    return jnp.where(mask, vals, ind)
+
+
+def mut_es_log_normal(key, ind, c, indpb):
+    """Self-adaptive ES mutation on ``(x, strategy)`` pairs (reference
+    mutation.py:180-219): strategies multiply by a log-normal factor with a
+    shared component t0·N(0,1) plus per-gene t·N(0,1); values move by
+    strategy-scaled noise."""
+    x, s = ind
+    size = x.shape[-1]
+    t = c / jnp.sqrt(2.0 * jnp.sqrt(size))
+    t0 = c / jnp.sqrt(2.0 * size)
+    k_mask, k_common, k_gene, k_val = jax.random.split(key, 4)
+    mask = jax.random.bernoulli(k_mask, indpb, x.shape)
+    n_common = jax.random.normal(k_common, (), x.dtype)
+    n_gene = jax.random.normal(k_gene, x.shape, x.dtype)
+    new_s = s * jnp.exp(t0 * n_common + t * n_gene)
+    new_x = x + new_s * jax.random.normal(k_val, x.shape, x.dtype)
+    return jnp.where(mask, new_x, x), jnp.where(mask, new_s, s)

@@ -1,0 +1,203 @@
+"""Selection operators — array-native equivalents of ``deap/tools/selection.py``.
+
+Selection is inherently population-level, so these are not vmapped: each
+``sel_*(key, fitness, k, ...)`` returns an ``(k,)`` int index array into the
+population; callers gather with ``Population.take``.  ``fitness`` may be a
+:class:`deap_tpu.base.Fitness` or a raw ``(pop, nobj)`` weighted-values
+array; invalid rows compare as ``-inf`` and therefore lose every
+(maximizing) comparison.
+
+Fitness comparisons are lexicographic on weighted values, exactly like the
+reference's ``Fitness.__gt__`` tuple compare (base.py:234-250); see
+:func:`deap_tpu.base.lex_argmax`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import Fitness, lex_argmax, lex_sort_indices
+
+__all__ = [
+    "sel_random", "sel_best", "sel_worst", "sel_tournament", "sel_roulette",
+    "sel_double_tournament", "sel_stochastic_universal_sampling",
+    "sel_lexicase", "sel_epsilon_lexicase", "sel_automatic_epsilon_lexicase",
+]
+
+
+def _wv(fitness) -> jax.Array:
+    if isinstance(fitness, Fitness):
+        return fitness.masked_wvalues()
+    return jnp.asarray(fitness)
+
+
+def sel_random(key, fitness, k):
+    """``k`` uniform draws with replacement (reference selection.py:12-24)."""
+    n = _wv(fitness).shape[0]
+    return jax.random.randint(key, (k,), 0, n)
+
+
+def sel_best(key, fitness, k):
+    """Top-``k`` by lexicographic fitness (reference selection.py:27-37).
+    ``key`` is accepted for slot uniformity and unused."""
+    del key
+    return lex_sort_indices(_wv(fitness), descending=True)[:k]
+
+
+def sel_worst(key, fitness, k):
+    """Bottom-``k`` (reference selection.py:39-49)."""
+    del key
+    return lex_sort_indices(_wv(fitness), descending=False)[:k]
+
+
+def sel_tournament(key, fitness, k, tournsize):
+    """``k`` tournaments of ``tournsize`` uniform aspirants each, keeping the
+    lexicographic best (reference selection.py:51-69).  One gather + one
+    masked argmax over a ``(k, tournsize, nobj)`` tensor."""
+    w = _wv(fitness)
+    n = w.shape[0]
+    aspirants = jax.random.randint(key, (k, tournsize), 0, n)
+    winners = lex_argmax(w[aspirants], axis=1)            # (k,)
+    return jnp.take_along_axis(aspirants, winners[:, None], 1)[:, 0]
+
+
+def sel_roulette(key, fitness, k):
+    """Fitness-proportionate selection on the first objective's *raw* value
+    (reference selection.py:71-102; like the reference, unsuitable for
+    minimization or negative fitness)."""
+    if isinstance(fitness, Fitness):
+        vals = jnp.where(fitness.valid, fitness.values[:, 0], 0.0)
+    else:
+        vals = jnp.asarray(fitness)[:, 0]
+    total = jnp.sum(vals)
+    p = jnp.where(total > 0, vals / jnp.where(total > 0, total, 1.0),
+                  jnp.ones_like(vals) / vals.shape[0])
+    cum = jnp.cumsum(p)
+    u = jax.random.uniform(key, (k,))
+    return jnp.clip(jnp.searchsorted(cum, u), 0, vals.shape[0] - 1)
+
+
+def sel_double_tournament(key, fitness, sizes, k, fitness_size,
+                          parsimony_size, fitness_first=True):
+    """Parsimony double tournament (reference selection.py:105-179, Luke &
+    Panait 2002): a fitness tournament of size ``fitness_size`` composed with
+    a probabilistic size tournament (``parsimony_size`` in [1, 2]) preferring
+    *smaller* individuals.  ``sizes`` is the per-individual size array (the
+    reference uses ``len(ind)``)."""
+    w = _wv(fitness)
+    n = w.shape[0]
+    k_fit, k_size, k_prob = jax.random.split(key, 3)
+
+    def fit_round(kk, select_from):
+        # select_from: (k, m) candidate indices; one fitness tournament per row
+        m = select_from.shape[1]
+        asp_cols = jax.random.randint(kk, (k, fitness_size), 0, m)
+        asp = jnp.take_along_axis(select_from, asp_cols, 1)
+        win = lex_argmax(w[asp], axis=1)
+        return jnp.take_along_axis(asp, win[:, None], 1)[:, 0]
+
+    def size_round(kk, kp, select_from):
+        # two aspirants; smaller wins w.p. parsimony_size/2
+        asp_cols = jax.random.randint(kk, (k, 2), 0, select_from.shape[1])
+        asp = jnp.take_along_axis(select_from, asp_cols, 1)
+        s1, s2 = sizes[asp[:, 0]], sizes[asp[:, 1]]
+        prob = parsimony_size / 2.0
+        # order so slot 0 is the smaller (ties keep order, like the reference)
+        smaller_first = jnp.where((s1 < s2)[:, None], asp, asp[:, ::-1])
+        pick_small = jax.random.bernoulli(kp, prob, (k,))
+        return jnp.where(pick_small, smaller_first[:, 0], smaller_first[:, 1])
+
+    all_idx = jnp.broadcast_to(jnp.arange(n), (k, n))
+    if fitness_first:
+        # size tournament chooses between two independent fitness-tournament
+        # winners (reference's tsel = fitness tournament, select_from=pop)
+        w1 = fit_round(jax.random.fold_in(k_fit, 0), all_idx)
+        w2 = fit_round(jax.random.fold_in(k_fit, 1), all_idx)
+        cand = jnp.stack([w1, w2], 1)
+        return size_round(k_size, k_prob, cand)
+    else:
+        # fitness tournament over size-tournament winners
+        winners = []
+        for i in range(fitness_size):
+            kk = jax.random.fold_in(k_size, i)
+            kp = jax.random.fold_in(k_prob, i)
+            winners.append(size_round(kk, kp, all_idx))
+        cand = jnp.stack(winners, 1)                       # (k, fitness_size)
+        win = lex_argmax(w[cand], axis=1)
+        return jnp.take_along_axis(cand, win[:, None], 1)[:, 0]
+
+
+def sel_stochastic_universal_sampling(key, fitness, k):
+    """SUS (reference selection.py:182-211): evenly-spaced pointers over the
+    fitness-sorted cumulative first-objective distribution."""
+    if isinstance(fitness, Fitness):
+        vals = jnp.where(fitness.valid, fitness.values[:, 0], 0.0)
+        w = fitness.masked_wvalues()
+    else:
+        vals = jnp.asarray(fitness)[:, 0]
+        w = jnp.asarray(fitness)
+    order = lex_sort_indices(w, descending=True)
+    sorted_vals = vals[order]
+    total = jnp.sum(vals)
+    distance = total / k
+    start = jax.random.uniform(key, (), minval=0.0, maxval=distance)
+    points = start + distance * jnp.arange(k)
+    cum = jnp.cumsum(sorted_vals)
+    picks = jnp.clip(jnp.searchsorted(cum, points, side="right"),
+                     0, vals.shape[0] - 1)
+    return order[picks]
+
+
+def _lexicase_one(key, cases, eps_fn):
+    """One lexicase selection: shuffle case order, then scan cases narrowing
+    the candidate mask to those within eps of the per-case best (reference
+    selection.py:214-323).  ``cases`` is (pop, ncases), maximizing."""
+    n, ncases = cases.shape
+    k_shuf, k_pick = jax.random.split(key)
+    order = jax.random.permutation(k_shuf, ncases)
+
+    def step(mask, case_idx):
+        col = cases[:, case_idx]
+        masked = jnp.where(mask, col, -jnp.inf)
+        best = jnp.max(masked)
+        eps = eps_fn(col, mask)
+        new_mask = mask & (col >= best - eps)
+        # keep at least one candidate
+        new_mask = jnp.where(jnp.any(new_mask), new_mask, mask)
+        return new_mask, None
+
+    mask, _ = lax.scan(step, jnp.ones(n, bool), order)
+    # uniform choice among survivors (reference: random.choice(candidates))
+    u = jax.random.uniform(k_pick, (n,))
+    return jnp.argmax(jnp.where(mask, u, -1.0))
+
+
+def _sel_lexicase_impl(key, cases, k, eps_fn):
+    cases = jnp.asarray(cases)
+    keys = jax.random.split(key, k)
+    return jax.vmap(lambda kk: _lexicase_one(kk, cases, eps_fn))(keys)
+
+
+def sel_lexicase(key, cases, k):
+    """Lexicase selection (reference selection.py:214-244, Spector 2012).
+    ``cases``: (pop, ncases) per-case fitness, already signed for
+    maximization (multiply by weights for minimization problems)."""
+    return _sel_lexicase_impl(key, cases, k, lambda col, mask: 0.0)
+
+
+def sel_epsilon_lexicase(key, cases, k, epsilon):
+    """Epsilon-lexicase with fixed epsilon (reference selection.py:247-280)."""
+    return _sel_lexicase_impl(key, cases, k, lambda col, mask: epsilon)
+
+
+def sel_automatic_epsilon_lexicase(key, cases, k):
+    """Epsilon-lexicase with epsilon = median absolute deviation of the
+    still-candidate case errors (reference selection.py:283-323, La Cava
+    2016)."""
+    def mad_eps(col, mask):
+        big = jnp.where(mask, col, jnp.nan)
+        med = jnp.nanmedian(big)
+        return jnp.nanmedian(jnp.abs(big - med))
+    return _sel_lexicase_impl(key, cases, k, mad_eps)

@@ -1,0 +1,14 @@
+"""Parallelism & distribution — the TPU-native replacement for the
+reference's ``multiprocessing.Pool.map`` / SCOOP plugin story (SURVEY §2.6).
+
+The parallelization boundary is the same one the reference documents
+(doc/tutorials/basic/part4.rst): swap the ``map`` slot of the toolbox.  Here
+``toolbox.register("map", tpu_map(mesh))`` makes fitness evaluation a
+mesh-sharded vmap over the population axis; everything else (variation,
+selection under jit over sharded arrays) parallelizes via XLA's sharding
+propagation without further user action.
+"""
+
+from .mapper import (tpu_map, default_mesh, shard_population,
+                     population_sharding)  # noqa: F401
+from .islands import ea_simple_islands  # noqa: F401

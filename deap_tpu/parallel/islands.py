@@ -1,0 +1,110 @@
+"""Island-model EA — TPU-native equivalent of the reference's multiprocess
+islands (examples/ga/onemax_island.py:40-150: one process per deme, emigrants
+pickled over ``multiprocessing.Pipe``).
+
+Here demes are a stacked leading axis ``(n_islands, pop, ...)``: the whole
+per-island generation step is vmapped over that axis, and ring migration is a
+static gather across it (``deap_tpu.ops.migration.mig_ring_stacked``).  Shard
+the island axis over a device mesh (``mesh=``) and XLA executes one island
+per chip with the migration gather lowered to a ``ppermute`` over ICI — the
+collective replacing pickle-over-pipes (SURVEY §2.6 P4/P7).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import Population, Fitness
+from ..algorithms import var_and, evaluate_population
+from ..ops.migration import mig_ring_stacked
+from ..ops.selection import sel_best
+
+__all__ = ["ea_simple_islands", "stack_populations", "unstack_populations"]
+
+
+def stack_populations(populations) -> Population:
+    """List of per-island populations -> one Population with leaves
+    (n_islands, pop, ...)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *populations)
+
+
+def unstack_populations(stacked: Population):
+    n = jax.tree_util.tree_leaves(stacked.genome)[0].shape[0]
+    return [jax.tree_util.tree_map(lambda x: x[i], stacked) for i in range(n)]
+
+
+def ea_simple_islands(key, populations: Population, toolbox, cxpb: float,
+                      mutpb: float, ngen: int, mig_freq: int, mig_k: int = 5,
+                      mig_selection: Callable = sel_best,
+                      migarray=None, stats=None, mesh: Mesh | None = None,
+                      island_axis: str = "island", verbose: bool = False):
+    """eaSimple per island with periodic ring migration (reference
+    examples/ga/onemax_island.py:112-150).
+
+    ``populations``: stacked Population, leaves ``(n_islands, pop, ...)``
+    (see :func:`stack_populations`).  Every ``mig_freq`` generations the
+    ``mig_k`` best of each island replace the best-slots of the next island
+    in the ring (reference onemax_island.py:131-133 uses ``migPipe`` with
+    selection=selBest, replacement=selRandom).
+
+    With ``mesh`` given, the island axis is sharded over it: each device owns
+    its islands and migration is the only cross-device communication.
+
+    Returns ``(populations, per_gen_stats)`` where the stats dict holds
+    stacked ``(ngen, n_islands, ...)`` arrays.
+    """
+    n_isl = populations.size  # leading axis = islands
+
+    if mesh is not None:
+        sh = NamedSharding(mesh, P(island_axis))
+        populations = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sh) if x.ndim else x, populations)
+
+    def island_gen(key, pop: Population) -> tuple:
+        k_sel, k_var = jax.random.split(key)
+        idx = toolbox.select(k_sel, pop.fitness, pop.size)
+        off = pop.take(idx)
+        off = var_and(k_var, off, toolbox, cxpb, mutpb)
+        off, nevals = evaluate_population(toolbox, off)
+        return off, nevals
+
+    def migrate(key, pops: Population) -> Population:
+        bundle = dict(genome=pops.genome,
+                      values=pops.fitness.values,
+                      valid=pops.fitness.valid)
+        w = jax.vmap(lambda f: f.masked_wvalues())(pops.fitness)
+        new_bundle, _ = mig_ring_stacked(
+            key, bundle, w, mig_k, mig_selection, migarray=migarray)
+        return Population(
+            genome=new_bundle["genome"],
+            fitness=Fitness(values=new_bundle["values"],
+                            valid=new_bundle["valid"],
+                            weights=pops.fitness.weights))
+
+    def gen_step(carry, gen):
+        key, pops = carry
+        key, k_gen, k_mig = jax.random.split(key, 3)
+        keys = jax.random.split(k_gen, n_isl)
+        pops, nevals = jax.vmap(island_gen)(keys, pops)
+        do_mig = (mig_freq > 0) & ((gen % mig_freq) == 0)
+        pops = lax.cond(do_mig, lambda p: migrate(k_mig, p), lambda p: p, pops)
+        rec = stats.compile(pops) if stats is not None else {}
+        rec = dict(rec)
+        rec["nevals"] = nevals
+        return (key, pops), rec
+
+    # initial evaluation per island
+    keys0 = jax.random.split(key, n_isl + 1)
+    key = keys0[0]
+    populations, _ = jax.vmap(
+        lambda p: evaluate_population(toolbox, p))(populations)
+
+    (key, populations), stacked = lax.scan(
+        gen_step, (key, populations), jnp.arange(1, ngen + 1))
+    return populations, stacked

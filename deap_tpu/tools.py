@@ -1,0 +1,74 @@
+"""``deap_tpu.tools`` — familiarity façade matching the reference's
+``deap.tools`` flat namespace (reference tools/__init__.py): operators,
+multi-objective selection, support classes and indicators, importable from
+one place.  snake_case is canonical; the reference's camelCase names are
+provided as aliases so existing DEAP user code maps one-to-one.
+"""
+
+from .ops import *                    # noqa: F401,F403
+from .ops import hv                   # noqa: F401
+from .utils.support import (Statistics, MultiStatistics, Logbook, HallOfFame,
+                            ParetoFront, History)  # noqa: F401
+
+from .ops import init as _init
+from .ops import crossover as _cx
+from .ops import mutation as _mut
+from .ops import selection as _sel
+from .ops import emo as _emo
+from .ops import migration as _mig
+from .ops import constraint as _con
+
+# -- camelCase aliases (reference API names) --------------------------------
+initRepeat = _init.init_repeat
+initIterate = _init.init_iterate
+initCycle = _init.init_cycle
+
+cxOnePoint = _cx.cx_one_point
+cxTwoPoint = _cx.cx_two_point
+cxTwoPoints = _cx.cx_two_point            # deprecated alias (crossover.py:63)
+cxUniform = _cx.cx_uniform
+cxPartialyMatched = _cx.cx_partialy_matched
+cxUniformPartialyMatched = _cx.cx_uniform_partialy_matched
+cxOrdered = _cx.cx_ordered
+cxBlend = _cx.cx_blend
+cxSimulatedBinary = _cx.cx_simulated_binary
+cxSimulatedBinaryBounded = _cx.cx_simulated_binary_bounded
+cxMessyOnePoint = _cx.cx_messy_one_point
+cxESBlend = _cx.cx_es_blend
+cxESTwoPoint = _cx.cx_es_two_point
+cxESTwoPoints = _cx.cx_es_two_point       # deprecated alias (crossover.py:448)
+
+mutGaussian = _mut.mut_gaussian
+mutPolynomialBounded = _mut.mut_polynomial_bounded
+mutShuffleIndexes = _mut.mut_shuffle_indexes
+mutFlipBit = _mut.mut_flip_bit
+mutUniformInt = _mut.mut_uniform_int
+mutESLogNormal = _mut.mut_es_log_normal
+
+selRandom = _sel.sel_random
+selBest = _sel.sel_best
+selWorst = _sel.sel_worst
+selTournament = _sel.sel_tournament
+selRoulette = _sel.sel_roulette
+selDoubleTournament = _sel.sel_double_tournament
+selStochasticUniversalSampling = _sel.sel_stochastic_universal_sampling
+selLexicase = _sel.sel_lexicase
+selEpsilonLexicase = _sel.sel_epsilon_lexicase
+selAutomaticEpsilonLexicase = _sel.sel_automatic_epsilon_lexicase
+
+selNSGA2 = _emo.sel_nsga2
+selTournamentDCD = _emo.sel_tournament_dcd
+sortNondominated = _emo.sort_nondominated
+sortLogNondominated = _emo.sort_log_nondominated
+assignCrowdingDist = _emo.assign_crowding_dist
+selNSGA3 = _emo.sel_nsga3
+selNSGA3WithMemory = _emo.SelNSGA3WithMemory
+uniformReferencePoints = _emo.uniform_reference_points
+selSPEA2 = _emo.sel_spea2
+
+migRing = _mig.mig_ring
+
+DeltaPenalty = _con.DeltaPenalty
+DeltaPenality = _con.DeltaPenalty
+ClosestValidPenalty = _con.ClosestValidPenalty
+ClosestValidPenality = _con.ClosestValidPenalty

@@ -1,0 +1,6 @@
+"""Support utilities: statistics, logbook, archives, genealogy, checkpoint."""
+
+from .support import (Statistics, MultiStatistics, Logbook, HallOfFame,
+                      ParetoFront, History, hof_init, hof_update,
+                      pareto_init, pareto_update)  # noqa: F401
+from .checkpoint import save_checkpoint, load_checkpoint  # noqa: F401

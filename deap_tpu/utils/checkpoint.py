@@ -1,0 +1,60 @@
+"""Checkpoint / resume.
+
+The reference documents checkpointing as a pattern — pickle a dict of
+{population, generation, halloffame, logbook, random.getstate()} every FREQ
+generations (doc/tutorials/advanced/checkpoint.rst:21-72).  Here it is a
+first-class API over arbitrary pytrees: device arrays are pulled to host
+numpy, everything else pickles as-is, and the PRNG **key** replaces
+``random.getstate()`` for exact resumption.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+import jax
+
+__all__ = ["save_checkpoint", "load_checkpoint", "async_save_checkpoint"]
+
+
+def _to_host(tree):
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, tree)
+
+
+def save_checkpoint(path, state: Any) -> None:
+    """Atomically pickle a state pytree (population, PRNG key, strategy
+    state, logbook, ...) to ``path``."""
+    path = Path(path)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    host_state = _to_host(state)
+    with open(tmp, "wb") as f:
+        pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+    tmp.replace(path)
+
+
+def async_save_checkpoint(path, state: Any) -> threading.Thread:
+    """Device→host transfer happens synchronously (cheap), serialization in
+    a background thread — the orbax-style async pattern, so the training
+    loop never blocks on disk."""
+    host_state = _to_host(state)
+
+    def _write():
+        path_ = Path(path)
+        tmp = path_.with_suffix(path_.suffix + ".tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        tmp.replace(path_)
+
+    t = threading.Thread(target=_write, daemon=True)
+    t.start()
+    return t
+
+
+def load_checkpoint(path) -> Any:
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,455 @@
+"""Observability & archives — array-native equivalent of ``deap/tools/support.py``.
+
+* :class:`Statistics` / :class:`MultiStatistics` — reducer registries whose
+  ``compile`` works on device arrays *inside jit* (reference
+  support.py:154-259): in a scanned generation loop the per-generation stat
+  dicts come out as stacked arrays, which :meth:`Logbook.record_stacked`
+  unpacks into chronological records host-side.
+* :class:`Logbook` — host-side chronological records with nested chapters
+  and the column-aligned ASCII ``stream`` (reference support.py:261-487).
+* :class:`HallOfFame` / :class:`ParetoFront` — fixed-capacity *device*
+  archives (functional update kernels threaded through the scan carry) with
+  thin host wrappers (reference support.py:490-640).  Fixed capacity +
+  masking replaces the reference's dynamically-growing sorted lists.
+* :class:`History` — host-side genealogy recorder (reference support.py:21-152).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from operator import eq
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import Fitness, Population, dominates, lex_sort_indices
+
+__all__ = [
+    "Statistics", "MultiStatistics", "Logbook",
+    "HallOfFame", "ParetoFront", "History",
+    "hof_init", "hof_update", "pareto_init", "pareto_update",
+]
+
+
+class Statistics:
+    """Reducer registry (reference Statistics, support.py:154-210).
+
+    ``key`` extracts the data from what ``compile`` receives — e.g.
+    ``Statistics(key=lambda pop: pop.fitness.values[:, 0])``.  Registered
+    functions should be jnp reducers so ``compile`` can run under jit.
+    """
+
+    def __init__(self, key: Callable = lambda x: x):
+        self.key = key
+        self.functions: Dict[str, Callable] = {}
+        self.fields: List[str] = []
+
+    def register(self, name: str, function: Callable, *args, **kargs):
+        self.functions[name] = partial(function, *args, **kargs)
+        self.fields.append(name)
+
+    def compile(self, data) -> Dict[str, Any]:
+        values = self.key(data)
+        return {name: func(values) for name, func in self.functions.items()}
+
+
+class MultiStatistics(dict):
+    """Dict of named :class:`Statistics` compiled together into nested
+    chapters (reference MultiStatistics, support.py:212-259)."""
+
+    def __init__(self, **kargs):
+        super().__init__(**kargs)
+        self.fields = sorted(kargs.keys())
+
+    def register(self, name: str, function: Callable, *args, **kargs):
+        for stats in self.values():
+            stats.register(name, function, *args, **kargs)
+
+    def compile(self, data) -> Dict[str, Dict[str, Any]]:
+        return {name: stats.compile(data) for name, stats in self.items()}
+
+
+class Logbook(list):
+    """Chronological list of dict records with nested chapters and aligned
+    ASCII streaming (reference Logbook, support.py:261-487)."""
+
+    def __init__(self):
+        super().__init__()
+        self.buffindex = 0
+        self.chapters: Dict[str, "Logbook"] = {}
+        self.columns_len = None
+        self.header = None
+        self.log_header = True
+
+    def record(self, **infos):
+        apply_to_all = {k: v for k, v in infos.items() if not isinstance(v, dict)}
+        for key, value in list(infos.items()):
+            if isinstance(value, dict):
+                chapter_infos = dict(value)
+                chapter_infos.update(apply_to_all)
+                if key not in self.chapters:
+                    self.chapters[key] = Logbook()
+                self.chapters[key].record(**chapter_infos)
+                del infos[key]
+        self.append(infos)
+
+    def record_stacked(self, **stacked):
+        """Unpack per-generation stacked arrays (as produced by a scanned
+        loop) into one ``record`` call per generation."""
+        def length(v):
+            if isinstance(v, dict):
+                return length(next(iter(v.values())))
+            return len(v)
+
+        def slice_i(v, i):
+            if isinstance(v, dict):
+                return {k: slice_i(x, i) for k, x in v.items()}
+            x = np.asarray(v)[i]
+            return x.item() if np.ndim(x) == 0 else x
+
+        ngen = length(next(iter(stacked.values())))
+        for i in range(ngen):
+            self.record(**{k: slice_i(v, i) for k, v in stacked.items()})
+
+    def select(self, *names):
+        if len(names) == 1:
+            return [entry.get(names[0], None) for entry in self]
+        return tuple([entry.get(name, None) for entry in self] for name in names)
+
+    def pop(self, index=0):
+        """Retrieve and delete element ``index``, also from the chapters
+        (reference support.py:322-333)."""
+        if index < self.buffindex:
+            self.buffindex -= 1
+        for chapter in self.chapters.values():
+            chapter.pop(index)
+        return super().pop(index)
+
+    def __delitem__(self, key):
+        for chapter in self.chapters.values():
+            chapter.__delitem__(key)
+        super().__delitem__(key)
+
+    @property
+    def stream(self) -> str:
+        startindex, self.buffindex = self.buffindex, len(self)
+        return self.__str__(startindex)
+
+    def __txt__(self, startindex):
+        columns = self.header
+        if not columns:
+            columns = sorted(self[0].keys()) + sorted(self.chapters.keys())
+        if not self.columns_len or len(self.columns_len) != len(columns):
+            self.columns_len = [len(c) for c in columns]
+
+        chapters_txt = {}
+        offsets = {}
+        for name, chapter in self.chapters.items():
+            chapters_txt[name] = chapter.__txt__(startindex)
+            if startindex == 0:
+                offsets[name] = len(chapters_txt[name]) - len(self)
+
+        str_matrix = []
+        for i, line in enumerate(self[startindex:], startindex):
+            str_line = []
+            for j, name in enumerate(columns):
+                if name in chapters_txt:
+                    column = chapters_txt[name][i + offsets[name]]
+                else:
+                    value = line.get(name, "")
+                    if isinstance(value, float):
+                        column = f"{value:g}"
+                    else:
+                        column = str(value)
+                self.columns_len[j] = max(self.columns_len[j], len(column))
+                str_line.append(column)
+            str_matrix.append(str_line)
+
+        if startindex == 0 and self.log_header:
+            header = []
+            nlines = 1
+            if len(self.chapters) > 0:
+                nlines += max(map(len, chapters_txt.values())) - len(self) + 1
+            header = [[] for _ in range(nlines)]
+            for j, name in enumerate(columns):
+                if name in chapters_txt:
+                    length = max(len(line.expandtabs())
+                                 for line in chapters_txt[name])
+                    blanks = nlines - 2 - offsets[name]
+                    for i in range(blanks):
+                        header[i].append(" " * length)
+                    header[blanks].append(name.center(length))
+                    header[blanks + 1].append("-" * length)
+                    for i in range(offsets[name]):
+                        header[blanks + 2 + i].append(
+                            chapters_txt[name][i])
+                else:
+                    length = max(len(line[j].expandtabs())
+                                 for line in str_matrix) if str_matrix else len(name)
+                    for line in header[:-1]:
+                        line.append(" " * max(length, len(name)))
+                    header[-1].append(name)
+            str_matrix = list(header) + str_matrix
+
+        template = "\t".join("{%i:<%i}" % (i, l)
+                             for i, l in enumerate(self.columns_len))
+        text = [template.format(*line) for line in str_matrix]
+        return text
+
+    def __str__(self, startindex=0):
+        text = self.__txt__(startindex)
+        return "\n".join(text)
+
+
+# ---------------------------------------------------------------------------
+# Device archives
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class _ArchiveState:
+    genome: Any                  # pytree, leaves (maxsize, ...)
+    values: jax.Array            # (maxsize, nobj) raw objective values
+    filled: jax.Array            # (maxsize,) bool
+    weights: tuple = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def wvalues(self):
+        w = self.values * jnp.asarray(self.weights, self.values.dtype)
+        return jnp.where(self.filled[:, None], w, -jnp.inf)
+
+
+def _flat_genome(genome):
+    """Flatten each individual's genome leaves into one (n, D) float row for
+    equality tests."""
+    leaves = jax.tree_util.tree_leaves(genome)
+    return jnp.concatenate(
+        [l.reshape(l.shape[0], -1).astype(jnp.float32) for l in leaves], axis=1)
+
+
+def hof_init(maxsize: int, population: Population) -> _ArchiveState:
+    """Empty hall-of-fame archive shaped like ``population``'s individuals
+    (reference HallOfFame, support.py:490-588)."""
+    genome = jax.tree_util.tree_map(
+        lambda g: jnp.zeros((maxsize,) + g.shape[1:], g.dtype), population.genome)
+    return _ArchiveState(
+        genome=genome,
+        values=jnp.zeros((maxsize, population.fitness.nobj),
+                         population.fitness.values.dtype),
+        filled=jnp.zeros((maxsize,), bool),
+        weights=population.fitness.weights,
+    )
+
+
+def hof_update(state: _ArchiveState, population: Population,
+               dedup: bool = True) -> _ArchiveState:
+    """Functional HOF update: keep the lexicographically best ``maxsize``
+    individuals of archive ∪ population (reference HallOfFame.update,
+    support.py:517-540).  With ``dedup`` (the reference's ``similar=eq``),
+    exact-duplicate genomes are inserted only once.
+
+    Cost note: to stay O(pop · log pop), duplicates are eliminated among the
+    top ``4·maxsize`` candidates only — beyond that margin duplicates cannot
+    displace distinct elites in practice.
+    """
+    maxsize = state.filled.shape[0]
+    cand_n = min(4 * maxsize, population.size) if dedup else maxsize
+
+    pop_w = population.fitness.masked_wvalues()
+    top = lex_sort_indices(pop_w, descending=True)[:cand_n]
+    cand = population.take(top)
+
+    all_genome = jax.tree_util.tree_map(
+        lambda a, b: jnp.concatenate([a, b], 0), state.genome, cand.genome)
+    all_values = jnp.concatenate([state.values, cand.fitness.values], 0)
+    all_filled = jnp.concatenate([state.filled, cand.fitness.valid[:cand_n]], 0)
+    w = all_values * jnp.asarray(state.weights, all_values.dtype)
+    w = jnp.where(all_filled[:, None], w, -jnp.inf)
+
+    order = lex_sort_indices(w, descending=True)
+    sorted_genome = jax.tree_util.tree_map(lambda g: g[order], all_genome)
+    sorted_values = all_values[order]
+    sorted_filled = all_filled[order]
+
+    if dedup:
+        flat = _flat_genome(sorted_genome)
+        m = flat.shape[0]
+        same = jnp.all(flat[:, None, :] == flat[None, :, :], -1)
+        earlier = jnp.tril(jnp.ones((m, m), bool), k=-1)
+        is_dup = jnp.any(same & earlier & sorted_filled[None, :], axis=1)
+        keep = sorted_filled & ~is_dup
+        reorder = jnp.argsort(~keep, stable=True)
+        sorted_genome = jax.tree_util.tree_map(lambda g: g[reorder], sorted_genome)
+        sorted_values = sorted_values[reorder]
+        sorted_filled = keep[reorder]
+
+    return _ArchiveState(
+        genome=jax.tree_util.tree_map(lambda g: g[:maxsize], sorted_genome),
+        values=sorted_values[:maxsize],
+        filled=sorted_filled[:maxsize],
+        weights=state.weights,
+    )
+
+
+def pareto_init(maxsize: int, population: Population) -> _ArchiveState:
+    """Empty Pareto archive (reference ParetoFront, support.py:591-640; the
+    reference grows without bound — here capacity is static, pruned by
+    crowding distance when full)."""
+    return hof_init(maxsize, population)
+
+
+def pareto_update(state: _ArchiveState, population: Population) -> _ArchiveState:
+    """Keep the non-dominated subset of archive ∪ population, dropping
+    crowding-poorest points when over capacity."""
+    from ..ops.emo import nondominated_ranks, assign_crowding_dist
+
+    maxsize = state.filled.shape[0]
+    pop_w = population.fitness.masked_wvalues()
+    # preselect the population's own nondominated subset, capped at maxsize
+    ranks_p, _ = nondominated_ranks(pop_w)
+    dist_p = assign_crowding_dist(population.fitness.values, ranks_p)
+    order_p = jnp.lexsort((-dist_p, ranks_p))[:maxsize]
+    cand = population.take(order_p)
+    cand_first = ranks_p[order_p] == 0
+    cand_valid = cand.fitness.valid & cand_first
+
+    all_genome = jax.tree_util.tree_map(
+        lambda a, b: jnp.concatenate([a, b], 0), state.genome, cand.genome)
+    all_values = jnp.concatenate([state.values, cand.fitness.values], 0)
+    all_filled = jnp.concatenate([state.filled, cand_valid], 0)
+    w = all_values * jnp.asarray(state.weights, all_values.dtype)
+    w = jnp.where(all_filled[:, None], w, -jnp.inf)
+
+    # nondominated among the union; exact-duplicate wvalue rows keep one copy
+    dom = dominates(w[:, None, :], w[None, :, :])
+    dominated = jnp.any(dom & all_filled[:, None], axis=0)
+    m = w.shape[0]
+    same = jnp.all(w[:, None, :] == w[None, :, :], -1)
+    earlier = jnp.tril(jnp.ones((m, m), bool), k=-1)
+    is_dup = jnp.any(same & earlier & all_filled[None, :], axis=1)
+    keep = all_filled & ~dominated & ~is_dup
+
+    ranks = jnp.where(keep, 0, 1).astype(jnp.int32)
+    dist = assign_crowding_dist(all_values, ranks)
+    order = jnp.lexsort((-jnp.where(keep, dist, -jnp.inf), ~keep))
+    return _ArchiveState(
+        genome=jax.tree_util.tree_map(lambda g: g[order][:maxsize], all_genome),
+        values=all_values[order][:maxsize],
+        filled=keep[order][:maxsize],
+        weights=state.weights,
+    )
+
+
+class HallOfFame:
+    """Host wrapper over the device HOF kernels, API-compatible with the
+    reference (support.py:490-588): ``update``, ``insert``-free iteration,
+    ``__getitem__`` returning ``(genome, values)`` pairs, ``clear``."""
+
+    _update_fn = staticmethod(hof_update)
+    _init_fn = staticmethod(hof_init)
+
+    def __init__(self, maxsize: int, similar: Callable | None = eq):
+        self.maxsize = maxsize
+        self.similar = similar
+        self.state: _ArchiveState | None = None
+
+    def init_state(self, population: Population) -> _ArchiveState:
+        self.state = self._init_fn(self.maxsize, population)
+        return self.state
+
+    def update(self, population: Population):
+        if self.state is None:
+            self.init_state(population)
+        if type(self)._update_fn is hof_update:
+            self.state = hof_update(self.state, population,
+                                    dedup=self.similar is not None)
+        else:
+            self.state = type(self)._update_fn(self.state, population)
+        return self.state
+
+    def clear(self):
+        self.state = None
+
+    def __len__(self):
+        if self.state is None:
+            return 0
+        return int(np.sum(np.asarray(self.state.filled)))
+
+    def __getitem__(self, i):
+        genome = jax.tree_util.tree_map(lambda g: np.asarray(g)[i], self.state.genome)
+        return genome, np.asarray(self.state.values)[i]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    @property
+    def keys(self):
+        return np.asarray(self.state.values)[: len(self)]
+
+
+class ParetoFront(HallOfFame):
+    """Host wrapper over the Pareto archive kernels (reference ParetoFront,
+    support.py:591-640)."""
+
+    _update_fn = staticmethod(pareto_update)
+    _init_fn = staticmethod(pareto_init)
+
+    def __init__(self, maxsize: int = 1024, similar: Callable | None = eq):
+        super().__init__(maxsize, similar)
+
+
+class History:
+    """Genealogy recorder (reference History, support.py:21-152).  Host-side:
+    snapshots flow through ``update`` with explicit parent indices (array
+    programs know lineage by index, not object identity).  Produces the same
+    ``genealogy_tree``/``genealogy_history`` structures, consumable by
+    NetworkX."""
+
+    def __init__(self):
+        self.genealogy_index = 0
+        self.genealogy_history: Dict[int, Any] = {}
+        self.genealogy_tree: Dict[int, tuple] = {}
+        self._latest: np.ndarray | None = None   # per-slot history index
+
+    def update(self, genomes, parent_slots=None):
+        """Record a population snapshot.  ``genomes``: pytree with leading
+        pop axis (host or device).  ``parent_slots``: optional (pop, nparents)
+        slot indices into the *previous* snapshot."""
+        flat = jax.tree_util.tree_leaves(genomes)[0]
+        n = np.asarray(flat).shape[0]
+        new_idx = np.zeros(n, dtype=np.int64)
+        for i in range(n):
+            self.genealogy_index += 1
+            new_idx[i] = self.genealogy_index
+            self.genealogy_history[self.genealogy_index] = (
+                jax.tree_util.tree_map(lambda g: np.asarray(g)[i], genomes))
+            if parent_slots is None or self._latest is None:
+                self.genealogy_tree[self.genealogy_index] = tuple()
+            else:
+                ps = np.atleast_1d(np.asarray(parent_slots)[i])
+                self.genealogy_tree[self.genealogy_index] = tuple(
+                    int(self._latest[p]) for p in ps)
+        self._latest = new_idx
+
+    def getGenealogy(self, index: int, max_depth: float = float("inf")):
+        """Ancestor subtree of history entry ``index`` (reference
+        support.py:123-152)."""
+        gtree = {}
+        visited = set()
+
+        def walk(idx, depth):
+            if depth > max_depth or idx in visited:
+                return
+            visited.add(idx)
+            parents = self.genealogy_tree.get(idx, ())
+            gtree[idx] = list(parents)
+            for p in parents:
+                walk(p, depth + 1)
+
+        walk(index, 0)
+        return gtree

@@ -1,0 +1,16 @@
+"""Test configuration: force CPU with 8 virtual devices so mesh-sharding
+tests exercise an 8-chip topology without TPUs (SURVEY §4's distributed
+testing recommendation).  The XLA flag must be set before the backend
+initializes; the platform override must go through jax.config because the
+environment pins an accelerator plugin."""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,101 @@
+"""Core container tests (reference behavior: deap/base.py, creator.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import base
+from deap_tpu.base import Fitness, Population, dominates, lex_argmax, lex_sort_indices
+
+
+def test_toolbox_register_unregister():
+    tb = base.Toolbox()
+
+    def foo(a, b, c=3):
+        """doc"""
+        return a + b + c
+
+    tb.register("bar", foo, 2)
+    assert tb.bar.__name__ == "bar"
+    assert tb.bar.__doc__ == "doc"
+    assert tb.bar(3) == 8
+    tb.unregister("bar")
+    assert not hasattr(tb, "bar")
+
+
+def test_toolbox_decorate():
+    tb = base.Toolbox()
+    tb.register("inc", lambda x: x + 1)
+
+    def double_out(fn):
+        def wrapped(*args, **kw):
+            return 2 * fn(*args, **kw)
+        return wrapped
+
+    tb.decorate("inc", double_out)
+    assert tb.inc(3) == 8
+
+
+def test_fitness_wvalues_and_validity():
+    fit = Fitness.empty(4, weights=(-1.0, 2.0))
+    assert fit.nobj == 2
+    assert not bool(fit.valid.any())
+    vals = jnp.array([[1.0, 2.0], [3.0, 4.0], [0.5, 0.5], [2.0, 2.0]])
+    fit = fit.with_values(vals)
+    np.testing.assert_allclose(fit.wvalues, vals * jnp.array([-1.0, 2.0]))
+    assert bool(fit.valid.all())
+    fit2 = fit.invalidate(jnp.array([True, False, False, False]))
+    assert not bool(fit2.valid[0])
+    assert bool(fit2.valid[1])
+    # masked wvalues: invalid rows -> -inf
+    assert np.all(np.asarray(fit2.masked_wvalues()[0]) == -np.inf)
+
+
+def test_fitness_partial_assignment():
+    fit = Fitness.empty(3, weights=(1.0,))
+    fit = fit.with_values(jnp.ones((3, 1)), where=jnp.array([True, False, True]))
+    assert bool(fit.valid[0]) and not bool(fit.valid[1]) and bool(fit.valid[2])
+
+
+def test_dominates():
+    a = jnp.array([1.0, 1.0])
+    b = jnp.array([0.5, 1.0])
+    assert bool(dominates(a, b))
+    assert not bool(dominates(b, a))
+    assert not bool(dominates(a, a))
+
+
+def test_lex_argmax_ties():
+    w = jnp.array([[1.0, 0.0], [1.0, 2.0], [0.5, 9.9]])
+    assert int(lex_argmax(w)) == 1
+
+
+def test_lex_sort_indices():
+    w = jnp.array([[1.0, 5.0], [2.0, 0.0], [1.0, 7.0]])
+    idx = np.asarray(lex_sort_indices(w, descending=True))
+    assert idx[0] == 1          # highest first objective
+    assert idx[1] == 2          # tie on first -> higher second
+    assert idx[2] == 0
+
+
+def test_population_take_concat():
+    genome = jnp.arange(12).reshape(4, 3)
+    pop = Population(genome=genome, fitness=Fitness.empty(4, (1.0,)))
+    sub = pop.take(jnp.array([2, 0]))
+    np.testing.assert_array_equal(np.asarray(sub.genome), [[6, 7, 8], [0, 1, 2]])
+    both = sub.concat(sub)
+    assert both.size == 4
+
+
+def test_creator():
+    from deap_tpu import creator
+    fmax = creator.create("TFitnessMax", weights=(1.0,))
+    spec = creator.create("TIndividual", fitness=fmax)
+    key = jax.random.PRNGKey(0)
+    from deap_tpu.ops import init as init_ops
+    pop = spec.init_population(key, 10, init_ops.bernoulli(0.5, (20,)))
+    assert pop.size == 10
+    assert pop.fitness.weights == (1.0,)
+    with pytest.warns(RuntimeWarning):
+        creator.create("TFitnessMax", weights=(1.0,))

@@ -1,0 +1,92 @@
+"""End-to-end OneMax GA — the reference's canonical README example
+(examples/ga/onemax.py: 100 bits, pop 300, cxTwoPoint, mutFlipBit 5%,
+tournament 3, cxpb 0.5, mutpb 0.2; converges to 100 typically in ~40
+generations)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deap_tpu import base, algorithms
+from deap_tpu.ops import crossover, mutation, selection, init as init_ops
+from deap_tpu.utils.support import Statistics, HallOfFame
+
+
+def make_toolbox():
+    toolbox = base.Toolbox()
+    toolbox.register("evaluate", lambda g: (jnp.sum(g).astype(jnp.float32),))
+    toolbox.register("mate", crossover.cx_two_point)
+    toolbox.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    toolbox.register("select", selection.sel_tournament, tournsize=3)
+    return toolbox
+
+
+def init_pop(key, n=300, nbits=100):
+    genome = jax.vmap(init_ops.bernoulli(0.5, (nbits,)))(jax.random.split(key, n))
+    return base.Population(genome=genome, fitness=base.Fitness.empty(n, (1.0,)))
+
+
+def test_onemax_converges():
+    key = jax.random.PRNGKey(42)
+    k_init, k_run = jax.random.split(key)
+    pop = init_pop(k_init)
+    toolbox = make_toolbox()
+
+    stats = Statistics(key=lambda p: p.fitness.values[:, 0])
+    stats.register("max", jnp.max)
+    stats.register("avg", jnp.mean)
+    hof = HallOfFame(1)
+
+    pop, logbook = algorithms.ea_simple(
+        k_run, pop, toolbox, cxpb=0.5, mutpb=0.2, ngen=60,
+        stats=stats, halloffame=hof)
+
+    best = float(np.max(np.asarray(pop.fitness.values[:, 0])))
+    assert best == 100.0, f"OneMax did not converge: best={best}"
+    # hall of fame carries the best individual
+    genome, values = hof[0]
+    assert values[0] == 100.0
+    assert np.asarray(genome).sum() == 100
+    # logbook has gen 0..60 with nevals
+    assert len(logbook) == 61
+    assert logbook[0]["gen"] == 0
+    assert logbook[-1]["gen"] == 60
+    maxes = logbook.select("max")
+    assert maxes[-1] == 100.0
+    assert maxes[0] <= maxes[-1]
+
+
+def test_onemax_mu_plus_lambda():
+    key = jax.random.PRNGKey(7)
+    k_init, k_run = jax.random.split(key)
+    pop = init_pop(k_init, n=100)
+    toolbox = make_toolbox()
+    pop, logbook = algorithms.ea_mu_plus_lambda(
+        k_run, pop, toolbox, mu=100, lambda_=200, cxpb=0.4, mutpb=0.4, ngen=40)
+    best = float(np.max(np.asarray(pop.fitness.values[:, 0])))
+    assert best >= 95.0
+
+
+def test_onemax_mu_comma_lambda():
+    key = jax.random.PRNGKey(9)
+    k_init, k_run = jax.random.split(key)
+    pop = init_pop(k_init, n=100)
+    toolbox = make_toolbox()
+    pop, logbook = algorithms.ea_mu_comma_lambda(
+        k_run, pop, toolbox, mu=100, lambda_=200, cxpb=0.4, mutpb=0.4, ngen=40)
+    best = float(np.max(np.asarray(pop.fitness.values[:, 0])))
+    assert best >= 90.0
+
+
+def test_var_and_invalidates_only_touched():
+    key = jax.random.PRNGKey(0)
+    pop = init_pop(jax.random.PRNGKey(1), n=20, nbits=10)
+    toolbox = make_toolbox()
+    from deap_tpu.algorithms import evaluate_population
+    pop, _ = evaluate_population(toolbox, pop)
+    assert bool(pop.fitness.valid.all())
+    off = algorithms.var_and(key, pop, toolbox, cxpb=0.0, mutpb=0.0)
+    # nothing touched -> everything still valid
+    assert bool(off.fitness.valid.all())
+    off = algorithms.var_and(key, pop, toolbox, cxpb=1.0, mutpb=1.0)
+    assert not bool(off.fitness.valid.any())
