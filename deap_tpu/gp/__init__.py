@@ -1,0 +1,68 @@
+"""Genetic Programming engine — TPU-native equivalent of ``deap/gp.py``.
+
+The reference represents programs as Python object trees compiled through
+``eval`` (gp.py:460-485).  Here a program is a fixed-capacity prefix token
+array ``(codes, consts, length)`` evaluated by a vmapped stack machine
+(:mod:`.interp`), generated (:mod:`.generate`) and varied (:mod:`.variation`)
+by jitted index arithmetic — the whole GP generation loop compiles to one
+XLA program.
+
+Standard protected primitives (the ones every reference example registers,
+e.g. examples/gp/symbreg.py) are provided in :data:`safe_ops`.
+"""
+
+import jax.numpy as jnp
+
+from .pset import (Primitive, Terminal, Ephemeral, Argument,
+                   PrimitiveSetTyped, PrimitiveSet, FrozenPSet)  # noqa: F401
+from .interp import (make_evaluator, make_population_evaluator,
+                     compile_tree)  # noqa: F401
+from .generate import (make_generator, gen_full, gen_grow,
+                       gen_half_and_half)  # noqa: F401
+from .variation import (cx_one_point, cx_one_point_leaf_biased, mut_uniform,
+                        mut_node_replacement, mut_ephemeral, mut_insert,
+                        mut_shrink, static_limit, subtree_bounds,
+                        node_depths, tree_height)  # noqa: F401
+from .tree import to_string, from_string, graph  # noqa: F401
+
+# camelCase aliases (reference API names)
+compile = compile_tree
+genFull = gen_full
+genGrow = gen_grow
+genHalfAndHalf = gen_half_and_half
+cxOnePoint = cx_one_point
+cxOnePointLeafBiased = cx_one_point_leaf_biased
+mutUniform = mut_uniform
+mutNodeReplacement = mut_node_replacement
+mutEphemeral = mut_ephemeral
+mutInsert = mut_insert
+mutShrink = mut_shrink
+staticLimit = static_limit
+
+
+def protected_div(left, right):
+    """Protected division -> 1 on |denominator| ~ 0 (the convention of the
+    reference's symbreg examples)."""
+    return jnp.where(jnp.abs(right) > 1e-9, left / jnp.where(
+        jnp.abs(right) > 1e-9, right, 1.0), 1.0)
+
+
+def protected_log(x):
+    return jnp.log(jnp.maximum(jnp.abs(x), 1e-9))
+
+
+def protected_sqrt(x):
+    return jnp.sqrt(jnp.abs(x))
+
+
+safe_ops = {
+    "add": (jnp.add, 2),
+    "sub": (jnp.subtract, 2),
+    "mul": (jnp.multiply, 2),
+    "div": (protected_div, 2),
+    "neg": (jnp.negative, 1),
+    "cos": (jnp.cos, 1),
+    "sin": (jnp.sin, 1),
+    "log": (protected_log, 1),
+    "sqrt": (protected_sqrt, 1),
+}

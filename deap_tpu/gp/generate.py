@@ -1,0 +1,160 @@
+"""GP tree generation — array-native equivalents of ``genFull``/``genGrow``/
+``genHalfAndHalf`` (reference gp.py:517-633).
+
+The reference generates trees with a Python loop over a typed stack
+(``generate``, gp.py:587-633).  Here the same typed-stack algorithm runs as
+a ``lax.while_loop`` emitting prefix tokens into a fixed-capacity buffer, so
+*whole populations* of random trees generate inside one jitted program
+(initialization, and crucially ``mutUniform``'s random subtrees inside the
+evolution loop).
+
+Capacity safety: when the emitted length plus outstanding slots approaches
+``cap``, the generator forces terminals — trees always fit the buffer (the
+reference instead grows unbounded Python lists)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .pset import PrimitiveSetTyped
+
+__all__ = ["make_generator", "gen_full", "gen_grow", "gen_half_and_half"]
+
+
+def make_generator(pset, cap: int, kind: str = "half_and_half") -> Callable:
+    """Build ``gen(key, min_depth, max_depth, ret_type=None) ->
+    (codes, consts, length)``.
+
+    ``kind``: "full" (terminals only at max depth, reference gp.py:517-535),
+    "grow" (terminals allowed from min depth per terminal ratio, reference
+    gp.py:537-558), or "half_and_half" (coin flip per tree, gp.py:560-575).
+    min/max depth must be static ints; ``ret_type`` may be a traced type id
+    (typed ``mutUniform`` passes the replaced subtree's type, reference
+    gp.py:750).
+
+    Raises at construction if any reachable argument type has no terminal —
+    such a set cannot bound tree depth (the reference raises IndexError at
+    generation time instead, gp.py:612-617)."""
+    f = pset.freeze() if isinstance(pset, PrimitiveSetTyped) else pset
+    term_cnt_np = f.term_by_type[1]
+    reachable = {f.pset.ret}
+    for i in range(f.n_nodes):
+        if f.is_primitive[i]:
+            reachable.update(int(t) for t in f.in_types[i, :f.arity[i]])
+    missing = [t for t in reachable if term_cnt_np[t] == 0]
+    if missing:
+        raise ValueError(
+            f"The primitive set has no terminal for type id(s) {missing}; "
+            "tree generation cannot terminate. Add a terminal of that type "
+            "(reference gp.generate raises IndexError for this, "
+            "gp.py:612-617).")
+
+    prim_arr, prim_cnt = (jnp.asarray(f.prim_by_type[0]),
+                          jnp.asarray(f.prim_by_type[1]))
+    term_arr, term_cnt = (jnp.asarray(f.term_by_type[0]),
+                          jnp.asarray(f.term_by_type[1]))
+    arity = jnp.asarray(f.arity)
+    in_types = jnp.asarray(f.in_types)
+    const_fns = f.const_fns
+    ret_type = f.pset.ret
+    max_arity = max(f.max_arity, 1)
+    terminal_ratio = f.terminal_ratio
+
+    def gen_one(key, min_depth: int, max_depth: int, ret_type=ret_type,
+                force_grow=None):
+        k_height, k_kind, key = jax.random.split(key, 3)
+        height = jax.random.randint(k_height, (), min_depth, max_depth + 1)
+        if kind == "full":
+            grow = jnp.asarray(False)
+        elif kind == "grow":
+            grow = jnp.asarray(True)
+        else:
+            grow = jax.random.bernoulli(k_kind, 0.5)
+        if force_grow is not None:
+            grow = force_grow
+
+        codes0 = jnp.zeros((cap,), jnp.int32)
+        consts0 = jnp.zeros((cap,), jnp.float32)
+        # typed stack of required (type, depth)
+        st_type0 = jnp.zeros((cap + max_arity,), jnp.int32).at[0].set(ret_type)
+        st_depth0 = jnp.zeros((cap + max_arity,), jnp.int32)
+
+        def cond(state):
+            _, _, pos, _, _, sp, _ = state
+            return (sp > 0) & (pos < cap)
+
+        def body(state):
+            codes, consts, pos, st_type, st_depth, sp, key = state
+            key, k_term, k_pick, k_const = jax.random.split(key, 4)
+            t = st_type[sp - 1]
+            d = st_depth[sp - 1]
+            sp = sp - 1
+
+            has_prim = prim_cnt[t] > 0
+            has_term = term_cnt[t] > 0
+            # reference genFull: terminal iff depth == height;
+            # genGrow: depth == height or (depth >= min and u < ratio)
+            at_bottom = d >= height
+            grow_term = (d >= min_depth) & (
+                jax.random.uniform(k_term) < terminal_ratio)
+            want_term = at_bottom | (grow & grow_term)
+            # capacity guard: outstanding slots must still fit
+            must_term = (pos + sp + max_arity) >= cap
+            choose_term = (want_term & has_term) | must_term | ~has_prim
+
+            tpick = jax.random.randint(k_pick, (), 0,
+                                       jnp.maximum(term_cnt[t], 1))
+            ppick = jax.random.randint(k_pick, (), 0,
+                                       jnp.maximum(prim_cnt[t], 1))
+            code = jnp.where(choose_term, term_arr[t, tpick],
+                             prim_arr[t, ppick])
+            const = lax.switch(code, const_fns, k_const)
+            codes = codes.at[pos].set(code)
+            consts = consts.at[pos].set(const)
+
+            # push chosen primitive's argument types, right-to-left so the
+            # leftmost child pops first (prefix order): reversed args occupy
+            # rows sp .. sp+a-1 with types in_types[code, a-1-j]
+            a = arity[code]
+            j = jnp.arange(max_arity)
+            push_rows = sp + j
+            real = j < a
+            arg_types_for_rows = in_types[code, jnp.clip(a - 1 - j, 0,
+                                                         max_arity - 1)]
+            st_type = st_type.at[jnp.where(real, push_rows,
+                                           cap + max_arity - 1)].set(
+                jnp.where(real, arg_types_for_rows, st_type[-1]))
+            st_depth = st_depth.at[jnp.where(real, push_rows,
+                                             cap + max_arity - 1)].set(
+                jnp.where(real, d + 1, st_depth[-1]))
+            sp = sp + a
+            return codes, consts, pos + 1, st_type, st_depth, sp, key
+
+        codes, consts, pos, _, _, _, _ = lax.while_loop(
+            cond, body,
+            (codes0, consts0, jnp.int32(0), st_type0, st_depth0,
+             jnp.int32(1), key))
+        return codes, consts, pos
+
+    return gen_one
+
+
+def gen_full(key, pset, min_, max_, cap: int = 64):
+    """One full-method tree (reference genFull, gp.py:517-535)."""
+    return make_generator(pset, cap, "full")(key, min_, max_)
+
+
+def gen_grow(key, pset, min_, max_, cap: int = 64):
+    """One grow-method tree (reference genGrow, gp.py:537-558)."""
+    return make_generator(pset, cap, "grow")(key, min_, max_)
+
+
+def gen_half_and_half(key, pset, min_, max_, cap: int = 64):
+    """Ramped half-and-half (reference genHalfAndHalf, gp.py:560-575)."""
+    return make_generator(pset, cap, "half_and_half")(key, min_, max_)

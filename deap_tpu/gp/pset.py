@@ -1,0 +1,314 @@
+"""Primitive sets — array-native equivalent of the reference's
+``PrimitiveSetTyped``/``PrimitiveSet`` (gp.py:258-454).
+
+The reference registers primitives/terminals into dicts and compiles trees
+by building Python source and ``eval``-ing it (gp.py:460-485).  Here the
+registry is *compiled to static tables* when frozen:
+
+* a node table (one integer code per primitive/terminal/ephemeral/argument),
+* arity / return-type / argument-type arrays,
+* per-type candidate lists for generation,
+* a tuple of jax op callables, one per node, dispatched by ``lax.switch``
+  inside the stack-machine interpreter (:mod:`deap_tpu.gp.interp`).
+
+Trees are then triples ``(codes, consts, length)`` of fixed-capacity arrays
+(prefix/depth-first order, exactly the reference's flat-list layout,
+gp.py:61-86) and every GP operation is index arithmetic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Primitive", "Terminal", "Ephemeral", "Argument",
+           "PrimitiveSetTyped", "PrimitiveSet"]
+
+
+@dataclasses.dataclass
+class Primitive:
+    """An operator node (reference Primitive, gp.py:185-211)."""
+    name: str
+    arity: int
+    func: Callable                       # (args: (max_arity, n), const) -> (n,)
+    ret_type: int
+    in_types: tuple
+    fmt: str | None = None               # e.g. "({0} + {1})"
+
+    def format(self, *args):
+        if self.fmt is not None:
+            return self.fmt.format(*args)
+        return f"{self.name}({', '.join(args)})"
+
+
+@dataclasses.dataclass
+class Terminal:
+    """A constant-valued leaf (reference Terminal, gp.py:214-238)."""
+    name: str
+    value: float
+    ret_type: int
+
+    def format(self):
+        return self.name
+
+
+@dataclasses.dataclass
+class Ephemeral:
+    """A random-constant leaf: the value is drawn per occurrence at
+    generation time and then mutated in place (reference Ephemeral,
+    gp.py:241-255).  ``sampler(key) -> float`` replaces the reference's
+    zero-arg ``random`` lambdas."""
+    name: str
+    sampler: Callable
+    ret_type: int
+
+
+@dataclasses.dataclass
+class Argument:
+    """An input-variable leaf (the reference's ARGx terminals,
+    gp.py:286-294)."""
+    name: str
+    index: int
+    ret_type: int
+
+
+class PrimitiveSetTyped:
+    """Typed primitive registry (reference PrimitiveSetTyped, gp.py:258-427).
+
+    Types are arbitrary hashables mapped to small ints internally.  After
+    all registrations, :meth:`freeze` compiles the static tables; the
+    interpreter and generators take the frozen set.
+    """
+
+    def __init__(self, name: str, in_types: Sequence[Any], ret_type: Any,
+                 prefix: str = "ARG"):
+        self.name = name
+        self._type_ids: dict = {}
+        self.ret = self._type_id(ret_type)
+        self.ins = [self._type_id(t) for t in in_types]
+        self.prefix = prefix
+        self.primitives: list[Primitive] = []
+        self.terminals: list[Terminal] = []
+        self.ephemerals: list[Ephemeral] = []
+        self.arguments: list[Argument] = []
+        self.mapping: dict[str, Any] = {}
+        for i, t in enumerate(self.ins):
+            arg = Argument(f"{prefix}{i}", i, t)
+            self.arguments.append(arg)
+            self.mapping[arg.name] = arg
+        self._frozen = None
+
+    # -- type bookkeeping ---------------------------------------------------
+    def _type_id(self, t) -> int:
+        if t not in self._type_ids:
+            self._type_ids[t] = len(self._type_ids)
+        return self._type_ids[t]
+
+    @property
+    def n_types(self) -> int:
+        return len(self._type_ids)
+
+    # -- registration (reference addPrimitive/addTerminal/addEphemeralConstant,
+    #    gp.py:297-383) ------------------------------------------------------
+    def _check_name(self, name):
+        if name in self.mapping:
+            raise ValueError(
+                f"Primitives are required to have a unique name. "
+                f"Consider using the argument 'name' to rename your "
+                f"second '{name}' primitive.")
+
+    def add_primitive(self, func: Callable, in_types: Sequence[Any],
+                      ret_type: Any, name: str | None = None,
+                      fmt: str | None = None):
+        """``func`` is a natural jnp function of ``arity`` array arguments,
+        each ``(n_points,)``, returning ``(n_points,)`` — e.g.
+        ``jnp.add`` or ``lambda a, b: jnp.where(jnp.abs(b) > 1e-9, a / b,
+        1.0)`` for protected division."""
+        name = name or getattr(func, "__name__", f"prim{len(self.primitives)}")
+        self._check_name(name)
+        prim = Primitive(name, len(in_types), func,
+                         self._type_id(ret_type),
+                         tuple(self._type_id(t) for t in in_types), fmt)
+        self.primitives.append(prim)
+        self.mapping[name] = prim
+        self._frozen = None
+        return prim
+
+    def add_terminal(self, value: float, ret_type: Any, name: str | None = None):
+        name = name or str(value)
+        self._check_name(name)
+        term = Terminal(name, float(value), self._type_id(ret_type))
+        self.terminals.append(term)
+        self.mapping[name] = term
+        self._frozen = None
+        return term
+
+    def add_ephemeral_constant(self, name: str, sampler: Callable, ret_type: Any):
+        """``sampler(key) -> scalar`` (jax); reference gp.py:348-383."""
+        self._check_name(name)
+        eph = Ephemeral(name, sampler, self._type_id(ret_type))
+        self.ephemerals.append(eph)
+        self.mapping[name] = eph
+        self._frozen = None
+        return eph
+
+    # camelCase aliases matching the reference API
+    addPrimitive = add_primitive
+    addTerminal = add_terminal
+    addEphemeralConstant = add_ephemeral_constant
+
+    # -- freezing -----------------------------------------------------------
+    @property
+    def nodes(self) -> list:
+        """Node table: primitives, then terminals, ephemerals, arguments —
+        a node's position is its integer code."""
+        return (list(self.primitives) + list(self.terminals)
+                + list(self.ephemerals) + list(self.arguments))
+
+    def freeze(self) -> "FrozenPSet":
+        if self._frozen is None:
+            self._frozen = FrozenPSet(self)
+        return self._frozen
+
+
+class PrimitiveSet(PrimitiveSetTyped):
+    """Untyped facade: every type is ``object`` (reference PrimitiveSet,
+    gp.py:430-454)."""
+
+    def __init__(self, name: str, arity: int, prefix: str = "ARG"):
+        super().__init__(name, [object] * arity, object, prefix)
+
+    def add_primitive(self, func, arity: int | Sequence = None, name=None,
+                      fmt=None):
+        if isinstance(arity, int):
+            in_types = [object] * arity
+        else:
+            in_types = arity
+        return super().add_primitive(func, in_types, object, name, fmt)
+
+    def add_terminal(self, value, name=None):
+        return super().add_terminal(value, object, name)
+
+    def add_ephemeral_constant(self, name, sampler):
+        return super().add_ephemeral_constant(name, sampler, object)
+
+    addPrimitive = add_primitive
+    addTerminal = add_terminal
+    addEphemeralConstant = add_ephemeral_constant
+
+
+class FrozenPSet:
+    """Static tables compiled from a PrimitiveSet — everything the jitted
+    interpreter/generators need, as numpy constants baked into the trace."""
+
+    def __init__(self, pset: PrimitiveSetTyped):
+        self.pset = pset
+        nodes = pset.nodes
+        self.n_nodes = len(nodes)
+        self.names = [getattr(n, "name") for n in nodes]
+        self.arity = np.array(
+            [n.arity if isinstance(n, Primitive) else 0 for n in nodes],
+            np.int32)
+        self.max_arity = int(self.arity.max()) if len(nodes) else 0
+        self.ret_type = np.array([n.ret_type for n in nodes], np.int32)
+        self.is_primitive = np.array(
+            [isinstance(n, Primitive) for n in nodes], bool)
+        self.is_terminal = ~self.is_primitive
+        self.is_ephemeral = np.array(
+            [isinstance(n, Ephemeral) for n in nodes], bool)
+        self.is_argument = np.array(
+            [isinstance(n, Argument) for n in nodes], bool)
+        self.arg_index = np.array(
+            [n.index if isinstance(n, Argument) else 0 for n in nodes],
+            np.int32)
+        self.const_value = np.array(
+            [n.value if isinstance(n, Terminal) else 0.0 for n in nodes],
+            np.float32)
+        # child types padded to max_arity
+        self.in_types = np.zeros((self.n_nodes, max(self.max_arity, 1)),
+                                 np.int32)
+        for i, n in enumerate(nodes):
+            if isinstance(n, Primitive):
+                self.in_types[i, :n.arity] = n.in_types
+
+        # per-type candidate lists (for generation): padded code arrays
+        nt = pset.n_types
+        self.prim_by_type = _candidates(
+            nt, [(i, n.ret_type) for i, n in enumerate(nodes)
+                 if isinstance(n, Primitive)])
+        self.term_by_type = _candidates(
+            nt, [(i, n.ret_type) for i, n in enumerate(nodes)
+                 if not isinstance(n, Primitive)])
+        # terminal ratio (reference pset.terminalRatio, gp.py:420-426)
+        n_term = int(self.is_terminal.sum())
+        self.terminal_ratio = n_term / max(1, self.n_nodes)
+
+        # ephemeral samplers table aligned with codes
+        self.eph_samplers = [
+            n.sampler if isinstance(n, Ephemeral) else None for n in nodes]
+
+        # which primitives have terminals available for every argument type
+        # (guards the padded candidate tables: gathering from an empty
+        # bucket would silently return code 0)
+        term_cnt = self.term_by_type[1]
+        self.args_have_terminals = np.array([
+            all(term_cnt[t] > 0 for t in n.in_types)
+            if isinstance(n, Primitive) else True
+            for n in nodes])
+        self._const_fns = None
+        self._device_tables = None
+
+        # jax ops for the interpreter: one callable per node code
+        def make_op(i, n):
+            if isinstance(n, Primitive):
+                k = n.arity
+                fn = n.func
+                return lambda args, const, X: fn(*(args[j] for j in range(k)))
+            if isinstance(n, Argument):
+                k = n.index
+                return lambda args, const, X: X[k]
+            # Terminal / Ephemeral: the per-node stored constant
+            return lambda args, const, X: jnp.broadcast_to(const, X.shape[1:])
+        self.ops = tuple(make_op(i, n) for i, n in enumerate(nodes))
+
+    def code_of(self, name: str) -> int:
+        return self.names.index(name)
+
+    @property
+    def const_fns(self):
+        """Cached per-code constant samplers for ``lax.switch`` (ephemerals
+        draw from their sampler; other nodes return their static value).
+        Cached so repeated operator calls reuse the same callables and jit
+        traces hit the cache."""
+        if self._const_fns is None:
+            fns = []
+            for i in range(self.n_nodes):
+                if self.eph_samplers[i] is not None:
+                    sampler = self.eph_samplers[i]
+                    fns.append(lambda key, s=sampler:
+                               jnp.asarray(s(key), jnp.float32))
+                else:
+                    v = float(self.const_value[i])
+                    fns.append(lambda key, v=v: jnp.asarray(v, jnp.float32))
+            self._const_fns = tuple(fns)
+        return self._const_fns
+
+
+def _candidates(n_types: int, pairs):
+    """pairs: (code, type) -> padded (n_types, max_count) array + counts."""
+    buckets = [[] for _ in range(max(n_types, 1))]
+    for code, t in pairs:
+        buckets[t].append(code)
+    width = max((len(b) for b in buckets), default=0)
+    width = max(width, 1)
+    arr = np.zeros((max(n_types, 1), width), np.int32)
+    cnt = np.zeros(max(n_types, 1), np.int32)
+    for t, b in enumerate(buckets):
+        cnt[t] = len(b)
+        for j, c in enumerate(b):
+            arr[t, j] = c
+    return arr, cnt

@@ -1,0 +1,123 @@
+"""Host-side GP tree utilities: string round-trip and graph export —
+equivalents of the reference's ``PrimitiveTree.__str__`` (gp.py:88-102),
+``from_string`` (gp.py:104-151) and ``graph`` (gp.py:1133-1203).
+
+Device code never needs these; they serve logging, debugging, tests and
+visualization of ``(codes, consts, length)`` prefix arrays."""
+
+from __future__ import annotations
+
+import re
+from typing import Sequence
+
+import numpy as np
+
+from .pset import (FrozenPSet, Primitive, Terminal, Ephemeral, Argument,
+                   PrimitiveSetTyped)
+
+__all__ = ["to_string", "from_string", "graph"]
+
+
+def _f(pset):
+    return pset.freeze() if isinstance(pset, PrimitiveSetTyped) else pset
+
+
+def to_string(tree, pset) -> str:
+    """Prefix array -> readable expression (reference __str__,
+    gp.py:88-102, same stack algorithm)."""
+    f = _f(pset)
+    codes, consts, length = tree
+    codes = np.asarray(codes)
+    consts = np.asarray(consts)
+    length = int(length)
+    string = ""
+    stack = []
+    for i in range(length):
+        c = int(codes[i])
+        node = f.pset.nodes[c]
+        stack.append((c, i, []))
+        while len(stack[-1][2]) == int(f.arity[stack[-1][0]]):
+            c2, pos, args = stack.pop()
+            n2 = f.pset.nodes[c2]
+            if isinstance(n2, Primitive):
+                string = n2.format(*args)
+            elif isinstance(n2, Ephemeral):
+                string = repr(float(consts[pos]))
+            elif isinstance(n2, Terminal):
+                string = n2.format()
+            else:
+                string = n2.name
+            if len(stack) == 0:
+                break
+            stack[-1][2].append(string)
+    return string
+
+
+def from_string(string: str, pset, cap: int = 64):
+    """Expression string -> prefix arrays (reference from_string,
+    gp.py:104-151).  Accepts primitive/terminal/argument names and numeric
+    literals (which become per-node constants on the first ephemeral code,
+    or anonymous constants when the set has none)."""
+    f = _f(pset)
+    tokens = re.split(r"[ \t\n\r\f\v(),]", string)
+    codes, consts = [], []
+    name_to_code = {n: i for i, n in enumerate(f.names)}
+    eph_codes = [i for i in range(f.n_nodes) if f.is_ephemeral[i]]
+    for tok in tokens:
+        if tok == "":
+            continue
+        if tok in name_to_code:
+            c = name_to_code[tok]
+            codes.append(c)
+            consts.append(float(f.const_value[c]))
+        else:
+            try:
+                val = float(tok)
+            except ValueError:
+                raise TypeError(
+                    f"Unable to find symbol {tok!r} in {f.pset.name}.")
+            if not eph_codes:
+                raise TypeError(
+                    f"Numeric literal {tok} requires an ephemeral constant "
+                    "in the primitive set.")
+            codes.append(eph_codes[0])
+            consts.append(val)
+    length = len(codes)
+    if length > cap:
+        raise ValueError(f"expression has {length} nodes > capacity {cap}")
+    codes_arr = np.zeros(cap, np.int32)
+    consts_arr = np.zeros(cap, np.float32)
+    codes_arr[:length] = codes
+    consts_arr[:length] = consts
+    return codes_arr, consts_arr, np.int32(length)
+
+
+def graph(tree, pset):
+    """(nodes, edges, labels) for NetworkX/pygraphviz rendering (reference
+    graph, gp.py:1133-1203)."""
+    f = _f(pset)
+    codes, consts, length = tree
+    codes = np.asarray(codes)
+    consts = np.asarray(consts)
+    length = int(length)
+    nodes = list(range(length))
+    edges = []
+    labels = {}
+    stack = []
+    for i in range(length):
+        c = int(codes[i])
+        node = f.pset.nodes[c]
+        if stack:
+            edges.append((stack[-1][0], i))
+            stack[-1][1] -= 1
+        if isinstance(node, Ephemeral):
+            labels[i] = round(float(consts[i]), 4)
+        else:
+            labels[i] = node.name
+        a = int(f.arity[c])
+        if a > 0:
+            stack.append([i, a])
+        else:
+            while stack and stack[-1][1] == 0:
+                stack.pop()
+    return nodes, edges, labels

@@ -1,0 +1,388 @@
+"""GP variation operators — array-native equivalents of the reference's
+subtree crossover/mutations (gp.py:640-882) and the ``staticLimit`` bloat
+decorator (gp.py:885-926).
+
+The reference's ``searchSubtree`` slice finder (gp.py:172-182) becomes pure
+index arithmetic: for prefix arrays the subtree rooted at ``i`` ends at the
+first ``j >= i`` where ``cumsum(1 - arity)`` exceeds its value before ``i``
+by exactly one.  Crossover/mutation are then masked three-segment gathers
+(head + donor subtree + tail) over the fixed-capacity buffers; a child that
+would overflow capacity leaves its parent unchanged (the array-native
+counterpart of rejecting oversized offspring)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .pset import PrimitiveSetTyped
+
+__all__ = ["subtree_bounds", "node_depths", "tree_height",
+           "cx_one_point", "cx_one_point_leaf_biased",
+           "mut_uniform", "mut_node_replacement", "mut_ephemeral",
+           "mut_insert", "mut_shrink", "static_limit"]
+
+
+def _frozen(pset):
+    return pset.freeze() if isinstance(pset, PrimitiveSetTyped) else pset
+
+
+def _surplus(codes, length, arity):
+    """cumsum(1 - arity) over valid tokens; the prefix-structure invariant:
+    the subtree from i ends where the surplus relative to i reaches 1."""
+    contrib = jnp.where(jnp.arange(codes.shape[0]) < length,
+                        1 - arity[codes], 0)
+    return jnp.cumsum(contrib)
+
+
+def subtree_bounds(codes, length, i, arity):
+    """(start, end) of the subtree rooted at ``i`` (reference searchSubtree,
+    gp.py:172-182)."""
+    cap = codes.shape[0]
+    s = _surplus(codes, length, arity)
+    base = jnp.where(i > 0, s[jnp.maximum(i - 1, 0)], 0)
+    k = jnp.arange(cap)
+    hit = (k >= i) & (s - base == 1)
+    end = jnp.argmax(hit) + 1
+    return i, jnp.where(jnp.any(hit), end, length)
+
+
+def _all_subtree_ends(codes, length, arity):
+    """end[j] for every root j — O(cap²) masked argmax (cap is small)."""
+    cap = codes.shape[0]
+    s = _surplus(codes, length, arity)
+    base = jnp.concatenate([jnp.zeros(1, s.dtype), s[:-1]])
+    k = jnp.arange(cap)
+    hit = (k[None, :] >= k[:, None]) & (s[None, :] - base[:, None] == 1)
+    ends = jnp.argmax(hit, axis=1) + 1
+    return jnp.where(jnp.any(hit, axis=1), ends, length)
+
+
+def node_depths(codes, length, arity):
+    """depth[i] = #ancestors of node i = #{j < i : end_j > i}."""
+    cap = codes.shape[0]
+    ends = _all_subtree_ends(codes, length, arity)
+    k = jnp.arange(cap)
+    anc = (k[:, None] > k[None, :]) & (ends[None, :] > k[:, None])
+    return jnp.sum(anc, axis=1)
+
+
+def tree_height(codes, length, arity):
+    """Height of the tree (reference PrimitiveTree.height, gp.py:153-164)."""
+    d = node_depths(codes, length, arity)
+    return jnp.max(jnp.where(jnp.arange(codes.shape[0]) < length, d, 0))
+
+
+def _splice(dst, dst_consts, l_dst, i, j, src, src_consts, a, b):
+    """Replace dst[i:j] with src[a:b]; returns (codes, consts, new_len,
+    fits).  When the result would overflow capacity, returns dst unchanged
+    with fits=False."""
+    cap = dst.shape[0]
+    seg = b - a
+    new_len = i + seg + (l_dst - j)
+    fits = new_len <= cap
+    p = jnp.arange(cap)
+    src_idx = jnp.clip(a + (p - i), 0, cap - 1)
+    tail_idx = jnp.clip(j + (p - i - seg), 0, cap - 1)
+    out = jnp.where(p < i, dst,
+                    jnp.where(p < i + seg, src[src_idx], dst[tail_idx]))
+    out_c = jnp.where(p < i, dst_consts,
+                      jnp.where(p < i + seg, src_consts[src_idx],
+                                dst_consts[tail_idx]))
+    out = jnp.where(p < new_len, out, 0)
+    out_c = jnp.where(p < new_len, out_c, 0.0)
+    return (jnp.where(fits, out, dst),
+            jnp.where(fits, out_c, dst_consts),
+            jnp.where(fits, new_len, l_dst),
+            fits)
+
+
+def _masked_choice(key, mask, fallback=0):
+    """Uniform index among True entries of mask (fallback if none)."""
+    u = jax.random.uniform(key, mask.shape)
+    any_ = jnp.any(mask)
+    return jnp.where(any_, jnp.argmax(jnp.where(mask, u, -1.0)), fallback)
+
+
+def _make_cx(pset, leaf_bias: float | None):
+    f = _frozen(pset)
+    arity = jnp.asarray(f.arity)
+    rtype = jnp.asarray(f.ret_type)
+    n_types = f.pset.n_types
+
+    def cx(key, t1, t2, termpb=0.1):
+        c1, k1cst, l1 = t1
+        c2, k2cst, l2 = t2
+        cap = c1.shape[0]
+        k_i1, k_i2, k_b1, k_b2 = jax.random.split(key, 4)
+        p = jnp.arange(cap)
+
+        # type availability in the partner (reference builds the
+        # types1/types2 dicts and intersects, gp.py:653-670)
+        rt1 = rtype[c1]
+        rt2 = rtype[c2]
+        # exclude roots when trees have >1 node (reference gp.py:648-651)
+        valid1 = (p < l1) & ((p >= 1) | (l1 <= 1))
+        valid2 = (p < l2) & ((p >= 1) | (l2 <= 1))
+        present2 = jnp.zeros((n_types,), bool).at[rt2].max(valid2)
+        elig1 = valid1 & present2[rt1]
+        if leaf_bias is not None:
+            k_i1, k_lb = jax.random.split(k_i1)
+            pick_term = jax.random.bernoulli(k_lb, termpb)
+            is_term1 = arity[c1] == 0
+            bias1 = elig1 & (is_term1 == pick_term)
+            elig1 = jnp.where(jnp.any(bias1), bias1, elig1)
+        i1 = _masked_choice(k_b1, elig1)
+        want_t = rt1[i1]
+        elig2 = valid2 & (rt2 == want_t)
+        if leaf_bias is not None:
+            k_i2, k_lb2 = jax.random.split(k_i2)
+            pick_term2 = jax.random.bernoulli(k_lb2, termpb)
+            is_term2 = arity[c2] == 0
+            bias2 = elig2 & (is_term2 == pick_term2)
+            elig2 = jnp.where(jnp.any(bias2), bias2, elig2)
+        i2 = _masked_choice(k_b2, elig2)
+        ok = jnp.any(elig1) & jnp.any(elig2)
+
+        s1, e1 = subtree_bounds(c1, l1, i1, arity)
+        s2, e2 = subtree_bounds(c2, l2, i2, arity)
+        n1, n1c, nl1, fit1 = _splice(c1, k1cst, l1, s1, e1, c2, k2cst, s2, e2)
+        n2, n2c, nl2, fit2 = _splice(c2, k2cst, l2, s2, e2, c1, k1cst, s1, e1)
+        keep = ok & fit1 & fit2
+
+        def sel(new, old):
+            return jnp.where(keep, new, old)
+        return ((sel(n1, c1), sel(n1c, k1cst), sel(nl1, l1)),
+                (sel(n2, c2), sel(n2c, k2cst), sel(nl2, l2)))
+
+    return cx
+
+
+def cx_one_point(key, tree1, tree2, pset):
+    """Typed one-point subtree crossover (reference gp.cxOnePoint,
+    gp.py:640-677)."""
+    return _make_cx(pset, None)(key, tree1, tree2)
+
+
+def cx_one_point_leaf_biased(key, tree1, tree2, pset, termpb=0.1):
+    """Koza 90/10 leaf-biased crossover (reference cxOnePointLeafBiased,
+    gp.py:680-732): with probability ``termpb`` both points are terminals,
+    else both internal."""
+    return _make_cx(pset, termpb)(key, tree1, tree2, termpb)
+
+
+def mut_uniform(key, tree, expr: Callable, pset):
+    """Replace a random subtree with a generated one of the *same return
+    type* (reference mutUniform, gp.py:738-752, which passes
+    ``type_=individual[index].ret``).  ``expr(key, ret_type) ->
+    (codes, consts, length)`` — e.g. a
+    :func:`deap_tpu.gp.generate.make_generator` closure, whose generators
+    accept the traced type id; a single-type expr may ignore the second
+    argument."""
+    f = _frozen(pset)
+    arity = jnp.asarray(f.arity)
+    rtype = jnp.asarray(f.ret_type)
+    codes, consts, length = tree
+    k_i, k_gen = jax.random.split(key)
+    i = jax.random.randint(k_i, (), 0, jnp.maximum(length, 1))
+    s, e = subtree_bounds(codes, length, i, arity)
+    try:
+        g_codes, g_consts, g_len = expr(k_gen, rtype[codes[i]])
+    except TypeError:
+        g_codes, g_consts, g_len = expr(k_gen)
+    n, nc, nl, fits = _splice(codes, consts, length, s, e,
+                              g_codes, g_consts, 0, g_len)
+    return n, nc, nl
+
+
+def mut_node_replacement(key, tree, pset):
+    """Replace a random node with another of identical signature (reference
+    mutNodeReplacement, gp.py:755-778): primitives swap with same-arity,
+    same-type primitives; terminals with same-type terminals."""
+    f = _frozen(pset)
+    arity = jnp.asarray(f.arity)
+    rtype = jnp.asarray(f.ret_type)
+    in_types = jnp.asarray(f.in_types)
+    is_eph = jnp.asarray(f.is_ephemeral)
+    codes, consts, length = tree
+    k_i, k_pick, k_const = jax.random.split(key, 3)
+    i = jax.random.randint(k_i, (), 0, jnp.maximum(length, 1))
+    c = codes[i]
+    same_sig = ((rtype == rtype[c]) & (arity == arity[c])
+                & jnp.all(in_types == in_types[c], axis=1))
+    new_c = _masked_choice(k_pick, same_sig, fallback=c)
+    # new ephemerals need a fresh constant; plain terminals their value
+    const = lax.switch(new_c, f.const_fns, k_const)
+    codes = codes.at[i].set(new_c.astype(codes.dtype))
+    consts = consts.at[i].set(jnp.where(is_eph[new_c] | (arity[new_c] == 0),
+                                        const, consts[i]))
+    return codes, consts, length
+
+
+def mut_ephemeral(key, tree, pset, mode: str = "one"):
+    """Re-draw ephemeral constants (reference mutEphemeral, gp.py:781-806):
+    mode "one" re-samples a single random ephemeral node, "all" every one."""
+    f = _frozen(pset)
+    is_eph = jnp.asarray(f.is_ephemeral)
+    codes, consts, length = tree
+    cap = codes.shape[0]
+    k_pick, k_new = jax.random.split(key)
+    mask = is_eph[codes] & (jnp.arange(cap) < length)
+    if mode == "one":
+        i = _masked_choice(k_pick, mask)
+        sel = (jnp.arange(cap) == i) & jnp.any(mask)
+    else:
+        sel = mask
+    fns = f.const_fns
+    keys = jax.random.split(k_new, cap)
+    new_consts = jax.vmap(lambda c, k: lax.switch(c, fns, k))(codes, keys)
+    return codes, jnp.where(sel, new_consts, consts), length
+
+
+def mut_insert(key, tree, pset):
+    """Insert a primitive above a random subtree (reference mutInsert,
+    gp.py:809-846): the old subtree becomes one argument; the other
+    arguments are filled with new terminals."""
+    f = _frozen(pset)
+    arity_np = f.arity
+    cap = tree[0].shape[0]
+    arity = jnp.asarray(arity_np)
+    rtype = jnp.asarray(f.ret_type)
+    in_types = jnp.asarray(f.in_types)
+    term_arr, term_cnt = (jnp.asarray(f.term_by_type[0]),
+                          jnp.asarray(f.term_by_type[1]))
+    max_arity = max(f.max_arity, 1)
+    codes, consts, length = tree
+    k_i, k_p, k_slot, k_terms, k_consts = jax.random.split(key, 5)
+    i = jax.random.randint(k_i, (), 0, jnp.maximum(length, 1))
+    t = rtype[codes[i]]
+    # primitives returning t that accept t somewhere
+    accepts = jnp.any((in_types == t[None]) &
+                      (jnp.arange(max_arity)[None, :] < arity[:, None]), axis=1)
+    # only primitives whose every argument type has terminals available —
+    # the padded candidate table would otherwise yield code 0 for an empty
+    # bucket and corrupt the prefix structure
+    fillable = jnp.asarray(f.args_have_terminals)
+    cand = (rtype == t) & (arity > 0) & accepts & fillable
+    p_code = _masked_choice(k_p, cand)
+    ok = jnp.any(cand)
+    a = arity[p_code]
+    # choose which slot receives the old subtree, among type-matching slots
+    slot_ok = (in_types[p_code] == t) & (jnp.arange(max_arity) < a)
+    slot = _masked_choice(k_slot, slot_ok)
+
+    s, e = subtree_bounds(codes, length, i, arity)
+    sub_len = e - s
+    # build the insertion segment: primitive, terminals, subtree at `slot`
+    seg_len = 1 + (a - 1) + sub_len
+    p_arange = jnp.arange(cap)
+    # terminal fill codes for each slot
+    tk = jax.random.split(k_terms, max_arity)
+    fill = jnp.stack([
+        term_arr[in_types[p_code, j],
+                 jax.random.randint(tk[j], (), 0,
+                                    jnp.maximum(term_cnt[in_types[p_code, j]], 1))]
+        for j in range(max_arity)])
+    fns = f.const_fns
+    ck = jax.random.split(k_consts, max_arity)
+    fill_consts = jnp.stack([lax.switch(fill[j], fns, ck[j])
+                             for j in range(max_arity)])
+
+    # segment layout: position 0 = primitive; then for each slot j<a either
+    # the subtree (at j == slot, occupying sub_len tokens) or one terminal
+    # offset of slot j in the segment:
+    j_idx = jnp.arange(max_arity)
+    # width of each slot: 1 except `slot` which is sub_len
+    widths = jnp.where(j_idx == slot, sub_len, 1) * (j_idx < a)
+    offsets = 1 + jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                   jnp.cumsum(widths)[:-1]])
+    seg = jnp.zeros(cap, codes.dtype).at[0].set(p_code)
+    seg_c = jnp.zeros(cap, consts.dtype)
+    # place terminals
+    for j in range(max_arity):
+        real = (j < a) & (j != slot)
+        seg = seg.at[jnp.where(real, offsets[j], cap - 1)].set(
+            jnp.where(real, fill[j], seg[cap - 1]))
+        seg_c = seg_c.at[jnp.where(real, offsets[j], cap - 1)].set(
+            jnp.where(real, fill_consts[j], seg_c[cap - 1]))
+    # place the subtree
+    sub_src = jnp.clip(s + (p_arange - offsets[slot]), 0, cap - 1)
+    in_sub = (p_arange >= offsets[slot]) & (p_arange < offsets[slot] + sub_len)
+    seg = jnp.where(in_sub, codes[sub_src], seg)
+    seg_c = jnp.where(in_sub, consts[sub_src], seg_c)
+
+    n, nc, nl, fits = _splice(codes, consts, length, s, e, seg, seg_c,
+                              0, seg_len)
+    keep = ok & fits
+    return (jnp.where(keep, n, codes), jnp.where(keep, nc, consts),
+            jnp.where(keep, nl, length))
+
+
+def mut_shrink(key, tree, pset):
+    """Replace a random primitive by one of its (type-matching) argument
+    subtrees (reference mutShrink, gp.py:849-882)."""
+    f = _frozen(pset)
+    arity = jnp.asarray(f.arity)
+    rtype = jnp.asarray(f.ret_type)
+    codes, consts, length = tree
+    cap = codes.shape[0]
+    k_i, k_arg = jax.random.split(key)
+    p = jnp.arange(cap)
+    is_prim = (arity[codes] > 0) & (p < length)
+    i = _masked_choice(k_i, is_prim)
+    ok = jnp.any(is_prim)
+    s, e = subtree_bounds(codes, length, i, arity)
+    # children roots: walk via subtree ends
+    ends = _all_subtree_ends(codes, length, arity)
+    # child starts: first child at i+1, next at end of previous
+    max_a = max(f.max_arity, 1)
+    child_starts = [i + 1]
+    for _ in range(max_a - 1):
+        child_starts.append(ends[jnp.clip(child_starts[-1], 0, cap - 1)])
+    child_starts = jnp.stack(child_starts)
+    a = arity[codes[i]]
+    match = (jnp.arange(max_a) < a) & (
+        rtype[codes[jnp.clip(child_starts, 0, cap - 1)]] == rtype[codes[i]])
+    which = _masked_choice(k_arg, match)
+    ok = ok & jnp.any(match)
+    cs = child_starts[which]
+    ce = ends[jnp.clip(cs, 0, cap - 1)]
+    n, nc, nl, fits = _splice(codes, consts, length, s, e,
+                              codes, consts, cs, ce)
+    keep = ok & fits
+    return (jnp.where(keep, n, codes), jnp.where(keep, nc, consts),
+            jnp.where(keep, nl, length))
+
+
+def static_limit(key_fn: Callable, max_value: int, pset):
+    """Bloat-control decorator (reference staticLimit, gp.py:885-926): if an
+    offspring exceeds ``max_value`` under ``key_fn`` (height or length), one
+    of its parents replaces it.
+
+    Wraps tree operators of signature ``op(key, tree, ...)-> tree`` or
+    ``op(key, t1, t2, ...) -> (t1', t2')``."""
+    f_check = key_fn
+
+    def decorator(op):
+        def wrapper(key, *trees_and_args):
+            trees = [t for t in trees_and_args if isinstance(t, tuple)
+                     and len(t) == 3]
+            out = op(key, *trees_and_args)
+            if isinstance(out, tuple) and isinstance(out[0], tuple):
+                new_trees = list(out)
+            else:
+                new_trees = [out]
+            result = []
+            for parent, child in zip(trees, new_trees):
+                over = f_check(child) > max_value
+                result.append(tuple(
+                    jnp.where(over, pa, ch)
+                    for pa, ch in zip(parent, child)))
+            return tuple(result) if len(result) > 1 else result[0]
+        return wrapper
+    return decorator

@@ -1,0 +1,190 @@
+"""Convergence/integration tests mirroring the reference CI suite
+(deap/tests/test_algorithms.py): full-strength stochastic runs asserting
+solution quality, not bit-exactness (the RNG semantics differ by design —
+SURVEY §7 hard-part 4)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deap_tpu import base, algorithms, cma, benchmarks, tools
+from deap_tpu.ops import crossover, mutation
+from deap_tpu.benchmarks.tools import hypervolume
+
+HV_THRESHOLD = 116.0      # reference test_algorithms.py:32 (optimal 120.777)
+NDIM = 5
+BOUND_LOW, BOUND_UP = 0.0, 1.0
+
+
+def test_cma():
+    """CMA-ES on sphere: best < 1e-8 after 100 gens (reference
+    test_algorithms.py:52-66)."""
+    strategy = cma.Strategy(centroid=[5.0] * NDIM, sigma=5.0, lambda_=20)
+    toolbox = base.Toolbox()
+    toolbox.register("evaluate", benchmarks.sphere)
+    toolbox.register("generate", strategy.generate)
+    toolbox.register("update", strategy.update)
+    pop, state, logbook = algorithms.ea_generate_update(
+        jax.random.PRNGKey(0), toolbox, strategy.init(), ngen=100,
+        weights=(-1.0,))
+    best = float(np.min(np.asarray(pop.fitness.values)))
+    assert best < 1e-8, f"CMA-ES did not converge: {best}"
+
+
+def _zdt1_toolbox(select):
+    tb = base.Toolbox()
+    tb.register("evaluate", benchmarks.zdt1)
+    tb.register("mate", crossover.cx_simulated_binary_bounded,
+                eta=20.0, low=BOUND_LOW, up=BOUND_UP)
+    tb.register("mutate", mutation.mut_polynomial_bounded,
+                eta=20.0, low=BOUND_LOW, up=BOUND_UP, indpb=1.0 / NDIM)
+    tb.register("select", select)
+    return tb
+
+
+def test_nsga2():
+    """NSGA-II on ZDT1: hypervolume > 116 after 100 gens, bounds preserved
+    (reference test_algorithms.py:69-116)."""
+    MU = 16
+    tb = _zdt1_toolbox(tools.selNSGA2)
+    key = jax.random.PRNGKey(1)
+    genome = jax.random.uniform(key, (MU, NDIM), minval=BOUND_LOW,
+                                maxval=BOUND_UP)
+    pop = base.Population(genome=genome,
+                          fitness=base.Fitness.empty(MU, (-1.0, -1.0)))
+    pop, _ = algorithms.ea_mu_plus_lambda(
+        jax.random.PRNGKey(2), pop, tb, mu=MU, lambda_=MU,
+        cxpb=0.7, mutpb=0.2, ngen=100)
+    hv = hypervolume(pop.fitness, ref=[11.0, 11.0])
+    assert hv > HV_THRESHOLD, f"NSGA-II hypervolume {hv} <= {HV_THRESHOLD}"
+    g = np.asarray(pop.genome)
+    assert np.all(g >= BOUND_LOW - 1e-6) and np.all(g <= BOUND_UP + 1e-6)
+
+
+def test_nsga3():
+    """NSGA-III on ZDT1 (reference test_algorithms.py:189-233)."""
+    MU = 16
+    ref_points = tools.uniformReferencePoints(2, p=12)
+    tb = _zdt1_toolbox(lambda key, fit, k: tools.selNSGA3(key, fit, k, ref_points))
+    key = jax.random.PRNGKey(3)
+    genome = jax.random.uniform(key, (MU, NDIM), minval=BOUND_LOW,
+                                maxval=BOUND_UP)
+    pop = base.Population(genome=genome,
+                          fitness=base.Fitness.empty(MU, (-1.0, -1.0)))
+    pop, _ = algorithms.ea_mu_plus_lambda(
+        jax.random.PRNGKey(4), pop, tb, mu=MU, lambda_=MU,
+        cxpb=0.7, mutpb=0.2, ngen=100)
+    hv = hypervolume(pop.fitness, ref=[11.0, 11.0])
+    assert hv > HV_THRESHOLD, f"NSGA-III hypervolume {hv} <= {HV_THRESHOLD}"
+
+
+def test_nsga3_with_memory():
+    """Memory variant stays correct across generations (reference
+    selNSGA3WithMemory, emo.py:450-476)."""
+    MU = 16
+    ref_points = tools.uniformReferencePoints(2, p=12)
+    sel = tools.selNSGA3WithMemory(ref_points)
+    tb = _zdt1_toolbox(sel)
+    key = jax.random.PRNGKey(5)
+    genome = jax.random.uniform(key, (MU, NDIM), minval=BOUND_LOW,
+                                maxval=BOUND_UP)
+    pop = base.Population(genome=genome,
+                          fitness=base.Fitness.empty(MU, (-1.0, -1.0)))
+    # host loop (memory is host state), fewer gens
+    from deap_tpu.algorithms import evaluate_population, var_or
+    pop, _ = evaluate_population(tb, pop)
+    k = jax.random.PRNGKey(6)
+    for gen in range(60):
+        k, k_var, k_sel = jax.random.split(k, 3)
+        off = var_or(k_var, pop, tb, MU, cxpb=0.7, mutpb=0.2)
+        off, _ = evaluate_population(tb, off)
+        pool = pop.concat(off)
+        pop = pool.take(sel(k_sel, pool.fitness, MU))
+    hv = hypervolume(pop.fitness, ref=[11.0, 11.0])
+    assert hv > 110.0
+    assert sel.extreme_points is not None  # memory is live
+
+
+def test_mo_cma_es():
+    """MO-CMA-ES on ZDT1: HV > 116 after 500 gens (reference
+    test_algorithms.py:119-186, seeded run with distance penalty)."""
+    MU, LAMBDA = 10, 10
+    NGEN = 500
+
+    def distance(feasible, original):
+        return np.sum((np.asarray(feasible) - np.asarray(original)) ** 2)
+
+    def closest_feasible(ind):
+        return np.clip(ind, BOUND_LOW, BOUND_UP)
+
+    def valid(ind):
+        return bool(np.all(ind >= BOUND_LOW) and np.all(ind <= BOUND_UP))
+
+    def evaluate(ind):
+        i = jnp.asarray(ind)
+        f1, f2 = benchmarks.zdt1(i)
+        return np.array([float(f1), float(f2)])
+
+    rng = np.random.RandomState(128)
+    pop = rng.rand(MU, NDIM)
+    values = np.stack([
+        evaluate(np.clip(p, BOUND_LOW, BOUND_UP))
+        - (-1.0) * 1e7 * distance(closest_feasible(p), p)
+        if not valid(p) else evaluate(p)
+        for p in pop])
+    strategy = cma.StrategyMultiObjective(
+        pop, (-1.0, -1.0), sigma=1.0, values=values, mu=MU, lambda_=LAMBDA)
+
+    key = jax.random.PRNGKey(128)
+    for gen in range(NGEN):
+        key, k = jax.random.split(key)
+        off = strategy.generate(k)
+        off_vals = []
+        for ind in off:
+            if valid(ind):
+                off_vals.append(evaluate(ind))
+            else:
+                f = closest_feasible(ind)
+                penalty = 1e7 * distance(f, ind)
+                off_vals.append(evaluate(f) + penalty)  # minimization
+        strategy.update(off, np.stack(off_vals))
+
+    # all parents close to feasible
+    assert np.all(strategy.parents >= BOUND_LOW - 1e-5)
+    assert np.all(strategy.parents <= BOUND_UP + 1e-5)
+    w = strategy.parent_values * np.array([-1.0, -1.0])
+    fit = base.Fitness(values=jnp.asarray(strategy.parent_values),
+                       valid=jnp.ones(MU, bool), weights=(-1.0, -1.0))
+    hv = hypervolume(fit, ref=[11.0, 11.0])
+    assert hv > HV_THRESHOLD, f"MO-CMA-ES hypervolume {hv} <= {HV_THRESHOLD}"
+
+
+def test_one_plus_lambda():
+    """(1+λ) CMA-ES minimizes sphere (reference cma.py:208-325 behavior)."""
+    strategy = cma.StrategyOnePlusLambda(
+        parent=[3.0] * NDIM, sigma=1.0, weights=(-1.0,), lambda_=8)
+    tb = base.Toolbox()
+    tb.register("evaluate", benchmarks.sphere)
+    tb.register("generate", strategy.generate)
+    tb.register("update", strategy.update)
+    pop, state, _ = algorithms.ea_generate_update(
+        jax.random.PRNGKey(10), tb, strategy.init(), ngen=300, weights=(-1.0,))
+    best = float(np.asarray(state.parent_wvalues)[0] * -1.0)
+    assert best < 1e-3, f"(1+lambda) did not converge: {best}"
+
+
+def test_spea2_selection():
+    """SPEA2 keeps a good spread on a simple biobjective cloud."""
+    key = jax.random.PRNGKey(11)
+    vals = jax.random.uniform(key, (64, 2))
+    fit = base.Fitness(values=vals, valid=jnp.ones(64, bool),
+                       weights=(-1.0, -1.0))
+    idx = tools.selSPEA2(None, fit, 16)
+    assert len(np.unique(np.asarray(idx))) == 16
+    # selected set must include the nondominated points (if <= 16)
+    from deap_tpu.ops.emo import nondominated_ranks
+    ranks, _ = nondominated_ranks(fit.masked_wvalues())
+    first = set(np.nonzero(np.asarray(ranks) == 0)[0].tolist())
+    if len(first) <= 16:
+        assert first <= set(np.asarray(idx).tolist())

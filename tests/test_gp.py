@@ -1,0 +1,185 @@
+"""GP engine tests: stack-interpreter correctness vs hand-built trees,
+generator validity, variation structural invariants, and the canonical
+symbolic-regression workload (reference examples/gp/symbreg.py: evolve
+x**4 + x**3 + x**2 + x)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deap_tpu import gp, base, algorithms
+from deap_tpu.ops import selection
+
+
+CAP = 32
+
+
+@pytest.fixture(scope="module")
+def pset():
+    ps = gp.PrimitiveSet("MAIN", 1)
+    ps.add_primitive(jnp.add, 2, name="add")
+    ps.add_primitive(jnp.subtract, 2, name="sub")
+    ps.add_primitive(jnp.multiply, 2, name="mul")
+    ps.add_primitive(gp.protected_div, 2, name="div")
+    ps.add_primitive(jnp.negative, 1, name="neg")
+    ps.add_primitive(jnp.cos, 1, name="cos")
+    ps.add_primitive(jnp.sin, 1, name="sin")
+    ps.add_ephemeral_constant(
+        "rand101", lambda key: jax.random.randint(key, (), -1, 2).astype(jnp.float32))
+    return ps
+
+
+def _valid_prefix(codes, length, arity):
+    """A prefix array is a single well-formed tree iff cumsum(1-arity)
+    reaches 1 exactly at the last token and stays >= 1 nowhere before."""
+    s = 0
+    for i in range(length):
+        s += 1 - int(arity[int(codes[i])])
+        if i < length - 1 and s >= 1:
+            return False
+    return s == 1
+
+
+def test_interpreter_matches_manual(pset):
+    """add(mul(x, x), sin(x)) evaluated exactly."""
+    tree = gp.from_string("add(mul(ARG0, ARG0), sin(ARG0))", pset, cap=CAP)
+    X = np.linspace(-2, 2, 11, dtype=np.float32)[None, :]
+    ev = gp.make_evaluator(pset, CAP)
+    out = np.asarray(ev(jnp.asarray(tree[0]), jnp.asarray(tree[1]),
+                        jnp.asarray(tree[2]), jnp.asarray(X)))
+    np.testing.assert_allclose(out, X[0] ** 2 + np.sin(X[0]), rtol=1e-5)
+
+
+def test_interpreter_constants(pset):
+    tree = gp.from_string("mul(1.0, sub(ARG0, -1.0))", pset, cap=CAP)
+    X = np.array([[0.0, 1.0, 2.0]], np.float32)
+    ev = gp.make_evaluator(pset, CAP)
+    out = np.asarray(ev(*map(jnp.asarray, tree), jnp.asarray(X)))
+    np.testing.assert_allclose(out, X[0] + 1.0, rtol=1e-6)
+
+
+def test_string_roundtrip(pset):
+    expr = "add(mul(ARG0, ARG0), sin(ARG0))"
+    tree = gp.from_string(expr, pset, cap=CAP)
+    assert gp.to_string(tree, pset) == expr
+
+
+def test_generators_produce_valid_trees(pset):
+    f = pset.freeze()
+    gen = gp.make_generator(pset, CAP, "half_and_half")
+    keys = jax.random.split(jax.random.PRNGKey(0), 64)
+    codes, consts, lengths = jax.vmap(lambda k: gen(k, 1, 4))(keys)
+    codes, lengths = np.asarray(codes), np.asarray(lengths)
+    for i in range(64):
+        assert lengths[i] >= 1
+        assert _valid_prefix(codes[i], lengths[i], f.arity), f"tree {i} invalid"
+    # heights within bounds
+    heights = np.asarray(jax.vmap(
+        lambda c, l: gp.tree_height(c, l, jnp.asarray(f.arity)))(
+            jnp.asarray(codes), jnp.asarray(lengths)))
+    assert heights.max() <= 4
+    # full generator at fixed depth: every leaf at that depth
+    genf = gp.make_generator(pset, CAP, "full")
+    c, k, l = genf(jax.random.PRNGKey(5), 3, 3)
+    h = int(gp.tree_height(c, l, jnp.asarray(f.arity)))
+    assert h == 3
+
+
+def test_crossover_preserves_validity(pset):
+    f = pset.freeze()
+    gen = gp.make_generator(pset, CAP, "half_and_half")
+    keys = jax.random.split(jax.random.PRNGKey(1), 32)
+    t = jax.vmap(lambda k: gen(k, 2, 5))(keys)
+    cx = jax.jit(lambda k, t1, t2: gp.cx_one_point(k, t1, t2, pset))
+    for i in range(0, 32, 2):
+        t1 = tuple(np.asarray(x[i]) for x in t)
+        t2 = tuple(np.asarray(x[i + 1]) for x in t)
+        (c1, k1, l1), (c2, k2, l2) = cx(jax.random.PRNGKey(100 + i),
+                                        tuple(map(jnp.asarray, t1)),
+                                        tuple(map(jnp.asarray, t2)))
+        assert _valid_prefix(np.asarray(c1), int(l1), f.arity)
+        assert _valid_prefix(np.asarray(c2), int(l2), f.arity)
+
+
+def test_mutations_preserve_validity(pset):
+    f = pset.freeze()
+    gen = gp.make_generator(pset, CAP, "half_and_half")
+    expr = gp.make_generator(pset, CAP, "full")
+    tree = gen(jax.random.PRNGKey(2), 2, 5)
+
+    mu = gp.mut_uniform(jax.random.PRNGKey(3), tree,
+                        lambda k: expr(k, 0, 2), pset)
+    assert _valid_prefix(np.asarray(mu[0]), int(mu[2]), f.arity)
+
+    mn = gp.mut_node_replacement(jax.random.PRNGKey(4), tree, pset)
+    assert _valid_prefix(np.asarray(mn[0]), int(mn[2]), f.arity)
+    assert int(mn[2]) == int(tree[2])          # same shape
+
+    me = gp.mut_ephemeral(jax.random.PRNGKey(5), tree, pset, mode="all")
+    assert _valid_prefix(np.asarray(me[0]), int(me[2]), f.arity)
+
+    mi = gp.mut_insert(jax.random.PRNGKey(6), tree, pset)
+    assert _valid_prefix(np.asarray(mi[0]), int(mi[2]), f.arity)
+    assert int(mi[2]) >= int(tree[2])
+
+    ms = gp.mut_shrink(jax.random.PRNGKey(7), tree, pset)
+    assert _valid_prefix(np.asarray(ms[0]), int(ms[2]), f.arity)
+    assert int(ms[2]) <= int(tree[2])
+
+
+def test_static_limit(pset):
+    f = pset.freeze()
+    arity = jnp.asarray(f.arity)
+    gen = gp.make_generator(pset, CAP, "full")
+    big = gen(jax.random.PRNGKey(8), 4, 4)
+    limited = gp.static_limit(
+        lambda t: gp.tree_height(t[0], t[2], arity), 2, pset)
+
+    def grower(key, tree):
+        return gp.mut_uniform(key, tree,
+                              lambda k: gen(k, 4, 4), pset)
+
+    small = gen(jax.random.PRNGKey(9), 1, 1)
+    out = limited(grower)(jax.random.PRNGKey(10), small)
+    h = int(gp.tree_height(out[0], out[2], arity))
+    assert h <= 2  # the oversized mutation was rejected
+
+
+def test_symbreg_evolution(pset):
+    """End-to-end GP: evolve x^4+x^3+x^2+x on 20 points (reference
+    examples/gp/symbreg.py); expect strong fitness improvement."""
+    f = pset.freeze()
+    X = np.linspace(-1, 1, 20, dtype=np.float32)[None, :]
+    target = X[0] ** 4 + X[0] ** 3 + X[0] ** 2 + X[0]
+    Xj = jnp.asarray(X)
+    tj = jnp.asarray(target)
+
+    ev = gp.make_evaluator(pset, CAP)
+    gen_init = gp.make_generator(pset, CAP, "half_and_half")
+    gen_mut = gp.make_generator(pset, CAP, "full")
+
+    def evaluate(tree):
+        out = ev(tree[0], tree[1], tree[2], Xj)
+        mse = jnp.mean((out - tj) ** 2)
+        return (jnp.where(jnp.isfinite(mse), mse, 1e6),)
+
+    toolbox = base.Toolbox()
+    toolbox.register("evaluate", evaluate)
+    toolbox.register("mate", lambda k, t1, t2: gp.cx_one_point(k, t1, t2, pset))
+    toolbox.register("mutate", lambda k, t: gp.mut_uniform(
+        k, t, lambda kk: gen_mut(kk, 0, 2), pset))
+    toolbox.register("select", selection.sel_tournament, tournsize=3)
+
+    NPOP = 128
+    keys = jax.random.split(jax.random.PRNGKey(11), NPOP)
+    codes, consts, lengths = jax.vmap(lambda k: gen_init(k, 1, 3))(keys)
+    pop = base.Population(
+        genome=(codes, consts, lengths),
+        fitness=base.Fitness.empty(NPOP, (-1.0,)))
+
+    pop, logbook = algorithms.ea_simple(
+        jax.random.PRNGKey(12), pop, toolbox, cxpb=0.8, mutpb=0.2, ngen=25)
+    best = float(np.min(np.asarray(pop.fitness.values)))
+    start = logbook[0]["gen"]
+    assert best < 0.05, f"GP symbreg did not improve enough: best mse {best}"
