@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .pset import PrimitiveSetTyped
+from .pset import PrimitiveSetTyped, freeze_pset
 
 __all__ = ["make_generator", "gen_full", "gen_grow", "gen_half_and_half"]
 
@@ -41,7 +41,7 @@ def make_generator(pset, cap: int, kind: str = "half_and_half") -> Callable:
     Raises at construction if any reachable argument type has no terminal —
     such a set cannot bound tree depth (the reference raises IndexError at
     generation time instead, gp.py:612-617)."""
-    f = pset.freeze() if isinstance(pset, PrimitiveSetTyped) else pset
+    f = freeze_pset(pset)
     term_cnt_np = f.term_by_type[1]
     reachable = {f.pset.ret}
     for i in range(f.n_nodes):

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .pset import FrozenPSet, PrimitiveSetTyped
+from .pset import FrozenPSet, PrimitiveSetTyped, freeze_pset
 
 __all__ = ["make_evaluator", "make_population_evaluator", "compile_tree"]
 
@@ -34,7 +34,7 @@ __all__ = ["make_evaluator", "make_population_evaluator", "compile_tree"]
 def make_evaluator(pset, cap: int) -> Callable:
     """Build ``evaluate(codes, consts, length, X) -> (n_points,)`` for trees
     of capacity ``cap``.  ``X`` is ``(n_args, n_points)``."""
-    f = pset.freeze() if isinstance(pset, PrimitiveSetTyped) else pset
+    f = freeze_pset(pset)
     arity = jnp.asarray(f.arity)
     max_arity = max(f.max_arity, 1)
     ops = f.ops

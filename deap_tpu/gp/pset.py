@@ -26,7 +26,12 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["Primitive", "Terminal", "Ephemeral", "Argument",
-           "PrimitiveSetTyped", "PrimitiveSet"]
+           "PrimitiveSetTyped", "PrimitiveSet", "freeze_pset"]
+
+
+def freeze_pset(pset):
+    """Coerce a (possibly already frozen) primitive set to a FrozenPSet."""
+    return pset.freeze() if isinstance(pset, PrimitiveSetTyped) else pset
 
 
 @dataclasses.dataclass
@@ -182,10 +187,13 @@ class PrimitiveSet(PrimitiveSetTyped):
     def __init__(self, name: str, arity: int, prefix: str = "ARG"):
         super().__init__(name, [object] * arity, object, prefix)
 
-    def add_primitive(self, func, arity: int | Sequence = None, name=None,
+    def add_primitive(self, func, arity: int | Sequence, name=None,
                       fmt=None):
         if isinstance(arity, int):
             in_types = [object] * arity
+        elif arity is None:
+            raise TypeError("add_primitive() requires an arity (int) or an "
+                            "explicit sequence of argument types")
         else:
             in_types = arity
         return super().add_primitive(func, in_types, object, name, fmt)

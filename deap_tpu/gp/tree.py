@@ -13,13 +13,9 @@ from typing import Sequence
 import numpy as np
 
 from .pset import (FrozenPSet, Primitive, Terminal, Ephemeral, Argument,
-                   PrimitiveSetTyped)
+                   PrimitiveSetTyped, freeze_pset as _f)
 
 __all__ = ["to_string", "from_string", "graph"]
-
-
-def _f(pset):
-    return pset.freeze() if isinstance(pset, PrimitiveSetTyped) else pset
 
 
 def to_string(tree, pset) -> str:

@@ -12,6 +12,7 @@ counterpart of rejecting oversized offspring)."""
 
 from __future__ import annotations
 
+import inspect
 from functools import partial
 from typing import Callable
 
@@ -20,16 +21,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .pset import PrimitiveSetTyped
+from .pset import PrimitiveSetTyped, freeze_pset as _frozen
 
 __all__ = ["subtree_bounds", "node_depths", "tree_height",
            "cx_one_point", "cx_one_point_leaf_biased",
            "mut_uniform", "mut_node_replacement", "mut_ephemeral",
            "mut_insert", "mut_shrink", "static_limit"]
-
-
-def _frozen(pset):
-    return pset.freeze() if isinstance(pset, PrimitiveSetTyped) else pset
 
 
 def _surplus(codes, length, arity):
@@ -102,6 +99,24 @@ def _splice(dst, dst_consts, l_dst, i, j, src, src_consts, a, b):
             fits)
 
 
+def _expr_takes_type(expr: Callable) -> bool:
+    """Whether ``expr`` accepts a second (return-type) argument.  Inspected
+    via the signature rather than a trial call, so TypeErrors raised *inside*
+    a two-argument expr (including tracer ConcretizationTypeError) propagate
+    instead of silently downgrading to the untyped call."""
+    try:
+        sig = inspect.signature(expr)
+    except (TypeError, ValueError):
+        return True
+    n = 0
+    for p in sig.parameters.values():
+        if p.kind in (p.VAR_POSITIONAL,):
+            return True
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            n += 1
+    return n >= 2
+
+
 def _masked_choice(key, mask, fallback=0):
     """Uniform index among True entries of mask (fallback if none)."""
     u = jax.random.uniform(key, mask.shape)
@@ -171,8 +186,9 @@ def cx_one_point(key, tree1, tree2, pset):
 
 def cx_one_point_leaf_biased(key, tree1, tree2, pset, termpb=0.1):
     """Koza 90/10 leaf-biased crossover (reference cxOnePointLeafBiased,
-    gp.py:680-732): with probability ``termpb`` both points are terminals,
-    else both internal."""
+    gp.py:680-732): each tree independently picks a terminal point with
+    probability ``termpb``, an internal point otherwise (the reference also
+    draws one coin per tree)."""
     return _make_cx(pset, termpb)(key, tree1, tree2, termpb)
 
 
@@ -191,9 +207,9 @@ def mut_uniform(key, tree, expr: Callable, pset):
     k_i, k_gen = jax.random.split(key)
     i = jax.random.randint(k_i, (), 0, jnp.maximum(length, 1))
     s, e = subtree_bounds(codes, length, i, arity)
-    try:
+    if _expr_takes_type(expr):
         g_codes, g_consts, g_len = expr(k_gen, rtype[codes[i]])
-    except TypeError:
+    else:
         g_codes, g_consts, g_len = expr(k_gen)
     n, nc, nl, fits = _splice(codes, consts, length, s, e,
                               g_codes, g_consts, 0, g_len)

@@ -1,0 +1,7 @@
+"""Native (C++) kernels for deap_tpu.
+
+The reference ships exactly one native component — the exact hypervolume
+extension (SURVEY §2.5; deap/tools/_hypervolume/).  This package holds our
+equivalent: ``hv.cpp`` compiled on demand by :mod:`deap_tpu.native.build`
+and bound through ctypes in :mod:`deap_tpu.native.hv`.
+"""

@@ -1,0 +1,48 @@
+"""Build the native hypervolume shared library.
+
+Usage::
+
+    python -m deap_tpu.native.build
+
+Compiles ``hv.cpp`` with the system C++ compiler into ``libdeap_tpu_hv.so``
+next to this file.  The reference builds its one native component as an
+optional CPython extension with a pure-Python fallback
+(setup.py:60, deap/tools/_hypervolume/pyhv.py); we follow the same policy —
+:mod:`deap_tpu.ops.hv` falls back to the numpy WFG implementation when the
+library is absent or the toolchain is missing.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "hv.cpp")
+LIB = os.path.join(HERE, "libdeap_tpu_hv.so")
+
+
+def build(force: bool = False) -> str | None:
+    """Compile the shared library; return its path, or None on failure."""
+    if not force and os.path.exists(LIB) and (
+            os.path.getmtime(LIB) >= os.path.getmtime(SRC)):
+        return LIB
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        return None
+    cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", SRC, "-o", LIB]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+    except (subprocess.CalledProcessError, OSError):
+        return None
+    return LIB
+
+
+if __name__ == "__main__":
+    path = build(force=True)
+    if path is None:
+        print("build failed (no C++ compiler found?)", file=sys.stderr)
+        sys.exit(1)
+    print(path)

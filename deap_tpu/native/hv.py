@@ -1,0 +1,43 @@
+"""ctypes binding for the native exact-hypervolume kernel.
+
+Mirrors the reference's ``hv.hypervolume(pointset, ref)`` CPython extension
+surface (deap/tools/_hypervolume/hv.cpp:123-126) without pybind11: the C++
+side exports a flat C ABI (``deap_tpu_hv``) and this module marshals numpy
+arrays through ctypes.  Importing raises if the shared library cannot be
+found or built, which :func:`deap_tpu.ops.hv._load_native` treats as "use
+the numpy fallback".
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .build import build
+
+_LIB_PATH = build()
+if _LIB_PATH is None:
+    raise ImportError("native hypervolume library unavailable")
+
+_lib = ctypes.CDLL(_LIB_PATH)
+_lib.deap_tpu_hv.restype = ctypes.c_double
+_lib.deap_tpu_hv.argtypes = [
+    ctypes.POINTER(ctypes.c_double), ctypes.c_long, ctypes.c_long,
+    ctypes.POINTER(ctypes.c_double),
+]
+
+
+def hypervolume(pointset, ref) -> float:
+    """Exact hypervolume (minimization) of ``pointset`` w.r.t. ``ref``."""
+    pts = np.ascontiguousarray(pointset, np.float64)
+    r = np.ascontiguousarray(ref, np.float64)
+    if pts.ndim != 2:
+        pts = pts.reshape(len(pts), -1)
+    n, d = pts.shape
+    if r.shape != (d,):
+        raise ValueError("reference point dimension mismatch")
+    return float(_lib.deap_tpu_hv(
+        pts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_long(n), ctypes.c_long(d),
+        r.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))

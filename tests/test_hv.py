@@ -1,0 +1,56 @@
+"""Hypervolume kernel tests — all three tiers (jax 2-D, native C++, numpy
+WFG) must agree on exact values, mirroring the reference's contract for
+``hv.hypervolume`` (deap/tools/_hypervolume/hv.cpp) and its pure-Python
+fallback (pyhv.py)."""
+
+import numpy as np
+import pytest
+
+from deap_tpu.ops.hv import hypervolume, hypervolume_2d, _wfg, _nds_min
+
+
+def test_unit_cube():
+    assert hypervolume([[0.0, 0.0, 0.0]], [1.0, 1.0, 1.0]) == pytest.approx(1.0)
+
+
+def test_point_beyond_ref_clipped():
+    # points not strictly dominating ref are discarded (fpli_hv preprocessing)
+    assert hypervolume([[2.0, 2.0]], [1.0, 1.0]) == 0.0
+    assert hypervolume([[0.5, 0.5], [2.0, 0.1]], [1.0, 1.0]) == pytest.approx(0.25)
+
+
+def test_2d_staircase():
+    pts = [[1.0, 3.0], [2.0, 2.0], [3.0, 1.0]]
+    ref = [4.0, 4.0]
+    # strips: (4-1)*(4-3)=3 plus (4-2)*(3-2)=2 plus (4-3)*(2-1)=1 → 6
+    assert hypervolume(pts, ref) == pytest.approx(6.0)
+    assert float(hypervolume_2d(np.array(pts), np.array(ref))) == pytest.approx(6.0)
+
+
+def test_dominated_points_ignored():
+    pts = [[1.0, 1.0], [2.0, 2.0], [1.5, 1.5]]
+    assert hypervolume(pts, [3.0, 3.0]) == pytest.approx(4.0)
+
+
+def test_tiers_agree_random_fronts():
+    rng = np.random.default_rng(7)
+    native = pytest.importorskip("deap_tpu.native.hv")
+    for d in (2, 3, 4, 5, 6):
+        for n in (1, 8, 40):
+            pts = rng.random((n, d))
+            ref = np.full(d, 1.5)
+            a = native.hypervolume(pts, ref)
+            b = _wfg(_nds_min(pts.copy()), ref)
+            assert a == pytest.approx(b, abs=1e-9), (d, n)
+            if d == 2:
+                c = float(hypervolume_2d(pts, ref))
+                assert c == pytest.approx(b, abs=1e-6)
+
+
+def test_permutation_invariance():
+    rng = np.random.default_rng(3)
+    pts = rng.random((30, 3))
+    ref = np.full(3, 2.0)
+    v1 = hypervolume(pts, ref)
+    v2 = hypervolume(pts[::-1], ref)
+    assert v1 == pytest.approx(v2, abs=1e-10)
