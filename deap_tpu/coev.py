@@ -1,0 +1,154 @@
+"""Co-evolution — cooperative (Potter & De Jong) and competitive (Hillis).
+
+The reference implements co-evolution purely as examples over the standard
+toolbox: cooperative species lists evolved round-robin with representatives
+shared across species (examples/coev/coop_base.py:16-70, coop_evol.py's
+main loop), and a competitive host–parasite pair of populations
+(examples/coev/hillis.py).  Here both architectures are first-class scanned
+loops over stacked arrays (SURVEY §2.6 P5: stacked population arrays,
+per-species vmap, representative broadcast):
+
+* :func:`ea_cooperative` — species stacked on a leading axis, one jitted
+  generation evolves *all* species in parallel; each individual is evaluated
+  on the collaboration set formed by substituting it for its species'
+  representative (the reference's ``[ind] + r``, coop_evol.py:94-96).
+* :func:`ea_host_parasite` — two populations with opposite objectives
+  evaluated pairwise through a shared encounter function (hillis.py:31-33:
+  host fitness minimizes what parasite fitness maximizes).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .algorithms import var_and, _record
+from .base import Fitness, Population
+from .utils.support import Logbook
+
+__all__ = ["ea_cooperative", "ea_host_parasite"]
+
+
+def ea_cooperative(key, species: Population, toolbox, cxpb: float,
+                   mutpb: float, ngen: int, stats=None, verbose=False):
+    """Cooperative co-evolution (reference coop_evol.py main loop).
+
+    ``species`` is a stacked :class:`Population` whose genome leaves carry a
+    leading ``(nspecies, pop, ...)`` axis.  ``toolbox.evaluate(collab)``
+    scores a collaboration set of shape ``(nspecies, ...)`` — one member per
+    species (reference ``matchSetStrength``, coop_base.py:56-64).
+    ``toolbox.mate/mutate/select`` act per species as usual.
+
+    Each generation, per species (vmapped): vary with :func:`var_and`,
+    evaluate every individual against the other species' representatives,
+    select; representatives are re-chosen as each species' best and shared
+    for the *next* generation, as in the reference (coop_evol.py:85-115).
+
+    Returns ``(species, representatives, logbook)``.
+    """
+    nspecies = jax.tree_util.tree_leaves(species.genome)[0].shape[0]
+    weights = species.fitness.weights
+
+    def eval_one(g, i, reps):
+        """Score individual ``g`` of species ``i`` on the collaboration set
+        formed by substituting it for its species' representative."""
+        collab = jax.tree_util.tree_map(lambda r, gg: r.at[i].set(gg), reps, g)
+        out = toolbox.evaluate(collab)
+        if isinstance(out, (tuple, list)):
+            return jnp.stack([jnp.asarray(o, jnp.float32).reshape(())
+                              for o in out])
+        return jnp.asarray(out, jnp.float32).reshape((-1,))
+
+    def species_step(key, pop_i, idx, reps):
+        k_var, k_sel = jax.random.split(key)
+        pop_i = var_and(k_var, pop_i, toolbox, cxpb, mutpb)
+        vals = jax.vmap(lambda g: eval_one(g, idx, reps))(pop_i.genome)
+        pop_i = pop_i.evaluated(vals)
+        sel_idx = toolbox.select(k_sel, pop_i.fitness, pop_i.size)
+        pop_i = pop_i.take(sel_idx)
+        # representative = best of the selected species
+        w = pop_i.fitness.masked_wvalues()[:, 0]
+        best = jnp.argmax(w)
+        rep = jax.tree_util.tree_map(lambda g: g[best], pop_i.genome)
+        return pop_i, rep
+
+    def gen_step(carry, _):
+        key, sp, reps = carry
+        key, k = jax.random.split(key)
+        keys = jax.random.split(k, nspecies)
+        sp, new_reps = jax.vmap(
+            species_step, in_axes=(0, 0, 0, None))(
+                keys, sp, jnp.arange(nspecies), reps)
+        rec = {}
+        if stats is not None:
+            flat = Population(
+                genome=jax.tree_util.tree_map(
+                    lambda g: g.reshape((-1,) + g.shape[2:]), sp.genome),
+                fitness=Fitness(
+                    values=sp.fitness.values.reshape(
+                        (-1, sp.fitness.values.shape[-1])),
+                    valid=sp.fitness.valid.reshape((-1,)),
+                    weights=weights))
+            rec = stats.compile(flat)
+        return (key, sp, new_reps), rec
+
+    # initial representatives: first individual of each species
+    # (reference: random.choice per species, coop_evol.py:77)
+    reps0 = jax.tree_util.tree_map(lambda g: g[:, 0], species.genome)
+
+    (key, species, reps), stacked = lax.scan(
+        gen_step, (key, species, reps0), None, length=ngen)
+
+    logbook = Logbook()
+    logbook.header = ["gen"] + (stats.fields if stats else [])
+    logbook.record_stacked(gen=jnp.arange(1, ngen + 1), **stacked)
+    if verbose:
+        print(logbook.stream)
+    return species, reps, logbook
+
+
+def ea_host_parasite(key, hosts: Population, parasites: Population,
+                     htoolbox, ptoolbox, encounter: Callable,
+                     cxpb: float, mutpb: float, ngen: int,
+                     stats=None, verbose=False):
+    """Competitive host–parasite co-evolution (reference
+    examples/coev/hillis.py): both populations vary each generation, then
+    host ``i`` meets parasite ``i`` through ``encounter(host_genome,
+    parasite_genome) -> scalar``; the raw encounter value is assigned to
+    *both* sides, whose fitness weights give it opposite signs (hillis.py:
+    host ``FitnessMin``, parasite ``FitnessMax`` on the same assess value).
+
+    Host and parasite populations must be the same size (the reference
+    pairs them index-wise, hillis.py main loop).  Returns
+    ``(hosts, parasites, logbook)``.
+    """
+    if hosts.size != parasites.size:
+        raise ValueError("host and parasite populations must be equal size")
+
+    def gen_step(carry, _):
+        key, h, p = carry
+        key, kh, kp, ksh, ksp = jax.random.split(key, 5)
+        h = var_and(kh, h, htoolbox, cxpb, mutpb)
+        p = var_and(kp, p, ptoolbox, cxpb, mutpb)
+        vals = jax.vmap(
+            lambda hg, pg: jnp.asarray(
+                encounter(hg, pg), jnp.float32).reshape((-1,)))(
+                    h.genome, p.genome)
+        h = h.evaluated(vals)
+        p = p.evaluated(vals)
+        h = h.take(htoolbox.select(ksh, h.fitness, h.size))
+        p = p.take(ptoolbox.select(ksp, p.fitness, p.size))
+        return (key, h, p), _record(stats, h, h.size)
+
+    (key, hosts, parasites), stacked = lax.scan(
+        gen_step, (key, hosts, parasites), None, length=ngen)
+
+    logbook = Logbook()
+    logbook.header = ["gen", "nevals"] + (stats.fields if stats else [])
+    logbook.record_stacked(gen=jnp.arange(1, ngen + 1), **stacked)
+    if verbose:
+        print(logbook.stream)
+    return hosts, parasites, logbook

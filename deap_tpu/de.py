@@ -1,0 +1,131 @@
+"""Differential Evolution — array-native.
+
+The reference implements DE purely as examples (examples/de/basic.py:40-77:
+rand/1/bin with ``selRandom(k=3)`` donors, one forced crossover index, greedy
+replacement; examples/de/sphere.py uses a low-level variant; de/dynamic.py
+runs multi-population DE with brownian individuals on MovingPeaks).  Here a
+whole generation is one jitted kernel: donor indices are drawn per-agent,
+the trial vector is built with a bernoulli + forced-index mask, and the
+greedy selection is a vectorized ``where``.
+
+``de_step`` covers the classic strategies via ``variant``:
+
+* ``"rand/1/bin"`` (reference basic.py) — donor base is a random distinct
+  agent;
+* ``"best/1/bin"`` — donor base is the population best;
+* ``"rand/2/bin"`` / ``"best/2/bin"`` — two difference pairs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .algorithms import _hof_setup, _norm_eval, _record
+from .base import Fitness, Population
+from .utils.support import Logbook
+
+__all__ = ["de_step", "de"]
+
+
+def _distinct_indices(key, n: int, k: int) -> jax.Array:
+    """(n, k) donor indices, each row drawn without replacement and biased
+    away from the row's own index (reference draws ``selRandom(pop, k=3)``
+    — which *can* collide; we do one better and exclude self/duplicates via
+    per-row permutation)."""
+    keys = jax.random.split(key, n)
+
+    def row(i, k_r):
+        perm = jax.random.permutation(k_r, n - 1)[:k]
+        return jnp.where(perm >= i, perm + 1, perm)   # skip self
+
+    return jax.vmap(row)(jnp.arange(n), keys)
+
+
+def de_step(key, population: Population, evaluate: Callable,
+            cr: float = 0.25, f: float = 1.0,
+            variant: str = "rand/1/bin") -> Population:
+    """One DE generation (reference examples/de/basic.py:55-77), jittable.
+
+    For each agent ``x``: pick donors, build ``v = base + f*(b - c)``
+    (one or two difference pairs), binomial-crossover into a trial ``y``
+    with at least one mutated component (the reference's forced
+    ``index = randrange(NDIM)``), evaluate, keep the better of ``x``/``y``.
+    """
+    genome = population.genome
+    if not isinstance(genome, jnp.ndarray):
+        raise TypeError("de_step requires a flat (pop, dim) genome array")
+    n, dim = genome.shape
+    base_kind, ndiff, _ = variant.split("/")
+    ndiff = int(ndiff)
+    if n < 2 + 2 * ndiff:
+        raise ValueError(
+            f"variant {variant!r} needs a population of at least "
+            f"{2 + 2 * ndiff} (got {n}) to draw distinct donors")
+
+    k_idx, k_cr, k_force = jax.random.split(key, 3)
+    donors = _distinct_indices(k_idx, n, 1 + 2 * ndiff)
+
+    w = population.fitness.masked_wvalues()[:, 0]
+    if base_kind == "best":
+        base = genome[jnp.argmax(w)][None, :]
+    else:
+        base = genome[donors[:, 0]]
+    diff = jnp.zeros_like(genome)
+    for d in range(ndiff):
+        b = genome[donors[:, 1 + 2 * d]]
+        c = genome[donors[:, 2 + 2 * d]]
+        diff = diff + (b - c)
+    v = base + f * diff
+
+    cross = jax.random.uniform(k_cr, (n, dim)) < cr
+    forced = jax.random.randint(k_force, (n,), 0, dim)
+    cross = cross | (jnp.arange(dim)[None, :] == forced[:, None])
+    y = jnp.where(cross, v, genome)
+
+    weights = population.fitness.weights
+    y_vals = jax.vmap(_norm_eval(evaluate))(y)
+    y_w = (y_vals * jnp.asarray(weights, y_vals.dtype))[:, 0]
+
+    keep_trial = y_w > w
+    new_genome = jnp.where(keep_trial[:, None], y, genome)
+    new_vals = jnp.where(keep_trial[:, None], y_vals, population.fitness.values)
+    fit = Fitness(values=new_vals,
+                  valid=population.fitness.valid | keep_trial,
+                  weights=weights)
+    return Population(genome=new_genome, fitness=fit)
+
+
+def de(key, population: Population, evaluate: Callable, ngen: int,
+       cr: float = 0.25, f: float = 1.0, variant: str = "rand/1/bin",
+       stats=None, halloffame=None, verbose=False):
+    """Scanned DE loop (the reference example's main(), basic.py:40-88).
+    The initial population is evaluated first, like the reference's
+    pre-loop eval.  Returns ``(population, logbook)``."""
+    vals = jax.vmap(_norm_eval(evaluate))(population.genome)
+    population = population.evaluated(vals)
+
+    hof_state, hof_upd = _hof_setup(halloffame, population)
+
+    def gen(carry, _):
+        key, pop, hof = carry
+        key, k = jax.random.split(key)
+        pop = de_step(k, pop, evaluate, cr=cr, f=f, variant=variant)
+        if hof is not None:
+            hof = hof_upd(hof, pop)
+        return (key, pop, hof), _record(stats, pop, pop.size)
+
+    (key, population, hof_state), stacked = lax.scan(
+        gen, (key, population, hof_state), None, length=ngen)
+
+    logbook = Logbook()
+    logbook.header = ["gen", "nevals"] + (stats.fields if stats else [])
+    logbook.record_stacked(gen=jnp.arange(1, ngen + 1), **stacked)
+    if halloffame is not None:
+        halloffame.state = hof_state
+    if verbose:
+        print(logbook.stream)
+    return population, logbook

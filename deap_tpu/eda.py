@@ -1,0 +1,113 @@
+"""Estimation-of-Distribution Algorithms — array-native ask/tell strategies.
+
+The reference ships EDA as two examples built on ``eaGenerateUpdate``:
+
+* EMNA — Estimation of Multivariate Normal Algorithm (examples/eda/emna.py:
+  32-62): sample ``centroid + sigma * N(0, I)``, re-estimate centroid from
+  the mu best and sigma from their pooled variance.
+* PBIL — Population-Based Incremental Learning (examples/eda/pbil.py:26-55):
+  maintain a per-bit probability vector, sample bitstrings, pull the vector
+  toward the generation's best with a learning rate, and mutate it.
+
+Both are plain pytree states with ``generate(state, key) -> genome`` /
+``update(state, population) -> state`` methods, directly pluggable into
+:func:`deap_tpu.algorithms.ea_generate_update` (reference
+algorithms.py:440-503) alongside :mod:`deap_tpu.cma`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .base import Population
+
+__all__ = ["EMNA", "EMNAState", "PBIL", "PBILState"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class EMNAState:
+    centroid: jax.Array        # (dim,)
+    sigma: jax.Array           # ()
+
+
+class EMNA:
+    """EMNA (Teytaud & Teytaud 2009, as in examples/eda/emna.py:32-62)."""
+
+    def __init__(self, centroid, sigma: float, mu: int, lambda_: int):
+        self.centroid0 = jnp.asarray(centroid, jnp.float32)
+        self.sigma0 = jnp.asarray(float(sigma))
+        self.dim = self.centroid0.shape[0]
+        self.mu = int(mu)
+        self.lambda_ = int(lambda_)
+
+    def init(self) -> EMNAState:
+        return EMNAState(centroid=self.centroid0, sigma=self.sigma0)
+
+    def generate(self, state: EMNAState, key) -> jax.Array:
+        z = jax.random.normal(key, (self.lambda_, self.dim),
+                              self.centroid0.dtype)
+        return state.centroid + state.sigma * z
+
+    def update(self, state: EMNAState, population: Population) -> EMNAState:
+        """Re-estimate from the mu best (emna.py:52-62): new centroid is the
+        mean of the best; sigma is the RMS deviation of the best around
+        their mean."""
+        w = population.fitness.masked_wvalues()[:, 0]
+        order = jnp.argsort(-w)[:self.mu]
+        z = population.genome[order] - state.centroid
+        avg = jnp.mean(z, axis=0)
+        sigma = jnp.sqrt(jnp.sum((z - avg) ** 2) / (self.mu * self.dim))
+        return EMNAState(centroid=state.centroid + avg, sigma=sigma)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PBILState:
+    prob_vector: jax.Array     # (dim,) in [0, 1]
+    key: jax.Array             # PRNG key consumed by update()'s mutation
+
+
+class PBIL:
+    """PBIL (Baluja 1994, as in examples/eda/pbil.py:26-55).
+
+    ``update`` needs randomness (the probability-vector mutation), but the
+    ask/tell protocol passes no key to ``update`` (reference
+    algorithms.py:497 calls ``toolbox.update(population)``), so the state
+    carries its own key and splits it per update.
+    """
+
+    def __init__(self, ndim: int, learning_rate: float, mut_prob: float,
+                 mut_shift: float, lambda_: int, seed: int = 0):
+        self.ndim = int(ndim)
+        self.learning_rate = float(learning_rate)
+        self.mut_prob = float(mut_prob)
+        self.mut_shift = float(mut_shift)
+        self.lambda_ = int(lambda_)
+        self.seed = int(seed)
+
+    def init(self, key=None) -> PBILState:
+        if key is None:
+            key = jax.random.PRNGKey(self.seed)
+        return PBILState(prob_vector=jnp.full((self.ndim,), 0.5), key=key)
+
+    def generate(self, state: PBILState, key) -> jax.Array:
+        u = jax.random.uniform(key, (self.lambda_, self.ndim))
+        return (u < state.prob_vector).astype(jnp.float32)
+
+    def update(self, state: PBILState, population: Population) -> PBILState:
+        """Pull toward the generation best, then mutate each component with
+        probability ``mut_prob`` toward a random bit by ``mut_shift``
+        (pbil.py:46-55, vectorized over components)."""
+        w = population.fitness.masked_wvalues()[:, 0]
+        best = population.genome[jnp.argmax(w)]
+        pv = state.prob_vector * (1.0 - self.learning_rate) \
+            + best * self.learning_rate
+        key, k_coin, k_bit = jax.random.split(state.key, 3)
+        coin = jax.random.uniform(k_coin, (self.ndim,)) < self.mut_prob
+        bit = jax.random.randint(k_bit, (self.ndim,), 0, 2).astype(pv.dtype)
+        mutated = pv * (1.0 - self.mut_shift) + bit * self.mut_shift
+        return PBILState(prob_vector=jnp.where(coin, mutated, pv), key=key)

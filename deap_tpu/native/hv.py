@@ -32,8 +32,10 @@ def hypervolume(pointset, ref) -> float:
     """Exact hypervolume (minimization) of ``pointset`` w.r.t. ``ref``."""
     pts = np.ascontiguousarray(pointset, np.float64)
     r = np.ascontiguousarray(ref, np.float64)
-    if pts.ndim != 2:
-        pts = pts.reshape(len(pts), -1)
+    if pts.ndim == 1:
+        pts = pts.reshape(1, -1)          # a single d-dim point
+    elif pts.ndim != 2:
+        pts = pts.reshape(-1, pts.shape[-1])
     n, d = pts.shape
     if r.shape != (d,):
         raise ValueError("reference point dimension mismatch")

@@ -118,8 +118,10 @@ def hypervolume(pointset, ref) -> float:
     ``hv.hypervolume`` (hv.cpp:123-126 / fpli_hv)."""
     pts = np.asarray(pointset, np.float64)
     ref = np.asarray(ref, np.float64)
-    if pts.ndim != 2:
-        pts = pts.reshape(len(pts), -1)
+    if pts.ndim == 1:
+        pts = pts.reshape(1, -1)          # a single d-dim point
+    elif pts.ndim != 2:
+        pts = pts.reshape(-1, pts.shape[-1])
     # discard points that do not strictly dominate the reference point,
     # like fpli_hv's preprocessing
     mask = np.all(pts < ref, axis=1)

@@ -1,0 +1,284 @@
+"""Particle Swarm Optimization — array-native.
+
+The reference implements PSO purely as examples over creator-built particle
+classes (examples/pso/basic.py:27-50 — `Particle = list` with ``speed``,
+``smin``/``smax``, ``best`` attributes; update rule at basic.py:40-50), plus
+a constriction-coefficient multiswarm variant for dynamic landscapes
+(examples/pso/multiswarm.py:83-97) and a species-based variant
+(examples/pso/speciation.py).  Here the whole swarm is one
+:class:`PSOState` pytree — positions, velocities, personal bests — and one
+jitted step updates every particle on the MXU-friendly ``(pop, dim)`` layout.
+
+Three entry points:
+
+* :func:`pso_init` / :func:`pso_step` / :func:`pso` — canonical gbest PSO
+  (basic.py's ``phi1``/``phi2`` rule with speed limits).
+* ``constriction=True`` — Clerc–Kennedy χ update used by the dynamic
+  multiswarm example (multiswarm.py:83-97: ``chi=0.729843788, c=2.05``).
+* :func:`multiswarm_step` — multi-swarm PSO with exclusion + anti-convergence
+  + quantum-cloud reinitialisation (Blackwell & Branke, as in
+  examples/pso/multiswarm.py): swarms are a stacked leading axis, vmapped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import Fitness, Population
+from .utils.support import Logbook
+
+__all__ = ["PSOState", "pso_init", "pso_step", "pso",
+           "MultiswarmState", "multiswarm_init", "multiswarm_step"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PSOState:
+    """Whole-swarm state: the array-native equivalent of the reference's
+    per-particle ``speed``/``best`` attributes plus the global ``best``
+    (examples/pso/basic.py:27-77)."""
+
+    position: jax.Array        # (pop, dim)
+    speed: jax.Array           # (pop, dim)
+    pbest: jax.Array           # (pop, dim)   personal best position
+    pbest_w: jax.Array         # (pop,)       personal best weighted fitness
+    gbest: jax.Array           # (dim,)       global best position
+    gbest_w: jax.Array         # ()           global best weighted fitness
+
+
+def _weighted(evaluate: Callable, weights) -> Callable:
+    if len(weights) != 1:
+        raise ValueError("PSO supports single-objective fitness")
+    from .algorithms import _norm_eval
+    w = float(weights[0])
+    norm = _norm_eval(evaluate)
+    return lambda x: norm(x)[0] * w
+
+
+def pso_init(key, n: int, dim: int, pmin: float, pmax: float,
+             smin: float, smax: float) -> PSOState:
+    """Uniform positions in [pmin, pmax], speeds in [smin, smax]
+    (reference generate(), examples/pso/basic.py:33-38)."""
+    kp, ks = jax.random.split(key)
+    pos = jax.random.uniform(kp, (n, dim), minval=pmin, maxval=pmax)
+    spd = jax.random.uniform(ks, (n, dim), minval=smin, maxval=smax)
+    return PSOState(position=pos, speed=spd, pbest=pos,
+                    pbest_w=jnp.full((n,), -jnp.inf),
+                    gbest=pos[0], gbest_w=jnp.array(-jnp.inf))
+
+
+def pso_step(key, state: PSOState, evaluate: Callable, weights=(-1.0,),
+             phi1: float = 2.0, phi2: float = 2.0,
+             smin: float | None = None, smax: float | None = None,
+             constriction: bool = False, chi: float = 0.729843788,
+             c: float = 2.05) -> tuple[PSOState, jax.Array]:
+    """One synchronous PSO generation, jit-friendly.
+
+    Canonical rule (reference updateParticle, basic.py:40-50):
+    ``v += u1*(pbest - x) + u2*(gbest - x)``, with per-component speed
+    clamping to [smin, smax] by magnitude; constriction rule
+    (multiswarm.py:83-97): ``v = chi*(v + ce1*(gbest-x) + ce2*(pbest-x))
+    - (1-chi)*v`` — we reproduce the reference's net effect
+    ``v_new = v + a`` with ``a = chi*(ce1_p + ce2_g) - (1-chi)*v``.
+
+    Evaluation happens *first* (as in the reference main loop,
+    basic.py:61-72: evaluate, update bests, then move), so the returned
+    state's bests reflect the *pre-move* positions.  Returns
+    ``(new_state, raw_fitness_of_evaluated_positions)``.
+    """
+    one = _weighted(evaluate, weights)
+    wfit = jax.vmap(one)(state.position)              # (pop,)
+
+    better = wfit > state.pbest_w
+    pbest = jnp.where(better[:, None], state.position, state.pbest)
+    pbest_w = jnp.where(better, wfit, state.pbest_w)
+
+    i_best = jnp.argmax(pbest_w)
+    g_better = pbest_w[i_best] > state.gbest_w
+    gbest = jnp.where(g_better, pbest[i_best], state.gbest)
+    gbest_w = jnp.where(g_better, pbest_w[i_best], state.gbest_w)
+
+    k1, k2 = jax.random.split(key)
+    shape = state.position.shape
+    if constriction:
+        ce1 = c * jax.random.uniform(k1, shape)
+        ce2 = c * jax.random.uniform(k2, shape)
+        a = (chi * (ce1 * (gbest - state.position)
+                    + ce2 * (pbest - state.position))
+             - (1.0 - chi) * state.speed)
+        speed = state.speed + a
+    else:
+        u1 = jax.random.uniform(k1, shape, maxval=phi1)
+        u2 = jax.random.uniform(k2, shape, maxval=phi2)
+        speed = (state.speed + u1 * (pbest - state.position)
+                 + u2 * (gbest - state.position))
+        if smin is not None or smax is not None:
+            mag = jnp.abs(speed)
+            lo = 0.0 if smin is None else smin
+            hi = jnp.inf if smax is None else smax
+            speed = jnp.sign(speed) * jnp.clip(mag, lo, hi)
+    position = state.position + speed
+
+    new = PSOState(position=position, speed=speed, pbest=pbest,
+                   pbest_w=pbest_w, gbest=gbest, gbest_w=gbest_w)
+    w0 = float(weights[0])
+    return new, wfit / w0
+
+
+def pso(key, state: PSOState, evaluate: Callable, ngen: int,
+        weights=(-1.0,), stats=None, verbose=False, **step_kwargs):
+    """Scanned gbest-PSO loop (the reference's example main loop,
+    basic.py:52-77).  Returns ``(final_state, logbook)``."""
+
+    def gen(carry, _):
+        key, st = carry
+        key, k = jax.random.split(key)
+        st, raw = pso_step(k, st, evaluate, weights, **step_kwargs)
+        rec = {}
+        if stats is not None:
+            pop = Population(
+                genome=st.position,
+                fitness=Fitness(values=raw[:, None],
+                                valid=jnp.ones(raw.shape[0], bool),
+                                weights=tuple(weights)))
+            rec = stats.compile(pop)
+        return (key, st), rec
+
+    (key, state), stacked = lax.scan(gen, (key, state), None, length=ngen)
+    logbook = Logbook()
+    logbook.header = ["gen"] + (stats.fields if stats else [])
+    logbook.record_stacked(gen=jnp.arange(1, ngen + 1), **stacked)
+    if verbose:
+        print(logbook.stream)
+    return state, logbook
+
+
+# ---------------------------------------------------------------------------
+# Multiswarm PSO for dynamic landscapes (examples/pso/multiswarm.py)
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class MultiswarmState:
+    """Stacked swarms: leading axis = swarm.  ``active`` masks live swarms
+    (the reference grows/kills python lists of swarms; we keep a static
+    capacity and a mask — SURVEY §7's masked dynamic-size rule)."""
+
+    position: jax.Array        # (ns, np, dim)
+    speed: jax.Array           # (ns, np, dim)
+    pbest: jax.Array           # (ns, np, dim)
+    pbest_w: jax.Array         # (ns, np)
+    sbest: jax.Array           # (ns, dim)    per-swarm best
+    sbest_w: jax.Array         # (ns,)
+    active: jax.Array          # (ns,) bool
+
+
+def multiswarm_init(key, nswarm: int, nparticle: int, dim: int,
+                    pmin: float, pmax: float, active: int | None = None
+                    ) -> MultiswarmState:
+    kp, ks = jax.random.split(key)
+    span = (pmax - pmin) / 2.0
+    pos = jax.random.uniform(kp, (nswarm, nparticle, dim),
+                             minval=pmin, maxval=pmax)
+    spd = jax.random.uniform(ks, (nswarm, nparticle, dim),
+                             minval=-span, maxval=span)
+    act = jnp.arange(nswarm) < (nswarm if active is None else active)
+    return MultiswarmState(
+        position=pos, speed=spd, pbest=pos,
+        pbest_w=jnp.full((nswarm, nparticle), -jnp.inf),
+        sbest=pos[:, 0], sbest_w=jnp.full((nswarm,), -jnp.inf),
+        active=act)
+
+
+def _quantum_cloud(key, centre, rcloud, shape):
+    """NUVD quantum cloud around ``centre`` (reference convertQuantum,
+    multiswarm.py:57-77): direction ~ N(0,1) normalized, radius
+    ``rcloud * |N(0, 1/3)|``."""
+    kd, ku = jax.random.split(key)
+    direction = jax.random.normal(kd, shape)
+    norm = jnp.linalg.norm(direction, axis=-1, keepdims=True)
+    u = jnp.abs(jax.random.normal(ku, shape[:-1] + (1,)) / 3.0)
+    return centre + rcloud * direction * u / jnp.maximum(norm, 1e-12)
+
+
+def multiswarm_step(key, state: MultiswarmState, evaluate: Callable,
+                    weights=(1.0,), rexcl: float = 0.5, rcloud: float = 0.5,
+                    chi: float = 0.729843788, c: float = 2.05,
+                    ) -> tuple[MultiswarmState, jax.Array]:
+    """One generation of multiswarm PSO with exclusion + anti-convergence
+    (reference main loop, examples/pso/multiswarm.py:100-210):
+
+    1. constriction-PSO update within each swarm (vmapped);
+    2. **exclusion**: of any two swarms whose bests are closer than
+       ``rexcl``, the worse one is reinitialised as a quantum cloud around
+       its best;
+    3. **anti-convergence**: if all swarms have converged (radius <
+       ``rexcl``), the worst swarm is randomised as a quantum cloud — the
+       masked-capacity stand-in for the reference's "add an extra swarm".
+
+    Returns ``(state, per-swarm best raw fitness)``.
+    """
+    w0 = float(weights[0])
+    one = _weighted(evaluate, weights)
+    ns, npart, dim = state.position.shape
+
+    wfit = jax.vmap(jax.vmap(one))(state.position)          # (ns, np)
+
+    better = wfit > state.pbest_w
+    pbest = jnp.where(better[..., None], state.position, state.pbest)
+    pbest_w = jnp.where(better, wfit, state.pbest_w)
+
+    i_best = jnp.argmax(pbest_w, axis=1)                    # (ns,)
+    row = jnp.take_along_axis(pbest, i_best[:, None, None], 1)[:, 0]
+    row_w = jnp.take_along_axis(pbest_w, i_best[:, None], 1)[:, 0]
+    s_better = row_w > state.sbest_w
+    sbest = jnp.where(s_better[:, None], row, state.sbest)
+    sbest_w = jnp.where(s_better, row_w, state.sbest_w)
+
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    shape = state.position.shape
+    ce1 = c * jax.random.uniform(k1, shape)
+    ce2 = c * jax.random.uniform(k2, shape)
+    a = (chi * (ce1 * (sbest[:, None] - state.position)
+                + ce2 * (pbest - state.position))
+         - (1.0 - chi) * state.speed)
+    speed = state.speed + a
+    position = state.position + speed
+
+    # exclusion: pairwise distances between swarm bests.  Exactly one of a
+    # close pair is reinitialised (the strictly worse one; index breaks
+    # ties), matching the reference's one-per-pair semantics.
+    d = jnp.linalg.norm(sbest[:, None] - sbest[None, :], axis=-1)
+    both = state.active[:, None] & state.active[None, :]
+    close = (d < rexcl) & both & ~jnp.eye(ns, dtype=bool)
+    idx = jnp.arange(ns)
+    worse = (sbest_w[:, None] < sbest_w[None, :]) | (
+        (sbest_w[:, None] == sbest_w[None, :]) & (idx[:, None] > idx[None, :]))
+    reinit = jnp.any(close & worse, axis=1)                  # (ns,)
+
+    # anti-convergence: all active swarms converged -> reinit the worst
+    radius = jnp.max(
+        jnp.linalg.norm(position - sbest[:, None], axis=-1), axis=1)
+    all_conv = jnp.all(~state.active | (radius < rexcl))
+    masked_w = jnp.where(state.active, sbest_w, jnp.inf)
+    worst = jnp.argmin(masked_w)
+    reinit = reinit | (all_conv & (jnp.arange(ns) == worst))
+
+    cloud = _quantum_cloud(k3, sbest[:, None], rcloud, shape)
+    span = jnp.max(jnp.abs(speed))
+    new_speed = jax.random.uniform(k4, shape, minval=-span, maxval=span)
+    position = jnp.where(reinit[:, None, None], cloud, position)
+    speed = jnp.where(reinit[:, None, None], new_speed, speed)
+    pbest = jnp.where(reinit[:, None, None], position, pbest)
+    pbest_w = jnp.where(reinit[:, None], -jnp.inf, pbest_w)
+
+    new = MultiswarmState(position=position, speed=speed, pbest=pbest,
+                          pbest_w=pbest_w, sbest=sbest, sbest_w=sbest_w,
+                          active=state.active)
+    return new, sbest_w / w0

@@ -1,0 +1,129 @@
+"""Convergence tests for the PSO / DE / EDA families — quality-threshold
+style like the reference CI (SURVEY §4), on the same workloads as the
+reference examples (examples/pso/basic.py, examples/de/basic.py,
+examples/eda/emna.py, examples/eda/pbil.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deap_tpu import base, algorithms, benchmarks
+from deap_tpu.pso import (pso_init, pso_step, pso,
+                          multiswarm_init, multiswarm_step)
+from deap_tpu.de import de, de_step
+from deap_tpu.eda import EMNA, PBIL
+
+
+def test_pso_sphere():
+    """gbest PSO minimizes the 2-D sphere well below the init scale."""
+    key = jax.random.PRNGKey(0)
+    k_init, k_run = jax.random.split(key)
+    state = pso_init(k_init, n=50, dim=2, pmin=-6, pmax=6, smin=-3, smax=3)
+    state, logbook = pso(k_run, state, benchmarks.sphere, ngen=200,
+                         weights=(-1.0,), smin=0.01, smax=3.0)
+    best = -float(state.gbest_w)
+    assert best < 1e-3, f"PSO gbest fitness {best}"
+
+
+def test_pso_h1_maximization():
+    """The reference's own PSO workload: maximize h1 (optimum 2 at
+    (8.6998, 6.7665), examples/pso/basic.py)."""
+    key = jax.random.PRNGKey(3)
+    k_init, k_run = jax.random.split(key)
+    state = pso_init(k_init, n=50, dim=2, pmin=-100, pmax=100,
+                     smin=-50, smax=50)
+    state, _ = pso(k_run, state, benchmarks.h1, ngen=300, weights=(1.0,),
+                   smin=0.5, smax=50.0)
+    assert float(state.gbest_w) > 1.0, float(state.gbest_w)
+
+
+def test_pso_constriction_jit():
+    """Constriction-coefficient update is jittable and improves fitness."""
+    key = jax.random.PRNGKey(1)
+    state = pso_init(key, n=30, dim=5, pmin=-5, pmax=5, smin=-2, smax=2)
+    step = jax.jit(lambda k, s: pso_step(k, s, benchmarks.sphere,
+                                         (-1.0,), constriction=True))
+    for i in range(100):
+        state, _ = step(jax.random.fold_in(key, i), state)
+    assert -float(state.gbest_w) < 1e-2
+
+
+def test_multiswarm_reinit():
+    """Multiswarm step runs jitted; exclusion keeps swarm bests apart."""
+    key = jax.random.PRNGKey(2)
+    state = multiswarm_init(key, nswarm=4, nparticle=8, dim=3,
+                            pmin=0.0, pmax=100.0)
+    step = jax.jit(lambda k, s: multiswarm_step(
+        k, s, lambda x: -jnp.sum((x - 50.0) ** 2), weights=(1.0,),
+        rexcl=5.0, rcloud=2.0))
+    for i in range(50):
+        state, sbw = step(jax.random.fold_in(key, i), state)
+    assert np.all(np.isfinite(np.asarray(sbw)))
+
+
+def test_de_sphere():
+    """DE rand/1/bin on sphere (reference examples/de/basic.py config:
+    CR=.25, F=1, MU=300) converges."""
+    key = jax.random.PRNGKey(0)
+    k_init, k_run = jax.random.split(key)
+    n, dim = 300, 10
+    genome = jax.random.uniform(k_init, (n, dim), minval=-3, maxval=3)
+    pop = base.Population(genome=genome,
+                          fitness=base.Fitness.empty(n, (-1.0,)))
+    pop, logbook = de(k_run, pop, benchmarks.sphere, ngen=400, cr=0.25, f=1.0)
+    best = float(np.min(np.asarray(pop.fitness.values)))
+    assert best < 1e-4, f"DE best {best}"
+
+
+def test_de_best_variant():
+    key = jax.random.PRNGKey(5)
+    genome = jax.random.uniform(key, (60, 5), minval=-3, maxval=3)
+    pop = base.Population(genome=genome,
+                          fitness=base.Fitness.empty(60, (-1.0,)))
+    pop, _ = de(key, pop, benchmarks.sphere, ngen=150, cr=0.5, f=0.6,
+                variant="best/1/bin")
+    assert float(np.min(np.asarray(pop.fitness.values))) < 1e-5
+
+
+def test_de_greedy_never_worsens():
+    """Greedy replacement: population best wvalue is monotone."""
+    key = jax.random.PRNGKey(7)
+    genome = jax.random.uniform(key, (40, 4), minval=-2, maxval=2)
+    pop = base.Population(genome=genome, fitness=base.Fitness.empty(40, (-1.0,)))
+    vals = jax.vmap(lambda g: jnp.stack([benchmarks.sphere(g)[0]]))(genome)
+    pop = pop.evaluated(vals)
+    prev = float(np.max(np.asarray(pop.fitness.masked_wvalues()[:, 0])))
+    for i in range(20):
+        pop = de_step(jax.random.fold_in(key, i), pop, benchmarks.sphere)
+        cur = float(np.max(np.asarray(pop.fitness.masked_wvalues()[:, 0])))
+        assert cur >= prev - 1e-6
+        prev = cur
+
+
+def test_emna_sphere():
+    """EMNA via ea_generate_update (reference emna.py: N=30, lambda=300,
+    mu=25) reaches near-zero on sphere."""
+    strategy = EMNA(centroid=[5.0] * 30, sigma=5.0, mu=25, lambda_=300)
+    tb = base.Toolbox()
+    tb.register("evaluate", benchmarks.sphere)
+    tb.register("generate", strategy.generate)
+    tb.register("update", strategy.update)
+    pop, state, logbook = algorithms.ea_generate_update(
+        jax.random.PRNGKey(0), tb, strategy.init(), ngen=150, weights=(-1.0,))
+    best = float(np.min(np.asarray(pop.fitness.values)))
+    assert best < 1e-3, f"EMNA best {best}"
+
+
+def test_pbil_onemax():
+    """PBIL on 50-bit OneMax (reference pbil.py config scaled): probability
+    vector converges toward all-ones."""
+    strategy = PBIL(ndim=50, learning_rate=0.3, mut_prob=0.1,
+                    mut_shift=0.05, lambda_=40)
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda ind: jnp.sum(ind))
+    tb.register("generate", strategy.generate)
+    tb.register("update", strategy.update)
+    pop, state, logbook = algorithms.ea_generate_update(
+        jax.random.PRNGKey(0), tb, strategy.init(), ngen=100, weights=(1.0,))
+    best = float(np.max(np.asarray(pop.fitness.values)))
+    assert best >= 45.0, f"PBIL best {best}"
