@@ -11,6 +11,7 @@ Standard protected primitives (the ones every reference example registers,
 e.g. examples/gp/symbreg.py) are provided in :data:`safe_ops`.
 """
 
+import jax
 import jax.numpy as jnp
 
 from .pset import (Primitive, Terminal, Ephemeral, Argument,
@@ -22,7 +23,8 @@ from .generate import (make_generator, gen_full, gen_grow,
 from .variation import (cx_one_point, cx_one_point_leaf_biased, mut_uniform,
                         mut_node_replacement, mut_ephemeral, mut_insert,
                         mut_shrink, static_limit, subtree_bounds,
-                        node_depths, tree_height)  # noqa: F401
+                        node_depths, tree_height, cx_semantic,
+                        mut_semantic)  # noqa: F401
 from .tree import to_string, from_string, graph  # noqa: F401
 
 # camelCase aliases (reference API names)
@@ -38,6 +40,8 @@ mutEphemeral = mut_ephemeral
 mutInsert = mut_insert
 mutShrink = mut_shrink
 staticLimit = static_limit
+cxSemantic = cx_semantic
+mutSemantic = mut_semantic
 
 
 def protected_div(left, right):
@@ -55,6 +59,12 @@ def protected_sqrt(x):
     return jnp.sqrt(jnp.abs(x))
 
 
+def logistic(x):
+    """The ``lf`` wrapper the semantic operators require (reference
+    gp.py:1227: ``1 / (1 + exp(-x))``)."""
+    return jax.nn.sigmoid(x)
+
+
 safe_ops = {
     "add": (jnp.add, 2),
     "sub": (jnp.subtract, 2),
@@ -65,4 +75,5 @@ safe_ops = {
     "sin": (jnp.sin, 1),
     "log": (protected_log, 1),
     "sqrt": (protected_sqrt, 1),
+    "lf": (logistic, 1),
 }

@@ -26,7 +26,8 @@ from .pset import PrimitiveSetTyped, freeze_pset as _frozen
 __all__ = ["subtree_bounds", "node_depths", "tree_height",
            "cx_one_point", "cx_one_point_leaf_biased",
            "mut_uniform", "mut_node_replacement", "mut_ephemeral",
-           "mut_insert", "mut_shrink", "static_limit"]
+           "mut_insert", "mut_shrink", "static_limit",
+           "cx_semantic", "mut_semantic"]
 
 
 def _surplus(codes, length, arity):
@@ -373,6 +374,133 @@ def mut_shrink(key, tree, pset):
     keep = ok & fits
     return (jnp.where(keep, n, codes), jnp.where(keep, nc, consts),
             jnp.where(keep, nl, length))
+
+
+def _append(codes, consts, length, src, src_consts, a, b):
+    """Append ``src[a:b]`` at the end of the tree buffer (``_splice`` with an
+    empty target window at ``length``)."""
+    return _splice(codes, consts, length, length, length, src, src_consts,
+                   a, b)
+
+
+def _scalar_code(f):
+    """A terminal/ephemeral code whose interpreter op reads the per-node
+    constant — used to inject literal scalars (the semantic operators'
+    mutation step and the constant 1.0).  Arguments read from X, so they
+    don't qualify."""
+    for i in range(f.n_nodes):
+        if not f.is_primitive[i] and not f.is_argument[i]:
+            return i
+    raise AssertionError(
+        "Semantic operators need at least one constant terminal or "
+        "ephemeral in the primitive set to encode literal scalars.")
+
+
+def _semantic_codes(f):
+    """Codes of the lf/mul/add/sub primitives the GSGP operators compose
+    with (the reference asserts the same four names, gp.py:1239-1240)."""
+    codes = {}
+    for name in ("lf", "mul", "add", "sub"):
+        assert name in f.pset.mapping, (
+            f"A '{name}' function is required in order to perform semantic "
+            "variation")
+        codes[name] = f.code_of(name)
+    return codes
+
+
+def mut_semantic(key, tree, pset, expr: Callable | None = None,
+                 ms=None, min_=2, max_=6):
+    """Geometric semantic mutation (Moraglio 2012; reference mutSemantic,
+    gp.py:1210-1263): ``child = ind + ms * (lf(tr1) - lf(tr2))`` built
+    *structurally* — prefix layout ``[add] ind [mul ms sub lf] tr1 [lf]
+    tr2``.  ``expr(key) -> tree`` generates the random trees (defaults to a
+    grow-method generator); ``ms`` is the mutation step (defaults to
+    U(0, 2), matching the reference).  A child that would overflow the
+    fixed capacity leaves the parent unchanged."""
+    from .generate import make_generator
+    f = _frozen(pset)
+    codes, consts, length = tree
+    cap = codes.shape[0]
+    sem = _semantic_codes(f)
+    ms_code = _scalar_code(f)
+    if expr is None:
+        expr = lambda k: make_generator(pset, cap, "grow")(k, min_, max_)
+    k_t1, k_t2, k_ms = jax.random.split(key, 3)
+    t1c, t1k, t1l = expr(k_t1)
+    t2c, t2k, t2l = expr(k_t2)
+    if ms is None:
+        ms = jax.random.uniform(k_ms, (), minval=0.0, maxval=2.0)
+    ms = jnp.asarray(ms, consts.dtype)
+
+    glue = jnp.array([sem["mul"], ms_code, sem["sub"], sem["lf"]],
+                     codes.dtype)
+    glue_c = jnp.array([0.0, 1.0, 0.0, 0.0], consts.dtype).at[1].set(ms)
+    head = jnp.array([sem["add"]], codes.dtype)
+    zero1 = jnp.zeros(1, consts.dtype)
+    lf1 = jnp.array([sem["lf"]], codes.dtype)
+
+    out = (jnp.zeros_like(codes), jnp.zeros_like(consts),
+           jnp.asarray(0, length.dtype), jnp.asarray(True))
+
+    def push(state, src, src_c, a, b):
+        c, k, l, ok = state
+        c, k, l, fit = _append(c, k, l, src, src_c, a, b)
+        return c, k, l, ok & fit
+
+    out = push(out, head, zero1, 0, 1)
+    out = push(out, codes, consts, 0, length)
+    out = push(out, glue, glue_c, 0, 4)
+    out = push(out, t1c, t1k, 0, t1l)
+    out = push(out, lf1, zero1, 0, 1)
+    out = push(out, t2c, t2k, 0, t2l)
+    nc, nk, nl, ok = out
+    return (jnp.where(ok, nc, codes), jnp.where(ok, nk, consts),
+            jnp.where(ok, nl, length))
+
+
+def cx_semantic(key, tree1, tree2, pset, expr: Callable | None = None,
+                min_=2, max_=6):
+    """Geometric semantic crossover (Moraglio 2012; reference cxSemantic,
+    gp.py:1266-1324): ``child1 = lf(tr)*ind1 + (1-lf(tr))*ind2`` and the
+    symmetric child2, built structurally with prefix layout ``[add mul lf]
+    tr ind1 [mul sub 1.0 lf] tr ind2``.  Children that would overflow the
+    capacity fall back to their parent (the array-native bloat bound)."""
+    from .generate import make_generator
+    f = _frozen(pset)
+    c1, k1, l1 = tree1
+    c2, k2, l2 = tree2
+    cap = c1.shape[0]
+    sem = _semantic_codes(f)
+    one_code = _scalar_code(f)
+    if expr is None:
+        expr = lambda k: make_generator(pset, cap, "grow")(k, min_, max_)
+    trc, trk, trl = expr(key)
+
+    head = jnp.array([sem["add"], sem["mul"], sem["lf"]], c1.dtype)
+    zero3 = jnp.zeros(3, k1.dtype)
+    mid = jnp.array([sem["mul"], sem["sub"], one_code, sem["lf"]], c1.dtype)
+    mid_c = jnp.array([0.0, 0.0, 1.0, 0.0], k1.dtype)
+
+    def build(pa, pa_c, pl, pb, pb_c, plb):
+        out = (jnp.zeros_like(pa), jnp.zeros_like(pa_c),
+               jnp.asarray(0, pl.dtype), jnp.asarray(True))
+
+        def push(state, src, src_c, a, b):
+            c, k, l, ok = state
+            c, k, l, fit = _append(c, k, l, src, src_c, a, b)
+            return c, k, l, ok & fit
+
+        out = push(out, head, zero3, 0, 3)
+        out = push(out, trc, trk, 0, trl)
+        out = push(out, pa, pa_c, 0, pl)
+        out = push(out, mid, mid_c, 0, 4)
+        out = push(out, trc, trk, 0, trl)
+        out = push(out, pb, pb_c, 0, plb)
+        nc, nk, nl, ok = out
+        return (jnp.where(ok, nc, pa), jnp.where(ok, nk, pa_c),
+                jnp.where(ok, nl, pl))
+
+    return build(c1, k1, l1, c2, k2, l2), build(c2, k2, l2, c1, k1, l1)
 
 
 def static_limit(key_fn: Callable, max_value: int, pset):

@@ -183,3 +183,76 @@ def test_symbreg_evolution(pset):
     best = float(np.min(np.asarray(pop.fitness.values)))
     start = logbook[0]["gen"]
     assert best < 0.05, f"GP symbreg did not improve enough: best mse {best}"
+
+
+@pytest.fixture(scope="module")
+def sem_pset():
+    """Primitive set with the lf/add/sub/mul names the semantic operators
+    require (reference gp.py:1239-1240)."""
+    ps = gp.PrimitiveSet("SEM", 1)
+    ps.add_primitive(jnp.add, 2, name="add")
+    ps.add_primitive(jnp.subtract, 2, name="sub")
+    ps.add_primitive(jnp.multiply, 2, name="mul")
+    ps.add_primitive(gp.logistic, 1, name="lf")
+    ps.add_ephemeral_constant(
+        "randc", lambda key: jax.random.uniform(key, (), minval=-1.0,
+                                                maxval=1.0))
+    return ps
+
+
+def test_mut_semantic(sem_pset):
+    """child = parent + ms*(lf(tr1) - lf(tr2)): the parent survives as a
+    prefix-embedded subtree and |child - parent| <= ms (since lf in (0,1))."""
+    cap = 128
+    gen = gp.make_generator(sem_pset, cap, "grow")
+    arity = jnp.asarray(sem_pset.freeze().arity)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(21))
+    parent = gen(k1, 2, 4)
+    child = gp.mut_semantic(k2, parent, sem_pset, ms=0.5, min_=1, max_=2)
+    pl = int(parent[2])
+    assert int(child[2]) > pl
+    assert bool(jnp.all(jnp.asarray(child[0])[1:1 + pl]
+                        == jnp.asarray(parent[0])[:pl]))
+    assert _valid_prefix(np.asarray(child[0]), int(child[2]),
+                         np.asarray(arity))
+    X = jnp.linspace(-2, 2, 9)[None, :]
+    ev = gp.make_evaluator(sem_pset, cap)
+    pv = ev(*map(jnp.asarray, parent), X)
+    cv = ev(*map(jnp.asarray, child), X)
+    assert bool(jnp.all(jnp.abs(cv - pv) <= 0.5 + 1e-5))
+
+
+def test_cx_semantic(sem_pset):
+    """children are convex combinations lf(tr)*p1 + (1-lf(tr))*p2 — every
+    child value lies between the parent values."""
+    cap = 256
+    gen = gp.make_generator(sem_pset, cap, "grow")
+    arity = jnp.asarray(sem_pset.freeze().arity)
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(22), 3)
+    p1 = gen(k1, 2, 4)
+    p2 = gen(k2, 2, 4)
+    c1, c2 = gp.cx_semantic(k3, p1, p2, sem_pset, min_=1, max_=2)
+    for child in (c1, c2):
+        assert _valid_prefix(np.asarray(child[0]), int(child[2]),
+                             np.asarray(arity))
+    X = jnp.linspace(-2, 2, 9)[None, :]
+    ev = gp.make_evaluator(sem_pset, cap)
+    v1 = ev(*map(jnp.asarray, p1), X)
+    v2 = ev(*map(jnp.asarray, p2), X)
+    lo = jnp.minimum(v1, v2) - 1e-5
+    hi = jnp.maximum(v1, v2) + 1e-5
+    for child in (c1, c2):
+        cv = ev(*map(jnp.asarray, child), X)
+        assert bool(jnp.all((cv >= lo) & (cv <= hi)))
+
+
+def test_semantic_overflow_keeps_parent(sem_pset):
+    """With a tiny capacity the composed child cannot fit; the operator must
+    return the parent unchanged rather than a corrupt tree."""
+    cap = 8
+    gen = gp.make_generator(sem_pset, cap, "grow")
+    k1, k2 = jax.random.split(jax.random.PRNGKey(23))
+    parent = gen(k1, 2, 3)
+    child = gp.mut_semantic(k2, parent, sem_pset, ms=0.5, min_=2, max_=3)
+    assert int(child[2]) == int(parent[2])
+    assert bool(jnp.all(jnp.asarray(child[0]) == jnp.asarray(parent[0])))
