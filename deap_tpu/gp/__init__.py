@@ -26,6 +26,10 @@ from .variation import (cx_one_point, cx_one_point_leaf_biased, mut_uniform,
                         node_depths, tree_height, cx_semantic,
                         mut_semantic)  # noqa: F401
 from .tree import to_string, from_string, graph  # noqa: F401
+from .harm import harm  # noqa: F401
+from .adf import (make_adf_evaluator, make_adf_population_evaluator,
+                  compile_adf)  # noqa: F401
+compileADF = compile_adf
 
 # camelCase aliases (reference API names)
 compile = compile_tree

@@ -1,0 +1,125 @@
+"""Automatically Defined Functions — array-native equivalent of the
+reference's ADF support (``PrimitiveSetTyped.addADF`` gp.py:412-427,
+``compileADF`` gp.py:488-511, example examples/gp/adf_symbreg.py).
+
+Reference model: an individual is a *list* of trees — the main tree plus one
+tree per ADF — and ``compileADF`` compiles them innermost-first, injecting
+each compiled ADF into the enclosing pset's eval context.
+
+Array-native model: an individual is a tuple of ``(codes, consts, length)``
+triples aligned with the pset list (main first, matching compileADF's
+ordering contract).  :func:`make_adf_evaluator` builds a *nested* stack
+machine: when the interpreter for pset ``i`` hits an ADF call node, the
+``lax.switch`` branch runs the interpreter for the callee pset with the
+popped argument rows as its input matrix.  ADF references are acyclic by
+construction (a set can only reference previously constructed sets), so the
+nesting is finite and the whole multi-tree program still compiles to one XLA
+computation.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .pset import Primitive, Argument, PrimitiveSetTyped, freeze_pset
+from .interp import run_stack_machine
+
+__all__ = ["make_adf_evaluator", "make_adf_population_evaluator",
+           "compile_adf"]
+
+
+def make_adf_evaluator(psets: Sequence[PrimitiveSetTyped], cap: int) -> Callable:
+    """Build ``evaluate(trees, X) -> (n_points,)`` for ADF programs.
+
+    ``psets``: the main set first, then the ADF sets it (transitively)
+    references — the same ordering ``compileADF`` expects (gp.py:490-492).
+    ``trees``: a matching sequence of ``(codes, consts, length)`` triples.
+    ``X``: ``(n_main_args, n_points)``.
+    """
+    fs = [freeze_pset(p) for p in psets]
+    name_to_idx = {f.pset.name: j for j, f in enumerate(fs)}
+    cache: dict = {}
+    building: list = []
+
+    def build(i: int) -> Callable:
+        if i in cache:
+            return cache[i]
+        if i in building:
+            chain = " -> ".join(fs[j].pset.name for j in building + [i])
+            raise ValueError(
+                f"cyclic ADF reference: {chain}; ADF references must be "
+                "acyclic")
+        building.append(i)
+        f = fs[i]
+        arity_arr = jnp.asarray(f.arity)
+        max_arity = max(f.max_arity, 1)
+        nodes = f.pset.nodes
+
+        # resolve sub-evaluators eagerly so cycles surface as the ValueError
+        # above (lazy resolution inside the branch closures would recurse at
+        # trace time instead)
+        subs = {}
+        for node in nodes:
+            if isinstance(node, Primitive) and node.name in name_to_idx:
+                j = name_to_idx[node.name]
+                subs[node.name] = (build(j), len(fs[j].pset.ins))
+
+        def evaluate_i(trees, X):
+            codes, consts, length = trees[i]
+
+            def make_branch(node):
+                if isinstance(node, Primitive) and node.name in subs:
+                    sub, n_args = subs[node.name]
+                    return lambda args, const: sub(trees, args[:n_args])
+                if isinstance(node, Primitive):
+                    k, fn = node.arity, node.func
+                    return lambda args, const: fn(*(args[t] for t in range(k)))
+                if isinstance(node, Argument):
+                    idx = node.index
+                    return lambda args, const: X[idx]
+                return lambda args, const: jnp.broadcast_to(const,
+                                                            X.shape[1:])
+            branches = tuple(make_branch(n) for n in nodes)
+            return run_stack_machine(codes, consts, length, X, branches,
+                                     arity_arr, max_arity, cap)
+
+        building.pop()
+        cache[i] = evaluate_i
+        return evaluate_i
+
+    return build(0)
+
+
+def make_adf_population_evaluator(psets, cap: int) -> Callable:
+    """``evaluate_pop(stacked_trees, X) -> (pop, n_points)`` — vmap of
+    :func:`make_adf_evaluator` over individuals whose tree triples carry a
+    leading population axis."""
+    ev = make_adf_evaluator(psets, cap)
+    return jax.vmap(ev, in_axes=(0, None))
+
+
+def compile_adf(trees, psets, cap: int | None = None) -> Callable:
+    """Host-facing parity with reference ``compileADF`` (gp.py:488-511):
+    returns a Python callable over the main pset's arguments."""
+    cap = cap or np.asarray(trees[0][0]).shape[-1]
+    ev = jax.jit(make_adf_evaluator(psets, cap))
+    trees = tuple((jnp.asarray(c), jnp.asarray(k), jnp.asarray(l))
+                  for c, k, l in trees)
+
+    def func(*args):
+        if args:
+            scalar = np.ndim(args[0]) == 0
+            X = jnp.stack([jnp.atleast_1d(jnp.asarray(a, jnp.float32))
+                           for a in args])
+        else:
+            scalar = False
+            X = jnp.zeros((1, 1), jnp.float32)
+        out = ev(trees, X)
+        return float(out[0]) if scalar else out
+
+    return func

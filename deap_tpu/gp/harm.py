@@ -1,0 +1,139 @@
+"""HARM-GP — bloat control by dynamically shaping the genotype size
+distribution (Gardner, Gagné & Parizeau 2015; reference ``gp.harm``,
+gp.py:933-1130).
+
+The reference's generation body (1) samples a large "natural" offspring
+population to model the size distribution, (2) KDE-smooths a size histogram,
+(3) picks a cutoff size from the best-fitness tail, (4) builds a target
+exponential-decay histogram above the cutoff, and (5) accepts offspring with
+probability target/natural per size bin — all with Python loops and
+variable-length lists.
+
+Array-native redesign: tree sizes are bounded by the fixed capacity ``cap``,
+so the size histogram is a *fixed-shape* ``(cap + 3,)`` array built with
+scatter-adds; the natural population is one :func:`~deap_tpu.algorithms.var_or`
+batch; acceptance is a masked gather that recycles accepted individuals when
+too few pass (the reference instead loops generating more).  The whole run
+compiles to one ``lax.scan``.
+
+The population genome must be the GP triple ``(codes, consts, lengths)``
+with leaves ``(pop, cap) (pop, cap) (pop,)`` — individual size is
+``lengths`` exactly as the reference uses ``len(individual)``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import Population, lex_sort_indices
+from ..algorithms import (var_or, evaluate_population, _hof_setup, _record,
+                          _finish)
+
+__all__ = ["harm"]
+
+_KDE = ((-2, 0.1), (-1, 0.2), (0, 0.4), (1, 0.2), (2, 0.1))
+
+
+def harm(key, population: Population, toolbox, cxpb: float, mutpb: float,
+         ngen: int, alpha: float = 0.05, beta: float = 10.0,
+         gamma: float = 0.25, rho: float = 0.9, nbrindsmodel: int = -1,
+         mincutoff: int = 20, stats=None, halloffame=None, verbose=False):
+    """Evolve ``population`` for ``ngen`` generations under HARM-GP size
+    control.  Same toolbox protocol as :func:`~deap_tpu.algorithms.ea_simple`
+    (``evaluate``/``mate``/``mutate``/``select``); recommended parameters
+    follow the paper: alpha=0.05, beta=10, gamma=0.25, rho=0.9 (reference
+    gp.py:975-981).  Returns ``(population, logbook)``."""
+    n = population.size
+    if nbrindsmodel == -1:
+        nbrindsmodel = max(2000, n)
+    m = nbrindsmodel
+    cap = jax.tree_util.tree_leaves(population.genome)[0].shape[-1]
+    nbins = cap + 3
+    ln2 = math.log(2.0)
+
+    key, k0 = jax.random.split(key)
+    population, nevals0 = evaluate_population(toolbox, population)
+    hof_state, hof_upd = _hof_setup(halloffame, population)
+    if hof_state is not None:
+        hof_state = hof_upd(hof_state, population)
+    rec0 = _record(stats, population, nevals0)
+
+    def halflife(x):
+        return x * alpha + beta
+
+    def gen_step(carry, _):
+        key, pop, hof = carry
+        key, k_sel, k_nat, k_acc = jax.random.split(key, 4)
+
+        # 1. natural distribution (reference _genpop with default
+        #    acceptance, gp.py:989-1038).  The reference draws every child's
+        #    parents through ``toolbox.select``; here one m-wide selection
+        #    builds the parent pool (each pick is an independent tournament,
+        #    so uniform draws from the pool in var_or reproduce the same
+        #    per-child selection pressure), then one varOr batch varies it.
+        #    Reproduced children keep their parent's valid fitness; cx/mut
+        #    children are invalid — exactly the mix the reference sorts
+        #    below.
+        parents = pop.take(toolbox.select(k_sel, pop.fitness, m))
+        natural = var_or(k_nat, parents, toolbox, m, cxpb, mutpb)
+        sizes = natural.genome[2].astype(jnp.int32)            # (m,)
+
+        # 2. KDE-smoothed size histogram (reference gp.py:1074-1084),
+        #    normalized to the population scale.
+        hist = jnp.zeros((nbins,), jnp.float32)
+        for off, w in _KDE:
+            b = sizes + off
+            ok = (b >= 0) & (b < nbins)
+            hist = hist.at[jnp.where(ok, b, nbins - 1)].add(
+                jnp.where(ok, w, 0.0))
+        natural_hist = hist * (n / m)
+
+        # 3. cutoff size: among the best-fitness tail of the natural pop
+        #    (invalid fitness sorts worst, like the reference's empty
+        #    wvalues), the smallest individual — floored at mincutoff
+        #    (reference gp.py:1087-1092).
+        order = lex_sort_indices(natural.fitness.masked_wvalues(),
+                                 descending=False)
+        cand_sizes = sizes[order[int(n * rho) - 1:]]
+        cutoff = jnp.maximum(mincutoff, jnp.min(cand_sizes))
+
+        # 4. target histogram: natural below the cutoff, exponential decay
+        #    with size-dependent half-life above it (reference gp.py:1095-1103).
+        bins = jnp.arange(nbins, dtype=jnp.float32)
+        hl = halflife(bins)
+        target_fn = (gamma * n * ln2 / hl) * jnp.exp(
+            -ln2 * (bins - cutoff.astype(jnp.float32)) / hl)
+        target_hist = jnp.where(bins <= cutoff, natural_hist, target_fn)
+
+        # 5. per-size acceptance probability (reference gp.py:1106-1112)
+        prob_hist = jnp.where(natural_hist > 0,
+                              target_hist / jnp.maximum(natural_hist, 1e-30),
+                              target_hist)
+
+        # accept each natural individual with its size's probability, then
+        # take the first n accepted (recycling accepted ones if fewer than n
+        # pass — the reference loops generating more instead,
+        # gp.py:1115-1117)
+        u = jax.random.uniform(k_acc, (m,))
+        accept = u <= prob_hist[jnp.clip(sizes, 0, nbins - 1)]
+        rank = jnp.where(accept, jnp.arange(m), m + jnp.arange(m))
+        by_accept = jnp.argsort(rank)
+        n_acc = jnp.sum(accept)
+        slots = jnp.arange(n) % jnp.maximum(n_acc, 1)
+        chosen = by_accept[slots]
+        offspring = natural.take(chosen)
+
+        offspring, nevals = evaluate_population(toolbox, offspring)
+        if hof is not None:
+            hof = hof_upd(hof, offspring)
+        return (key, offspring, hof), _record(stats, offspring, nevals)
+
+    (key, population, hof_state), stacked = lax.scan(
+        gen_step, (key, population, hof_state), None, length=ngen)
+    logbook = _finish(key, population, hof_state, halloffame, stats, rec0,
+                      stacked, ngen, verbose)
+    return population, logbook

@@ -28,7 +28,36 @@ from jax import lax
 
 from .pset import FrozenPSet, PrimitiveSetTyped, freeze_pset
 
-__all__ = ["make_evaluator", "make_population_evaluator", "compile_tree"]
+__all__ = ["make_evaluator", "make_population_evaluator", "compile_tree",
+           "run_stack_machine"]
+
+
+def run_stack_machine(codes, consts, length, X, branches, arity, max_arity,
+                      cap):
+    """The shared scan core: run the prefix program right-to-left, pushing
+    terminal values and applying primitives via ``lax.switch`` over
+    ``branches`` (one callable per node code, signature
+    ``(args (max_arity, n_points), const) -> (n_points,)``).  Returns the
+    value at the top of the stack."""
+    n_points = X.shape[1]
+    stack0 = jnp.zeros((cap + 1, n_points), X.dtype)
+
+    def step(carry, tok):
+        stack, sp = carry
+        c, const, pos = tok
+        active = pos < length
+        a = arity[c]
+        arg_rows = jnp.clip(sp - 1 - jnp.arange(max_arity), 0, cap)
+        args = stack[arg_rows]                      # (max_arity, n_points)
+        res = lax.switch(c, branches, args, const)
+        new_sp = jnp.where(active, sp - a + 1, sp)
+        row = jnp.where(active, jnp.clip(new_sp - 1, 0, cap - 1), cap)
+        stack = stack.at[row].set(res)              # row `cap` = scratch
+        return (stack, new_sp), None
+
+    toks = (codes[::-1], consts[::-1], jnp.arange(cap)[::-1])
+    (stack, sp), _ = lax.scan(step, (stack0, jnp.int32(0)), toks)
+    return stack[jnp.clip(sp - 1, 0, cap - 1)]
 
 
 def make_evaluator(pset, cap: int) -> Callable:
@@ -40,25 +69,10 @@ def make_evaluator(pset, cap: int) -> Callable:
     ops = f.ops
 
     def evaluate(codes, consts, length, X):
-        n_points = X.shape[1]
-        stack0 = jnp.zeros((cap + 1, n_points), X.dtype)
-
-        def step(carry, tok):
-            stack, sp = carry
-            c, const, pos = tok
-            active = pos < length
-            a = arity[c]
-            arg_rows = jnp.clip(sp - 1 - jnp.arange(max_arity), 0, cap)
-            args = stack[arg_rows]                      # (max_arity, n_points)
-            res = lax.switch(c, ops, args, const, X)
-            new_sp = jnp.where(active, sp - a + 1, sp)
-            row = jnp.where(active, jnp.clip(new_sp - 1, 0, cap - 1), cap)
-            stack = stack.at[row].set(res)              # row `cap` = scratch
-            return (stack, new_sp), None
-
-        toks = (codes[::-1], consts[::-1], jnp.arange(cap)[::-1])
-        (stack, sp), _ = lax.scan(step, (stack0, jnp.int32(0)), toks)
-        return stack[jnp.clip(sp - 1, 0, cap - 1)]
+        branches = tuple(
+            (lambda args, const, op=op: op(args, const, X)) for op in ops)
+        return run_stack_machine(codes, consts, length, X, branches, arity,
+                                 max_arity, cap)
 
     return evaluate
 

@@ -161,10 +161,39 @@ class PrimitiveSetTyped:
         self._frozen = None
         return eph
 
+    def add_adf(self, adfset: "PrimitiveSetTyped"):
+        """Register another primitive set as a callable ADF node (reference
+        addADF, gp.py:412-427).  The node's signature mirrors the ADF set's
+        argument/return types; its behavior is supplied per-individual by
+        :func:`deap_tpu.gp.adf.make_adf_evaluator` (the plain evaluator
+        yields NaN for ADF nodes)."""
+        inv = {v: k for k, v in adfset._type_ids.items()}
+        # call the typed base implementation explicitly: the untyped facade
+        # overrides add_primitive with an (func, arity) signature
+        return PrimitiveSetTyped.add_primitive(
+            self, None, [inv[i] for i in adfset.ins], inv[adfset.ret],
+            name=adfset.name)
+
+    def rename_arguments(self, **kargs):
+        """Rename input arguments, e.g. ``rename_arguments(ARG0="x")``
+        (reference renameArguments, gp.py:396-410)."""
+        for old_name, new_name in kargs.items():
+            arg = self.mapping.get(old_name)
+            if not isinstance(arg, Argument):
+                raise ValueError(f"{old_name!r} is not an argument of "
+                                 f"primitive set {self.name!r}")
+            self._check_name(new_name)
+            del self.mapping[old_name]
+            arg.name = new_name
+            self.mapping[new_name] = arg
+        self._frozen = None
+
     # camelCase aliases matching the reference API
     addPrimitive = add_primitive
     addTerminal = add_terminal
     addEphemeralConstant = add_ephemeral_constant
+    addADF = add_adf
+    renameArguments = rename_arguments
 
     # -- freezing -----------------------------------------------------------
     @property
@@ -273,6 +302,11 @@ class FrozenPSet:
         # jax ops for the interpreter: one callable per node code
         def make_op(i, n):
             if isinstance(n, Primitive):
+                if n.func is None:
+                    # ADF placeholder: only meaningful through the nested
+                    # interpreter (deap_tpu.gp.adf); NaN flags misuse here
+                    return lambda args, const, X: jnp.full(
+                        X.shape[1:], jnp.nan, X.dtype)
                 k = n.arity
                 fn = n.func
                 return lambda args, const, X: fn(*(args[j] for j in range(k)))

@@ -256,3 +256,84 @@ def test_semantic_overflow_keeps_parent(sem_pset):
     child = gp.mut_semantic(k2, parent, sem_pset, ms=0.5, min_=2, max_=3)
     assert int(child[2]) == int(parent[2])
     assert bool(jnp.all(jnp.asarray(child[0]) == jnp.asarray(parent[0])))
+
+
+def test_harm_controls_bloat(pset):
+    """HARM-GP (reference gp.py:933-1130) should reach good fitness on
+    symbreg while holding the size distribution well under capacity."""
+    cap = 48
+    X = jnp.linspace(-1, 1, 20)[None, :]
+    target = X[0] ** 2 + X[0]
+    ev = gp.make_evaluator(pset, cap)
+    gen_init = gp.make_generator(pset, cap, "half_and_half")
+    gen_mut = gp.make_generator(pset, cap, "full")
+
+    def evaluate(tree):
+        out = ev(tree[0], tree[1], tree[2], X)
+        mse = jnp.mean((out - target) ** 2)
+        return (jnp.where(jnp.isfinite(mse), mse, 1e6),)
+
+    tb = base.Toolbox()
+    tb.register("evaluate", evaluate)
+    tb.register("mate", lambda k, t1, t2: gp.cx_one_point(k, t1, t2, pset))
+    tb.register("mutate", lambda k, t: gp.mut_uniform(
+        k, t, lambda kk: gen_mut(kk, 0, 2), pset))
+    tb.register("select", selection.sel_tournament, tournsize=3)
+
+    npop = 64
+    keys = jax.random.split(jax.random.PRNGKey(31), npop)
+    codes, consts, lengths = jax.vmap(lambda k: gen_init(k, 1, 3))(keys)
+    pop = base.Population(genome=(codes, consts, lengths),
+                          fitness=base.Fitness.empty(npop, (-1.0,)))
+    pop, logbook = gp.harm(jax.random.PRNGKey(32), pop, tb, cxpb=0.8,
+                           mutpb=0.15, ngen=12, nbrindsmodel=512,
+                           mincutoff=8)
+    best = float(np.min(np.asarray(pop.fitness.values)))
+    mean_size = float(np.mean(np.asarray(pop.genome[2])))
+    assert best < 0.5, f"harm did not converge: best mse {best}"
+    assert mean_size < cap * 0.8, f"harm failed to control size: {mean_size}"
+
+
+def test_adf_nested_evaluation():
+    """ADF programs (reference addADF gp.py:412-427, compileADF
+    gp.py:488-511): main calls ADF0 which calls ADF1; exact arithmetic."""
+    cap = 32
+    adf1 = gp.PrimitiveSet("ADF1", 2)
+    adf1.add_primitive(jnp.add, 2, name="add")
+    adf1.add_primitive(jnp.multiply, 2, name="mul")
+    adf0 = gp.PrimitiveSet("ADF0", 2)
+    adf0.add_primitive(jnp.add, 2, name="add")
+    adf0.add_primitive(jnp.subtract, 2, name="sub")
+    adf0.add_adf(adf1)
+    main = gp.PrimitiveSet("MAIN", 1)
+    main.add_primitive(jnp.add, 2, name="add")
+    main.add_primitive(jnp.multiply, 2, name="mul")
+    main.add_adf(adf0)
+    main.add_adf(adf1)
+    main.rename_arguments(ARG0="x")
+
+    psets = (main, adf0, adf1)
+    # ADF1(a,b) = a*b + a; ADF0(a,b) = ADF1(a,b) - b; main = ADF0(x,x) + x
+    trees = (gp.from_string("add(ADF0(x, x), x)", main, cap=cap),
+             gp.from_string("sub(ADF1(ARG0, ARG1), ARG1)", adf0, cap=cap),
+             gp.from_string("add(mul(ARG0, ARG1), ARG0)", adf1, cap=cap))
+    f = gp.compile_adf(trees, psets, cap=cap)
+    xs = np.linspace(-2, 2, 7)
+    np.testing.assert_allclose(np.asarray(f(xs)), xs ** 2 + xs, rtol=1e-5)
+
+    pe = gp.make_adf_population_evaluator(psets, cap)
+    stacked = jax.tree_util.tree_map(
+        lambda *a: jnp.stack([jnp.asarray(x) for x in a]), *([trees] * 3))
+    out = pe(stacked, jnp.asarray(xs, jnp.float32)[None, :])
+    assert out.shape == (3, 7)
+    np.testing.assert_allclose(np.asarray(out[1]), xs ** 2 + xs, rtol=1e-5)
+
+
+def test_rename_arguments_roundtrip(pset):
+    ps = gp.PrimitiveSet("RN", 2)
+    ps.add_primitive(jnp.add, 2, name="add")
+    ps.rename_arguments(ARG0="x", ARG1="y")
+    tree = gp.from_string("add(x, y)", ps, cap=8)
+    assert gp.to_string(tree, ps) == "add(x, y)"
+    with pytest.raises(ValueError):
+        ps.rename_arguments(ARG7="z")
