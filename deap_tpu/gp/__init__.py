@@ -29,6 +29,7 @@ from .tree import to_string, from_string, graph  # noqa: F401
 from .harm import harm  # noqa: F401
 from .adf import (make_adf_evaluator, make_adf_population_evaluator,
                   compile_adf)  # noqa: F401
+from .routine import make_routine_interpreter  # noqa: F401
 compileADF = compile_adf
 
 # camelCase aliases (reference API names)
@@ -68,6 +69,42 @@ def logistic(x):
     gp.py:1227: ``1 / (1 + exp(-x))``)."""
     return jax.nn.sigmoid(x)
 
+
+def _b(x):
+    return x != 0
+
+
+def b_and(a, b):
+    return (_b(a) & _b(b)).astype(a.dtype)
+
+
+def b_or(a, b):
+    return (_b(a) | _b(b)).astype(a.dtype)
+
+
+def b_xor(a, b):
+    return (_b(a) ^ _b(b)).astype(a.dtype)
+
+
+def b_not(a):
+    return (~_b(a)).astype(a.dtype)
+
+
+def b_if_then_else(c, a, b):
+    return jnp.where(_b(c), a, b)
+
+
+#: Boolean primitives encoded on the float stack (0.0 = false) — the
+#: interpreter requires every op to return the stack dtype, so logical ops
+#: cast back (used by the multiplexer/parity examples, reference
+#: examples/gp/multiplexer.py, parity.py).
+bool_ops = {
+    "and_": (b_and, 2),
+    "or_": (b_or, 2),
+    "xor_": (b_xor, 2),
+    "not_": (b_not, 1),
+    "if_then_else": (b_if_then_else, 3),
+}
 
 safe_ops = {
     "add": (jnp.add, 2),

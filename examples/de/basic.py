@@ -1,0 +1,29 @@
+"""Differential evolution, rand/1/bin (reference examples/de/basic.py):
+for each agent build a donor from three distinct partners, binomial
+crossover, keep the better of agent/trial — one jitted generation, scanned.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base, benchmarks
+from deap_tpu.de import de
+
+
+POP, NDIM, NGEN = 300, 10, 200
+
+
+def main(seed=15, verbose=True):
+    key = jax.random.PRNGKey(seed)
+    k_init, key = jax.random.split(key)
+    genome = jax.random.uniform(k_init, (POP, NDIM), jnp.float32, -3.0, 3.0)
+    pop = base.Population(genome, base.Fitness.empty(POP, (-1.0,)))
+    pop, _ = de(key, pop, benchmarks.sphere, ngen=NGEN, cr=0.25, f=1.0)
+    best = float(jnp.min(pop.fitness.values))
+    if verbose:
+        print(f"best sphere value: {best:.3e}")
+    return best
+
+
+if __name__ == "__main__":
+    main()

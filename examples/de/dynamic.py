@@ -1,0 +1,51 @@
+"""DE on a dynamic landscape (reference examples/de/dynamic.py): DE tracking
+MovingPeaks, with a fraction of agents re-randomized ("brownian" agents)
+after each landscape change so the population never fully converges.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base
+from deap_tpu.benchmarks.movingpeaks import MovingPeaks, SCENARIO_1
+from deap_tpu.de import de_step
+
+
+POP, NDIM, NGEN, CHANGE_EVERY, N_BROWNIAN = 100, 5, 120, 60, 25
+BOUNDS = (0.0, 100.0)
+
+
+def main(seed=17, verbose=True):
+    mp = MovingPeaks(dim=NDIM, key=jax.random.PRNGKey(seed), **SCENARIO_1)
+    key = jax.random.PRNGKey(seed + 1)
+    k_init, key = jax.random.split(key)
+    genome = jax.random.uniform(k_init, (POP, NDIM), jnp.float32, *BOUNDS)
+    pop = base.Population(genome, base.Fitness.empty(POP, (1.0,)))
+
+    errors = []
+    for gen in range(NGEN):
+        key, k_step, k_rnd = jax.random.split(key, 3)
+        peaks = mp.state
+        evaluate = lambda x: mp.evaluate(x, peaks)
+        pop = de_step(k_step, pop, evaluate, cr=0.6, f=0.4)
+        best = float(jnp.max(pop.fitness.values))
+        errors.append(float(mp.globalMaximum()[0]) - best)
+        if (gen + 1) % CHANGE_EVERY == 0:
+            mp.changePeaks()
+            # re-randomize the worst N_BROWNIAN agents and invalidate all
+            w = pop.fitness.masked_wvalues()[:, 0]
+            order = jnp.argsort(w)                     # worst first
+            fresh = jax.random.uniform(
+                k_rnd, (N_BROWNIAN, NDIM), jnp.float32, *BOUNDS)
+            genome = pop.genome.at[order[:N_BROWNIAN]].set(fresh)
+            pop = base.Population(genome,
+                                  base.Fitness.empty(POP, (1.0,)))
+    if verbose:
+        print(f"mean tracking error: {np.mean(errors):.3f} "
+              f"(final {errors[-1]:.3f})")
+    return errors
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""DE variants on the sphere (reference examples/de/sphere.py, which uses a
+best/1/bin-style scheme): compare rand/1/bin against best/1/bin and
+rand/2/bin on a 20-D sphere.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base, benchmarks
+from deap_tpu.de import de
+
+
+POP, NDIM, NGEN = 300, 20, 150
+
+
+def main(seed=16, verbose=True):
+    results = {}
+    for variant in ("rand/1/bin", "best/1/bin", "rand/2/bin"):
+        key = jax.random.PRNGKey(seed)
+        k_init, key = jax.random.split(key)
+        genome = jax.random.uniform(k_init, (POP, NDIM), jnp.float32,
+                                    -3.0, 3.0)
+        pop = base.Population(genome, base.Fitness.empty(POP, (-1.0,)))
+        pop, _ = de(key, pop, benchmarks.sphere, ngen=NGEN,
+                    cr=0.25, f=0.6, variant=variant)
+        results[variant] = float(jnp.min(pop.fitness.values))
+    if verbose:
+        for v, b in results.items():
+            print(f"{v:12s} best: {b:.3e}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

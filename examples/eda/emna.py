@@ -1,0 +1,34 @@
+"""EMNA — Estimation of Multivariate Normal Algorithm (reference
+examples/eda/emna.py:32-62): ask/tell loop re-estimating an isotropic
+Gaussian from the μ best of each λ-sample.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base, benchmarks
+from deap_tpu.algorithms import ea_generate_update
+from deap_tpu.eda import EMNA
+
+
+NDIM, NGEN = 5, 150
+
+
+def main(seed=18, verbose=True):
+    strategy = EMNA(centroid=[5.0] * NDIM, sigma=5.0, mu=25, lambda_=100)
+    tb = base.Toolbox()
+    tb.register("evaluate", benchmarks.sphere)
+    tb.register("generate", strategy.generate)
+    tb.register("update", strategy.update)
+
+    pop, state, logbook = ea_generate_update(
+        jax.random.PRNGKey(seed), tb, strategy.init(), ngen=NGEN,
+        weights=(-1.0,))
+    best = float(jnp.min(pop.fitness.values))
+    if verbose:
+        print(f"best sphere value: {best:.3e}")
+    return best
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""PBIL — Population-Based Incremental Learning (reference
+examples/eda/pbil.py:26-55): a probability vector over bits, nudged toward
+the best sample each generation and mutated, on OneMax.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base
+from deap_tpu.algorithms import ea_generate_update
+from deap_tpu.eda import PBIL
+
+
+N_BITS, NGEN = 50, 100
+
+
+def main(seed=19, verbose=True):
+    strategy = PBIL(ndim=N_BITS, learning_rate=0.3, mut_prob=0.1,
+                    mut_shift=0.05, lambda_=20, seed=seed)
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: (jnp.sum(g),))
+    tb.register("generate", strategy.generate)
+    tb.register("update", strategy.update)
+
+    pop, state, logbook = ea_generate_update(
+        jax.random.PRNGKey(seed), tb, strategy.init(), ngen=NGEN,
+        weights=(1.0,))
+    best = float(jnp.max(pop.fitness.values))
+    if verbose:
+        print(f"best onemax: {best:.0f}/{N_BITS}")
+    return best
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""(1+λ)-CMA-ES (reference examples/es/cma_1+l.py): single parent,
+success-rule step-size control and Cholesky covariance update (Igel 2007;
+reference cma.py:208-325).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base, cma, benchmarks
+from deap_tpu.algorithms import ea_generate_update
+
+
+N, NGEN = 5, 150
+
+
+def main(seed=10, verbose=True):
+    parent = jax.random.uniform(jax.random.PRNGKey(seed), (N,),
+                                jnp.float32, -5.0, 5.0)
+    strategy = cma.StrategyOnePlusLambda(parent, sigma=5.0, lambda_=10)
+
+    tb = base.Toolbox()
+    tb.register("evaluate", benchmarks.rastrigin)
+    tb.register("generate", strategy.generate)
+    tb.register("update", strategy.update)
+
+    pop, state, logbook = ea_generate_update(
+        jax.random.PRNGKey(seed + 1), tb, strategy.init(), ngen=NGEN,
+        weights=(-1.0,))
+    best = float(jnp.min(pop.fitness.values))
+    if verbose:
+        print(f"best rastrigin value: {best:.4f}")
+    return best
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Evolution strategy on sphere minimization (reference examples/es/fctmin.py):
+(μ, λ)-ES with self-adaptive strategy parameters — each individual carries
+its own mutation strengths, varied by ES blend crossover and log-normal
+strategy mutation.
+
+The reference attaches a ``strategy`` attribute via creator; here the genome
+pytree is ``{"x": (dim,), "strategy": (dim,)}`` — attributes are sibling
+leaves.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base, algorithms, benchmarks
+from deap_tpu.ops import crossover, mutation, selection
+
+
+MU, LAMBDA, NDIM, NGEN = 10, 100, 30, 120
+MIN_STRATEGY = 0.001
+
+
+def main(seed=7, verbose=True):
+    def mate(key, a, b):
+        (xa, xb), (sa, sb) = crossover.cx_es_blend(
+            key, (a["x"], a["strategy"]), (b["x"], b["strategy"]), alpha=0.1)
+        return {"x": xa, "strategy": sa}, {"x": xb, "strategy": sb}
+
+    def mutate(key, ind):
+        x, s = mutation.mut_es_log_normal(
+            key, (ind["x"], ind["strategy"]), c=1.0, indpb=0.3)
+        return {"x": x, "strategy": jnp.maximum(s, MIN_STRATEGY)}
+
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: benchmarks.sphere(g["x"]))
+    tb.register("mate", mate)
+    tb.register("mutate", mutate)
+    tb.register("select", selection.sel_best)
+
+    key = jax.random.PRNGKey(seed)
+    k_x, k_s, key = jax.random.split(key, 3)
+    genome = {
+        "x": jax.random.uniform(k_x, (MU, NDIM), jnp.float32, -3.0, 3.0),
+        "strategy": jax.random.uniform(k_s, (MU, NDIM), jnp.float32, 0.5, 3.0),
+    }
+    pop = base.Population(genome, base.Fitness.empty(MU, (-1.0,)))
+
+    pop, logbook = algorithms.ea_mu_comma_lambda(
+        key, pop, tb, mu=MU, lambda_=LAMBDA, cxpb=0.6, mutpb=0.3, ngen=NGEN)
+    best = float(jnp.min(pop.fitness.values))
+    if verbose:
+        print(f"best sphere value: {best:.6f}")
+    return pop, best
+
+
+if __name__ == "__main__":
+    main()

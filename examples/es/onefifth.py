@@ -1,0 +1,46 @@
+"""(1+1)-ES with the 1/5th success rule (reference examples/es/onefifth.py):
+the simplest adaptive evolution strategy — one parent, one Gaussian child
+per step, step size multiplied up on success and down on failure.
+
+The whole adaptive loop is one ``lax.scan``.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu import benchmarks
+
+
+NDIM, NGEN = 10, 600
+C = 0.817          # Rechenberg/Schwefel constant, reference onefifth.py
+
+
+def main(seed=8, verbose=True):
+    def step(carry, key):
+        x, sigma, fx = carry
+        k_z, = jax.random.split(key, 1)
+        child = x + sigma * jax.random.normal(k_z, x.shape)
+        fc = benchmarks.sphere(child)[0]
+        success = fc < fx
+        x = jnp.where(success, child, x)
+        fx = jnp.where(success, fc, fx)
+        # 1/5th rule: expand on success, shrink otherwise
+        sigma = jnp.where(success, sigma / C, sigma * C ** 0.25)
+        return (x, sigma, fx), fx
+
+    key = jax.random.PRNGKey(seed)
+    k_init, key = jax.random.split(key)
+    x0 = jax.random.uniform(k_init, (NDIM,), jnp.float32, -5.0, 5.0)
+    f0 = benchmarks.sphere(x0)[0]
+
+    keys = jax.random.split(key, NGEN)
+    (x, sigma, fx), hist = lax.scan(step, (x0, jnp.float32(5.0), f0), keys)
+    if verbose:
+        print(f"best fitness {float(fx):.3e}, final sigma {float(sigma):.3e}")
+    return float(fx)
+
+
+if __name__ == "__main__":
+    main()

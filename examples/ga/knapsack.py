@@ -1,0 +1,86 @@
+"""Multi-objective 0/1 knapsack (reference examples/ga/knapsack.py): the
+reference uses *set*-typed individuals with custom set-union/difference
+crossover; the array-native genome is the set's indicator mask — a boolean
+vector — which makes the custom operators one-line masked ops.
+
+Objectives: minimize weight, maximize value; selection NSGA-II.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu import base
+from deap_tpu.algorithms import evaluate_population, var_and
+from deap_tpu.ops import emo
+
+
+N_ITEMS, MU, NGEN = 20, 50, 50
+MAX_ITEM, MAX_WEIGHT = 5, 50
+
+
+def main(seed=2, verbose=True):
+    rng = np.random.RandomState(64)
+    weights_arr = jnp.asarray(rng.randint(1, 10, N_ITEMS), jnp.float32)
+    values_arr = jnp.asarray(rng.uniform(0, 100, N_ITEMS), jnp.float32)
+
+    def evaluate(mask):
+        w = jnp.sum(mask * weights_arr)
+        v = jnp.sum(mask * values_arr)
+        # overweight/overfull → heavily penalized (reference returns a
+        # sentinel (10000, 0) for violating bags)
+        bad = (w > MAX_WEIGHT) | (jnp.sum(mask) > MAX_ITEM)
+        return (jnp.where(bad, 1e4, w), jnp.where(bad, 0.0, v))
+
+    def cx_set(key, a, b):
+        """Reference cxSet: child1 = intersection, child2 = symmetric
+        difference — exact mask algebra."""
+        return a * b, jnp.abs(a - b)
+
+    def mut_set(key, mask):
+        """Reference mutSet: add or remove one random element."""
+        k_op, k_el = jax.random.split(key)
+        i = jax.random.randint(k_el, (), 0, N_ITEMS)
+        add = jax.random.bernoulli(k_op)
+        return mask.at[i].set(jnp.where(add, 1.0, 0.0))
+
+    tb = base.Toolbox()
+    tb.register("evaluate", evaluate)
+    tb.register("mate", cx_set)
+    tb.register("mutate", mut_set)
+
+    key = jax.random.PRNGKey(seed)
+    key, k_init = jax.random.split(key)
+    genome = (jax.random.uniform(k_init, (MU, N_ITEMS)) < 0.25).astype(jnp.float32)
+    weights = (-1.0, 1.0)                     # min weight, max value
+    pop = base.Population(genome, base.Fitness.empty(MU, weights))
+
+    def gen_step(carry, _):
+        key, pop = carry
+        key, k_var, k_sel = jax.random.split(key, 3)
+        off = var_and(k_var, pop, tb, cxpb=0.3, mutpb=0.2)
+        off, _ = evaluate_population(tb, off)
+        pool = pop.concat(off)
+        sel = emo.sel_nsga2(k_sel, pool.fitness, MU)
+        new = pool.take(sel)
+        return (key, new), None
+
+    @jax.jit
+    def run(key, pop):
+        pop, _ = evaluate_population(tb, pop)
+        (key, pop), _ = lax.scan(gen_step, (key, pop), None, length=NGEN)
+        return pop
+
+    pop = run(key, pop)
+    vals = np.asarray(pop.fitness.values)
+    feasible = vals[:, 0] <= MAX_WEIGHT
+    if verbose:
+        print(f"feasible: {feasible.sum()}/{MU}; "
+              f"best value {vals[feasible, 1].max():.1f} at weight "
+              f"{vals[feasible][np.argmax(vals[feasible, 1]), 0]:.0f}")
+    return pop
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""NSGA-II on ZDT1 (reference examples/ga/nsga2.py): bounded SBX crossover,
+polynomial mutation, dominance/crowding tournament for mating and NSGA-II
+environmental selection — the canonical multi-objective GA.
+
+Quality gate (reference deap/tests/test_algorithms.py:32,110-113):
+hypervolume at reference point (11, 11) > 116 after 100 generations.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu import base, benchmarks
+from deap_tpu.algorithms import evaluate_population
+from deap_tpu.benchmarks import tools as btools
+from deap_tpu.ops import crossover, mutation, emo
+
+
+MU, NGEN, NDIM = 64, 100, 30
+LOW, UP = 0.0, 1.0
+
+
+def main(seed=1, mu=MU, ngen=NGEN, verbose=True):
+    tb = base.Toolbox()
+    tb.register("evaluate", benchmarks.zdt1)
+    tb.register("mate", crossover.cx_simulated_binary_bounded,
+                eta=20.0, low=LOW, up=UP)
+    tb.register("mutate", mutation.mut_polynomial_bounded,
+                eta=20.0, low=LOW, up=UP, indpb=1.0 / NDIM)
+
+    key = jax.random.PRNGKey(seed)
+    key, k_init = jax.random.split(key)
+    genome = jax.random.uniform(k_init, (mu, NDIM), jnp.float32, LOW, UP)
+    pop = base.Population(genome, base.Fitness.empty(mu, (-1.0, -1.0)))
+
+    def gen_step(carry, _):
+        key, pop = carry
+        key, k_mate, k_cx, k_mut, k_sel = jax.random.split(key, 5)
+        # mating pool via dominance/crowding tournament (emo.py:145-195)
+        idx = emo.sel_tournament_dcd(k_mate, pop.fitness, mu)
+        off = pop.take(idx)
+        # pairwise SBX + polynomial mutation
+        keys = jax.random.split(k_cx, mu // 2)
+        ga = jax.tree_util.tree_map(lambda x: x[0::2], off.genome)
+        gb = jax.tree_util.tree_map(lambda x: x[1::2], off.genome)
+        ca, cb = jax.vmap(tb.mate)(keys, ga, gb)
+        child = jnp.stack([ca, cb], 1).reshape(mu, NDIM)
+        mkeys = jax.random.split(k_mut, mu)
+        child = jax.vmap(tb.mutate)(mkeys, child)
+        off = base.Population(child, base.Fitness.empty(mu, (-1.0, -1.0)))
+        off, _ = evaluate_population(tb, off)
+        pool = pop.concat(off)
+        sel = emo.sel_nsga2(k_sel, pool.fitness, mu)
+        new = pool.take(sel)
+        return (key, new), jnp.min(new.fitness.values, axis=0)
+
+    @jax.jit
+    def run(key, pop):
+        pop, _ = evaluate_population(tb, pop)
+        return lax.scan(gen_step, (key, pop), None, length=ngen)
+
+    (key, pop), mins = run(key, pop)
+    hv = btools.hypervolume(pop.fitness, ref=np.array([11.0, 11.0]))
+    if verbose:
+        print(f"final hypervolume {hv:.3f} (ZDT1 optimum ≈ 120.777, "
+              f"gate > 116)")
+    return pop, hv
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""OneMax, minimal form (reference examples/ga/onemax_short.py): the same
+problem as :mod:`onemax` with no stats plumbing — the smallest complete GA.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base, algorithms
+from deap_tpu.ops import crossover, mutation, selection
+
+
+def main(seed=0):
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: (jnp.sum(g),))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+
+    key, k_init = jax.random.split(jax.random.PRNGKey(seed))
+    genome = jax.random.bernoulli(k_init, 0.5, (300, 100)).astype(jnp.float32)
+    pop = base.Population(genome, base.Fitness.empty(300, (1.0,)))
+    pop, _ = algorithms.ea_simple(key, pop, tb, cxpb=0.5, mutpb=0.2, ngen=40)
+    print("best:", float(jnp.max(pop.fitness.values)))
+    return pop
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Traveling salesman with permutation genomes (reference examples/ga/tsp.py):
+partially-matched crossover + index-shuffle mutation over city orderings.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base, algorithms
+from deap_tpu.ops import crossover, mutation, selection
+
+
+N_CITIES, POP, NGEN = 25, 200, 80
+
+
+def main(seed=3, verbose=True):
+    rng = np.random.RandomState(169)
+    coords = jnp.asarray(rng.rand(N_CITIES, 2), jnp.float32)
+
+    def evaluate(perm):
+        p = perm.astype(jnp.int32)
+        a = coords[p]
+        b = coords[jnp.roll(p, -1)]
+        return (jnp.sum(jnp.linalg.norm(a - b, axis=-1)),)
+
+    tb = base.Toolbox()
+    tb.register("evaluate", evaluate)
+    tb.register("mate", crossover.cx_partialy_matched)
+    tb.register("mutate", mutation.mut_shuffle_indexes, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+
+    key = jax.random.PRNGKey(seed)
+    key, k_init = jax.random.split(key)
+    keys = jax.random.split(k_init, POP)
+    genome = jax.vmap(lambda k: jax.random.permutation(k, N_CITIES))(keys)
+    pop = base.Population(genome, base.Fitness.empty(POP, (-1.0,)))
+
+    pop, logbook = algorithms.ea_simple(
+        key, pop, tb, cxpb=0.7, mutpb=0.2, ngen=NGEN)
+    best = float(jnp.min(pop.fitness.values))
+    # sanity: tours must remain permutations
+    tours = np.asarray(pop.genome, np.int32)
+    assert all(sorted(t) == list(range(N_CITIES)) for t in tours[:5])
+    if verbose:
+        print(f"shortest tour length: {best:.3f}")
+    return pop, best
+
+
+if __name__ == "__main__":
+    main()

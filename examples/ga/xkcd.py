@@ -1,0 +1,81 @@
+"""xkcd #287 "NP-complete" menu problem (reference examples/ga/xkcd.py):
+order appetizers totalling exactly $15.05 — minimize price error and item
+count as two objectives.
+
+The reference uses set-typed individuals; the array genome is the count
+vector of each menu item (0..3 of each).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu import base
+from deap_tpu.algorithms import evaluate_population, var_and
+from deap_tpu.ops import emo
+
+
+ITEMS = [("Mixed Fruit", 2.15), ("French Fries", 2.75), ("Side Salad", 3.35),
+         ("Hot Wings", 3.55), ("Mozzarella Sticks", 4.20),
+         ("Sampler Plate", 5.80)]
+TARGET = 15.05
+MU, NGEN, MAX_COUNT = 40, 60, 3
+
+
+def main(seed=6, verbose=True):
+    prices = jnp.asarray([p for _, p in ITEMS], jnp.float32)
+
+    def evaluate(counts):
+        total = jnp.sum(counts * prices)
+        return (jnp.abs(total - TARGET), jnp.sum(counts))
+
+    def mate(key, a, b):
+        """Uniform count exchange."""
+        m = jax.random.bernoulli(key, 0.5, a.shape)
+        return jnp.where(m, a, b), jnp.where(m, b, a)
+
+    def mutate(key, counts):
+        k_i, k_d = jax.random.split(key)
+        i = jax.random.randint(k_i, (), 0, len(ITEMS))
+        delta = jax.random.choice(k_d, jnp.array([-1.0, 1.0]))
+        return counts.at[i].set(jnp.clip(counts[i] + delta, 0, MAX_COUNT))
+
+    tb = base.Toolbox()
+    tb.register("evaluate", evaluate)
+    tb.register("mate", mate)
+    tb.register("mutate", mutate)
+
+    key = jax.random.PRNGKey(seed)
+    key, k_init = jax.random.split(key)
+    genome = jax.random.randint(
+        k_init, (MU, len(ITEMS)), 0, 2).astype(jnp.float32)
+    pop = base.Population(genome, base.Fitness.empty(MU, (-1.0, -1.0)))
+
+    def gen_step(carry, _):
+        key, pop = carry
+        key, k_var, k_sel = jax.random.split(key, 3)
+        off = var_and(k_var, pop, tb, cxpb=0.3, mutpb=0.6)
+        off, _ = evaluate_population(tb, off)
+        pool = pop.concat(off)
+        new = pool.take(emo.sel_nsga2(k_sel, pool.fitness, MU))
+        return (key, new), None
+
+    @jax.jit
+    def run(key, pop):
+        pop, _ = evaluate_population(tb, pop)
+        (key, pop), _ = lax.scan(gen_step, (key, pop), None, length=NGEN)
+        return pop
+
+    pop = run(key, pop)
+    vals = np.asarray(pop.fitness.values)
+    best = np.argmin(vals[:, 0])
+    counts = np.asarray(pop.genome[best], np.int32)
+    if verbose:
+        order = [f"{c}x {n}" for c, (n, _) in zip(counts, ITEMS) if c]
+        print(f"best order (err ${vals[best, 0]:.2f}): {', '.join(order)}")
+    return pop
+
+
+if __name__ == "__main__":
+    main()

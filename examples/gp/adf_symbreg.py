@@ -1,0 +1,88 @@
+"""Symbolic regression with Automatically Defined Functions (reference
+examples/gp/adf_symbreg.py): individuals carry a main tree plus ADF trees;
+the nested stack machine evaluates the whole program in one XLA computation.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base, gp, algorithms
+from deap_tpu.ops import selection
+
+
+CAP, POP, NGEN = 48, 200, 30
+
+
+def main(seed=25, ngen=NGEN, verbose=True):
+    adf0 = gp.PrimitiveSet("ADF0", 2)
+    for name, (fn, ar) in (("add", gp.safe_ops["add"]),
+                           ("sub", gp.safe_ops["sub"]),
+                           ("mul", gp.safe_ops["mul"])):
+        adf0.add_primitive(fn, ar, name=name)
+
+    main_ps = gp.PrimitiveSet("MAIN", 1)
+    for name, (fn, ar) in (("add", gp.safe_ops["add"]),
+                           ("sub", gp.safe_ops["sub"]),
+                           ("mul", gp.safe_ops["mul"]),
+                           ("div", gp.safe_ops["div"])):
+        main_ps.add_primitive(fn, ar, name=name)
+    main_ps.add_ephemeral_constant(
+        "rand101",
+        lambda key: jax.random.randint(key, (), -1, 2).astype(jnp.float32))
+    main_ps.add_adf(adf0)
+    main_ps.rename_arguments(ARG0="x")
+
+    psets = (main_ps, adf0)
+    X = jnp.linspace(-1, 1, 20, dtype=jnp.float32)[None, :]
+    target = X[0] ** 4 + X[0] ** 3 + X[0] ** 2 + X[0]
+
+    ev = gp.make_adf_evaluator(psets, CAP)
+    gen_main = gp.make_generator(main_ps, CAP, "half_and_half")
+    gen_adf = gp.make_generator(adf0, CAP, "half_and_half")
+    mut_main = gp.make_generator(main_ps, CAP, "full")
+    mut_adf = gp.make_generator(adf0, CAP, "full")
+
+    def evaluate(trees):
+        out = ev(trees, X)
+        mse = jnp.mean((out - target) ** 2)
+        return (jnp.where(jnp.isfinite(mse), mse, 1e6),)
+
+    def mate(key, a, b):
+        """Per-tree crossover (the reference cycles cxOnePoint over each
+        tree of the individual)."""
+        k0, k1 = jax.random.split(key)
+        m0a, m0b = gp.cx_one_point(k0, a[0], b[0], main_ps)
+        a0a, a0b = gp.cx_one_point(k1, a[1], b[1], adf0)
+        return (m0a, a0a), (m0b, a0b)
+
+    def mutate(key, trees):
+        k0, k1 = jax.random.split(key)
+        m = gp.mut_uniform(k0, trees[0], lambda kk: mut_main(kk, 0, 2),
+                           main_ps)
+        a = gp.mut_uniform(k1, trees[1], lambda kk: mut_adf(kk, 0, 2), adf0)
+        return (m, a)
+
+    tb = base.Toolbox()
+    tb.register("evaluate", evaluate)
+    tb.register("mate", mate)
+    tb.register("mutate", mutate)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+
+    key, k_init = jax.random.split(jax.random.PRNGKey(seed))
+    keys = jax.random.split(k_init, POP)
+    main_trees = jax.vmap(lambda k: gen_main(k, 1, 2))(keys)
+    adf_trees = jax.vmap(lambda k: gen_adf(k, 1, 2))(
+        jax.vmap(jax.random.fold_in)(keys, jnp.ones(POP, jnp.uint32)))
+    pop = base.Population((main_trees, adf_trees),
+                          base.Fitness.empty(POP, (-1.0,)))
+
+    pop, logbook = algorithms.ea_simple(
+        key, pop, tb, cxpb=0.5, mutpb=0.2, ngen=ngen)
+    if verbose:
+        print(f"best mse: {float(jnp.min(pop.fitness.values)):.5f}")
+    return pop
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Even-parity (reference examples/gp/parity.py): boolean GP over
+and/or/xor/not on PARITY_FANIN inputs; fitness counts matching rows of the
+full truth table.
+"""
+
+import itertools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base, gp, algorithms
+from deap_tpu.ops import selection
+
+
+CAP, POP, NGEN = 64, 300, 40
+FANIN = 4
+SIZE = 2 ** FANIN
+
+
+def main(seed=27, ngen=NGEN, verbose=True):
+    ps = gp.PrimitiveSet("PARITY", FANIN)
+    for name in ("and_", "or_", "xor_", "not_"):
+        fn, ar = gp.bool_ops[name]
+        ps.add_primitive(fn, ar, name=name)
+    ps.add_terminal(1.0, name="one")
+    ps.add_terminal(0.0, name="zero")
+
+    rows = np.array(list(itertools.product([0, 1], repeat=FANIN)), np.float32)
+    X = jnp.asarray(rows.T)
+    target = jnp.asarray(rows.sum(1) % 2 == 0)          # even parity
+
+    ev = gp.make_evaluator(ps, CAP)
+    gen_init = gp.make_generator(ps, CAP, "half_and_half")
+    gen_mut = gp.make_generator(ps, CAP, "grow")
+
+    def evaluate(tree):
+        out = ev(tree[0], tree[1], tree[2], X)
+        correct = jnp.sum((out != 0) == target)
+        return (correct.astype(jnp.float32),)
+
+    tb = base.Toolbox()
+    tb.register("evaluate", evaluate)
+    tb.register("mate", lambda k, a, b: gp.cx_one_point(k, a, b, ps))
+    tb.register("mutate", lambda k, t: gp.mut_uniform(
+        k, t, lambda kk: gen_mut(kk, 0, 2), ps))
+    tb.register("select", selection.sel_tournament, tournsize=3)
+
+    key, k_init = jax.random.split(jax.random.PRNGKey(seed))
+    keys = jax.random.split(k_init, POP)
+    codes, consts, lengths = jax.vmap(lambda k: gen_init(k, 3, 5))(keys)
+    pop = base.Population((codes, consts, lengths),
+                          base.Fitness.empty(POP, (1.0,)))
+    pop, _ = algorithms.ea_simple(key, pop, tb, cxpb=0.8, mutpb=0.15,
+                                  ngen=ngen)
+    best = float(jnp.max(pop.fitness.values))
+    if verbose:
+        print(f"best: {best:.0f}/{SIZE} truth-table rows correct")
+    return best
+
+
+if __name__ == "__main__":
+    main()

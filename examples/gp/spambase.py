@@ -1,0 +1,81 @@
+"""Spam classification by GP (reference examples/gp/spambase.py): evolve a
+real-valued expression over the 57 spambase features; an email is classified
+spam when the expression is positive.  Fitness = accuracy on a random
+subset, every individual × every sample evaluated in one interpreter pass.
+
+Uses the UCI spambase CSV if a path is supplied (the reference bundles it);
+otherwise falls back to a synthetic linearly-separable-ish dataset so the
+example is self-contained.
+"""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base, gp, algorithms
+from deap_tpu.ops import selection
+
+
+CAP, POP, NGEN, N_FEAT, N_SAMPLES = 96, 200, 30, 10, 400
+
+
+def load_data(path=None, seed=0):
+    if path and os.path.exists(path):
+        data = np.loadtxt(path, delimiter=",")
+        X, y = data[:, :-1], data[:, -1]
+        return X.astype(np.float32), y.astype(np.float32)
+    rng = np.random.RandomState(seed)
+    w = rng.randn(N_FEAT)
+    X = rng.randn(N_SAMPLES, N_FEAT).astype(np.float32)
+    logits = X @ w + 0.3 * rng.randn(N_SAMPLES)
+    return X, (logits > 0).astype(np.float32)
+
+
+def main(seed=28, ngen=NGEN, path=None, verbose=True):
+    Xh, yh = load_data(path, seed)
+    n_feat = Xh.shape[1]
+    X = jnp.asarray(Xh.T)                        # (n_feat, n_samples)
+    y = jnp.asarray(yh)
+
+    ps = gp.PrimitiveSet("SPAM", n_feat)
+    for name in ("add", "sub", "mul", "div"):
+        fn, ar = gp.safe_ops[name]
+        ps.add_primitive(fn, ar, name=name)
+    ps.add_ephemeral_constant(
+        "rand", lambda key: jax.random.uniform(key, (), minval=-1.0,
+                                               maxval=1.0))
+
+    ev = gp.make_evaluator(ps, CAP)
+    gen_init = gp.make_generator(ps, CAP, "half_and_half")
+    gen_mut = gp.make_generator(ps, CAP, "full")
+
+    def evaluate(tree):
+        out = ev(tree[0], tree[1], tree[2], X)
+        pred = out > 0
+        acc = jnp.mean((pred == (y > 0.5)).astype(jnp.float32))
+        return (jnp.where(jnp.isfinite(acc), acc, 0.0),)
+
+    tb = base.Toolbox()
+    tb.register("evaluate", evaluate)
+    tb.register("mate", lambda k, a, b: gp.cx_one_point(k, a, b, ps))
+    tb.register("mutate", lambda k, t: gp.mut_uniform(
+        k, t, lambda kk: gen_mut(kk, 0, 2), ps))
+    tb.register("select", selection.sel_tournament, tournsize=3)
+
+    key, k_init = jax.random.split(jax.random.PRNGKey(seed))
+    keys = jax.random.split(k_init, POP)
+    codes, consts, lengths = jax.vmap(lambda k: gen_init(k, 1, 3))(keys)
+    pop = base.Population((codes, consts, lengths),
+                          base.Fitness.empty(POP, (1.0,)))
+    pop, _ = algorithms.ea_simple(key, pop, tb, cxpb=0.6, mutpb=0.2,
+                                  ngen=ngen)
+    best = float(jnp.max(pop.fitness.values))
+    if verbose:
+        print(f"best classification accuracy: {best:.3f}")
+    return best
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Symbolic regression of x⁴ + x³ + x² + x (reference examples/gp/symbreg.py
+— the canonical GP workload).  Trees are prefix arrays evaluated by the
+vmapped stack machine; the full evolution compiles to one scanned program
+(no ``compile``/``eval`` anywhere — SURVEY §3.4's hot path eliminated).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base, gp, algorithms
+from deap_tpu.ops import selection
+from deap_tpu.utils.support import Statistics, HallOfFame
+
+
+CAP, POP, NGEN = 64, 300, 40
+
+
+def build_pset():
+    ps = gp.PrimitiveSet("MAIN", 1)
+    ps.add_primitive(jnp.add, 2, name="add")
+    ps.add_primitive(jnp.subtract, 2, name="sub")
+    ps.add_primitive(jnp.multiply, 2, name="mul")
+    ps.add_primitive(gp.protected_div, 2, name="div")
+    ps.add_primitive(jnp.negative, 1, name="neg")
+    ps.add_primitive(jnp.cos, 1, name="cos")
+    ps.add_primitive(jnp.sin, 1, name="sin")
+    ps.add_ephemeral_constant(
+        "rand101",
+        lambda key: jax.random.randint(key, (), -1, 2).astype(jnp.float32))
+    ps.rename_arguments(ARG0="x")
+    return ps
+
+
+def main(seed=22, ngen=NGEN, verbose=True):
+    ps = build_pset()
+    X = jnp.linspace(-1, 1, 20, dtype=jnp.float32)[None, :]
+    target = X[0] ** 4 + X[0] ** 3 + X[0] ** 2 + X[0]
+
+    ev = gp.make_evaluator(ps, CAP)
+    gen_init = gp.make_generator(ps, CAP, "half_and_half")
+    gen_mut = gp.make_generator(ps, CAP, "full")
+
+    def evaluate(tree):
+        out = ev(tree[0], tree[1], tree[2], X)
+        mse = jnp.mean((out - target) ** 2)
+        return (jnp.where(jnp.isfinite(mse), mse, 1e6),)
+
+    tb = base.Toolbox()
+    tb.register("evaluate", evaluate)
+    tb.register("mate", lambda k, a, b: gp.cx_one_point(k, a, b, ps))
+    tb.register("mutate", lambda k, t: gp.mut_uniform(
+        k, t, lambda kk: gen_mut(kk, 0, 2), ps))
+    tb.register("select", selection.sel_tournament, tournsize=3)
+
+    key, k_init = jax.random.split(jax.random.PRNGKey(seed))
+    keys = jax.random.split(k_init, POP)
+    codes, consts, lengths = jax.vmap(lambda k: gen_init(k, 1, 3))(keys)
+    pop = base.Population((codes, consts, lengths),
+                          base.Fitness.empty(POP, (-1.0,)))
+
+    stats = Statistics(lambda p: p.fitness.values[:, 0])
+    stats.register("min", jnp.min)
+    stats.register("avg", jnp.mean)
+    hof = HallOfFame(1)
+    pop, logbook = algorithms.ea_simple(
+        key, pop, tb, cxpb=0.5, mutpb=0.1, ngen=ngen,
+        stats=stats, halloffame=hof, verbose=False)
+
+    best_i = int(jnp.argmin(pop.fitness.values[:, 0]))
+    tree = tuple(np.asarray(t[best_i]) for t in pop.genome)
+    if verbose:
+        print(f"best mse: {float(jnp.min(pop.fitness.values)):.5f}")
+        print("best expr:", gp.to_string(tree, ps))
+    return pop
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Symbolic regression under HARM-GP bloat control (reference
+examples/gp/symbreg_harm.py): same problem as :mod:`symbreg`, evolved with
+:func:`deap_tpu.gp.harm` shaping the size distribution.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base, gp
+from deap_tpu.ops import selection
+from examples.gp.symbreg import build_pset
+
+
+CAP, POP, NGEN = 64, 128, 20
+
+
+def main(seed=24, ngen=NGEN, verbose=True):
+    ps = build_pset()
+    X = jnp.linspace(-1, 1, 20, dtype=jnp.float32)[None, :]
+    target = X[0] ** 4 + X[0] ** 3 + X[0] ** 2 + X[0]
+
+    ev = gp.make_evaluator(ps, CAP)
+    gen_init = gp.make_generator(ps, CAP, "half_and_half")
+    gen_mut = gp.make_generator(ps, CAP, "full")
+
+    def evaluate(tree):
+        out = ev(tree[0], tree[1], tree[2], X)
+        mse = jnp.mean((out - target) ** 2)
+        return (jnp.where(jnp.isfinite(mse), mse, 1e6),)
+
+    tb = base.Toolbox()
+    tb.register("evaluate", evaluate)
+    tb.register("mate", lambda k, a, b: gp.cx_one_point(k, a, b, ps))
+    tb.register("mutate", lambda k, t: gp.mut_uniform(
+        k, t, lambda kk: gen_mut(kk, 0, 2), ps))
+    tb.register("select", selection.sel_tournament, tournsize=3)
+
+    key, k_init = jax.random.split(jax.random.PRNGKey(seed))
+    keys = jax.random.split(k_init, POP)
+    codes, consts, lengths = jax.vmap(lambda k: gen_init(k, 1, 3))(keys)
+    pop = base.Population((codes, consts, lengths),
+                          base.Fitness.empty(POP, (-1.0,)))
+
+    pop, logbook = gp.harm(key, pop, tb, cxpb=0.5, mutpb=0.1, ngen=ngen,
+                           alpha=0.05, beta=10, gamma=0.25, rho=0.9,
+                           nbrindsmodel=1024, mincutoff=10)
+    if verbose:
+        print(f"best mse: {float(jnp.min(pop.fitness.values)):.5f}, "
+              f"mean size: {float(jnp.mean(pop.genome[2])):.1f}/{CAP}")
+    return pop
+
+
+if __name__ == "__main__":
+    main()

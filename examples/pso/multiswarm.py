@@ -1,0 +1,47 @@
+"""Multiswarm PSO on a dynamic landscape (reference
+examples/pso/multiswarm.py): constriction-coefficient swarms with exclusion
+and anti-convergence (Blackwell & Branke) tracking the optimum of a
+MovingPeaks benchmark as it shifts.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deap_tpu.benchmarks.movingpeaks import MovingPeaks, SCENARIO_2
+from deap_tpu.pso import multiswarm_init, multiswarm_step
+
+
+NSWARMS, NPARTICLES, NDIM, NGEN = 5, 10, 5, 60
+BOUNDS = (0.0, 100.0)
+
+
+def main(seed=14, verbose=True):
+    mp = MovingPeaks(dim=NDIM, key=jax.random.PRNGKey(seed), **SCENARIO_2)
+    key = jax.random.PRNGKey(seed + 1)
+    k_init, key = jax.random.split(key)
+
+    state = multiswarm_init(k_init, NSWARMS, NPARTICLES, NDIM,
+                            pmin=BOUNDS[0], pmax=BOUNDS[1])
+    rexcl = (BOUNDS[1] - BOUNDS[0]) / (2 * NSWARMS ** (1.0 / NDIM))
+
+    offline_errors = []
+    for gen in range(NGEN):
+        key, k_step = jax.random.split(key)
+        peaks = mp.state           # freeze the current landscape for the step
+        evaluate = lambda x: mp.evaluate(x, peaks)
+        state, sbest = multiswarm_step(k_step, state, evaluate,
+                                       weights=(1.0,), rexcl=rexcl,
+                                       rcloud=rexcl / 2)
+        err = float(mp.globalMaximum()[0] - jnp.max(sbest))
+        offline_errors.append(err)
+        if (gen + 1) % 20 == 0:
+            mp.changePeaks()       # the landscape shifts
+    if verbose:
+        print(f"mean offline error: {np.mean(offline_errors):.3f} "
+              f"(final {offline_errors[-1]:.3f})")
+    return offline_errors
+
+
+if __name__ == "__main__":
+    main()

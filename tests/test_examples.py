@@ -1,0 +1,54 @@
+"""Smoke tests over the examples acceptance suite (SURVEY §2.7): each
+example's ``main`` runs at reduced budget and meets a loose quality bar.
+The full-budget runs are exercised manually / by the bench harness."""
+
+import sys
+import os
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_onemax_short():
+    from examples.ga import onemax_short
+    pop = onemax_short.main()
+    import jax.numpy as jnp
+    assert float(jnp.max(pop.fitness.values)) >= 95
+
+
+def test_nsga2_hypervolume_gate():
+    from examples.ga import nsga2
+    pop, hv = nsga2.main(ngen=100, verbose=False)
+    assert hv > 116.0, f"hypervolume {hv} below the reference gate"
+
+
+def test_cma_minfct_gate():
+    from examples.es import cma_minfct
+    best = cma_minfct.main(verbose=False)
+    assert best < 1e-8
+
+
+def test_knapsack_feasible():
+    from examples.ga import knapsack
+    import numpy as np
+    pop = knapsack.main(verbose=False)
+    vals = np.asarray(pop.fitness.values)
+    assert (vals[:, 0] <= knapsack.MAX_WEIGHT).all()
+
+
+def test_multiplexer_solves():
+    from examples.gp import multiplexer
+    best = multiplexer.main(ngen=25, verbose=False)
+    assert best >= 56          # ≥ 87% of the truth table at reduced budget
+
+
+def test_ant_routine_interpreter():
+    from examples.gp import ant
+    best = ant.main(ngen=8, verbose=False)
+    assert best >= 20          # random-ish programs eat < 10
+
+
+def test_pbil():
+    from examples.eda import pbil
+    assert pbil.main(verbose=False) >= 45
