@@ -333,9 +333,10 @@ class StrategyMultiObjective:
             picks = np.asarray(jax.random.randint(
                 k_pick, (self.lambda_,), 0, len(front)))
             p_idx = front[picks]
-        offspring = np.stack([
-            self.parents[p] + self.sigmas[p] * (self.A[p] @ arz[i])
-            for i, p in enumerate(p_idx)])
+        # one batched matmul over the gathered per-parent Cholesky factors
+        # (λ, dim, dim) @ (λ, dim, 1) — instead of λ sequential host matmuls
+        Az = np.einsum("pij,pj->pi", self.A[p_idx], arz)
+        offspring = self.parents[p_idx] + self.sigmas[p_idx, None] * Az
         self._last_offspring_parent = p_idx
         return offspring
 

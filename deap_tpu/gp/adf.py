@@ -113,9 +113,20 @@ def compile_adf(trees, psets, cap: int | None = None) -> Callable:
 
     def func(*args):
         if args:
-            scalar = np.ndim(args[0]) == 0
-            X = jnp.stack([jnp.atleast_1d(jnp.asarray(a, jnp.float32))
-                           for a in args])
+            ndims = {np.ndim(a) for a in args}
+            if len(ndims) > 1:
+                raise TypeError(
+                    "compile_adf arguments must be all scalars or all "
+                    f"equal-length 1-D arrays, got ndims {sorted(ndims)}")
+            scalar = ndims == {0}
+            cols = [jnp.atleast_1d(jnp.asarray(a, jnp.float32))
+                    for a in args]
+            lengths = {c.shape[0] for c in cols}
+            if len(lengths) > 1:
+                raise TypeError(
+                    "compile_adf array arguments must share one length, "
+                    f"got lengths {sorted(lengths)}")
+            X = jnp.stack(cols)
         else:
             scalar = False
             X = jnp.zeros((1, 1), jnp.float32)

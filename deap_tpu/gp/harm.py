@@ -55,7 +55,6 @@ def harm(key, population: Population, toolbox, cxpb: float, mutpb: float,
     nbins = cap + 3
     ln2 = math.log(2.0)
 
-    key, k0 = jax.random.split(key)
     population, nevals0 = evaluate_population(toolbox, population)
     hof_state, hof_upd = _hof_setup(halloffame, population)
     if hof_state is not None:
@@ -124,7 +123,11 @@ def harm(key, population: Population, toolbox, cxpb: float, mutpb: float,
         by_accept = jnp.argsort(rank)
         n_acc = jnp.sum(accept)
         slots = jnp.arange(n) % jnp.maximum(n_acc, 1)
-        chosen = by_accept[slots]
+        # degenerate case n_acc == 0 (possible only at extreme cutoffs):
+        # keep the first n natural individuals instead of replicating one
+        # rejected individual n times (the reference would keep generating
+        # until n are accepted, gp.py:1115-1117)
+        chosen = jnp.where(n_acc > 0, by_accept[slots], jnp.arange(n))
         offspring = natural.take(chosen)
 
         offspring, nevals = evaluate_population(toolbox, offspring)

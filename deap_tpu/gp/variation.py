@@ -387,10 +387,20 @@ def _scalar_code(f):
     """A terminal/ephemeral code whose interpreter op reads the per-node
     constant — used to inject literal scalars (the semantic operators'
     mutation step and the constant 1.0).  Arguments read from X, so they
-    don't qualify."""
+    don't qualify.  A plain Terminal is preferred over an Ephemeral: a later
+    ``mut_ephemeral`` resamples nodes carrying ephemeral codes, which would
+    silently rewrite the injected literal and break the semantic operators'
+    convex-combination property (the reference embeds a Terminal that
+    mutEphemeral never touches, gp.py:1210-1324)."""
+    fallback = None
     for i in range(f.n_nodes):
         if not f.is_primitive[i] and not f.is_argument[i]:
-            return i
+            if not f.is_ephemeral[i]:
+                return i
+            if fallback is None:
+                fallback = i
+    if fallback is not None:
+        return fallback
     raise AssertionError(
         "Semantic operators need at least one constant terminal or "
         "ephemeral in the primitive set to encode literal scalars.")

@@ -12,6 +12,13 @@ Permutation operators (PMX, OX) reproduce the reference's algorithms
 sequential swap chain of PMX runs in a ``lax.fori_loop`` over the genome
 axis (genome length is the short axis; the population axis is the wide,
 vmapped one).
+
+Batched tier: elementwise operators additionally expose a population-level
+variant as a ``.batched`` attribute — ``op.batched(key, A, B, ...)`` with a
+leading population axis and ONE key.  Semantically identical distribution,
+but a single bulk PRNG draw replaces per-row ``jax.random.split`` fan-outs
+(splitting 10⁶ keys per generation measurably dominates the flagship bench;
+see ``deap_tpu/algorithms.py`` which auto-dispatches to ``.batched`` forms).
 """
 
 from __future__ import annotations
@@ -31,19 +38,25 @@ __all__ = [
 ]
 
 
-def _two_cut_points(key, size, low=1):
+def _two_cut_points(key, size, low=1, shape=()):
     """Two distinct crossover points with the reference's distribution
     (crossover.py:45-52 for cxTwoPoint, low=1; crossover.py:115-119 for PMX,
     low=0): cxpoint1 ∈ [low, size] inclusive, cxpoint2 ∈ [low, size-1]
     inclusive, bumped past cxpoint1 and ordered.  (``random.randint`` bounds
-    are inclusive; jax's upper bound is exclusive, hence the +1s.)"""
+    are inclusive; jax's upper bound is exclusive, hence the +1s.)
+    ``shape`` draws a batch of independent cut pairs (batched operators use
+    ``(n, 1)`` so the cuts broadcast against genome columns)."""
     k1, k2 = jax.random.split(key)
-    c1 = jax.random.randint(k1, (), low, size + 1)    # [low, size]
-    c2 = jax.random.randint(k2, (), low, size)        # [low, size-1]
+    c1 = jax.random.randint(k1, shape, low, size + 1)  # [low, size]
+    c2 = jax.random.randint(k2, shape, low, size)      # [low, size-1]
     c2 = jnp.where(c2 >= c1, c2 + 1, c2)
     lo = jnp.minimum(c1, c2)
     hi = jnp.maximum(c1, c2)
     return lo, hi
+
+
+def _swap_where(mask, ind1, ind2):
+    return jnp.where(mask, ind2, ind1), jnp.where(mask, ind1, ind2)
 
 
 def cx_one_point(key, ind1, ind2):
@@ -51,9 +64,17 @@ def cx_one_point(key, ind1, ind2):
     size = ind1.shape[-1]
     point = jax.random.randint(key, (), 1, size)
     mask = jnp.arange(size) >= point
-    c1 = jnp.where(mask, ind2, ind1)
-    c2 = jnp.where(mask, ind1, ind2)
-    return c1, c2
+    return _swap_where(mask, ind1, ind2)
+
+
+def _cx_one_point_batched(key, A, B):
+    n, size = A.shape[0], A.shape[-1]
+    point = jax.random.randint(key, (n, 1), 1, size)
+    mask = jnp.arange(size)[None, :] >= point
+    return _swap_where(mask, A, B)
+
+
+cx_one_point.batched = _cx_one_point_batched
 
 
 def cx_two_point(key, ind1, ind2):
@@ -62,18 +83,28 @@ def cx_two_point(key, ind1, ind2):
     lo, hi = _two_cut_points(key, size)
     idx = jnp.arange(size)
     mask = (idx >= lo) & (idx < hi)
-    c1 = jnp.where(mask, ind2, ind1)
-    c2 = jnp.where(mask, ind1, ind2)
-    return c1, c2
+    return _swap_where(mask, ind1, ind2)
+
+
+def _cx_two_point_batched(key, A, B):
+    n, size = A.shape[0], A.shape[-1]
+    lo, hi = _two_cut_points(key, size, shape=(n, 1))
+    idx = jnp.arange(size)[None, :]
+    mask = (idx >= lo) & (idx < hi)
+    return _swap_where(mask, A, B)
+
+
+cx_two_point.batched = _cx_two_point_batched
 
 
 def cx_uniform(key, ind1, ind2, indpb):
     """Swap each attribute independently w.p. ``indpb`` (reference
     crossover.py:73-91)."""
     mask = jax.random.bernoulli(key, indpb, ind1.shape)
-    c1 = jnp.where(mask, ind2, ind1)
-    c2 = jnp.where(mask, ind1, ind2)
-    return c1, c2
+    return _swap_where(mask, ind1, ind2)
+
+
+cx_uniform.batched = cx_uniform    # shape-polymorphic: one key, (n, size) mask
 
 
 def _pmx_swap_chain(ind1, ind2, p1, p2, active_mask):
@@ -175,6 +206,9 @@ def cx_blend(key, ind1, ind2, alpha):
     return c1, c2
 
 
+cx_blend.batched = cx_blend        # shape-polymorphic bulk draws
+
+
 def cx_simulated_binary(key, ind1, ind2, eta):
     """SBX (reference crossover.py:263-288): spread factor beta from the
     polynomial distribution with index ``eta``."""
@@ -189,6 +223,9 @@ def cx_simulated_binary(key, ind1, ind2, eta):
     return c1, c2
 
 
+cx_simulated_binary.batched = cx_simulated_binary   # shape-polymorphic
+
+
 def cx_simulated_binary_bounded(key, ind1, ind2, eta, low, up):
     """Bounded SBX as used by NSGA-II (reference crossover.py:291-364):
     per-gene applied w.p. 0.5 when parents differ; the spread factor is
@@ -197,11 +234,11 @@ def cx_simulated_binary_bounded(key, ind1, ind2, eta, low, up):
     low = jnp.broadcast_to(jnp.asarray(low, ind1.dtype), (size,))
     up = jnp.broadcast_to(jnp.asarray(up, ind1.dtype), (size,))
     k_apply, k_rand, k_swap = jax.random.split(key, 3)
-    apply_ = jax.random.bernoulli(k_apply, 0.5, (size,)) & (
+    apply_ = jax.random.bernoulli(k_apply, 0.5, ind1.shape) & (
         jnp.abs(ind1 - ind2) > 1e-14)
     x1 = jnp.minimum(ind1, ind2)
     x2 = jnp.maximum(ind1, ind2)
-    rand = jax.random.uniform(k_rand, (size,))
+    rand = jax.random.uniform(k_rand, ind1.shape)
     diff = jnp.where(x2 - x1 > 1e-14, x2 - x1, 1.0)   # guarded denominator
 
     def beta_q(beta):
@@ -218,10 +255,13 @@ def cx_simulated_binary_bounded(key, ind1, ind2, eta, low, up):
     c2 = 0.5 * (x1 + x2 + beta_q(beta2) * diff)
     c1 = jnp.clip(c1, low, up)
     c2 = jnp.clip(c2, low, up)
-    swap = jax.random.bernoulli(k_swap, 0.5, (size,))
+    swap = jax.random.bernoulli(k_swap, 0.5, ind1.shape)
     o1 = jnp.where(swap, c2, c1)
     o2 = jnp.where(swap, c1, c2)
     return (jnp.where(apply_, o1, ind1), jnp.where(apply_, o2, ind2))
+
+
+cx_simulated_binary_bounded.batched = cx_simulated_binary_bounded
 
 
 def cx_messy_one_point(key, ind1, ind2):
@@ -271,6 +311,9 @@ def cx_es_blend(key, ind1, ind2, alpha):
     return (nx1, ns1), (nx2, ns2)
 
 
+cx_es_blend.batched = cx_es_blend  # shape-polymorphic
+
+
 def cx_es_two_point(key, ind1, ind2):
     """ES two-point crossover (reference crossover.py:419-446): the same two
     cut points swap both values and strategies."""
@@ -279,7 +322,20 @@ def cx_es_two_point(key, ind1, ind2):
     lo, hi = _two_cut_points(key, size)
     idx = jnp.arange(size)
     mask = (idx >= lo) & (idx < hi)
-    swap = lambda a, b: (jnp.where(mask, b, a), jnp.where(mask, a, b))
-    nx1, nx2 = swap(x1, x2)
-    ns1, ns2 = swap(s1, s2)
+    nx1, nx2 = _swap_where(mask, x1, x2)
+    ns1, ns2 = _swap_where(mask, s1, s2)
     return (nx1, ns1), (nx2, ns2)
+
+
+def _cx_es_two_point_batched(key, A, B):
+    (x1, s1), (x2, s2) = A, B
+    n, size = x1.shape[0], x1.shape[-1]
+    lo, hi = _two_cut_points(key, size, shape=(n, 1))
+    idx = jnp.arange(size)[None, :]
+    mask = (idx >= lo) & (idx < hi)
+    nx1, nx2 = _swap_where(mask, x1, x2)
+    ns1, ns2 = _swap_where(mask, s1, s2)
+    return (nx1, ns1), (nx2, ns2)
+
+
+cx_es_two_point.batched = _cx_es_two_point_batched

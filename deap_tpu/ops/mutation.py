@@ -3,6 +3,13 @@
 Per-individual pure functions ``mut(key, ind, ...) -> ind``; algorithms vmap
 them over the population.  Per-gene ``if random.random() < indpb`` loops of
 the reference become Bernoulli masks fused into one elementwise kernel.
+
+Elementwise operators whose draws are shaped by ``ind.shape`` are
+shape-polymorphic: called with a ``(pop, size)`` batch and ONE key they
+produce the identical distribution without a per-row key fan-out, so they
+double as their own population-level ``.batched`` form (see the batched-tier
+note in ``deap_tpu/ops/crossover.py`` and the dispatch in
+``deap_tpu/algorithms.py``).
 """
 
 from __future__ import annotations
@@ -27,6 +34,9 @@ def mut_gaussian(key, ind, mu, sigma, indpb):
     return jnp.where(mask, ind + noise, ind)
 
 
+mut_gaussian.batched = mut_gaussian        # shape-polymorphic bulk draws
+
+
 def mut_polynomial_bounded(key, ind, eta, low, up, indpb):
     """Deb's polynomial bounded mutation, as in NSGA-II (reference
     mutation.py:51-95)."""
@@ -49,6 +59,9 @@ def mut_polynomial_bounded(key, ind, eta, low, up, indpb):
     delta_q = jnp.where(rand < 0.5, dq1, dq2)
     x = jnp.clip(ind + delta_q * span, low, up)
     return jnp.where(mask, x, ind)
+
+
+mut_polynomial_bounded.batched = mut_polynomial_bounded
 
 
 def mut_shuffle_indexes(key, ind, indpb):
@@ -78,6 +91,9 @@ def mut_flip_bit(key, ind, indpb):
     return jnp.where(mask, 1 - ind, ind)
 
 
+mut_flip_bit.batched = mut_flip_bit
+
+
 def mut_uniform_int(key, ind, low, up, indpb):
     """Replace each gene w.p. ``indpb`` with a uniform integer in
     [low, up] inclusive (reference mutation.py:145-177)."""
@@ -85,6 +101,9 @@ def mut_uniform_int(key, ind, low, up, indpb):
     mask = jax.random.bernoulli(k_mask, indpb, ind.shape)
     vals = jax.random.randint(k_val, ind.shape, low, up + 1, dtype=ind.dtype)
     return jnp.where(mask, vals, ind)
+
+
+mut_uniform_int.batched = mut_uniform_int
 
 
 def mut_es_log_normal(key, ind, c, indpb):
@@ -103,3 +122,20 @@ def mut_es_log_normal(key, ind, c, indpb):
     new_s = s * jnp.exp(t0 * n_common + t * n_gene)
     new_x = x + new_s * jax.random.normal(k_val, x.shape, x.dtype)
     return jnp.where(mask, new_x, x), jnp.where(mask, new_s, s)
+
+
+def _mut_es_log_normal_batched(key, ind, c, indpb):
+    x, s = ind
+    n, size = x.shape[0], x.shape[-1]
+    t = c / jnp.sqrt(2.0 * jnp.sqrt(size))
+    t0 = c / jnp.sqrt(2.0 * size)
+    k_mask, k_common, k_gene, k_val = jax.random.split(key, 4)
+    mask = jax.random.bernoulli(k_mask, indpb, x.shape)
+    n_common = jax.random.normal(k_common, (n, 1), x.dtype)  # per individual
+    n_gene = jax.random.normal(k_gene, x.shape, x.dtype)
+    new_s = s * jnp.exp(t0 * n_common + t * n_gene)
+    new_x = x + new_s * jax.random.normal(k_val, x.shape, x.dtype)
+    return jnp.where(mask, new_x, x), jnp.where(mask, new_s, s)
+
+
+mut_es_log_normal.batched = _mut_es_log_normal_batched

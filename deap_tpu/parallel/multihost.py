@@ -1,0 +1,137 @@
+"""Multi-host / cluster distribution — the TPU-native replacement for the
+reference's SCOOP tier (P3 in SURVEY §2.6: ``python -m scoop`` network
+futures, doc/tutorials/basic/part4.rst:14-44,
+examples/ga/onemax_island_scoop.py:28,49).
+
+The reference ships work to a grid by pickling individuals to remote
+futures.  Here every host runs the SAME program (SPMD): after
+:func:`initialize_cluster`, ``jax.devices()`` spans every chip of every
+host, one :func:`cluster_mesh` covers the slice (ICI) and the cross-slice
+DCN links, and the population lives as ONE logical array sharded over that
+mesh.  The generation step stays the exact same jitted function as
+single-host — XLA inserts the cross-host collectives (psum/all-gather for
+selection and statistics, ppermute for island migration) where the
+shardings demand them.  Nothing is pickled, ever.
+
+Launch (one process per host, same script)::
+
+    JAX_COORDINATOR=host0:1234 NPROC=4 PROC_ID=$i python train.py
+
+    # in train.py
+    from deap_tpu.parallel import initialize_cluster, cluster_mesh
+    initialize_cluster()                       # reads the env
+    mesh = cluster_mesh(("pop",))
+    pop = distribute_population(pop, mesh)     # host-local shard -> global
+    ...same ea_simple / ea_simple_islands code as single host...
+
+On managed TPU pods (GKE/queued resources) ``initialize_cluster()`` with no
+arguments auto-detects everything, exactly like bare
+``jax.distributed.initialize()``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental import multihost_utils
+
+from ..base import Population
+
+__all__ = ["initialize_cluster", "cluster_mesh", "distribute_population",
+           "fetch_global", "process_index", "process_count"]
+
+
+def initialize_cluster(coordinator_address: str | None = None,
+                       num_processes: int | None = None,
+                       process_id: int | None = None,
+                       local_device_ids=None) -> None:
+    """Join the cluster: wraps ``jax.distributed.initialize``.
+
+    Priority: explicit args > ``JAX_COORDINATOR``/``NPROC``/``PROC_ID`` env
+    vars > JAX's own auto-detection (TPU pod metadata).  Safe to call twice
+    (a second call is a no-op), so library code can call it defensively.
+    """
+    # NB: must not touch jax.devices()/process_count() here — any backend
+    # query initializes XLA and makes jax.distributed.initialize illegal
+    if getattr(initialize_cluster, "_done", False):
+        return
+    try:
+        from jax._src import distributed as _dist
+        if _dist.global_state.client is not None:   # already initialized
+            initialize_cluster._done = True
+            return
+    except (ImportError, AttributeError):
+        pass                     # private probe; fall through to initialize
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR")
+    if num_processes is None and "NPROC" in os.environ:
+        num_processes = int(os.environ["NPROC"])
+    if process_id is None and "PROC_ID" in os.environ:
+        process_id = int(os.environ["PROC_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    initialize_cluster._done = True
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def cluster_mesh(axis_names=("pop",), shape=None) -> Mesh:
+    """A mesh over every device of every process.
+
+    ``shape`` defaults to putting all devices on the first axis; pass e.g.
+    ``shape=(n_islands, -1)`` with ``axis_names=("island", "pop")`` for the
+    island×pop layout.  Device order follows ``jax.devices()`` (all devices,
+    cluster-wide), so contiguous mesh neighbors are ICI neighbors within a
+    host/slice and DCN only carries the outer-axis edges — the layout that
+    keeps island migration and population reductions on the fast links.
+    """
+    devs = np.array(jax.devices())
+    if shape is None:
+        shape = (devs.size,) if len(axis_names) == 1 else None
+    if shape is None:
+        raise ValueError("shape required when len(axis_names) > 1")
+    return Mesh(devs.reshape(shape), axis_names)
+
+
+def distribute_population(population: Population, mesh: Mesh,
+                          axis_name: str = "pop") -> Population:
+    """Host-local population shard -> one global sharded Population.
+
+    Each process holds its own ``pop_local`` rows (the analogue of each
+    SCOOP worker owning its sub-population); the result is a global array of
+    ``pop_local * process_count`` rows sharded over the mesh, which every
+    jitted step treats as one population.  Single-process: a plain
+    ``device_put`` with the same sharding."""
+    sh = NamedSharding(mesh, P(axis_name))
+
+    def put(x):
+        if x.ndim == 0:
+            return x
+        if jax.process_count() == 1:
+            return jax.device_put(x, sh)
+        return multihost_utils.host_local_array_to_global_array(
+            np.asarray(x), mesh, P(axis_name))
+
+    return jax.tree_util.tree_map(put, population)
+
+
+def fetch_global(tree):
+    """Globally-sharded pytree -> replicated host numpy on every process
+    (for logging/checkpointing; the analogue of gathering results from the
+    futures grid)."""
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(np.asarray, tree)
+    return multihost_utils.process_allgather(tree, tiled=True)

@@ -139,65 +139,58 @@ class Logbook(list):
         return self.__str__(startindex)
 
     def __txt__(self, startindex):
+        """Render records ``startindex:`` as aligned text lines.
+
+        Column-major pipeline: each column independently yields a *header
+        block* (possibly several lines — chapters carry a centered title, a
+        dash rule, and their own nested header) and a *body block* (one cell
+        per record, chapters contributing their pre-rendered lines).  Blocks
+        are then bottom-aligned and zipped into rows.  Column widths live in
+        ``self.columns_len`` and only ever grow, so successive ``stream``
+        chunks stay aligned with earlier output.
+        """
         columns = self.header
         if not columns:
             columns = sorted(self[0].keys()) + sorted(self.chapters.keys())
         if not self.columns_len or len(self.columns_len) != len(columns):
-            self.columns_len = [len(c) for c in columns]
+            self.columns_len = [len(str(c)) for c in columns]
 
-        chapters_txt = {}
-        offsets = {}
-        for name, chapter in self.chapters.items():
-            chapters_txt[name] = chapter.__txt__(startindex)
-            if startindex == 0:
-                offsets[name] = len(chapters_txt[name]) - len(self)
+        show_header = startindex == 0 and self.log_header
+        n_body = len(self) - startindex
 
-        str_matrix = []
-        for i, line in enumerate(self[startindex:], startindex):
-            str_line = []
-            for j, name in enumerate(columns):
-                if name in chapters_txt:
-                    column = chapters_txt[name][i + offsets[name]]
-                else:
-                    value = line.get(name, "")
-                    if isinstance(value, float):
-                        column = f"{value:g}"
-                    else:
-                        column = str(value)
-                self.columns_len[j] = max(self.columns_len[j], len(column))
-                str_line.append(column)
-            str_matrix.append(str_line)
+        heads: list[list[str]] = []     # per-column header block
+        bodies: list[list[str]] = []    # per-column body cells
+        for j, name in enumerate(columns):
+            chapter = self.chapters.get(name)
+            if chapter is not None:
+                sub = chapter.__txt__(startindex)
+                split = len(sub) - n_body
+                width = max((len(s.expandtabs()) for s in sub),
+                            default=len(str(name)))
+                head = [str(name).center(width), "-" * width] + sub[:split]
+                body = sub[split:]
+            else:
+                body = []
+                for rec in self[startindex:]:
+                    v = rec.get(name, "")
+                    body.append(f"{v:g}" if isinstance(v, float) else str(v))
+                width = max(len(s) for s in body) if body else 0
+                head = [str(name)]
+            self.columns_len[j] = max(self.columns_len[j], width)
+            heads.append(head)
+            bodies.append(body)
 
-        if startindex == 0 and self.log_header:
-            header = []
-            nlines = 1
-            if len(self.chapters) > 0:
-                nlines += max(map(len, chapters_txt.values())) - len(self) + 1
-            header = [[] for _ in range(nlines)]
-            for j, name in enumerate(columns):
-                if name in chapters_txt:
-                    length = max(len(line.expandtabs())
-                                 for line in chapters_txt[name])
-                    blanks = nlines - 2 - offsets[name]
-                    for i in range(blanks):
-                        header[i].append(" " * length)
-                    header[blanks].append(name.center(length))
-                    header[blanks + 1].append("-" * length)
-                    for i in range(offsets[name]):
-                        header[blanks + 2 + i].append(
-                            chapters_txt[name][i])
-                else:
-                    length = max(len(line[j].expandtabs())
-                                 for line in str_matrix) if str_matrix else len(name)
-                    for line in header[:-1]:
-                        line.append(" " * max(length, len(name)))
-                    header[-1].append(name)
-            str_matrix = list(header) + str_matrix
+        rows: list[list[str]] = []
+        if show_header:
+            depth = max(len(h) for h in heads)
+            padded = [[""] * (depth - len(h)) + h for h in heads]
+            rows.extend(list(r) for r in zip(*padded))
+        if n_body:
+            rows.extend(list(r) for r in zip(*bodies))
 
-        template = "\t".join("{%i:<%i}" % (i, l)
-                             for i, l in enumerate(self.columns_len))
-        text = [template.format(*line) for line in str_matrix]
-        return text
+        return ["\t".join(cell.ljust(w)
+                          for cell, w in zip(row, self.columns_len))
+                for row in rows]
 
     def __str__(self, startindex=0):
         text = self.__txt__(startindex)

@@ -1,0 +1,229 @@
+"""Tests for observability & persistence: Logbook rendering, statistics,
+checkpoint exact-resume, and the incremental non-dominated sort's
+equivalence to a naive recount — the reference's test surface for these is
+tests/test_logbook.py + doc/tutorials/advanced/checkpoint.rst."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base, algorithms
+from deap_tpu.ops import crossover, mutation, selection
+from deap_tpu.ops.emo import nondominated_ranks, _dominator_counts, sel_spea2
+from deap_tpu.base import dominance_matrix
+from deap_tpu.utils.support import Logbook, Statistics, MultiStatistics
+from deap_tpu.utils.checkpoint import (save_checkpoint, load_checkpoint,
+                                       async_save_checkpoint)
+
+
+# ---------------------------------------------------------------------------
+# Logbook (reference tests/test_logbook.py:6-36)
+# ---------------------------------------------------------------------------
+
+
+def make_logbook():
+    lb = Logbook()
+    lb.record(gen=0, nevals=30,
+              fit={"min": 0.13, "avg": 1.25}, size={"min": 2, "avg": 3.25})
+    lb.record(gen=1, nevals=28,
+              fit={"min": 0.05, "avg": 0.91}, size={"min": 2, "avg": 4.75})
+    return lb
+
+
+def test_logbook_chapters_and_select():
+    lb = make_logbook()
+    assert lb.select("gen") == [0, 1]
+    assert lb.chapters["fit"].select("min") == [0.13, 0.05]
+    assert lb.chapters["size"].select("avg") == [3.25, 4.75]
+
+
+def test_logbook_render_header_and_alignment():
+    lb = make_logbook()
+    lb.header = ["gen", "nevals", "fit", "size"]
+    lb.chapters["fit"].header = ["min", "avg"]
+    lb.chapters["size"].header = ["min", "avg"]
+    text = str(lb)
+    lines = text.split("\n")
+    # header block: chapter titles, dash rule, column names, then 2 records
+    assert "fit" in lines[0] and "size" in lines[0]
+    assert set(lines[1].split()) <= {"-" * n for n in range(1, 60)} or "-" in lines[1]
+    assert lines[2].split("\t")[0].strip() == "gen"
+    assert len(lines) == 3 + 2
+    body0 = lines[3]
+    assert body0.startswith("0")
+    assert "0.13" in body0 and "3.25" in body0
+    # all rows align to the same tab-column widths
+    widths = [len(c) for c in lines[3].split("\t")]
+    assert [len(c) for c in lines[4].split("\t")] == widths
+
+
+def test_logbook_stream_is_incremental():
+    lb = Logbook()
+    lb.header = ["gen", "nevals"]
+    lb.record(gen=0, nevals=10)
+    first = lb.stream
+    assert "gen" in first and "0" in first
+    lb.record(gen=1, nevals=20)
+    second = lb.stream
+    assert "gen" not in second          # header printed once
+    assert second.strip().startswith("1")
+
+
+def test_logbook_no_header_sorts_keys():
+    lb = Logbook()
+    lb.record(beta=2, alpha=1)
+    lines = str(lb).split("\n")
+    assert lines[0].split("\t")[0].strip() == "alpha"
+
+
+def test_logbook_pop_keeps_chapters_synced():
+    lb = make_logbook()
+    first = lb.pop(0)
+    assert first["gen"] == 0
+    assert lb.select("gen") == [1]
+    assert lb.chapters["fit"].select("min") == [0.05]
+
+
+def test_statistics_and_multistatistics():
+    stats = Statistics(key=lambda xs: jnp.asarray(xs))
+    stats.register("avg", jnp.mean)
+    stats.register("max", jnp.max)
+    rec = stats.compile([1.0, 2.0, 3.0])
+    assert float(rec["avg"]) == 2.0 and float(rec["max"]) == 3.0
+    ms = MultiStatistics(fit=Statistics(key=lambda d: jnp.asarray(d["f"])),
+                         size=Statistics(key=lambda d: jnp.asarray(d["s"])))
+    ms.register("min", jnp.min)
+    rec = ms.compile({"f": [1.0, 2.0], "s": [3.0, 5.0]})
+    assert float(rec["fit"]["min"]) == 1.0
+    assert float(rec["size"]["min"]) == 3.0
+    assert ms.fields == ["fit", "size"]
+
+
+# ---------------------------------------------------------------------------
+# Incremental non-dominated sort == naive recount
+# ---------------------------------------------------------------------------
+
+
+def _naive_ranks(w):
+    """Reference-shaped peel: recount dominators each front (the O(F·N²)
+    formulation the incremental kernel must reproduce exactly)."""
+    n = w.shape[0]
+    dom = np.asarray(dominance_matrix(jnp.asarray(w)))
+    active = np.ones(n, bool)
+    ranks = np.full(n, n)
+    r = 0
+    while active.any():
+        counts = (dom & active[:, None]).sum(0)
+        front = active & (counts == 0)
+        ranks[front] = r
+        active &= ~front
+        r += 1
+    return ranks
+
+
+def test_incremental_ranks_match_naive():
+    key = jax.random.PRNGKey(0)
+    for n, nobj, fc in [(50, 2, 8), (200, 3, 16), (333, 2, 1024)]:
+        w = jax.random.normal(jax.random.fold_in(key, n), (n, nobj))
+        # duplicates exercise the equal-fitness path
+        w = jnp.concatenate([w, w[: n // 5]], 0)
+        ranks, nf = jax.jit(
+            lambda w: nondominated_ranks(w, front_chunk=fc))(w)
+        expected = _naive_ranks(np.asarray(w))
+        np.testing.assert_array_equal(np.asarray(ranks), expected)
+        assert int(nf) == expected.max() + 1
+
+
+def test_spea2_chunked_matches_small_chunk():
+    """Chunk size must not affect the selection."""
+    key = jax.random.PRNGKey(2)
+    w = jax.random.normal(key, (60, 2))
+    a = np.asarray(sel_spea2(None, w, 20, chunk=1024))
+    b = np.asarray(sel_spea2(None, w, 20, chunk=7))
+    np.testing.assert_array_equal(np.sort(a), np.sort(b))
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint exact-resume (reference checkpoint.rst:21-72)
+# ---------------------------------------------------------------------------
+
+
+def _onemax_setup():
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: (jnp.sum(g),))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    key = jax.random.PRNGKey(42)
+    k_init, k_run = jax.random.split(key)
+    g = jax.random.bernoulli(k_init, 0.5, (64, 40)).astype(jnp.float32)
+    pop = base.Population(genome=g, fitness=base.Fitness.empty(64, (1.0,)))
+    return tb, pop, k_run
+
+
+def _run_segmented(tb, pop, key, schedule):
+    """Run ea_simple in segments, threading (pop, key) like a checkpointed
+    driver would."""
+    for ngen in schedule:
+        key, k_seg = jax.random.split(key)
+        pop, _ = algorithms.ea_simple(k_seg, pop, tb, 0.6, 0.3, ngen)
+    return pop
+
+
+def test_checkpoint_exact_resume(tmp_path):
+    """Run 4+4 generations with a save/load between segments: the resumed
+    trajectory must be bit-identical to an uninterrupted segmented run."""
+    tb, pop, key = _onemax_setup()
+
+    # uninterrupted two-segment run
+    ref_pop = _run_segmented(tb, pop, key, [4, 4])
+
+    # segment 1, checkpoint, restore, segment 2
+    key2, k_seg1 = jax.random.split(key)
+    mid, _ = algorithms.ea_simple(k_seg1, pop, tb, 0.6, 0.3, 4)
+    path = tmp_path / "ckpt.pkl"
+    save_checkpoint(path, {"population": mid, "key": key2, "gen": 4})
+    state = load_checkpoint(path)
+    res_pop = base.Population(
+        genome=jnp.asarray(state["population"].genome),
+        fitness=base.Fitness(
+            values=jnp.asarray(state["population"].fitness.values),
+            valid=jnp.asarray(state["population"].fitness.valid),
+            weights=state["population"].fitness.weights))
+    rkey = jnp.asarray(state["key"])
+    _, k_seg2 = jax.random.split(rkey)
+    out, _ = algorithms.ea_simple(k_seg2, res_pop, tb, 0.6, 0.3, 4)
+
+    np.testing.assert_array_equal(np.asarray(out.genome),
+                                  np.asarray(ref_pop.genome))
+    np.testing.assert_array_equal(np.asarray(out.fitness.values),
+                                  np.asarray(ref_pop.fitness.values))
+    assert state["gen"] == 4
+
+
+def test_stream_every_emits_per_generation(capfd):
+    """Per-generation streaming from inside the scan (reference prints
+    ``logbook.stream`` every generation, algorithms.py:159-160)."""
+    from deap_tpu.utils.support import Statistics
+    tb, pop, key = _onemax_setup()
+    stats = Statistics(key=lambda p: p.fitness.values[:, 0])
+    stats.register("max", jnp.max)
+    _, lb = algorithms.ea_simple(key, pop, tb, 0.5, 0.2, 10,
+                                 stats=stats, stream_every=2)
+    jax.effects_barrier()
+    lines = [l for l in capfd.readouterr().out.splitlines()
+             if l.startswith("gen=")]
+    assert len(lines) == 5
+    assert "max=" in lines[0] and "nevals=" in lines[0]
+    # the logbook still carries every generation
+    assert len(lb) == 11
+
+
+def test_async_checkpoint_roundtrip(tmp_path):
+    path = tmp_path / "async.pkl"
+    state = {"a": jnp.arange(5), "k": jax.random.PRNGKey(0), "s": "meta"}
+    t = async_save_checkpoint(path, state)
+    t.join(timeout=30)
+    loaded = load_checkpoint(path)
+    np.testing.assert_array_equal(loaded["a"], np.arange(5))
+    assert loaded["s"] == "meta"
