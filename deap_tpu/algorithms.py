@@ -67,10 +67,17 @@ def _batched_form(tool):
     pop axis, identical distribution); :meth:`Toolbox.register` copies the
     function ``__dict__`` onto the partial, so the attribute survives
     registration and the frozen keyword arguments are re-applied here.
-    Returns ``None`` when no batched form exists (vmap fallback) or when the
-    tool froze *positional* args (their placement is ambiguous)."""
+    Returns ``None`` — i.e. vmap fallback — when no batched form exists,
+    when the tool froze *positional* args (their placement is ambiguous),
+    or when the registered function is not the op the batched form belongs
+    to: a ``functools.wraps`` decorator copies ``__dict__`` (including
+    ``batched``) onto its wrapper, and dispatching to the raw batched op
+    would silently skip the decorator (e.g. a bounds clamp).  The
+    ``base_op`` back-link set by ``ops.batched_op`` detects that."""
     fn = getattr(tool, "batched", None)
     if fn is None or getattr(tool, "args", ()):
+        return None
+    if getattr(fn, "base_op", None) is not getattr(tool, "func", tool):
         return None
     return partial(fn, **getattr(tool, "keywords", {}))
 

@@ -1,0 +1,19 @@
+"""Registration of population-level ("batched") operator forms.
+
+``batched_op(op, impl)`` marks ``impl`` as ``op``'s batched variant and
+back-links ``impl.base_op = op``.  The back-link is what makes the dispatch
+in ``deap_tpu.algorithms._batched_form`` safe under ``toolbox.decorate``:
+``functools.wraps`` copies ``__dict__`` — including ``batched`` — onto
+decorator wrappers, but the wrapper is not ``base_op``, so decorated
+operators fall back to the vmapped per-individual path and the decorator is
+honored."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+def batched_op(op: Callable, impl: Callable) -> Callable:
+    impl.base_op = op
+    op.batched = impl
+    return op

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ._dispatch import batched_op
+
 __all__ = [
     "cx_one_point", "cx_two_point", "cx_uniform",
     "cx_partialy_matched", "cx_uniform_partialy_matched", "cx_ordered",
@@ -74,7 +76,7 @@ def _cx_one_point_batched(key, A, B):
     return _swap_where(mask, A, B)
 
 
-cx_one_point.batched = _cx_one_point_batched
+batched_op(cx_one_point, _cx_one_point_batched)
 
 
 def cx_two_point(key, ind1, ind2):
@@ -94,7 +96,7 @@ def _cx_two_point_batched(key, A, B):
     return _swap_where(mask, A, B)
 
 
-cx_two_point.batched = _cx_two_point_batched
+batched_op(cx_two_point, _cx_two_point_batched)
 
 
 def cx_uniform(key, ind1, ind2, indpb):
@@ -104,7 +106,7 @@ def cx_uniform(key, ind1, ind2, indpb):
     return _swap_where(mask, ind1, ind2)
 
 
-cx_uniform.batched = cx_uniform    # shape-polymorphic: one key, (n, size) mask
+batched_op(cx_uniform, cx_uniform)  # shape-polymorphic: one key, (n, size) mask
 
 
 def _pmx_swap_chain(ind1, ind2, p1, p2, active_mask):
@@ -206,7 +208,7 @@ def cx_blend(key, ind1, ind2, alpha):
     return c1, c2
 
 
-cx_blend.batched = cx_blend        # shape-polymorphic bulk draws
+batched_op(cx_blend, cx_blend)      # shape-polymorphic bulk draws
 
 
 def cx_simulated_binary(key, ind1, ind2, eta):
@@ -223,7 +225,7 @@ def cx_simulated_binary(key, ind1, ind2, eta):
     return c1, c2
 
 
-cx_simulated_binary.batched = cx_simulated_binary   # shape-polymorphic
+batched_op(cx_simulated_binary, cx_simulated_binary)   # shape-polymorphic
 
 
 def cx_simulated_binary_bounded(key, ind1, ind2, eta, low, up):
@@ -261,7 +263,7 @@ def cx_simulated_binary_bounded(key, ind1, ind2, eta, low, up):
     return (jnp.where(apply_, o1, ind1), jnp.where(apply_, o2, ind2))
 
 
-cx_simulated_binary_bounded.batched = cx_simulated_binary_bounded
+batched_op(cx_simulated_binary_bounded, cx_simulated_binary_bounded)
 
 
 def cx_messy_one_point(key, ind1, ind2):
@@ -311,7 +313,7 @@ def cx_es_blend(key, ind1, ind2, alpha):
     return (nx1, ns1), (nx2, ns2)
 
 
-cx_es_blend.batched = cx_es_blend  # shape-polymorphic
+batched_op(cx_es_blend, cx_es_blend)  # shape-polymorphic
 
 
 def cx_es_two_point(key, ind1, ind2):
@@ -338,4 +340,4 @@ def _cx_es_two_point_batched(key, A, B):
     return (nx1, ns1), (nx2, ns2)
 
 
-cx_es_two_point.batched = _cx_es_two_point_batched
+batched_op(cx_es_two_point, _cx_es_two_point_batched)

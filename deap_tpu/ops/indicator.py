@@ -15,7 +15,8 @@ import jax.numpy as jnp
 from ..base import Fitness
 from .hv import hypervolume as _hv
 
-__all__ = ["hypervolume", "additive_epsilon", "multiplicative_epsilon"]
+__all__ = ["hypervolume", "additive_epsilon", "multiplicative_epsilon",
+           "hypervolume_contributions", "hypervolume_contributions_2d"]
 
 
 def _wobj(front):
@@ -39,6 +40,47 @@ def hypervolume(front, **kargs) -> int:
         for i in range(len(wobj))
     ]
     return int(np.argmax(contrib))
+
+
+def hypervolume_contributions(front, ref=None) -> np.ndarray:
+    """Per-individual exclusive hypervolume (the ``hypervolume_contrib``
+    helper of reference examples/ga/mo_rhv.py:60-80): contribution of point
+    i = HV(P) - HV(P \\ {i}).  Host-side, any dimensionality."""
+    wobj = _wobj(front)
+    if ref is None:
+        ref = np.max(wobj, axis=0) + 1
+    total = _hv(wobj, ref)
+    return np.array([
+        total - _hv(np.concatenate((wobj[:i], wobj[i + 1:])), ref)
+        for i in range(len(wobj))
+    ])
+
+
+def hypervolume_contributions_2d(obj, mask, ref):
+    """Jit-friendly exclusive hypervolume for a masked 2-objective
+    *nondominated* set: with points sorted by f1 ascending (so f2 descends),
+    contribution_i is the exclusive box ``(f1_next - f1_i) * (f2_prev -
+    f2_i)`` with the reference point capping both ends.  ``obj`` is
+    ``(n, 2)`` minimization objectives; rows where ``mask`` is False get
+    contribution 0.  Duplicated points annihilate each other's boxes, which
+    matches the exclusive-contribution definition."""
+    n = obj.shape[0]
+    f1 = jnp.where(mask, obj[:, 0], jnp.inf)
+    order = jnp.argsort(f1)
+    f1s = f1[order]
+    f2s = jnp.where(mask, obj[:, 1], jnp.inf)[order]
+    nc = jnp.sum(mask)
+    i = jnp.arange(n)
+    # interior neighbors are ALSO capped at the reference point, so points
+    # outside the ref box neither gain nor grant volume
+    next_f1 = jnp.minimum(jnp.where(i + 1 < nc, jnp.roll(f1s, -1), ref[0]),
+                          ref[0])
+    prev_f2 = jnp.minimum(jnp.where(i > 0, jnp.roll(f2s, 1), ref[1]),
+                          ref[1])
+    width = jnp.maximum(next_f1 - f1s, 0.0)
+    height = jnp.maximum(prev_f2 - f2s, 0.0)
+    contrib_sorted = jnp.where(i < nc, width * height, 0.0)
+    return jnp.zeros(n, obj.dtype).at[order].set(contrib_sorted)
 
 
 def additive_epsilon(front, **kargs) -> int:

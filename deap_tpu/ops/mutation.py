@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ._dispatch import batched_op
+
 __all__ = [
     "mut_gaussian", "mut_polynomial_bounded", "mut_shuffle_indexes",
     "mut_flip_bit", "mut_uniform_int", "mut_es_log_normal",
@@ -34,7 +36,7 @@ def mut_gaussian(key, ind, mu, sigma, indpb):
     return jnp.where(mask, ind + noise, ind)
 
 
-mut_gaussian.batched = mut_gaussian        # shape-polymorphic bulk draws
+batched_op(mut_gaussian, mut_gaussian)      # shape-polymorphic bulk draws
 
 
 def mut_polynomial_bounded(key, ind, eta, low, up, indpb):
@@ -61,7 +63,7 @@ def mut_polynomial_bounded(key, ind, eta, low, up, indpb):
     return jnp.where(mask, x, ind)
 
 
-mut_polynomial_bounded.batched = mut_polynomial_bounded
+batched_op(mut_polynomial_bounded, mut_polynomial_bounded)
 
 
 def mut_shuffle_indexes(key, ind, indpb):
@@ -91,7 +93,7 @@ def mut_flip_bit(key, ind, indpb):
     return jnp.where(mask, 1 - ind, ind)
 
 
-mut_flip_bit.batched = mut_flip_bit
+batched_op(mut_flip_bit, mut_flip_bit)
 
 
 def mut_uniform_int(key, ind, low, up, indpb):
@@ -103,7 +105,7 @@ def mut_uniform_int(key, ind, low, up, indpb):
     return jnp.where(mask, vals, ind)
 
 
-mut_uniform_int.batched = mut_uniform_int
+batched_op(mut_uniform_int, mut_uniform_int)
 
 
 def mut_es_log_normal(key, ind, c, indpb):
@@ -138,4 +140,4 @@ def _mut_es_log_normal_batched(key, ind, c, indpb):
     return jnp.where(mask, new_x, x), jnp.where(mask, new_s, s)
 
 
-mut_es_log_normal.batched = _mut_es_log_normal_batched
+batched_op(mut_es_log_normal, _mut_es_log_normal_batched)

@@ -72,11 +72,22 @@ def initialize_cluster(coordinator_address: str | None = None,
         num_processes = int(os.environ["NPROC"])
     if process_id is None and "PROC_ID" in os.environ:
         process_id = int(os.environ["PROC_ID"])
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids)
+    except (RuntimeError, ValueError) as e:
+        # RuntimeError: backend already initialized (library use inside a
+        # session that touched devices first).  ValueError: no coordinator
+        # given and none auto-detected (plain single host).  Both degrade
+        # to single-process; a real multi-process run configures a
+        # coordinator and initializes before any backend query.
+        if num_processes not in (None, 1):
+            raise
+        import warnings
+        warnings.warn(f"single-process fallback: {e}")
     initialize_cluster._done = True
 
 

@@ -1,0 +1,125 @@
+"""Shared machinery for the cooperative co-evolution progression (reference
+examples/coev/coop_base.py:16-107 — *Potter & De Jong 2001* §4.2): species
+of 64-bit strings jointly form a *match set*; fitness against a target set
+is the mean over targets of the best match-set member.
+
+Array-native redesign: a species is a ``(pop, 64)`` 0/1 matrix, the whole
+progression's inner evaluation — "strength of [ind] + representatives on
+every target" (reference matchSetStrength, coop_base.py:57-64) — is one
+broadcasted equality-count: precompute the representatives' best match per
+target, then ``mean(maximum(ind_match, rep_best))`` scores the ENTIRE
+species in one fused op.  The generalizing / niching / adaptation variants
+(coop_gen/niche/adapt) drive this with different schemata and species
+schedules.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu import base
+from deap_tpu.algorithms import vary_genome
+from deap_tpu.ops import crossover, mutation, selection
+
+IND_SIZE = 64
+SPECIES_SIZE = 50
+
+NOISE = "*##*###*###*****##*##****#*##*###*#****##******##*#**#*#**######"
+SCHEMATAS = (
+    "1##1###1###11111##1##1111#1##1###1#1111##111111##1#11#1#11######",
+    "1##1###1###11111##1##1000#0##0###0#0000##000000##0#00#0#00######",
+    "0##0###0###00000##0##0000#0##0###0#0000##001111##1#11#1#11######",
+)
+
+
+def schema_arrays(schema: str):
+    """(fixed_mask, fixed_vals) float arrays from a '#01' schema string."""
+    fixed = np.array([c in "01" for c in schema], np.float32)
+    vals = np.array([1.0 if c == "1" else 0.0 for c in schema], np.float32)
+    return jnp.asarray(fixed), jnp.asarray(vals)
+
+
+def init_target_set(key, schema: str, size: int):
+    """Noisy strings honoring a schema's fixed positions (reference
+    initTargetSet, coop_base.py:31-44)."""
+    fixed, vals = schema_arrays(schema)
+    noise = jax.random.bernoulli(key, 0.5, (size, IND_SIZE)).astype(jnp.float32)
+    return jnp.where(fixed[None, :] > 0, vals[None, :], noise)
+
+
+def match_strength(x, y):
+    """#matching bits (reference matchStrength, coop_base.py:46-49);
+    broadcasts over leading axes."""
+    return jnp.sum((x == y).astype(jnp.float32), axis=-1)
+
+
+def match_set_strength(match_set, targets):
+    """Mean over targets of the best set member (reference
+    matchSetStrength, coop_base.py:57-64)."""
+    m = match_strength(match_set[:, None, :], targets[None, :, :])
+    return (jnp.mean(jnp.max(m, axis=0)),)
+
+
+def match_set_strength_no_noise(match_set, targets, noise_str: str = NOISE):
+    """Match strength counting only non-noise positions (reference
+    matchSetStrengthNoNoise, coop_base.py:66-74)."""
+    keep = jnp.asarray([c == "*" for c in noise_str], bool)
+    eq = (match_set[:, None, :] == targets[None, :, :]) & keep[None, None, :]
+    m = jnp.sum(eq.astype(jnp.float32), axis=-1)
+    return (jnp.mean(jnp.max(m, axis=0)),)
+
+
+def species_fitness(species_genome, rep_rest, targets):
+    """Fitness of every member of one species joined with the other
+    species' representatives — the reference's per-individual
+    ``evaluate([ind] + r, target_set)`` loop (coop_gen.py:85-87) as one op.
+    ``rep_rest``: (nrep, 64) other-species representatives (may be empty)."""
+    ind_m = match_strength(species_genome[:, None, :], targets[None, :, :])
+    if rep_rest.shape[0]:
+        rep_m = match_strength(rep_rest[:, None, :], targets[None, :, :])
+        best_rep = jnp.max(rep_m, axis=0)
+        ind_m = jnp.maximum(ind_m, best_rep[None, :])
+    return jnp.mean(ind_m, axis=1)
+
+
+def make_toolbox():
+    """The progression's shared operators (reference coop_base.py:103-107)."""
+    tb = base.Toolbox()
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=1.0 / IND_SIZE)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    return tb
+
+
+def init_species(key, n_species: int):
+    """(n_species, SPECIES_SIZE, IND_SIZE) random bit species."""
+    return jax.random.bernoulli(
+        key, 0.5, (n_species, SPECIES_SIZE, IND_SIZE)).astype(jnp.float32)
+
+
+def evolve_round(key, species, reps, targets, tb):
+    """One round-robin pass: every species varies (cxpb=.6, mutpb=1 as in
+    coop_gen.py:82), scores against the *previous* round's representatives
+    of the other species, tournament-selects, and elects its best as next
+    representative (coop_gen.py:79-98).  ``species``: (S, pop, 64); ``reps``:
+    (S, 64).  Returns (species, reps, per-species max fitness)."""
+    n_species = species.shape[0]
+
+    def one_species(k, s, i):
+        k_var, k_sel = jax.random.split(k)
+        varied, _ = vary_genome(k_var, s, tb, 0.6, 1.0)
+        others = jnp.delete(reps, i, axis=0, assume_unique_indices=True)
+        fit = species_fitness(varied, others, targets)
+        idx = tb.select(k_sel, fit[:, None], s.shape[0])
+        new_s = varied[idx]
+        new_fit = fit[idx]
+        best = varied[jnp.argmax(fit)]
+        return new_s, best, jnp.max(fit)
+
+    keys = jax.random.split(key, n_species)
+    new_s, new_reps, best_fit = jax.vmap(one_species)(
+        keys, species, jnp.arange(n_species))
+    return new_s, new_reps, best_fit

@@ -1,0 +1,103 @@
+"""CMA-ES internals plotting (reference examples/es/cma_plotting.py):
+rastrigin N=10, lambda=200, 125 generations, tracking sigma, the covariance
+axis ratio, the squared scaling axes diagD**2, the best fitness, the best
+vector, and per-coordinate standard deviations — then the reference's
+4-panel figure.
+
+Array-native: the whole run is one jitted ``lax.scan`` whose per-generation
+outputs ARE the plotting traces (the reference fills numpy buffers from
+strategy attributes inside its Python loop, cma_plotting.py:60-93).
+Headless: the figure is written to ``cma_plotting.png`` (or a caller path)
+instead of ``plt.show()``."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu import base, benchmarks, cma
+from deap_tpu.algorithms import evaluate_population
+
+N = 10
+NGEN = 125
+LAMBDA = 20 * N
+
+
+def main(seed=64, ngen=NGEN, out_png="cma_plotting.png", verbose=True):
+    strategy = cma.Strategy(centroid=[5.0] * N, sigma=5.0, lambda_=LAMBDA)
+
+    tb = base.Toolbox()
+    tb.register("evaluate", benchmarks.rastrigin)
+
+    def gen_step(carry, k):
+        state, fbest, xbest = carry
+        genome = strategy.generate(state, k)
+        pop = base.Population(genome, base.Fitness.empty(LAMBDA, (-1.0,)))
+        pop, _ = evaluate_population(tb, pop)
+        state = strategy.update(state, pop)
+        fits = pop.fitness.values[:, 0]
+        i = jnp.argmin(fits)
+        better = fits[i] < fbest
+        fbest = jnp.where(better, fits[i], fbest)
+        xbest = jnp.where(better, genome[i], xbest)
+        trace = dict(
+            sigma=state.sigma,
+            axis_ratio=(jnp.max(state.diagD) / jnp.min(state.diagD)) ** 2,
+            diagD2=state.diagD ** 2,
+            fbest=fbest,
+            best=xbest,
+            std=jnp.std(genome, axis=0),
+            favg=jnp.mean(fits), fmin=jnp.min(fits), fmax=jnp.max(fits),
+        )
+        return (state, fbest, xbest), trace
+
+    @jax.jit
+    def run(key):
+        keys = jax.random.split(key, ngen)
+        carry0 = (strategy.init(), jnp.inf, jnp.zeros(N))
+        return lax.scan(gen_step, carry0, keys)
+
+    (_, fbest, _), tr = run(jax.random.PRNGKey(seed))
+    tr = {k: np.asarray(v) for k, v in tr.items()}
+
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    x = np.arange(0, LAMBDA * ngen, LAMBDA)
+    plt.figure(figsize=(10, 8))
+    plt.subplot(2, 2, 1)
+    plt.semilogy(x, tr["favg"], "--b")
+    plt.semilogy(x, tr["fmax"], "--b")
+    plt.semilogy(x, tr["fmin"], "-b")
+    plt.semilogy(x, tr["fbest"], "-c")
+    plt.semilogy(x, tr["sigma"], "-g")
+    plt.semilogy(x, tr["axis_ratio"], "-r")
+    plt.grid(True)
+    plt.title("blue: f-values, green: sigma, red: axis ratio")
+
+    plt.subplot(2, 2, 2)
+    plt.plot(x, tr["best"])
+    plt.grid(True)
+    plt.title("Object Variables")
+
+    plt.subplot(2, 2, 3)
+    plt.semilogy(x, tr["diagD2"])
+    plt.grid(True)
+    plt.title("Scaling (All Main Axes)")
+
+    plt.subplot(2, 2, 4)
+    plt.semilogy(x, tr["std"])
+    plt.grid(True)
+    plt.title("Standard Deviations in All Coordinates")
+
+    plt.tight_layout()
+    plt.savefig(out_png, dpi=90)
+    plt.close()
+    if verbose:
+        print(f"final best rastrigin: {float(fbest):.4e}; wrote {out_png}")
+    return float(fbest)
+
+
+if __name__ == "__main__":
+    main()

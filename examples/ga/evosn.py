@@ -1,0 +1,141 @@
+"""Evolving sorting networks (reference examples/ga/evosn.py:27-141): a
+3-objective NSGA-II GA over variable-length comparator lists, minimizing
+(sorting misses, network length, network depth) on 6 wires.
+
+Array-native: a network is a fixed-capacity ``{"wires": (CAP, 2), "length"}``
+genome (see ``sortingnetwork.py``); the reference's mutWire / mutAddWire /
+mutDelWire trio (evosn.py:40-51, applied with independent probabilities,
+evosn.py:112-121) becomes one composite masked mutation; crossover swaps a
+two-point window inside the shared prefix (the reference's list cxTwoPoint
+cuts within the shorter parent); all 2^6 assessments run as one tensor per
+network, vmapped over the population.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu import base
+from deap_tpu.algorithms import evaluate_population, var_and
+from deap_tpu.ops import emo
+
+from . import sortingnetwork as sn
+
+INPUTS = 6
+CAP = 24
+MIN_SIZE, MAX_SIZE = 9, 12
+CXPB, MUTPB, INDPB, ADDPB, DELPB = 0.5, 0.2, 0.05, 0.01, 0.01
+
+
+def rand_wires(key, shape):
+    return jax.random.randint(key, shape + (2,), 0, INPUTS)
+
+
+def make_toolbox(cases):
+    tb = base.Toolbox()
+
+    def evaluate(g):
+        misses = sn.assess(g["wires"], g["length"], cases)
+        _, depth = sn.assign_levels(g["wires"], g["length"], CAP, INPUTS)
+        return (misses.astype(jnp.float32),
+                g["length"].astype(jnp.float32),
+                depth.astype(jnp.float32))
+
+    def mate(key, a, b):
+        """Two-point window swap within the shared prefix (reference uses
+        list cxTwoPoint, evosn.py:66: cuts fall inside the shorter parent,
+        lengths are preserved)."""
+        size = jnp.minimum(a["length"], b["length"])
+        k1, k2 = jax.random.split(key)
+        c1 = jax.random.randint(k1, (), 0, jnp.maximum(size, 1))
+        c2 = jax.random.randint(k2, (), 0, jnp.maximum(size, 1))
+        lo, hi = jnp.minimum(c1, c2), jnp.maximum(c1, c2) + 1
+        m = ((jnp.arange(CAP) >= lo) & (jnp.arange(CAP) < hi))[:, None]
+        wa = jnp.where(m, b["wires"], a["wires"])
+        wb = jnp.where(m, a["wires"], b["wires"])
+        return (dict(wires=wa, length=a["length"]),
+                dict(wires=wb, length=b["length"]))
+
+    def mutate(key, g):
+        """Composite of the reference's three wire mutations with their own
+        firing probabilities (evosn.py:112-121)."""
+        (k_w, k_wp, k_wv, k_add, k_addp, k_addw, k_del,
+         k_delp) = jax.random.split(key, 8)
+        wires, length = g["wires"], g["length"]
+        slot = jnp.arange(CAP)
+
+        # mutWire w.p. MUTPB: resample each active wire pair w.p. INDPB
+        m = (jax.random.bernoulli(k_wp, MUTPB)
+             & jax.random.bernoulli(k_w, INDPB, (CAP,)) & (slot < length))
+        wires = jnp.where(m[:, None], rand_wires(k_wv, (CAP,)), wires)
+
+        # mutAddWire: insert a random wire at a random index w.p. ADDPB
+        do_add = jax.random.bernoulli(k_addp, ADDPB) & (length < CAP)
+        pos = jax.random.randint(k_add, (), 0, length + 1)
+        src = jnp.clip(slot - 1, 0, CAP - 1)
+        shifted = jnp.where((slot > pos)[:, None], wires[src], wires)
+        shifted = jnp.where((slot == pos)[:, None],
+                            rand_wires(k_addw, ()), shifted)
+        wires = jnp.where(do_add, shifted, wires)
+        length = jnp.where(do_add, length + 1, length)
+
+        # mutDelWire: delete a random index w.p. DELPB (keep >= 1)
+        do_del = jax.random.bernoulli(k_delp, DELPB) & (length > 1)
+        dpos = jax.random.randint(k_del, (), 0, jnp.maximum(length, 1))
+        dsrc = jnp.clip(slot + 1, 0, CAP - 1)
+        deleted = jnp.where((slot >= dpos)[:, None], wires[dsrc], wires)
+        wires = jnp.where(do_del, deleted, wires)
+        length = jnp.where(do_del, length - 1, length)
+
+        return dict(wires=wires, length=length)
+
+    tb.register("evaluate", evaluate)
+    tb.register("mate", mate)
+    tb.register("mutate", mutate)
+    return tb
+
+
+def main(seed=64, pop_size=300, ngen=40, verbose=True):
+    cases = sn.all_binary_cases(INPUTS)
+    tb = make_toolbox(cases)
+    key = jax.random.PRNGKey(seed)
+    key, k_w, k_l = jax.random.split(key, 3)
+    lengths = jax.random.randint(k_l, (pop_size,), MIN_SIZE, MAX_SIZE + 1)
+    genome = dict(wires=rand_wires(k_w, (pop_size, CAP)), length=lengths)
+    weights = (-1.0, -1.0, -1.0)
+    pop = base.Population(genome, base.Fitness.empty(pop_size, weights))
+
+    def gen_step(carry, _):
+        key, pop = carry
+        key, k_var, k_sel = jax.random.split(key, 3)
+        off = var_and(k_var, pop, tb, cxpb=CXPB, mutpb=1.0)
+        off, _ = evaluate_population(tb, off)
+        pool = pop.concat(off)
+        sel = emo.sel_nsga2(k_sel, pool.fitness, pop_size)
+        new = pool.take(sel)
+        return (key, new), jnp.min(pool.fitness.values[:, 0])
+
+    @jax.jit
+    def run(key, pop):
+        pop, _ = evaluate_population(tb, pop)
+        (key, pop), best = lax.scan(gen_step, (key, pop), None, length=ngen)
+        return pop, best
+
+    pop, best_curve = run(key, pop)
+    vals = np.asarray(pop.fitness.values)
+    # best sorter: fewest misses, then shortest
+    order = np.lexsort((vals[:, 1], vals[:, 0]))
+    b = order[0]
+    if verbose:
+        wires = np.asarray(jax.tree_util.tree_map(lambda x: x[b],
+                                                  pop.genome)["wires"])
+        length = int(vals[b, 1])
+        print(sn.draw(wires, length, INPUTS))
+        print(f"{int(vals[b, 0])} errors, length {int(vals[b, 1])}, "
+              f"depth {int(vals[b, 2])}")
+    return pop, vals[b]
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+"""Array-native sorting-network model (reference
+examples/ga/sortingnetwork.py:19-121).
+
+The reference models a network as a list of *levels* built greedily by
+``addConnector`` (a comparator goes one level past the deepest level whose
+comparators' wire intervals overlap it, sortingnetwork.py:33-57), sorts by
+sweeping levels (py:59-64), and assesses on all binary sequences via the
+zero-one principle (py:66-80).
+
+Here a network is a fixed-capacity genome ``{"wires": (cap, 2) int32,
+"length": () int32}``; the greedy level assignment is a ``lax.scan`` over
+connector slots carrying per-level wire-coverage bitmasks, execution applies
+comparators in (level, insertion) order — within a level comparators are
+interval-disjoint by construction, so this reproduces the reference's
+level-sweep semantics — and assessment evaluates ALL 2^dim binary cases as
+one ``(2^dim, dim)`` tensor per network, vmapped over the population.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def assign_levels(wires, length, cap, dim):
+    """Greedy level index per connector (reference addConnector,
+    sortingnetwork.py:33-50): a connector lands one past the deepest level
+    whose covered wire-interval overlaps its own; no-op connectors
+    (wire1 == wire2, py:35-36) and slots beyond ``length`` get the sentinel
+    level ``cap``.  Returns ``(levels (cap,), depth ())``."""
+    lo = jnp.minimum(wires[:, 0], wires[:, 1])
+    hi = jnp.maximum(wires[:, 0], wires[:, 1])
+
+    def body(level_mask, x):
+        i, a, b = x
+        m = (jnp.arange(dim) >= a) & (jnp.arange(dim) <= b)
+        active = (i < length) & (a != b)
+        conflicts = jnp.any(level_mask & m[None, :], axis=1)       # (cap,)
+        has = jnp.any(conflicts)
+        deepest = cap - 1 - jnp.argmax(conflicts[::-1])
+        place = jnp.clip(jnp.where(has, deepest + 1, 0), 0, cap - 1)
+        new_mask = level_mask.at[place].set(level_mask[place] | m)
+        level_mask = jnp.where(active, new_mask, level_mask)
+        level = jnp.where(active, place, cap)
+        return level_mask, level
+
+    mask0 = jnp.zeros((cap, dim), bool)
+    _, levels = lax.scan(body, mask0, (jnp.arange(cap), lo, hi))
+    depth = jnp.max(jnp.where(levels < cap, levels + 1, 0))
+    return levels, depth
+
+
+def apply_network(wires, length, cases):
+    """Run every comparator over a ``(ncase, dim)`` batch in (level,
+    insertion) order — the reference's level sweep (sortingnetwork.py:59-64)."""
+    cap = wires.shape[0]
+    dim = cases.shape[-1]
+    lo = jnp.minimum(wires[:, 0], wires[:, 1])
+    hi = jnp.maximum(wires[:, 0], wires[:, 1])
+    levels, _ = assign_levels(wires, length, cap, dim)
+    order = jnp.argsort(levels * (cap + 1) + jnp.arange(cap))
+    lo, hi, levels = lo[order], hi[order], levels[order]
+
+    def body(vals, x):
+        a, b, lvl = x
+        active = lvl < cap
+        col = jnp.arange(dim)
+        oh_a = (col == a) & active
+        oh_b = (col == b) & active
+        va = vals[:, a]
+        vb = vals[:, b]
+        small = jnp.minimum(va, vb)[:, None]
+        large = jnp.maximum(va, vb)[:, None]
+        return jnp.where(oh_a[None, :], small,
+                         jnp.where(oh_b[None, :], large, vals)), None
+
+    vals, _ = lax.scan(body, cases, (lo, hi, levels))
+    return vals
+
+
+def all_binary_cases(dim: int) -> jnp.ndarray:
+    """All 2^dim 0/1 sequences — the zero-one principle test set
+    (reference assess, sortingnetwork.py:71-72)."""
+    n = 1 << dim
+    i = np.arange(n)[:, None]
+    return jnp.asarray((i >> np.arange(dim)[None, :]) & 1, jnp.float32)
+
+
+def assess(wires, length, cases):
+    """Number of unsorted outputs over ``cases`` (reference
+    sortingnetwork.py:66-80)."""
+    out = apply_network(wires, length, cases)
+    expect = jnp.sort(out, axis=1)
+    return jnp.sum(jnp.any(out != expect, axis=1))
+
+
+def draw(wires_np, length, dim) -> str:
+    """ASCII rendering, host-side (reference sortingnetwork.py:82-110
+    layout: one 7-char column per level, 'x' endpoints joined by '|')."""
+    wires_np = np.asarray(wires_np)[:int(length)]
+    levels, _ = assign_levels(jnp.asarray(wires_np),
+                              jnp.asarray(len(wires_np)), len(wires_np), dim)
+    levels = np.asarray(levels)
+    depth = int(levels[levels < len(wires_np)].max() + 1) if len(wires_np) else 0
+    rows = [list(f"{w}" + " o" + "-" * (7 * depth)) for w in range(dim)]
+    gaps = [[" "] * (3 + 7 * depth) for _ in range(dim - 1)]
+    for (a, b), lvl in zip(wires_np, levels):
+        a, b = int(min(a, b)), int(max(a, b))
+        if a == b:
+            continue
+        col = 3 + int(lvl) * 7 + 3
+        rows[a][col] = "x"
+        rows[b][col] = "x"
+        for w in range(a, b):
+            gaps[w][col] = "|"
+        for w in range(a + 1, b):
+            rows[w][col] = "|"
+    out = []
+    for w in range(dim):
+        out.append("".join(rows[w]))
+        if w < dim - 1:
+            out.append("".join(gaps[w]))
+    return "\n".join(out)

@@ -1,54 +1,124 @@
-"""Smoke tests over the examples acceptance suite (SURVEY §2.7): each
-example's ``main`` runs at reduced budget and meets a loose quality bar.
-The full-budget runs are exercised manually / by the bench harness."""
+"""Smoke tests over the ENTIRE examples acceptance suite (SURVEY §2.7):
+every example module on disk runs at (reduced) budget and meets a quality
+bar matching the reference script's own success criterion where one exists.
+``test_every_example_covered`` pins CI coverage == disk coverage, so a new
+example without a smoke entry fails the suite."""
 
-import sys
+import importlib
 import os
+import pathlib
+import sys
 
+import numpy as np
 import pytest
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# modules that are libraries for other examples, not runnable workloads
+LIBRARY_MODULES = {
+    "examples.coev.coop_base",        # shared Potter&DeJong machinery
+    "examples.ga.sortingnetwork",     # network model for evosn
+    "examples.ga.knn",                # classifier model for evoknn
+}
+# runnable, but exercised by a dedicated test elsewhere
+COVERED_ELSEWHERE = {
+    "examples.ga.onemax_multihost": "tests/test_multihost.py (2 processes)",
+}
 
 
-def test_onemax_short():
-    from examples.ga import onemax_short
-    pop = onemax_short.main()
+def _mod(name):
+    return importlib.import_module(name)
+
+
+# name -> (main kwargs, check(result) or None)
+SMOKE = {
+    # --- ga ---
+    "examples.ga.onemax": (dict(), lambda r: _fit_max(r[0]) >= 95),
+    "examples.ga.onemax_short": (dict(), lambda r: _fit_max(r) >= 95),
+    "examples.ga.onemax_sharded": (dict(ngen=20, pop_size=1024),
+                                   lambda r: _fit_max(r) >= 90),
+    "examples.ga.onemax_island": (dict(), lambda r: _fit_max(r) >= 90),
+    "examples.ga.onemax_multidemic": (dict(), lambda r: _fit_max(r) >= 85),
+    "examples.ga.nsga2": (dict(ngen=100), lambda r: r[1] > 116.0),
+    "examples.ga.nsga3": (dict(ngen=60), lambda r: r[1] < 1.0),
+    "examples.ga.mo_rhv": (dict(ngen=100), lambda r: r[1] > 116.0),
+    "examples.ga.knapsack": (dict(), lambda r: bool(
+        (np.asarray(r.fitness.values)[:, 0] <= 50).all())),
+    "examples.ga.kursawefct": (dict(), None),
+    "examples.ga.nqueens": (dict(), lambda r: r[1] <= 2),
+    "examples.ga.tsp": (dict(), lambda r: np.isfinite(r[1])),
+    "examples.ga.xkcd": (dict(), None),
+    "examples.ga.evosn": (dict(pop_size=200, ngen=20),
+                          lambda r: r[1][0] <= 6),
+    "examples.ga.evoknn": (dict(ngen=20), lambda r: r[1][0] >= 0.9),
+    # --- gp ---
+    "examples.gp.symbreg": (dict(ngen=25), None),
+    "examples.gp.symbreg_epsilon_lexicase": (dict(ngen=15), None),
+    "examples.gp.symbreg_harm": (dict(ngen=10), None),
+    "examples.gp.adf_symbreg": (dict(ngen=10), None),
+    "examples.gp.multiplexer": (dict(ngen=25), lambda r: r >= 56),
+    "examples.gp.parity": (dict(ngen=10), lambda r: r >= 8),
+    "examples.gp.spambase": (dict(ngen=8), lambda r: r >= 0.6),
+    "examples.gp.ant": (dict(ngen=8), lambda r: r >= 20),
+    # --- es ---
+    "examples.es.cma_minfct": (dict(), lambda r: r < 1e-8),
+    "examples.es.cma_one_plus_lambda": (dict(), lambda r: r < 30.0),
+    # rastrigin: BIPOP restarts reach the global basin's rim (~0.99)
+    "examples.es.cma_bipop": (dict(), lambda r: r < 2.0),
+    "examples.es.cma_mo": (dict(ngen=120), lambda r: r > 116.0),
+    "examples.es.cma_plotting": (dict(ngen=60, out_png="/tmp/cma_plot_test.png"),
+                                 lambda r: r < 10.0),
+    "examples.es.fctmin": (dict(), lambda r: r[1] < 1.0),
+    "examples.es.onefifth": (dict(), lambda r: r < 1e-4),
+    # --- pso / de / eda ---
+    "examples.pso.basic": (dict(), lambda r: r < 1.0),
+    "examples.pso.multiswarm": (dict(), None),
+    "examples.pso.speciation": (dict(), lambda r: r >= 1),
+    "examples.de.basic": (dict(), lambda r: r < 1e-1),
+    "examples.de.sphere": (dict(), None),
+    "examples.de.dynamic": (dict(), None),
+    "examples.eda.emna": (dict(), lambda r: r < 1e-2),
+    "examples.eda.pbil": (dict(), lambda r: r >= 45),
+    # --- coev ---
+    "examples.coev.coop_evol": (dict(), lambda r: r >= 85),
+    "examples.coev.coop_gen": (dict(ngen=100), lambda r: r[1] >= 45),
+    "examples.coev.coop_niche": (dict(ngen=120),
+                                 lambda r: min(r[1]) >= 0.9),
+    "examples.coev.coop_adapt": (dict(ngen=200), lambda r: r[1] >= 42),
+    "examples.coev.symbreg": (dict(ngen=30), lambda r: r < 1.0),
+    "examples.coev.hillis": (dict(), lambda r: r <= 20),
+    # --- misc ---
+    "examples.bbob": (dict(), None),
+}
+
+
+def _fit_max(pop):
     import jax.numpy as jnp
-    assert float(jnp.max(pop.fitness.values)) >= 95
+    return float(jnp.max(pop.fitness.values))
 
 
-def test_nsga2_hypervolume_gate():
-    from examples.ga import nsga2
-    pop, hv = nsga2.main(ngen=100, verbose=False)
-    assert hv > 116.0, f"hypervolume {hv} below the reference gate"
+def test_every_example_covered():
+    """CI coverage must equal disk coverage."""
+    on_disk = set()
+    for p in (REPO / "examples").rglob("*.py"):
+        if p.name == "__init__.py":
+            continue
+        rel = p.relative_to(REPO).with_suffix("")
+        on_disk.add(".".join(rel.parts))
+    expected = set(SMOKE) | LIBRARY_MODULES | set(COVERED_ELSEWHERE)
+    missing = on_disk - expected
+    stale = expected - on_disk
+    assert not missing, f"examples with no smoke test: {sorted(missing)}"
+    assert not stale, f"smoke entries with no file: {sorted(stale)}"
 
 
-def test_cma_minfct_gate():
-    from examples.es import cma_minfct
-    best = cma_minfct.main(verbose=False)
-    assert best < 1e-8
-
-
-def test_knapsack_feasible():
-    from examples.ga import knapsack
-    import numpy as np
-    pop = knapsack.main(verbose=False)
-    vals = np.asarray(pop.fitness.values)
-    assert (vals[:, 0] <= knapsack.MAX_WEIGHT).all()
-
-
-def test_multiplexer_solves():
-    from examples.gp import multiplexer
-    best = multiplexer.main(ngen=25, verbose=False)
-    assert best >= 56          # ≥ 87% of the truth table at reduced budget
-
-
-def test_ant_routine_interpreter():
-    from examples.gp import ant
-    best = ant.main(ngen=8, verbose=False)
-    assert best >= 20          # random-ish programs eat < 10
-
-
-def test_pbil():
-    from examples.eda import pbil
-    assert pbil.main(verbose=False) >= 45
+@pytest.mark.parametrize("name", sorted(SMOKE))
+def test_example(name):
+    kwargs, check = SMOKE[name]
+    mod = _mod(name)
+    if "verbose" in mod.main.__code__.co_varnames:
+        kwargs = dict(kwargs, verbose=False)
+    result = mod.main(**kwargs)
+    if check is not None:
+        assert check(result), f"{name} quality gate failed: {result!r}"

@@ -219,6 +219,43 @@ def test_stream_every_emits_per_generation(capfd):
     assert len(lb) == 11
 
 
+def test_decorated_operator_not_bypassed_by_batched_dispatch():
+    """A functools.wraps decorator copies __dict__ (incl. ``batched``) onto
+    its wrapper; the dispatch must detect that and HONOR the decorator
+    instead of calling the raw batched op."""
+    import functools
+    from deap_tpu.algorithms import _batched_form, _apply_op
+
+    def clamp(op):
+        @functools.wraps(op)
+        def wrapper(key, ind, **kw):
+            return jnp.clip(op(key, ind, **kw), -1.0, 1.0)
+        return wrapper
+
+    tb = base.Toolbox()
+    tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=100.0,
+                indpb=1.0)
+    assert _batched_form(tb.mutate) is not None  # undecorated: fast path
+    tb.decorate("mutate", clamp)
+    assert tb.mutate.batched is not None         # attribute DID survive...
+    assert _batched_form(tb.mutate) is None      # ...but dispatch rejects it
+    out = _apply_op(tb.mutate, jax.random.PRNGKey(0), 8,
+                    jnp.zeros((8, 4)))
+    assert float(jnp.max(jnp.abs(out))) <= 1.0, "decorator was bypassed"
+
+
+def test_hv_contributions_2d_ref_caps_interior():
+    """Points outside the reference box must neither gain nor grant
+    exclusive volume."""
+    from deap_tpu.ops.indicator import hypervolume_contributions_2d
+    obj = jnp.array([[0.5, 3.0], [2.0, 1.0]])    # p2 outside ref box (f1)
+    ref = jnp.array([1.5, 4.0])
+    c = np.asarray(hypervolume_contributions_2d(
+        obj, jnp.ones(2, bool), ref))
+    np.testing.assert_allclose(c[0], (1.5 - 0.5) * (4.0 - 3.0), rtol=1e-6)
+    assert c[1] == 0.0
+
+
 def test_async_checkpoint_roundtrip(tmp_path):
     path = tmp_path / "async.pkl"
     state = {"a": jnp.arange(5), "k": jax.random.PRNGKey(0), "s": "meta"}
