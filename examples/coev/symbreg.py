@@ -71,9 +71,16 @@ def main(seed=5, ngen=NGEN, verbose=True):
         ga_pop, gp_pop, best_ga, best_gp = carry
         k_sga, k_sgp, k_vga, k_vgp = jax.random.split(k, 4)
 
-        # score current populations against the other side's champion
+        # score current populations against the other side's champion —
+        # one evaluation per population per generation; the champions are
+        # elected from these same scores (they lag one variation step,
+        # exactly like the reference's selBest-at-end-of-loop,
+        # symbreg.py:123-124)
         ga_fit = champion_error(best_gp, ga_pop)     # GA maximizes this
         gp_fit = program_errors(gp_pop, best_ga)     # GP minimizes this
+        best_ga = ga_pop[jnp.argmax(ga_fit)]
+        best_gp = jax.tree_util.tree_map(
+            lambda x: x[jnp.argmin(gp_fit)], gp_pop)
 
         # tournament select + varAnd each side (reference symbreg.py:80-116)
         idx_ga = selection.sel_tournament(k_sga, ga_fit[:, None], POP, 3)
@@ -82,15 +89,8 @@ def main(seed=5, ngen=NGEN, verbose=True):
         gp_new, _ = vary_genome(
             k_vgp, jax.tree_util.tree_map(lambda x: x[idx_gp], gp_pop),
             tb_gp, CXPB, MUTPB)
-
-        # new champions from the re-scored offspring
-        ga_fit2 = champion_error(best_gp, ga_new)
-        gp_fit2 = program_errors(gp_new, best_ga)
-        best_ga = ga_new[jnp.argmax(ga_fit2)]
-        best_gp = jax.tree_util.tree_map(
-            lambda x: x[jnp.argmin(gp_fit2)], gp_new)
-        return (ga_new, gp_new, best_ga, best_gp), (jnp.max(ga_fit2),
-                                                    jnp.min(gp_fit2))
+        return (ga_new, gp_new, best_ga, best_gp), (jnp.max(ga_fit),
+                                                    jnp.min(gp_fit))
 
     @jax.jit
     def run(key, ga_pop, gp_pop):

@@ -244,6 +244,22 @@ def test_decorated_operator_not_bypassed_by_batched_dispatch():
     assert float(jnp.max(jnp.abs(out))) <= 1.0, "decorator was bypassed"
 
 
+def test_hv_contributions_generic_matches_2d_closed_form():
+    """The any-dimension leave-one-out helper must agree with the 2-D
+    closed form on a nondominated 2-D front."""
+    from deap_tpu.ops.indicator import (hypervolume_contributions,
+                                        hypervolume_contributions_2d)
+    key = jax.random.PRNGKey(0)
+    f1 = jnp.sort(jax.random.uniform(key, (12,)))
+    f2 = jnp.sort(jax.random.uniform(jax.random.fold_in(key, 1), (12,)))[::-1]
+    obj = jnp.stack([f1, f2], 1)          # nondominated by construction
+    ref = np.array([2.0, 2.0])
+    c2d = np.asarray(hypervolume_contributions_2d(
+        obj, jnp.ones(12, bool), jnp.asarray(ref)))
+    generic = hypervolume_contributions(-obj, ref=ref)
+    np.testing.assert_allclose(c2d, generic, atol=1e-5)
+
+
 def test_hv_contributions_2d_ref_caps_interior():
     """Points outside the reference box must neither gain nor grant
     exclusive volume."""
