@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Multi-objective benchmark: NSGA-II at pop=100k on ZDT1 (BASELINE
+config 4).  Prints ONE JSON line like bench.py.
+
+Round-1 verdict follow-up: the naive front-peeling recomputed O(MN²)
+dominator counts per front — at pop=10⁵ with its hundreds of fronts that is
+O(F·MN²) ≈ 10¹³ comparisons.  The incremental count-update peel
+(deap_tpu/ops/emo.py nondominated_ranks) does ~2·O(MN²) total regardless of
+front count; this harness measures the full ``sel_nsga2``
+(ranks + crowding + composite sort) plus one whole generation (variation,
+evaluation, environmental selection of 100k from 200k) with the same
+linearity-validated timing as bench.py.
+
+Stock DEAP measured 0.0322 gens/sec at pop=4k and is super-quadratic
+(BASELINE.md) — pop=100k is hours per generation there, so ``vs_baseline``
+divides by the measured pop=4k number scaled quadratically (conservative:
+the observed 1k→4k scaling was worse than quadratic).
+
+Env overrides: BENCH_POP (default 100_000), BENCH_NGEN (3 timed gens).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+POP = int(os.environ.get("BENCH_POP", 100_000))
+NDIM = 30
+NGEN = int(os.environ.get("BENCH_NGEN", 3))
+
+
+def run_tpu():
+    import numpy as np
+    import jax
+
+    if os.environ.get("BENCH_PRNG", "rbg") == "rbg":
+        jax.config.update("jax_default_prng_impl", "rbg")
+
+    import jax.numpy as jnp
+    from jax import lax
+    from deap_tpu import base, benchmarks
+    from deap_tpu.algorithms import evaluate_population, vary_genome
+    from deap_tpu.ops import crossover, mutation, emo
+
+    tb = base.Toolbox()
+    tb.register("evaluate", benchmarks.zdt1)
+    tb.register("mate", crossover.cx_simulated_binary_bounded,
+                low=0.0, up=1.0, eta=20.0)
+    tb.register("mutate", mutation.mut_polynomial_bounded,
+                low=0.0, up=1.0, eta=20.0, indpb=1.0 / NDIM)
+    weights = (-1.0, -1.0)
+
+    def generation(carry, _):
+        key, pop = carry
+        key, k_var, k_sel = jax.random.split(key, 3)
+        genome, _ = vary_genome(k_var, pop.genome, tb, 0.9, 1.0,
+                                pairing="halves")
+        off = base.Population(genome, base.Fitness.empty(POP, weights))
+        off, _ = evaluate_population(tb, off)
+        pool = pop.concat(off)
+        sel = emo.sel_nsga2(k_sel, pool.fitness, POP)
+        new = pool.take(sel)
+        return (key, new), jnp.min(new.fitness.values[:, 0])
+
+    def make_run(ngen):
+        @jax.jit
+        def run(key, pop):
+            return lax.scan(generation, (key, pop), None, length=ngen)
+        return run
+
+    key = jax.random.PRNGKey(0)
+    genome = jax.random.uniform(key, (POP, NDIM), jnp.float32)
+    pop = base.Population(genome, base.Fitness.empty(POP, weights))
+    pop, _ = evaluate_population(tb, pop)
+
+    def timed(ngen):
+        run = make_run(ngen)
+        _, best = run(key, pop)
+        np.asarray(best[-1:])
+        t0 = time.perf_counter()
+        _, best = run(key, pop)
+        best_host = np.asarray(best)
+        return time.perf_counter() - t0, float(best_host[-1])
+
+    t1, _ = timed(NGEN)
+    t2, best = timed(2 * NGEN)
+    ratio = t2 / t1
+    marginal = (t2 - t1) / NGEN
+    return 1.0 / marginal, ratio, best, jax.devices()[0].platform
+
+
+def measured_baseline():
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            measured = json.load(f).get("measured", {})
+        gps4k = measured["nsga2_zdt1_pop4000_gens_per_sec_serial"]
+    except (OSError, KeyError, ValueError):
+        return None
+    return gps4k / (POP / 4000) ** 2      # conservative quadratic scaling
+
+
+def main():
+    gens_per_sec, ratio, best, platform = run_tpu()
+    linear_ok = 1.5 <= ratio <= 2.7
+    baseline = measured_baseline()
+    vs = (gens_per_sec / baseline) if (baseline and linear_ok) else -1.0
+    print(json.dumps({
+        "metric": f"nsga2_zdt1_pop{POP}_gens_per_sec",
+        "value": round(gens_per_sec, 4) if linear_ok else -1,
+        "unit": "generations/sec",
+        "vs_baseline": round(vs, 1),
+        "extra": {
+            "platform": platform,
+            "timing_linearity": {"t2N_over_tN": round(ratio, 3),
+                                 "ok": linear_ok},
+            "best_f1_end": best,
+            "stock_deap_projected_gens_per_sec": baseline,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -244,6 +244,41 @@ def test_decorated_operator_not_bypassed_by_batched_dispatch():
     assert float(jnp.max(jnp.abs(out))) <= 1.0, "decorator was bypassed"
 
 
+def test_vary_genome_halves_pairing():
+    """``pairing='halves'`` must place children in half blocks with an
+    aligned touched mask, and equal the adjacent pairing's result up to the
+    interleave permutation when fed the interleave-permuted parents."""
+    from deap_tpu.algorithms import vary_genome
+    tb = base.Toolbox()
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.0)  # cx only
+    key = jax.random.PRNGKey(8)
+    for n in (10, 11):                    # even + odd (leftover row)
+        g = jax.random.normal(jax.random.fold_in(key, n), (n, 6))
+        n2 = n // 2
+        out_h, touched_h = vary_genome(key, g, tb, cxpb=1.0, mutpb=0.0,
+                                       pairing="halves")
+        # adjacent pairing on the interleaved parent layout pairs the SAME
+        # rows with the SAME per-pair randomness -> identical children, in
+        # interleaved order
+        perm = np.zeros(n, int)
+        perm[0:2 * n2:2] = np.arange(n2)
+        perm[1:2 * n2:2] = n2 + np.arange(n2)
+        if n % 2:
+            perm[-1] = n - 1
+        out_a, touched_a = vary_genome(key, g[perm], tb, cxpb=1.0,
+                                       mutpb=0.0, pairing="adjacent")
+        np.testing.assert_array_equal(np.asarray(out_h)[perm],
+                                      np.asarray(out_a))
+        np.testing.assert_array_equal(np.asarray(touched_h)[perm],
+                                      np.asarray(touched_a))
+        assert bool(touched_h[:2 * n2].all())
+        if n % 2:
+            assert not bool(touched_h[-1])
+            np.testing.assert_array_equal(np.asarray(out_h[-1]),
+                                          np.asarray(g[-1]))
+
+
 def test_hv_contributions_generic_matches_2d_closed_form():
     """The any-dimension leave-one-out helper must agree with the 2-D
     closed form on a nondominated 2-D front."""
