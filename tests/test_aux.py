@@ -1,0 +1,185 @@
+"""Tests for auxiliary subsystems with no prior coverage: constraint
+penalty decorators, benchmark eval-transform decorators, the History
+genealogy recorder, indicator least-contributor selection, and the
+camelCase tools façade (reference test surface: tests/test_constraint-like
+doctests, benchmarks/tools.py doctests, support.py History docs)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base, benchmarks, tools
+from deap_tpu.ops.constraint import DeltaPenalty, ClosestValidPenalty
+from deap_tpu.ops import indicator
+from deap_tpu.benchmarks.tools import (translate, rotate, noise, scale,
+                                       bound, diversity, convergence, igd)
+from deap_tpu.utils.support import History
+
+
+# ---------------------------------------------------------------------------
+# constraint decorators (reference constraint.py:10-132)
+# ---------------------------------------------------------------------------
+
+
+def test_delta_penalty():
+    feasible = lambda g: jnp.all(jnp.abs(g) <= 1.0)
+    dist = lambda g: jnp.sum(jnp.maximum(jnp.abs(g) - 1.0, 0.0))
+    evaluate = DeltaPenalty(feasible, 100.0, weights=(-1.0,),
+                            distance=dist)(benchmarks.sphere)
+    ok = np.asarray(evaluate(jnp.array([0.5, 0.5])))
+    np.testing.assert_allclose(ok, [0.5], rtol=1e-6)
+    # infeasible: delta - sign(w)*dist = 100 - (-1)*1.0 = 101 (minimization:
+    # penalty must be WORSE than any feasible value)
+    bad = np.asarray(evaluate(jnp.array([2.0, 0.0])))
+    np.testing.assert_allclose(bad, [101.0], rtol=1e-6)
+
+
+def test_closest_valid_penalty():
+    feasible = lambda g: jnp.all(jnp.abs(g) <= 1.0)
+    project = lambda g: jnp.clip(g, -1.0, 1.0)
+    evaluate = ClosestValidPenalty(feasible, project, alpha=2.0,
+                                   weights=(-1.0,))(benchmarks.sphere)
+    # infeasible (2, 0): projected to (1, 0) -> sphere = 1, distance = 1,
+    # penalty = 1 - (-1)*2*1 = 3
+    bad = np.asarray(evaluate(jnp.array([2.0, 0.0])))
+    np.testing.assert_allclose(bad, [3.0], rtol=1e-5)
+    ok = np.asarray(evaluate(jnp.array([0.3, 0.4])))
+    np.testing.assert_allclose(ok, [0.25], rtol=1e-5)
+
+
+def test_penalty_under_vmap_in_toolbox():
+    """The decorators must compose with the vmapped evaluation path."""
+    feasible = lambda g: jnp.all(g >= 0.0)
+    tb = base.Toolbox()
+    tb.register("evaluate",
+                DeltaPenalty(feasible, 1e3, weights=(-1.0,))(benchmarks.sphere))
+    from deap_tpu.algorithms import evaluate_population
+    g = jnp.array([[0.5, 0.5], [-0.5, 0.5]])
+    pop = base.Population(g, base.Fitness.empty(2, (-1.0,)))
+    pop, _ = evaluate_population(tb, pop)
+    vals = np.asarray(pop.fitness.values[:, 0])
+    np.testing.assert_allclose(vals, [0.5, 1e3], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# benchmark eval-transform decorators (reference benchmarks/tools.py:25-255)
+# ---------------------------------------------------------------------------
+
+
+def test_translate_decorator():
+    ev = translate([1.0, 2.0])(benchmarks.sphere)
+    # evaluating at the translation vector hits the optimum
+    np.testing.assert_allclose(np.asarray(ev(jnp.array([1.0, 2.0]))), [0.0],
+                               atol=1e-6)
+
+
+def test_rotate_decorator():
+    theta = np.pi / 4
+    R = np.array([[np.cos(theta), -np.sin(theta)],
+                  [np.sin(theta), np.cos(theta)]])
+    ev = rotate(R)(benchmarks.sphere)
+    # sphere is rotation-invariant
+    x = jnp.array([0.3, -0.7])
+    np.testing.assert_allclose(np.asarray(ev(x)),
+                               np.asarray(benchmarks.sphere(x)), rtol=1e-5)
+
+
+def test_noise_and_scale_and_bound():
+    ev = noise(lambda key: 0.0)(benchmarks.sphere)   # zero noise = identity
+    x = jnp.array([1.0, 1.0])
+    key = jax.random.PRNGKey(0)
+    np.testing.assert_allclose(np.asarray(ev(x, key=key)), [2.0], rtol=1e-6)
+
+    # scale divides by the factor before evaluating (reference
+    # tools.py:171-210: "the function is scaled", not the point)
+    ev = scale([2.0, 4.0])(benchmarks.sphere)
+    np.testing.assert_allclose(np.asarray(ev(jnp.array([1.0, 1.0]))),
+                               [0.5 ** 2 + 0.25 ** 2], rtol=1e-5)
+
+    # bound decorates OPERATORS: children are brought back into the box
+    # (reference tools.py:212-255 wraps mate/mutate outputs)
+    big_step = lambda key, g: g + 10.0
+    mut = bound(([-1.0, -1.0], [1.0, 1.0]), "clip")(big_step)
+    np.testing.assert_allclose(
+        np.asarray(mut(key, jnp.array([0.0, 0.5]))), [1.0, 1.0], rtol=1e-6)
+    mut_wrap = bound(([0.0, 0.0], [1.0, 1.0]), "wrap")(lambda k, g: g + 1.25)
+    np.testing.assert_allclose(
+        np.asarray(mut_wrap(key, jnp.array([0.0, 0.5]))), [0.25, 0.75],
+        rtol=1e-5)
+
+
+def test_mo_quality_metrics():
+    # perfect front == optimal front -> zero convergence error, igd 0
+    front = jnp.array([[0.0, 1.0], [0.5, 0.3], [1.0, 0.0]])
+    assert float(convergence(front, front)) < 1e-6
+    assert float(igd(front, front)) < 1e-6
+    d = float(diversity(front, np.array([0.0, 1.0]), np.array([1.0, 0.0])))
+    assert np.isfinite(d)
+
+
+# ---------------------------------------------------------------------------
+# History genealogy (reference support.py:21-152)
+# ---------------------------------------------------------------------------
+
+
+def test_history_genealogy():
+    h = History()
+    g0 = jnp.array([[0.0], [1.0], [2.0]])
+    h.update(g0)                                   # founders: no parents
+    # generation 1: row 0 from parents (0, 1); row 1 from (2,); row 2 from (1,)
+    g1 = jnp.array([[0.5], [2.0], [1.0]])
+    h.update(g1, parent_slots=[[0, 1], [2, 2], [1, 1]])
+    assert h.genealogy_index == 6
+    assert h.genealogy_tree[4] == (1, 2)
+    assert h.genealogy_tree[5] == (3, 3)
+    assert h.genealogy_tree[1] == ()
+    tree = h.getGenealogy(4)
+    assert set(tree) == {4, 1, 2}
+    np.testing.assert_allclose(h.genealogy_history[4], [0.5])
+
+
+# ---------------------------------------------------------------------------
+# indicator least-contributor (reference indicator.py:26-94)
+# ---------------------------------------------------------------------------
+
+
+def test_least_contributor_indicators():
+    # wvalues for a maximization-normalized 2-obj front; middle point is
+    # nearly dominated -> least hypervolume contribution
+    w = jnp.array([[-0.0, -1.0], [-0.45, -0.55], [-1.0, -0.0]])
+    assert indicator.hypervolume(w) == 1
+
+    # epsilon indicators: parity with the reference's formula
+    # (indicator.py:59-90: contribution(i) = min_{j!=i} max_d eps(i, j),
+    # return argmin) computed independently with python loops
+    wv = np.array([[-1.0, -3.0], [-1.9, -2.1], [-3.0, -1.0], [-3.1, -3.1]])
+    wobj = -wv
+
+    def expected(op):
+        contribs = []
+        for i in range(len(wobj)):
+            vals = [max(op(wobj[i], wobj[j])) for j in range(len(wobj))
+                    if j != i]
+            contribs.append(min(vals))
+        return int(np.argmin(contribs))
+
+    assert indicator.additive_epsilon(jnp.asarray(wv)) == expected(
+        lambda a, b: a - b)
+    assert indicator.multiplicative_epsilon(jnp.asarray(wv)) == expected(
+        lambda a, b: a / b)
+
+
+# ---------------------------------------------------------------------------
+# camelCase façade (reference flat tools namespace)
+# ---------------------------------------------------------------------------
+
+
+def test_tools_facade_aliases():
+    from deap_tpu.ops import crossover, selection, mutation, init
+    assert tools.cxTwoPoint is crossover.cx_two_point
+    assert tools.cxTwoPoints is crossover.cx_two_point   # deprecated alias
+    assert tools.selBest is selection.sel_best
+    assert tools.mutFlipBit is mutation.mut_flip_bit
+    assert tools.initRepeat is init.init_repeat
+    # the façade keeps the support classes too
+    assert tools.Statistics is not None and tools.Logbook is not None
