@@ -35,6 +35,12 @@ from .base import Population, Fitness, lex_sort_indices
 from .ops import indicator as _indicator
 from .ops.emo import nondominated_ranks
 
+# jitted entry for the host-driven MO-CMA paths: called eagerly, the
+# incremental peel's while_loops dispatch per primitive (a measured ~0.5 s
+# per call on CPU vs ~1 ms compiled; shapes here are constant, so the
+# compile is paid once)
+_nd_ranks = jax.jit(nondominated_ranks)
+
 __all__ = ["Strategy", "StrategyOnePlusLambda", "StrategyMultiObjective",
            "CMAState", "OnePlusLambdaState"]
 
@@ -326,7 +332,7 @@ class StrategyMultiObjective:
             # sample uniformly among first-front parents
             if self.parent_values is not None:
                 w = np.asarray(self.parent_values) * np.asarray(self.fitness_weights)
-                ranks = np.asarray(nondominated_ranks(jnp.asarray(w))[0])
+                ranks = np.asarray(_nd_ranks(jnp.asarray(w))[0])
                 front = np.nonzero(ranks == 0)[0]
             else:
                 front = np.arange(n)
@@ -348,7 +354,7 @@ class StrategyMultiObjective:
         if n <= self.mu:
             return list(range(n)), []
         w = values * np.asarray(self.fitness_weights)
-        ranks = np.asarray(nondominated_ranks(jnp.asarray(w))[0])
+        ranks = np.asarray(_nd_ranks(jnp.asarray(w))[0])
         order_fronts = [np.nonzero(ranks == r)[0]
                         for r in range(int(ranks.max()) + 1)]
         chosen, not_chosen = [], []

@@ -302,6 +302,7 @@ def sel_nsga3(key, fitness, k, ref_points, ideal_override=None,
     ``ideal_override`` / ``prior_extreme`` carry cross-generation memory
     (best-so-far ideal point, previous extreme points) for the
     :class:`SelNSGA3WithMemory` variant (reference emo.py:450-476)."""
+    ref_points = jnp.asarray(ref_points)     # accept lists / host arrays
     w, _ = _wv_values(fitness)
     n = w.shape[0]
     obj = -w                                             # minimization space
@@ -322,9 +323,9 @@ def sel_nsga3(key, fitness, k, ref_points, ideal_override=None,
                if prior_extreme is not None else None)
     extreme_t = _find_extreme_points(obj_t, considered, prior_t)
     intercepts = _find_intercepts(extreme_t, obj_t, considered)
-    niche, niche_dist = _associate_to_niche(obj, jnp.asarray(ref_points), ideal, intercepts)
+    niche, niche_dist = _associate_to_niche(obj, ref_points, ideal, intercepts)
 
-    nref = np.asarray(ref_points).shape[0]
+    nref = ref_points.shape[0]      # static whether host array or tracer
     counts0 = jax.ops.segment_sum(base.astype(jnp.int32), niche, num_segments=nref)
 
     def pick_one(i, state):
@@ -373,13 +374,46 @@ class SelNSGA3WithMemory:
         self.best_point = np.full(nobj, np.inf)
         self.extreme_points = None
         self._nd = nd
+        self._jitted = {}
+
+    def _fn(self, k: int, with_memory: bool):
+        """Cached jitted selection (host-driven loops would otherwise run
+        the peel's while_loops eagerly — a measured ~100x slowdown)."""
+        key_ = (k, with_memory)
+        if key_ not in self._jitted:
+            if with_memory:
+                self._jitted[key_] = jax.jit(
+                    lambda key, fitness, rp, io, pe: sel_nsga3(
+                        key, fitness, k, rp, ideal_override=io,
+                        prior_extreme=pe, return_memory=True))
+            else:
+                self._jitted[key_] = jax.jit(
+                    lambda key, fitness, rp: sel_nsga3(
+                        key, fitness, k, rp, return_memory=True))
+        return self._jitted[key_]
 
     def __call__(self, key, fitness, k):
-        idx, (ideal, extreme) = sel_nsga3(
-            key, fitness, k, self.ref_points,
-            ideal_override=self.best_point if np.all(np.isfinite(self.best_point)) else None,
-            prior_extreme=self.extreme_points,
-            return_memory=True)
+        operand = fitness.values if hasattr(fitness, "values") else fitness
+        if isinstance(operand, jax.core.Tracer) or isinstance(
+                key, jax.core.Tracer):
+            # host-side memory cannot update per iteration of a traced loop
+            raise RuntimeError(
+                "SelNSGA3WithMemory keeps cross-generation state on the "
+                "host and cannot be traced inside a scanned algorithm; "
+                "either drive generations from a host loop (the reference's "
+                "pattern), or call sel_nsga3(..., ideal_override=, "
+                "prior_extreme=, return_memory=True) and thread the "
+                "returned (ideal, extreme) through your scan carry.")
+        with_memory = (bool(np.all(np.isfinite(self.best_point)))
+                       and self.extreme_points is not None)
+        if with_memory:
+            idx, (ideal, extreme) = self._fn(k, True)(
+                key, fitness, jnp.asarray(self.ref_points),
+                jnp.asarray(self.best_point),
+                jnp.asarray(self.extreme_points))
+        else:
+            idx, (ideal, extreme) = self._fn(k, False)(
+                key, fitness, jnp.asarray(self.ref_points))
         self.best_point = np.asarray(ideal)
         self.extreme_points = np.asarray(extreme)
         return idx

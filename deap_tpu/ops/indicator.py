@@ -27,6 +27,34 @@ def _wobj(front):
     return -w
 
 
+def _contributions_2d_host(wobj: np.ndarray, ref) -> np.ndarray:
+    """Exclusive hypervolume of each point of a *mutually nondominated*
+    2-objective minimization set, host-side closed form: sort by f1, each
+    point owns the box to its neighbors (ref-capped); exact duplicates get
+    0 from both sides.  O(n log n) instead of the n leave-one-out WFG
+    evaluations of the generic path — microseconds vs milliseconds per
+    call, and MO-CMA-ES calls this inside a per-generation removal loop.
+
+    Returns ``None`` when the set is NOT mutually nondominated (then the
+    neighbor-box formula is wrong: a dominated point resurfaces in
+    ``P \\ {i}`` and reclaims part of i's box) so callers fall back to the
+    exact leave-one-out path."""
+    order = np.lexsort((wobj[:, 1], wobj[:, 0]))
+    f1 = wobj[order, 0]
+    f2 = wobj[order, 1]
+    dup = (np.diff(f1) == 0) & (np.diff(f2) == 0)
+    # sorted by (f1 asc, f2 asc): mutual nondominance <=> f2 strictly
+    # decreases between distinct consecutive points
+    if np.any(~dup & (np.diff(f2) >= 0)):
+        return None
+    next_f1 = np.minimum(np.append(f1[1:], ref[0]), ref[0])
+    prev_f2 = np.minimum(np.concatenate(([ref[1]], f2[:-1])), ref[1])
+    contrib = np.maximum(next_f1 - f1, 0.0) * np.maximum(prev_f2 - f2, 0.0)
+    out = np.empty(len(wobj))
+    out[order] = contrib
+    return out
+
+
 def hypervolume(front, **kargs) -> int:
     """Index of the individual with the least hypervolume contribution
     (reference indicator.py:26-47): the point whose removal leaves the
@@ -35,6 +63,10 @@ def hypervolume(front, **kargs) -> int:
     ref = kargs.get("ref", None)
     if ref is None:
         ref = np.max(wobj, axis=0) + 1
+    if wobj.shape[1] == 2:
+        contrib_2d = _contributions_2d_host(wobj, np.asarray(ref))
+        if contrib_2d is not None:
+            return int(np.argmin(contrib_2d))
     contrib = [
         _hv(np.concatenate((wobj[:i], wobj[i + 1:])), ref)
         for i in range(len(wobj))

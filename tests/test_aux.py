@@ -174,6 +174,50 @@ def test_least_contributor_indicators():
 # ---------------------------------------------------------------------------
 
 
+def test_least_contributor_2d_fast_path_matches_leave_one_out():
+    """The closed-form 2-D least contributor must agree with the exact
+    leave-one-out computation on nondominated fronts, and fall back to it
+    on sets that are NOT mutually nondominated (where the neighbor-box
+    formula is wrong)."""
+    from deap_tpu.ops.hv import hypervolume as hv_exact
+    from deap_tpu.ops.indicator import _contributions_2d_host
+
+    def leave_one_out_least(wobj, ref):
+        rem = [hv_exact(np.concatenate((wobj[:i], wobj[i + 1:])), ref)
+               for i in range(len(wobj))]
+        return int(np.argmax(rem))
+
+    # weak domination (equal f1): dominated point must be removed
+    wobj = np.array([[1.0, 0.0], [1.0, 5.0]])
+    ref = np.array([6.0, 6.0])
+    assert _contributions_2d_host(wobj, ref) is None     # detects it
+    assert indicator.hypervolume(jnp.asarray(-wobj), ref=ref) == \
+        leave_one_out_least(wobj, ref) == 1
+
+    # dominated interior row: fast path must decline (neighbor boxes wrong)
+    wobj = np.array([[0.0, 2.0], [1.0, 3.0], [2.0, 0.0]])
+    ref = np.array([3.0, 4.0])
+    assert _contributions_2d_host(wobj, ref) is None
+    assert indicator.hypervolume(jnp.asarray(-wobj), ref=ref) == \
+        leave_one_out_least(wobj, ref)
+
+    # strictly nondominated fronts (+ exact duplicates): fast path exact
+    rng = np.random.RandomState(3)
+    for _ in range(10):
+        n = rng.randint(3, 9)
+        f1 = np.sort(rng.rand(n))
+        f2 = np.sort(rng.rand(n))[::-1].copy()
+        wobj = np.stack([f1, f2], 1)
+        wobj = np.concatenate([wobj, wobj[:1]])          # duplicate row
+        ref = wobj.max(0) + 1
+        c = _contributions_2d_host(wobj, ref)
+        assert c is not None
+        rem = [hv_exact(np.concatenate((wobj[:i], wobj[i + 1:])), ref)
+               for i in range(len(wobj))]
+        total = hv_exact(wobj, ref)
+        np.testing.assert_allclose(c, total - np.asarray(rem), atol=1e-6)
+
+
 def test_tools_facade_aliases():
     from deap_tpu.ops import crossover, selection, mutation, init
     assert tools.cxTwoPoint is crossover.cx_two_point
