@@ -44,7 +44,10 @@ from .utils.support import (Logbook, HallOfFame, ParetoFront,
 
 __all__ = ["var_and", "vary_genome", "var_or", "ea_simple",
            "ea_mu_plus_lambda", "ea_mu_comma_lambda", "ea_generate_update",
-           "evaluate_population"]
+           "evaluate_population",
+           # reference camelCase aliases (bound at end of module)
+           "varAnd", "varOr", "eaSimple", "eaMuPlusLambda",
+           "eaMuCommaLambda", "eaGenerateUpdate"]
 
 
 # ---------------------------------------------------------------------------
@@ -444,3 +447,12 @@ def ea_generate_update(key, toolbox, state, ngen: int, weights=(-1.0,),
     if verbose:
         print(logbook.stream)
     return last_pop, state, logbook
+
+
+# -- reference camelCase aliases (deap/algorithms.py API names) --------------
+varAnd = var_and
+varOr = var_or
+eaSimple = ea_simple
+eaMuPlusLambda = ea_mu_plus_lambda
+eaMuCommaLambda = ea_mu_comma_lambda
+eaGenerateUpdate = ea_generate_update
