@@ -471,7 +471,12 @@ def sel_spea2(key, fitness, k, chunk: int = 1024):
     raw, _ = lax.scan(raw_body, jnp.zeros((n,), w.dtype),
                       (chunks, s_pad.reshape(-1, c)))
 
-    # k-NN density (reference L716-719): kth smallest distance per row
+    # k-NN density: kth smallest distance per row.  Deliberate deviation
+    # from the reference: we use the paper form 1/(sqrt(d2_k)+2) (Zitzler
+    # 2001 eq. 4) where reference L716-719 uses 1/(d2_k+2) on the *squared*
+    # distance over a quirky half-filled distance vector — same ordering
+    # pressure, different numeric values, so bit-parity with stock DEAP's
+    # dominated-fill order is not expected
     kth = min(int(np.sqrt(n)), n - 1) if n > 1 else 0
     row_ids = jnp.arange(n + pad).reshape(-1, c)
     def knn_body(_, block):

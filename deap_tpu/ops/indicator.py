@@ -95,7 +95,16 @@ def hypervolume_contributions_2d(obj, mask, ref):
     f2_i)`` with the reference point capping both ends.  ``obj`` is
     ``(n, 2)`` minimization objectives; rows where ``mask`` is False get
     contribution 0.  Duplicated points annihilate each other's boxes, which
-    matches the exclusive-contribution definition."""
+    matches the exclusive-contribution definition.
+
+    **PRECONDITION (unchecked):** the masked rows must be *mutually
+    nondominated* — e.g. exactly one rank of ``nondominated_ranks``.  A
+    dominated point in the mask silently grants its sorted neighbor's box
+    volume and every downstream contribution is wrong.  There is no
+    fallback here (unlike the host-side ``hypervolume``, which detects the
+    violation and switches to leave-one-out); callers that cannot
+    guarantee a single front must use :func:`hypervolume_contributions`.
+    """
     n = obj.shape[0]
     f1 = jnp.where(mask, obj[:, 0], jnp.inf)
     order = jnp.argsort(f1)

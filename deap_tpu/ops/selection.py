@@ -66,8 +66,15 @@ def sel_tournament(key, fitness, k, tournsize):
     random scalar gather (the measured hot spot at pop=10⁶ on TPU — gathers
     are the expensive primitive, sorts are cheap) with one sort plus a
     ``(k,)`` gather.  Ties: individuals tied on fitness occupy adjacent ranks
-    and split the block's probability by sort order instead of uniformly —
-    an O(1/n) within-block skew with no selection-pressure consequence."""
+    and split the block's probability by sort order instead of uniformly.
+    This is a *deterministic index* bias (under the reversed stable lexsort
+    the later original index always gets the better rank of a tied block),
+    not a random O(1/n) one — aspirant sampling would break such ties
+    uniformly.  It carries no selection-pressure consequence, but when
+    exact tie neutrality matters (e.g. discrete fitness with huge tied
+    blocks), shuffle the population first or use a selector that samples
+    aspirants explicitly (:func:`sel_double_tournament` with
+    ``parsimony_size=1``)."""
     w = _wv(fitness)
     n = w.shape[0]
     order = lex_sort_indices(w, descending=True)          # best rank first

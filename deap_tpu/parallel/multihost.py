@@ -15,7 +15,8 @@ shardings demand them.  Nothing is pickled, ever.
 
 Launch (one process per host, same script)::
 
-    JAX_COORDINATOR=host0:1234 NPROC=4 PROC_ID=$i python train.py
+    DEAP_TPU_COORDINATOR=host0:1234 DEAP_TPU_NPROC=4 DEAP_TPU_PROC_ID=$i \\
+        python train.py
 
     # in train.py
     from deap_tpu.parallel import initialize_cluster, cluster_mesh
@@ -51,9 +52,14 @@ def initialize_cluster(coordinator_address: str | None = None,
                        local_device_ids=None) -> None:
     """Join the cluster: wraps ``jax.distributed.initialize``.
 
-    Priority: explicit args > ``JAX_COORDINATOR``/``NPROC``/``PROC_ID`` env
-    vars > JAX's own auto-detection (TPU pod metadata).  Safe to call twice
-    (a second call is a no-op), so library code can call it defensively.
+    Priority: explicit args > ``DEAP_TPU_COORDINATOR`` / ``DEAP_TPU_NPROC``
+    / ``DEAP_TPU_PROC_ID`` env vars > JAX's own auto-detection (TPU pod
+    metadata).  The legacy spellings ``JAX_COORDINATOR``/``NPROC``/``PROC_ID``
+    are still read, but the generic ``NPROC``/``PROC_ID`` only when a
+    coordinator address is also present — a stray ``NPROC`` exported for
+    ``make -j$NPROC`` on a dev box must not turn a defensive no-arg call
+    into a hung/ raising multi-process join.  Safe to call twice (a second
+    call is a no-op), so library code can call it defensively.
     """
     # NB: must not touch jax.devices()/process_count() here — any backend
     # query initializes XLA and makes jax.distributed.initialize illegal
@@ -66,12 +72,21 @@ def initialize_cluster(coordinator_address: str | None = None,
             return
     except (ImportError, AttributeError):
         pass                     # private probe; fall through to initialize
-    coordinator_address = coordinator_address or os.environ.get(
-        "JAX_COORDINATOR")
-    if num_processes is None and "NPROC" in os.environ:
-        num_processes = int(os.environ["NPROC"])
-    if process_id is None and "PROC_ID" in os.environ:
-        process_id = int(os.environ["PROC_ID"])
+    coordinator_address = (coordinator_address
+                           or os.environ.get("DEAP_TPU_COORDINATOR")
+                           or os.environ.get("JAX_COORDINATOR"))
+    if num_processes is None and "DEAP_TPU_NPROC" in os.environ:
+        num_processes = int(os.environ["DEAP_TPU_NPROC"])
+    if process_id is None and "DEAP_TPU_PROC_ID" in os.environ:
+        process_id = int(os.environ["DEAP_TPU_PROC_ID"])
+    if "JAX_COORDINATOR" in os.environ:
+        # legacy generic names: only honored next to the legacy coordinator
+        # spelling — a stray NPROC (e.g. exported for make -j$NPROC) must
+        # not leak into namespaced or explicit-arg launches
+        if num_processes is None and "NPROC" in os.environ:
+            num_processes = int(os.environ["NPROC"])
+        if process_id is None and "PROC_ID" in os.environ:
+            process_id = int(os.environ["PROC_ID"])
     explicit = coordinator_address is not None or process_id is not None
     try:
         jax.distributed.initialize(

@@ -13,8 +13,10 @@ Single host (this CI)::
 
 Multi host (one process per host)::
 
-    JAX_COORDINATOR=host0:1234 NPROC=2 PROC_ID=0 python .../onemax_multihost.py
-    JAX_COORDINATOR=host0:1234 NPROC=2 PROC_ID=1 python .../onemax_multihost.py
+    DEAP_TPU_COORDINATOR=host0:1234 DEAP_TPU_NPROC=2 DEAP_TPU_PROC_ID=0 \\
+        python .../onemax_multihost.py
+    DEAP_TPU_COORDINATOR=host0:1234 DEAP_TPU_NPROC=2 DEAP_TPU_PROC_ID=1 \\
+        python .../onemax_multihost.py
 """
 
 import numpy as np

@@ -25,7 +25,7 @@ WORKER = textwrap.dedent("""
     # any backend query (same dance as tests/conftest.py)
     jax.config.update("jax_platforms", "cpu")
     from deap_tpu.parallel import initialize_cluster
-    initialize_cluster()      # reads JAX_COORDINATOR / NPROC / PROC_ID
+    initialize_cluster()      # reads DEAP_TPU_COORDINATOR/NPROC/PROC_ID env
     import examples.ga.onemax_multihost as m
     best = m.main(ngen=10, pop_per_process=64, verbose=False)
     assert len(jax.devices()) == 8, jax.devices()
@@ -44,12 +44,17 @@ def _free_port():
 def test_two_process_cluster_onemax():
     port = _free_port()
     env_base = {k: v for k, v in os.environ.items()
-                if not k.startswith(("XLA_", "JAX_"))}
+                if not k.startswith(("XLA_", "JAX_", "DEAP_TPU_"))}
     procs = []
     for pid in range(2):
-        env = dict(env_base,
-                   JAX_COORDINATOR=f"127.0.0.1:{port}",
-                   NPROC="2", PROC_ID=str(pid))
+        if pid == 0:           # namespaced spelling
+            env = dict(env_base,
+                       DEAP_TPU_COORDINATOR=f"127.0.0.1:{port}",
+                       DEAP_TPU_NPROC="2", DEAP_TPU_PROC_ID=str(pid))
+        else:                  # legacy spelling (honored with a coordinator)
+            env = dict(env_base,
+                       JAX_COORDINATOR=f"127.0.0.1:{port}",
+                       NPROC="2", PROC_ID=str(pid))
         procs.append(subprocess.Popen(
             [sys.executable, "-c", WORKER], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
