@@ -16,7 +16,14 @@ Stock DEAP measured 0.0322 gens/sec at pop=4k and is super-quadratic
 divides by the measured pop=4k number scaled quadratically (conservative:
 the observed 1k→4k scaling was worse than quadratic).
 
-Env overrides: BENCH_POP (default 100_000), BENCH_NGEN (3 timed gens).
+Round-2 verdict follow-up: ``BENCH_SELECT=spea2`` swaps the environmental
+selection for ``sel_spea2`` — whose truncation is now excess-bounded and
+incremental (O(N²) once + O(excess·N) maintenance instead of the round-2
+O(N³)-flavored recompute-per-removal) — so SPEA2 gets measured at the same
+populations as NSGA-II instead of being excluded.
+
+Env overrides: BENCH_POP (default 100_000), BENCH_NGEN (3 timed gens),
+BENCH_SELECT (nsga2 | spea2).
 """
 
 import json
@@ -29,6 +36,9 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 POP = int(os.environ.get("BENCH_POP", 100_000))
 NDIM = 30
 NGEN = int(os.environ.get("BENCH_NGEN", 3))
+SELECT = os.environ.get("BENCH_SELECT", "nsga2")
+if SELECT not in ("nsga2", "spea2"):
+    raise SystemExit(f"BENCH_SELECT={SELECT!r}: expected 'nsga2' or 'spea2'")
 
 
 def run_tpu():
@@ -60,7 +70,10 @@ def run_tpu():
         off = base.Population(genome, base.Fitness.empty(POP, weights))
         off, _ = evaluate_population(tb, off)
         pool = pop.concat(off)
-        sel = emo.sel_nsga2(k_sel, pool.fitness, POP)
+        if SELECT == "spea2":
+            sel = emo.sel_spea2(k_sel, pool.fitness, POP)
+        else:
+            sel = emo.sel_nsga2(k_sel, pool.fitness, POP)
         new = pool.take(sel)
         return (key, new), jnp.min(new.fitness.values[:, 0])
 
@@ -97,7 +110,7 @@ def measured_baseline():
     try:
         with open(path) as f:
             measured = json.load(f).get("measured", {})
-        gps4k = measured["nsga2_zdt1_pop4000_gens_per_sec_serial"]
+        gps4k = measured[f"{SELECT}_zdt1_pop4000_gens_per_sec_serial"]
     except (OSError, KeyError, ValueError):
         return None
     return gps4k / (POP / 4000) ** 2      # conservative quadratic scaling
@@ -109,7 +122,7 @@ def main():
     baseline = measured_baseline()
     vs = (gens_per_sec / baseline) if (baseline and linear_ok) else -1.0
     print(json.dumps({
-        "metric": f"nsga2_zdt1_pop{POP}_gens_per_sec",
+        "metric": f"{SELECT}_zdt1_pop{POP}_gens_per_sec",
         "value": round(gens_per_sec, 4) if linear_ok else -1,
         "unit": "generations/sec",
         "vs_baseline": round(vs, 1),

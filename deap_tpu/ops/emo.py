@@ -65,24 +65,76 @@ def _rows_dominate_counts(rows: jax.Array, w: jax.Array) -> jax.Array:
     return jnp.sum(dominates(rows[:, None, :], w[None, :, :]), axis=0)
 
 
+def _nondominated_ranks_2d(w: jax.Array):
+    """Exact 2-objective non-dominated ranks in O(n log n): the staircase
+    sweep behind the reference's Fortin-2013 ``sortLogNondominated``
+    specialised to nobj=2 (reference emo.py:234-441; Jensen 2004 §III.A).
+
+    Sort by (f1 asc, f2 asc) in minimization space; maintain ``best[r]`` =
+    the minimum f2 of any point already assigned to front ``r`` (an array
+    non-decreasing in ``r``): a new point is dominated by front ``r`` iff
+    ``best[r] <= f2``, so its front is the first ``r`` with
+    ``best[r] > f2`` — one ``searchsorted``.  Exact duplicates share the
+    run head's front (identical points never dominate each other) and do
+    not update the staircase.  One ``lax.scan`` of n tiny steps — compare
+    the peel's O(F·front_chunk·N) on deep-front data (F ≈ N fronts turns
+    the peel into O(N²·chunk); the sweep doesn't care)."""
+    n = w.shape[0]
+    big = jnp.finfo(w.dtype).max
+    f = jnp.clip(-w, -big, big)               # minimization, ±inf made finite
+    order = jnp.lexsort((f[:, 1], f[:, 0]))
+    f1s, f2s = f[order, 0], f[order, 1]
+
+    def step(carry, x):
+        best, pf1, pf2, pr = carry
+        f1, f2 = x
+        dup = (f1 == pf1) & (f2 == pf2)
+        r_new = jnp.searchsorted(best, f2, side="right").astype(jnp.int32)
+        r = jnp.where(dup, pr, r_new)
+        best = jnp.where(dup, best, best.at[r_new].set(f2))
+        return (best, f1, f2, r), r
+
+    init = (jnp.full((n,), jnp.inf, f.dtype),
+            jnp.nan * jnp.ones((), f.dtype), jnp.nan * jnp.ones((), f.dtype),
+            jnp.int32(0))
+    _, rs = lax.scan(step, init, (f1s, f2s))
+    ranks = jnp.zeros((n,), jnp.int32).at[order].set(rs)
+    return ranks, jnp.max(rs) + 1
+
+
 def nondominated_ranks(w: jax.Array, valid: jax.Array | None = None,
-                       front_chunk: int = 1024):
+                       front_chunk: int = 1024, method: str = "auto"):
     """Pareto front index for every individual (0 = first front) — the
     partition of reference ``sortNondominated`` (emo.py:53-117) as a rank
     array.  Returns ``(ranks, n_fronts)``; invalid rows land in the last
     fronts because their wvalues are ``-inf``.
 
-    Incremental count-peeling: dominator counts are computed **once** (one
-    chunked O(MN²) pass), then each peeled front *subtracts* its own
-    dominance contribution from the survivors' counts — front members are
-    compacted into static ``(front_chunk, nobj)`` buffers via sized
-    ``nonzero`` so the subtraction is a ``(C, N)`` kernel.  Total work is
-    ~2·O(MN²) regardless of front count, where the naive peel
-    (recount-per-front) is O(F·MN²) — the difference between seconds and
-    hours at pop=10⁵ with its hundreds of fronts."""
+    Two algorithms, identical partitions:
+
+    * ``sweep2d`` (nobj=2 only): the exact O(n log n) staircase sweep
+      (:func:`_nondominated_ranks_2d`) — front count does not matter.
+    * ``peel``: incremental count-peeling for any nobj — dominator counts
+      are computed **once** (one chunked O(MN²) pass), then each peeled
+      front *subtracts* its own dominance contribution from the survivors'
+      counts; front members are compacted into static ``(front_chunk,
+      nobj)`` buffers via sized ``nonzero`` so the subtraction is a
+      ``(C, N)`` kernel.  Total ~2·O(MN²) on shallow-front data, but the
+      per-front compaction costs O(front_chunk·N) even for tiny fronts, so
+      adversarially deep data (F ≈ N fronts) degrades to O(N²·chunk).
+
+    ``method="auto"`` uses the sweep when nobj==2 and the peel otherwise
+    (measured on the bench TPU: the sweep is never slower at nobj=2 and is
+    orders of magnitude faster on deep-front data — see bench_ndsort.py
+    and docs/emo numbers)."""
     n, m = w.shape
     if valid is not None:
         w = jnp.where(valid[:, None], w, -jnp.inf)
+    if method not in ("auto", "sweep2d", "peel"):
+        raise ValueError(f"unknown method {method!r}")
+    if method == "sweep2d" and m != 2:
+        raise ValueError("sweep2d requires exactly 2 objectives")
+    if m == 2 and method in ("auto", "sweep2d"):
+        return _nondominated_ranks_2d(w)
     c = min(front_chunk, n)
     counts = _dominator_counts(w, jnp.ones((n,), bool))
     # sentinel row n: -inf rows dominate nothing, and the sentinel slot of
@@ -144,9 +196,14 @@ def sort_nondominated(fitness, k, first_front_only=False):
 def sort_log_nondominated(fitness, k, first_front_only=False):
     """Generalized-Jensen/Fortin-2013 entry point (reference
     sortLogNondominated, emo.py:234-441).  Produces the identical partition
-    into fronts; on TPU the chunked count-peeling kernel is the faster
-    implementation for the population sizes where XLA shines, so both names
-    share it."""
+    into fronts.  For nobj=2 this genuinely IS a log-time algorithm here:
+    :func:`nondominated_ranks` dispatches to the exact O(n log n) staircase
+    sweep (Jensen's 2-D base case, which the reference's ``sweepA`` also
+    implements).  For nobj>2 the chunked count-peel is used — measured
+    faster on TPU than a recursive divide-and-conquer would be at the
+    population sizes where XLA shines (deep recursion + data-dependent
+    splits defeat fixed-shape compilation; see bench_ndsort.py for the
+    front-depth scaling numbers)."""
     return sort_nondominated(fitness, k, first_front_only)
 
 
@@ -443,11 +500,24 @@ def sel_spea2(key, fitness, k, chunk: int = 1024):
 
     All pairwise structures (dominance, distances) are consumed in
     ``(chunk, N)`` row blocks — memory is O(chunk·N), never O(N²) (an 80 GB
-    matrix at pop=10⁵).  Truncation recomputes each survivor's nearest
-    neighbors per removal, like the reference's repeated scans; its
-    lexicographic full-distance-vector tie-break is applied over the nearest
-    ``min(n-1, 8)`` neighbors — deeper float-distance ties are
-    probability-zero.  ``key`` unused (deterministic)."""
+    matrix at pop=10⁵).
+
+    Truncation is *incremental*: one full chunked pass builds each
+    nondominated point's ``min(n-1, 8)`` nearest-neighbor distances and
+    indices, then a ``while_loop`` bounded by the actual excess
+    (``n_nondom - k`` iterations, not ``n``) removes victims one at a
+    time, invalidating the victim from every list (an O(n·8) mask +
+    per-row re-sort) and re-deriving a row's list from scratch — a
+    ``(64, n)`` distance pass — only when more than half its entries have
+    died.  Dying neighbors can only *shorten* a list, never reorder it,
+    so the surviving prefix is always the true nearest-alive prefix.
+    Total cost is O(n²) once plus O(excess·n) maintenance, where the
+    recompute-per-removal formulation was O(excess·n²).  The reference's
+    lexicographic full-distance-vector tie-break is applied over the
+    nearest-list prefix — deeper float-distance ties are probability-zero
+    (exact-duplicate clusters may resolve in list order, as the
+    reference's own quickselect ties do).  ``key`` unused
+    (deterministic)."""
     del key
     w, _ = _wv_values(fitness)
     n, nobj = w.shape
@@ -503,33 +573,72 @@ def sel_spea2(key, fitness, k, chunk: int = 1024):
     selected_fill = selected_fill.at[fill_order].set(
         selected_fill[fill_order] | take_mask)
 
-    # Case B: too many nondominated → iterative truncation
+    # Case B: too many nondominated → incremental truncation
     tb = min(n - 1, 8) if n > 1 else 1
+    min_valid = (tb + 1) // 2            # refresh a row below this many alive
+    rc = min(n, 64)                      # rows refreshed per recompute pass
+    ids = jnp.arange(n)
 
-    def nearest_tb(alive):
-        """(n, tb) ascending nearest alive-to-alive distances, chunked."""
-        alive_pad = jnp.concatenate([alive, jnp.zeros((pad,), bool)])
+    def nearest_lists(alive):
+        """Ascending ``(n, tb)`` distances + indices of each row's nearest
+        alive points (one chunked full pass)."""
         def body(_, block):
-            wi, ai, ri = block
+            wi, ri = block
             d2 = jnp.sum((wi[:, None, :] - w[None, :, :]) ** 2, axis=-1)
-            self_pair = ri[:, None] == jnp.arange(n)[None, :]
-            d2 = jnp.where(self_pair | ~(ai[:, None] & alive[None, :]),
-                           jnp.inf, d2)
-            neg, _ = lax.top_k(-d2, tb)
-            return None, -neg
-        _, blocks = lax.scan(body, None,
-                             (chunks, alive_pad.reshape(-1, c), row_ids))
-        return blocks.reshape(-1, tb)[:n]
+            bad = (ri[:, None] == ids[None, :]) | ~alive[None, :]
+            neg, di = lax.top_k(-jnp.where(bad, jnp.inf, d2), tb)
+            return None, (-neg, di)
+        _, (db, ib) = lax.scan(body, None, (chunks, row_ids))
+        return db.reshape(-1, tb)[:n], ib.reshape(-1, tb)[:n]
 
-    def remove_one(i, alive):
-        over = jnp.sum(alive) > k
-        near = nearest_tb(alive)                           # (n, tb)
-        near = jnp.where(alive[:, None], near, jnp.inf)
-        keys = [near[:, j] for j in range(tb - 1, -1, -1)]
-        victim = jnp.lexsort(keys)[0]
-        return jnp.where(over, alive.at[victim].set(False), alive)
+    def refresh_rows(alive, dist, idx, need):
+        """Rebuild the lists of rows flagged ``need`` from scratch, ``rc``
+        rows per ``(rc, n)`` distance pass (same sized-nonzero compaction
+        as the front peel's subtract kernel)."""
+        w_sent = jnp.concatenate([w, jnp.zeros((1, nobj), w.dtype)], 0)
 
-    truncated = lax.fori_loop(0, n, remove_one, nondom)
+        def r_cond(s):
+            _, _, need = s
+            return jnp.any(need)
+
+        def r_body(s):
+            dist, idx, need = s
+            rows = jnp.nonzero(need, size=rc, fill_value=n)[0]
+            d2 = jnp.sum((w_sent[rows][:, None, :] - w[None, :, :]) ** 2, -1)
+            bad = (rows[:, None] == ids[None, :]) | ~alive[None, :]
+            neg, di = lax.top_k(-jnp.where(bad, jnp.inf, d2), tb)
+            dist = dist.at[rows].set(-neg, mode="drop")
+            idx = idx.at[rows].set(di, mode="drop")
+            return dist, idx, need.at[rows].set(False, mode="drop")
+
+        dist, idx, _ = lax.while_loop(r_cond, r_body, (dist, idx, need))
+        return dist, idx
+
+    def remove_one(state):
+        alive, dist, idx = state
+        masked = jnp.where(alive[:, None], dist, jnp.inf)
+        victim = jnp.lexsort([masked[:, j] for j in range(tb - 1, -1, -1)])[0]
+        alive = alive.at[victim].set(False)
+        # drop the victim from every list; surviving entries keep their
+        # relative order, so a row re-sort restores the ascending prefix
+        dist = jnp.where(idx == victim, jnp.inf, dist)
+        order = jnp.argsort(dist, axis=1)
+        dist = jnp.take_along_axis(dist, order, 1)
+        idx = jnp.take_along_axis(idx, order, 1)
+        n_alive = jnp.sum(alive)
+        full = jnp.minimum(min_valid, n_alive - 1)
+        need = alive & (jnp.sum(jnp.isfinite(dist), 1) < full)
+        dist, idx = refresh_rows(alive, dist, idx, need)
+        return alive, dist, idx
+
+    def truncate(nondom):
+        dist0, idx0 = nearest_lists(nondom)
+        alive, _, _ = lax.while_loop(
+            lambda s: jnp.sum(s[0]) > k, remove_one, (nondom, dist0, idx0))
+        return alive
+
+    # lax.cond so the nearest-neighbor pass only runs when truncating
+    truncated = lax.cond(n_nondom > k, truncate, lambda nd: nd, nondom)
 
     selected = jnp.where(n_nondom < k, selected_fill,
                          jnp.where(n_nondom > k, truncated, nondom))

@@ -127,11 +127,37 @@ def test_incremental_ranks_match_naive():
         w = jax.random.normal(jax.random.fold_in(key, n), (n, nobj))
         # duplicates exercise the equal-fitness path
         w = jnp.concatenate([w, w[: n // 5]], 0)
-        ranks, nf = jax.jit(
-            lambda w: nondominated_ranks(w, front_chunk=fc))(w)
+        ranks, nf = jax.jit(lambda w: nondominated_ranks(
+            w, front_chunk=fc, method="peel"))(w)
         expected = _naive_ranks(np.asarray(w))
         np.testing.assert_array_equal(np.asarray(ranks), expected)
         assert int(nf) == expected.max() + 1
+
+
+def test_sweep2d_ranks_match_peel():
+    """The O(n log n) 2-objective staircase sweep (the default at nobj=2)
+    must produce the exact peel partition on every tricky regime: deep
+    fronts (F=N), one antichain, exact duplicates, first-objective ties,
+    and invalid (-inf) rows."""
+    rng = np.random.default_rng(1)
+    line = np.stack([np.arange(80.0), np.arange(80.0)], 1)
+    cases = [
+        rng.normal(size=(150, 2)),
+        line,                                              # F = N fronts
+        np.stack([np.arange(80.0), -np.arange(80.0)], 1),  # one front
+        np.repeat(rng.normal(size=(30, 2)), 3, axis=0),    # duplicates
+        np.stack([np.repeat(np.arange(20.0), 4),
+                  rng.normal(size=80)], 1),                # f1 ties
+        np.concatenate([rng.normal(size=(50, 2)),
+                        np.full((5, 2), -np.inf)], 0),     # invalid rows
+    ]
+    for w in cases:
+        w = jnp.asarray(np.asarray(w, np.float32))
+        r_sweep, nf_sweep = jax.jit(nondominated_ranks)(w)      # auto->sweep
+        r_peel, nf_peel = jax.jit(
+            lambda w: nondominated_ranks(w, method="peel"))(w)
+        np.testing.assert_array_equal(np.asarray(r_sweep), np.asarray(r_peel))
+        assert int(nf_sweep) == int(nf_peel)
 
 
 def test_spea2_chunked_matches_small_chunk():
@@ -141,6 +167,43 @@ def test_spea2_chunked_matches_small_chunk():
     a = np.asarray(sel_spea2(None, w, 20, chunk=1024))
     b = np.asarray(sel_spea2(None, w, 20, chunk=7))
     np.testing.assert_array_equal(np.sort(a), np.sort(b))
+
+
+def _naive_spea2_truncation(w, k):
+    """Reference-shaped truncation oracle: recompute every survivor's full
+    sorted distance vector per removal, drop the lexicographic minimum
+    (the semantics of reference emo.py:741-805, density form as in
+    sel_spea2's docstring)."""
+    counts = np.array([
+        sum(1 for i in range(len(w))
+            if i != j and np.all(w[i] >= w[j]) and np.any(w[i] > w[j]))
+        for j in range(len(w))])
+    alive = counts == 0
+    while alive.sum() > k:
+        live = np.nonzero(alive)[0]
+        d2 = np.sum((w[live][:, None] - w[live][None, :]) ** 2, axis=-1)
+        np.fill_diagonal(d2, np.inf)
+        dvecs = np.sort(d2, axis=1)
+        victim = live[np.lexsort(dvecs[:, ::-1].T)[0]]
+        alive[victim] = False
+    return np.nonzero(alive)[0]
+
+
+def test_spea2_incremental_truncation_matches_naive():
+    """The excess-bounded incremental truncation must pick the same
+    survivors as per-removal full recomputation (distinct distances a.s.,
+    so the nearest-prefix tie-break never engages)."""
+    for seed, n, k in [(3, 80, 10), (4, 50, 30), (5, 120, 64)]:
+        rng = np.random.default_rng(seed)
+        # mutually nondominated arc (maximizing wvalues) + dominated interior
+        theta = rng.uniform(0.05, np.pi / 2 - 0.05, n)
+        front = np.stack([np.cos(theta), np.sin(theta)], 1)
+        inner = front[rng.integers(0, n, n // 4)] * 0.5
+        w = np.concatenate([front, inner]).astype(np.float32)
+        want = _naive_spea2_truncation(w, k)
+        assert len(want) == k, "input no longer exercises truncation"
+        got = np.sort(np.asarray(sel_spea2(None, jnp.asarray(w), k)))
+        np.testing.assert_array_equal(got, np.sort(want))
 
 
 # ---------------------------------------------------------------------------
