@@ -250,40 +250,88 @@ def _record(stats, population, nevals):
     return rec
 
 
-def _stream_record(stream_every: int, gen, rec) -> None:
-    """Per-generation streaming output from INSIDE the scanned loop — parity
-    with the reference's ``print(logbook.stream)`` every generation
-    (algorithms.py:159-160), which a compiled scan can't do natively.  Emits
-    a host callback every ``stream_every`` generations; 0 disables (then the
-    only cost is nothing — this traces to no-ops)."""
+def _emit_stream(gen, rec) -> None:
+    """Host-side one-line record print (the streaming analogue of the
+    reference's ``print(logbook.stream)``, algorithms.py:159-160)."""
+    def flat(prefix, d, out):
+        for k in sorted(d):
+            v = d[k]
+            if isinstance(v, dict):
+                flat(f"{prefix}{k}.", v, out)
+            else:
+                a = np.asarray(v)
+                out.append(f"{prefix}{k}={a.item():g}" if a.ndim == 0
+                           else f"{prefix}{k}={a}")
+    parts = [f"gen={int(gen)}"]
+    flat("", rec, parts)
+    print("\t".join(parts), flush=True)
+
+
+def _resolve_stream_mode(stream_every: int, stream_mode: str) -> str:
+    """``off`` | ``callback`` (jax.debug.callback from inside the scan) |
+    ``segmented`` (k generations per dispatch, host print between chunks —
+    for backends without host-callback support, e.g. the axon PJRT
+    plugin)."""
     if not stream_every:
-        return
-    if jax.default_backend() in ("axon",):
-        # this PJRT plugin cannot do host send/recv callbacks; degrade to
-        # the post-run logbook rather than failing the whole scan
-        import warnings
-        warnings.warn("stream_every ignored: backend "
-                      f"'{jax.default_backend()}' does not support host "
-                      "callbacks; records are still in the returned logbook")
-        return
+        return "off"
+    if stream_mode == "auto":
+        return ("segmented" if jax.default_backend() in ("axon",)
+                else "callback")
+    if stream_mode not in ("callback", "segmented"):
+        raise ValueError(f"stream_mode {stream_mode!r}: expected "
+                         "'auto', 'callback' or 'segmented'")
+    return stream_mode
 
-    def emit(gen, rec):
-        def flat(prefix, d, out):
-            for k in sorted(d):
-                v = d[k]
-                if isinstance(v, dict):
-                    flat(f"{prefix}{k}.", v, out)
-                else:
-                    a = np.asarray(v)
-                    out.append(f"{prefix}{k}={a.item():g}" if a.ndim == 0
-                               else f"{prefix}{k}={a}")
-        parts = [f"gen={int(gen)}"]
-        flat("", rec, parts)
-        print("\t".join(parts), flush=True)
 
+def _stream_record(stream_mode: str, stream_every: int, gen, rec) -> None:
+    """In-scan streaming emit (callback mode only; other modes are handled
+    outside the trace by :func:`_scan_generations`)."""
+    if stream_mode != "callback":
+        return
     lax.cond(gen % stream_every == 0,
-             lambda: jax.debug.callback(emit, gen, rec),
+             lambda: jax.debug.callback(_emit_stream, gen, rec),
              lambda: None)
+
+
+def _scan_generations(gen_step, carry, ngen: int, stream_every: int,
+                      stream_mode: str):
+    """``lax.scan`` over generations 1..ngen — as ONE dispatch normally, or
+    segmented into ``stream_every``-generation chunks with a host print of
+    the chunk's last record in between (``segmented`` mode; trajectory is
+    bit-identical to the single scan, the generations are simply dispatched
+    in groups).  At most two program shapes compile (the chunk size and one
+    remainder)."""
+    if stream_mode != "segmented":
+        return lax.scan(gen_step, carry, jnp.arange(1, ngen + 1))
+    if any(isinstance(leaf, jax.core.Tracer)
+           for leaf in jax.tree_util.tree_leaves(carry)):
+        import warnings
+        warnings.warn("stream_every ignored: segmented streaming needs to "
+                      "drive the generations from the host, but the loop is "
+                      "being traced (e.g. under jit); records are still in "
+                      "the returned logbook")
+        return lax.scan(gen_step, carry, jnp.arange(1, ngen + 1))
+
+    jitted = {}
+
+    def seg(carry, lo, length):
+        if length not in jitted:
+            jitted[length] = jax.jit(
+                lambda c, g: lax.scan(gen_step, c, g + jnp.arange(length)))
+        return jitted[length](carry, jnp.asarray(lo))
+
+    chunks = []
+    pos = 1
+    while pos <= ngen:
+        k = min(stream_every, ngen - pos + 1)
+        carry, stacked = seg(carry, pos, k)
+        last = jax.tree_util.tree_map(lambda x: np.asarray(x[-1]), stacked)
+        _emit_stream(pos + k - 1, last)
+        chunks.append(stacked)
+        pos += k
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate([jnp.atleast_1d(x) for x in xs]), *chunks)
+    return carry, stacked
 
 
 def _finish(key, population, hof_state, halloffame, stats, rec0, stacked,
@@ -304,7 +352,8 @@ def _finish(key, population, hof_state, halloffame, stats, rec0, stacked,
 
 def ea_simple(key, population: Population, toolbox, cxpb: float, mutpb: float,
               ngen: int, stats=None, halloffame=None, verbose=False,
-              reevaluate_all: bool = False, stream_every: int = 0):
+              reevaluate_all: bool = False, stream_every: int = 0,
+              stream_mode: str = "auto"):
     """The simplest GA (reference eaSimple, algorithms.py:85-189): per
     generation select ``n`` parents, apply :func:`var_and`, evaluate, update
     the hall of fame.  Runs as one ``lax.scan``; returns
@@ -318,7 +367,14 @@ def ea_simple(key, population: Population, toolbox, cxpb: float, mutpb: float,
     scalar gathers are the expensive primitive.  ``nevals`` still counts
     only the rows variation touched, preserving the reference's bookkeeping
     (algorithms.py:149-152).  Leave ``False`` for stochastic evaluators,
-    where re-sampling untouched rows would change the trajectory."""
+    where re-sampling untouched rows would change the trajectory.
+
+    ``stream_every=k`` prints a record every ``k`` generations mid-run:
+    via an in-scan host callback where the backend supports one, else by
+    segmenting the scan into ``k``-generation dispatches with a host print
+    between chunks (bit-identical trajectory; ``stream_mode`` forces
+    ``"callback"``/``"segmented"`` explicitly)."""
+    smode = _resolve_stream_mode(stream_every, stream_mode)
     key, k0 = jax.random.split(key)
     population, nevals0 = evaluate_population(toolbox, population)
     hof_state, hof_upd = _hof_setup(halloffame, population)
@@ -344,11 +400,11 @@ def ea_simple(key, population: Population, toolbox, cxpb: float, mutpb: float,
         if hof is not None:
             hof = hof_upd(hof, off)
         rec = _record(stats, off, nevals)
-        _stream_record(stream_every, gen, rec)
+        _stream_record(smode, stream_every, gen, rec)
         return (key, off, hof), rec
 
-    (key, population, hof_state), stacked = lax.scan(
-        gen_step, (key, population, hof_state), jnp.arange(1, ngen + 1))
+    (key, population, hof_state), stacked = _scan_generations(
+        gen_step, (key, population, hof_state), ngen, stream_every, smode)
     logbook = _finish(key, population, hof_state, halloffame, stats, rec0,
                       stacked, ngen, verbose)
     return population, logbook
@@ -356,7 +412,8 @@ def ea_simple(key, population: Population, toolbox, cxpb: float, mutpb: float,
 
 def _ea_mu_lambda(key, population, toolbox, mu, lambda_, cxpb, mutpb, ngen,
                   stats, halloffame, verbose, plus: bool,
-                  stream_every: int = 0):
+                  stream_every: int = 0, stream_mode: str = "auto"):
+    smode = _resolve_stream_mode(stream_every, stream_mode)
     key, k0 = jax.random.split(key)
     population, nevals0 = evaluate_population(toolbox, population)
     hof_state, hof_upd = _hof_setup(halloffame, population)
@@ -375,11 +432,11 @@ def _ea_mu_lambda(key, population, toolbox, mu, lambda_, cxpb, mutpb, ngen,
         idx = toolbox.select(k_sel, pool.fitness, mu)
         new_pop = pool.take(idx)
         rec = _record(stats, new_pop, nevals)
-        _stream_record(stream_every, gen, rec)
+        _stream_record(smode, stream_every, gen, rec)
         return (key, new_pop, hof), rec
 
-    (key, population, hof_state), stacked = lax.scan(
-        gen_step, (key, population, hof_state), jnp.arange(1, ngen + 1))
+    (key, population, hof_state), stacked = _scan_generations(
+        gen_step, (key, population, hof_state), ngen, stream_every, smode)
     logbook = _finish(key, population, hof_state, halloffame, stats, rec0,
                       stacked, ngen, verbose)
     return population, logbook
@@ -387,35 +444,36 @@ def _ea_mu_lambda(key, population, toolbox, mu, lambda_, cxpb, mutpb, ngen,
 
 def ea_mu_plus_lambda(key, population, toolbox, mu, lambda_, cxpb, mutpb,
                       ngen, stats=None, halloffame=None, verbose=False,
-                      stream_every: int = 0):
+                      stream_every: int = 0, stream_mode: str = "auto"):
     """(μ + λ) strategy (reference eaMuPlusLambda, algorithms.py:248-337):
     offspring by :func:`var_or`, next generation selected from parents ∪
     offspring."""
     return _ea_mu_lambda(key, population, toolbox, mu, lambda_, cxpb, mutpb,
                          ngen, stats, halloffame, verbose, plus=True,
-                         stream_every=stream_every)
+                         stream_every=stream_every, stream_mode=stream_mode)
 
 
 def ea_mu_comma_lambda(key, population, toolbox, mu, lambda_, cxpb, mutpb,
                        ngen, stats=None, halloffame=None, verbose=False,
-                       stream_every: int = 0):
+                       stream_every: int = 0, stream_mode: str = "auto"):
     """(μ , λ) strategy (reference eaMuCommaLambda, algorithms.py:340-437):
     next generation selected from offspring only (λ ≥ μ required)."""
     assert lambda_ >= mu, ("lambda must be greater or equal to mu.")
     return _ea_mu_lambda(key, population, toolbox, mu, lambda_, cxpb, mutpb,
                          ngen, stats, halloffame, verbose, plus=False,
-                         stream_every=stream_every)
+                         stream_every=stream_every, stream_mode=stream_mode)
 
 
 def ea_generate_update(key, toolbox, state, ngen: int, weights=(-1.0,),
                        stats=None, halloffame=None, verbose=False,
-                       stream_every: int = 0):
+                       stream_every: int = 0, stream_mode: str = "auto"):
     """Ask-tell loop (reference eaGenerateUpdate, algorithms.py:440-503):
     ``toolbox.generate(state, key) -> genome batch`` then
     ``toolbox.update(state, population) -> state`` — the functional form of
     the reference's strategy objects (used by CMA-ES, EDA, PSO).
 
     Returns ``(population, state, logbook)``."""
+    smode = _resolve_stream_mode(stream_every, stream_mode)
     weights = tuple(weights)
 
     sample = toolbox.generate(state, jax.random.fold_in(key, 0))
@@ -433,11 +491,12 @@ def ea_generate_update(key, toolbox, state, ngen: int, weights=(-1.0,),
         if hof is not None:
             hof = hof_upd(hof, pop)
         rec = _record(stats, pop, nevals)
-        _stream_record(stream_every, gen, rec)
+        _stream_record(smode, stream_every, gen, rec)
         return (key, state, hof, pop), rec
 
-    (key, state, hof_state, last_pop), stacked = lax.scan(
-        gen_step, (key, state, hof_state, sample_pop), jnp.arange(1, ngen + 1))
+    (key, state, hof_state, last_pop), stacked = _scan_generations(
+        gen_step, (key, state, hof_state, sample_pop), ngen, stream_every,
+        smode)
 
     logbook = Logbook()
     logbook.header = ["gen", "nevals"] + (stats.fields if stats else [])

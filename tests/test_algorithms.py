@@ -188,3 +188,41 @@ def test_spea2_selection():
     first = set(np.nonzero(np.asarray(ranks) == 0)[0].tolist())
     if len(first) <= 16:
         assert first <= set(np.asarray(idx).tolist())
+
+
+def test_segmented_streaming_matches_single_scan(capsys):
+    """``stream_mode="segmented"`` (the fallback for callback-less backends
+    like axon) must produce the bit-identical trajectory of the single-scan
+    run, while printing a record every ``stream_every`` generations."""
+    from deap_tpu.utils.support import Statistics
+
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: jnp.sum(g).astype(jnp.float32))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    from deap_tpu.ops import selection
+    tb.register("select", selection.sel_tournament, tournsize=3)
+
+    key = jax.random.PRNGKey(7)
+    genome = jax.random.bernoulli(key, 0.5, (64, 40)).astype(jnp.int32)
+    stats = Statistics(lambda p: p.fitness.values[:, 0])
+    stats.register("max", jnp.max)
+
+    def run(**kw):
+        pop = base.Population(genome, base.Fitness.empty(64, (1.0,)))
+        return algorithms.ea_simple(key, pop, tb, 0.5, 0.2, ngen=11,
+                                    stats=stats, **kw)
+
+    pop_a, log_a = run()
+    capsys.readouterr()
+    pop_b, log_b = run(stream_every=4, stream_mode="segmented")
+    out = capsys.readouterr().out
+
+    np.testing.assert_array_equal(np.asarray(pop_a.genome),
+                                  np.asarray(pop_b.genome))
+    np.testing.assert_array_equal(np.asarray(pop_a.fitness.values),
+                                  np.asarray(pop_b.fitness.values))
+    assert log_a.select("max") == log_b.select("max")
+    lines = [l for l in out.splitlines() if l.startswith("gen=")]
+    assert [l.split("\t")[0] for l in lines] == ["gen=4", "gen=8", "gen=11"]
+    assert all("max=" in l for l in lines)
