@@ -5,10 +5,15 @@ population lists (migration.py:4-51).  Here islands are a *stacked* leading
 axis of the population arrays, and migration is pure index arithmetic:
 
 * :func:`mig_ring_stacked` — islands stacked on axis 0 of one device array;
-  the destination mapping is a static permutation, so the exchange is a
-  single gather.  This is what runs **inside** a jitted multi-device island
-  model, where XLA lowers the stacked roll to ``ppermute`` over ICI when the
-  island axis is sharded over a mesh (see ``deap_tpu.parallel.islands``).
+  for any *cyclic* destination mapping (the default ring included) the
+  exchange is expressed as ``jnp.roll`` on the island axis, which GSPMD
+  lowers to a ``collective-permute`` over ICI when that axis is sharded
+  over a mesh — verified against the optimized HLO by
+  ``tests/test_parallel.py::test_migration_lowers_to_collective_permute``.
+  A non-cyclic ``migarray`` falls back to a static gather, which lowers to
+  an all-gather + local gather (full island-axis traffic) — fine
+  in-device, costly cross-device.  This is what runs **inside** a jitted
+  multi-device island model (see ``deap_tpu.parallel.islands``).
 * :func:`mig_ring` — host-level convenience over a list of
   :class:`Population` objects, mirroring the reference signature.
 """
@@ -50,6 +55,11 @@ def mig_ring_stacked(key, genomes, fitness_w, k, selection: Callable,
     for frm, to in enumerate(migarray):
         source[to] = frm
     src = jnp.asarray(source)
+    # cyclic mapping (source[j] = (j - s) mod n)? then the exchange is a
+    # roll, which the SPMD partitioner turns into a collective-permute on a
+    # sharded island axis; a general gather would lower to an all-gather
+    shift = (0 - source[0]) % n_isl
+    cyclic = all(source[j] == (j - shift) % n_isl for j in range(n_isl))
 
     keys = jax.random.split(key, 2 * n_isl).reshape(n_isl, 2, -1)
     emig_idx = jax.vmap(lambda kk, w: selection(kk, w, k))(keys[:, 0], fitness_w)
@@ -60,7 +70,10 @@ def mig_ring_stacked(key, genomes, fitness_w, k, selection: Callable,
 
     def exchange(leaf):
         emigrants = jax.vmap(lambda g, i: g[i])(leaf, emig_idx)      # (isl, k, ...)
-        incoming = emigrants[src]                                     # ring gather
+        if cyclic:
+            incoming = jnp.roll(emigrants, shift, axis=0)             # -> ppermute
+        else:
+            incoming = emigrants[src]                                 # -> all-gather
         return jax.vmap(lambda g, i, v: g.at[i].set(v))(leaf, repl_idx, incoming)
 
     new_genomes = jax.tree_util.tree_map(exchange, genomes)

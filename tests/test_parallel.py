@@ -213,3 +213,73 @@ def test_ea_simple_islands_migration_effect():
         f"elite failed to reach every island: {with_mig}")
     assert (without[1:] == 0).all(), (
         f"elite leaked without migration: {without}")
+
+
+# ---------------------------------------------------------------------------
+# Collective structure: pin what GSPMD actually inserts (round-2 verdict —
+# the README's "migration lowers to ppermute" claim must be checked against
+# the optimized HLO, not asserted)
+# ---------------------------------------------------------------------------
+
+
+def _island_sharding():
+    mesh = Mesh(np.array(jax.devices()[:8]), ("island",))
+    return mesh, NamedSharding(mesh, P("island"))
+
+
+def _stacked_state(key, n_isl=8, pop=32, nbits=24):
+    g = jax.random.bernoulli(key, 0.5, (n_isl, pop, nbits)).astype(jnp.float32)
+    vals = jax.random.normal(key, (n_isl, pop, 1))
+    valid = jnp.ones((n_isl, pop), bool)
+    return g, vals, valid
+
+
+def test_migration_lowers_to_collective_permute():
+    """Ring migration over a sharded island axis must compile to a
+    ``collective-permute`` (the ppermute the docs promise), NOT an
+    all-gather of every island's emigrants."""
+    mesh, sh = _island_sharding()
+    key = jax.random.PRNGKey(0)
+    g, vals, valid = _stacked_state(key)
+
+    def migrate(key, g, vals, valid):
+        bundle = dict(genome=g, values=vals, valid=valid)
+        w = jnp.where(valid[..., None], vals, -jnp.inf)
+        out, _ = mig_ring_stacked(key, bundle, w, 5, selection.sel_best)
+        return out
+
+    txt = (jax.jit(migrate, in_shardings=(None, sh, sh, sh))
+           .lower(key, g, vals, valid).compile().as_text())
+    assert "collective-permute" in txt, "ring exchange did not ppermute"
+    assert "all-gather" not in txt, "migration all-gathers the island axis"
+    assert "all-to-all" not in txt
+
+
+def test_island_generation_body_is_collective_free():
+    """The per-island generation step (select/vary/evaluate vmapped over a
+    sharded island axis) must contain NO cross-device communication at all:
+    migration is the only cross-chip traffic of the island model."""
+    mesh, sh = _island_sharding()
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(1)
+    g, vals, valid = _stacked_state(key)
+    n_isl, pop = g.shape[0], g.shape[1]
+
+    def gen(key, g, vals, valid):
+        def one(key, gi, vi, vdi):
+            p = base.Population(gi, base.Fitness(values=vi, valid=vdi,
+                                                 weights=(1.0,)))
+            k1, k2 = jax.random.split(key)
+            idx = tb.select(k1, p.fitness, pop)
+            off = p.take(idx)
+            off = algorithms.var_and(k2, off, tb, 0.5, 0.2)
+            off, _ = algorithms.evaluate_population(tb, off)
+            return off.genome, off.fitness.values, off.fitness.valid
+        keys = jax.random.split(key, n_isl)
+        return jax.vmap(one)(keys, g, vals, valid)
+
+    txt = (jax.jit(gen, in_shardings=(None, sh, sh, sh))
+           .lower(key, g, vals, valid).compile().as_text())
+    for coll in ("collective-permute", "all-gather", "all-reduce",
+                 "all-to-all"):
+        assert coll not in txt, f"unexpected cross-shard {coll} in gen body"
