@@ -17,9 +17,16 @@ Configs (BASELINE.json):
                       quadratic in stock DEAP — sortNondominated alone is
                       O(N^2) fitness comparisons ≈ 10^10 at 100k — so it is
                       measured at feasible sizes and the scaling recorded)
+  5. GP symbreg       pop=4096, 1024 points, compile/eval per individual
+                      (the reference's hottest path, gp.py:460-485)
+  6. SPEA2 ZDT1       dim=30, pop=1k & 4k (selSPEA2 environmental selection)
 
-Writes the measured numbers into BASELINE.json under "measured" and prints
-them.  Rerun:  python baselines/measure_stock_deap.py
+Writes the measured numbers into BASELINE.json under "measured" (merged —
+existing keys survive) and prints them.
+
+Rerun all:        python baselines/measure_stock_deap.py
+Rerun a subset:   python baselines/measure_stock_deap.py gp spea2
+(subset names: onemax rastrigin cmaes nsga2 gp spea2)
 """
 
 import json
@@ -165,39 +172,153 @@ def config4_nsga2(pop_size):
     return run
 
 
+def config5_gp_symbreg(pop_size=4096, npoints=1024):
+    """Stock GP symbreg shaped like BASELINE's GP bench: quartic target,
+    compile (string build + Python eval, gp.py:460-485) then pure-Python
+    arithmetic per point — the loop the vmapped stack machine replaces."""
+    import operator
+    from deap import gp as dgp
+
+    random.seed(5)
+    pset = dgp.PrimitiveSet("MAIN", 1)
+    pset.addPrimitive(operator.add, 2)
+    pset.addPrimitive(operator.sub, 2)
+    pset.addPrimitive(operator.mul, 2)
+
+    def protectedDiv(a, b):
+        return a / b if abs(b) > 1e-6 else 1.0
+    pset.addPrimitive(protectedDiv, 2)
+    pset.addPrimitive(operator.neg, 1)
+    import math
+    pset.addPrimitive(math.cos, 1)
+    pset.addPrimitive(math.sin, 1)
+    pset.addEphemeralConstant("rand101", lambda: random.randint(-1, 1))
+
+    if not hasattr(creator, "TreeMin"):
+        creator.create("TreeMin", dgp.PrimitiveTree,
+                       fitness=creator.FitnessMin, pset=pset)
+
+    points = [-1.0 + 2.0 * i / (npoints - 1) for i in range(npoints)]
+
+    def evaluate(ind):
+        func = dgp.compile(expr=ind, pset=pset)
+        err = 0.0
+        for x in points:
+            try:
+                v = func(x)
+            except (OverflowError, ValueError):
+                return (1e6,)
+            err += (v - (x ** 4 + x ** 3 + x ** 2 + x)) ** 2
+        return (err / npoints,)
+
+    tb = base.Toolbox()
+    tb.register("expr", dgp.genHalfAndHalf, pset=pset, min_=1, max_=2)
+    tb.register("individual", tools.initIterate, creator.TreeMin, tb.expr)
+    tb.register("population", tools.initRepeat, list, tb.individual)
+    tb.register("evaluate", evaluate)
+    tb.register("select", tools.selTournament, tournsize=3)
+    tb.register("mate", dgp.cxOnePoint)
+    tb.register("expr_mut", dgp.genFull, min_=0, max_=2)
+    tb.register("mutate", dgp.mutUniform, expr=tb.expr_mut, pset=pset)
+    tb.decorate("mate", dgp.staticLimit(
+        key=operator.attrgetter("height"), max_value=17))
+    tb.decorate("mutate", dgp.staticLimit(
+        key=operator.attrgetter("height"), max_value=17))
+
+    pop = tb.population(n=pop_size)
+    for ind, fit in zip(pop, map(tb.evaluate, pop)):
+        ind.fitness.values = fit
+
+    def run(ngen):
+        algorithms.eaSimple(pop, tb, cxpb=0.5, mutpb=0.1, ngen=ngen,
+                            verbose=False)
+    return run
+
+
+def config6_spea2(pop_size):
+    random.seed(6)
+    tb = base.Toolbox()
+    tb.register("attr", random.random)
+    tb.register("individual", tools.initRepeat, creator.IndMO, tb.attr, 30)
+    tb.register("population", tools.initRepeat, list, tb.individual)
+    tb.register("evaluate", eval_zdt1)
+    tb.register("mate", tools.cxSimulatedBinaryBounded, low=0.0, up=1.0,
+                eta=20.0)
+    tb.register("mutate", tools.mutPolynomialBounded, low=0.0, up=1.0,
+                eta=20.0, indpb=1.0 / 30)
+    tb.register("select", tools.selSPEA2)
+    pop = tb.population(n=pop_size)
+    for ind, fit in zip(pop, map(tb.evaluate, pop)):
+        ind.fitness.values = fit
+
+    def run(ngen):
+        nonlocal pop
+        for _ in range(ngen):
+            offspring = tools.selTournament(pop, pop_size, tournsize=2)
+            offspring = [tb.clone(ind) for ind in offspring]
+            offspring = algorithms.varAnd(offspring, tb, 0.9, 1.0 / 30)
+            invalid = [ind for ind in offspring if not ind.fitness.valid]
+            for ind, fit in zip(invalid, map(tb.evaluate, invalid)):
+                ind.fitness.values = fit
+            pop = tb.select(pop + offspring, pop_size)
+    return run
+
+
 def main():
+    known = {"onemax", "rastrigin", "cmaes", "nsga2", "gp", "spea2"}
+    subset = set(sys.argv[1:]) or known
+    unknown = subset - known
+    if unknown:
+        raise SystemExit(f"unknown config name(s) {sorted(unknown)}; "
+                         f"choose from {sorted(known)}")
     nproc = min(8, multiprocessing.cpu_count())
     results = {}
 
-    results["onemax_pop300_gens_per_sec_serial"] = round(
-        timed_gens(config1_onemax(), 40), 3)
+    if "onemax" in subset:
+        results["onemax_pop300_gens_per_sec_serial"] = round(
+            timed_gens(config1_onemax(), 40), 3)
 
-    results["rastrigin_dim100_pop"] = 10_000
-    results["rastrigin_dim100_gens_per_sec_serial"] = round(
-        timed_gens(config2_rastrigin(), 3), 4)
-    with multiprocessing.Pool(nproc) as pool:
-        results["rastrigin_dim100_gens_per_sec_mp%d" % nproc] = round(
-            timed_gens(config2_rastrigin(pool.map), 3), 4)
+    if "rastrigin" in subset:
+        results["rastrigin_dim100_pop"] = 10_000
+        results["rastrigin_dim100_gens_per_sec_serial"] = round(
+            timed_gens(config2_rastrigin(), 3), 4)
+        with multiprocessing.Pool(nproc) as pool:
+            results["rastrigin_dim100_gens_per_sec_mp%d" % nproc] = round(
+                timed_gens(config2_rastrigin(pool.map), 3), 4)
 
-    results["cmaes_sphere_n100_lambda4096_gens_per_sec_serial"] = round(
-        timed_gens(config3_cmaes(), 10), 3)
+    if "cmaes" in subset:
+        results["cmaes_sphere_n100_lambda4096_gens_per_sec_serial"] = round(
+            timed_gens(config3_cmaes(), 10), 3)
 
-    for pop in (1000, 4000):
-        results["nsga2_zdt1_pop%d_gens_per_sec_serial" % pop] = round(
-            timed_gens(config4_nsga2(pop), 3), 4)
-    results["nsga2_note"] = (
-        "stock sortNondominated is O(N^2); pop=100k would need ~10^10 "
-        "dominance comparisons per generation (hours/gen) — measured at "
-        "1k/4k instead; observed scaling recorded by the two sizes")
+    if "nsga2" in subset:
+        for pop in (1000, 4000):
+            results["nsga2_zdt1_pop%d_gens_per_sec_serial" % pop] = round(
+                timed_gens(config4_nsga2(pop), 3), 4)
+        results["nsga2_note"] = (
+            "stock sortNondominated is O(N^2); pop=100k would need ~10^10 "
+            "dominance comparisons per generation (hours/gen) — measured at "
+            "1k/4k instead; observed scaling recorded by the two sizes")
+
+    if "gp" in subset:
+        results["gp_symbreg_pop4096_pts1024_gens_per_sec_serial"] = round(
+            timed_gens(config5_gp_symbreg(), 2), 4)
+
+    if "spea2" in subset:
+        for pop in (1000, 4000):
+            results["spea2_zdt1_pop%d_gens_per_sec_serial" % pop] = round(
+                timed_gens(config6_spea2(pop), 2), 4)
 
     print(json.dumps(results, indent=2))
 
     baseline_path = os.path.join(REPO, "BASELINE.json")
     with open(baseline_path) as f:
         data = json.load(f)
-    data["measured"] = dict(results,
-                            host=os.uname().nodename,
-                            cpus=multiprocessing.cpu_count())
+    measured = data.get("measured", {})
+    measured.update(results)
+    if results:                      # don't re-stamp provenance for a no-op run
+        measured["host"] = os.uname().nodename
+        measured["cpus"] = multiprocessing.cpu_count()
+    data["measured"] = measured
     with open(baseline_path, "w") as f:
         json.dump(data, f, indent=2)
     print("written to BASELINE.json under 'measured'")

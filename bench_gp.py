@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""GP throughput benchmark: symbolic regression (quartic target) at
+pop=4096, tree capacity 64, 1024 sample points — the reference's hottest
+path (``gp.compile`` string-build + Python ``eval`` + per-point Python
+arithmetic, /root/reference/deap/gp.py:460-485, SURVEY §3.4) against the
+vmapped prefix stack machine (``deap_tpu/gp/interp.py``).
+
+Prints ONE JSON line like bench.py.  Metric is generations/sec of the full
+evolve loop (rank tournament, typed one-point subtree crossover, uniform
+subtree mutation, full-population fitness via the stack machine) as one
+``lax.scan``; ``extra`` carries tree-evals/sec (pop x gens/sec) and
+point-evals/sec.  Timing honesty kit identical to bench.py: marginal
+(t(2N)-t(N))/N with a linearity self-check.
+
+``vs_baseline`` divides by the stock-DEAP measurement of the same shape
+(BASELINE.json measured.gp_symbreg_pop4096_pts1024_gens_per_sec_serial,
+written by ``baselines/measure_stock_deap.py gp``).
+
+Env overrides: BENCH_POP (4096), BENCH_CAP (64), BENCH_POINTS (1024),
+BENCH_NGEN (10), BENCH_PRNG (rbg | threefry).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+POP = int(os.environ.get("BENCH_POP", 4096))
+CAP = int(os.environ.get("BENCH_CAP", 64))
+NPOINTS = int(os.environ.get("BENCH_POINTS", 1024))
+NGEN = int(os.environ.get("BENCH_NGEN", 10))
+
+
+def run_tpu():
+    import numpy as np
+    import jax
+
+    if os.environ.get("BENCH_PRNG", "rbg") == "rbg":
+        jax.config.update("jax_default_prng_impl", "rbg")
+
+    import jax.numpy as jnp
+    from jax import lax
+    from deap_tpu import base, gp
+    from deap_tpu.algorithms import vary_genome, evaluate_population
+    from deap_tpu.ops import selection
+
+    ps = gp.PrimitiveSet("MAIN", 1)
+    ps.add_primitive(jnp.add, 2, name="add")
+    ps.add_primitive(jnp.subtract, 2, name="sub")
+    ps.add_primitive(jnp.multiply, 2, name="mul")
+    ps.add_primitive(gp.protected_div, 2, name="div")
+    ps.add_primitive(jnp.negative, 1, name="neg")
+    ps.add_primitive(jnp.cos, 1, name="cos")
+    ps.add_primitive(jnp.sin, 1, name="sin")
+    ps.add_ephemeral_constant(
+        "rand101",
+        lambda key: jax.random.randint(key, (), -1, 2).astype(jnp.float32))
+
+    X = jnp.linspace(-1, 1, NPOINTS, dtype=jnp.float32)[None, :]
+    target = X[0] ** 4 + X[0] ** 3 + X[0] ** 2 + X[0]
+
+    ev = gp.make_evaluator(ps, CAP)
+    gen_init = gp.make_generator(ps, CAP, "half_and_half")
+    gen_mut = gp.make_generator(ps, CAP, "full")
+
+    def evaluate(tree):
+        out = ev(tree[0], tree[1], tree[2], X)
+        mse = jnp.mean((out - target) ** 2)
+        return (jnp.where(jnp.isfinite(mse), mse, 1e6),)
+
+    tb = base.Toolbox()
+    tb.register("evaluate", evaluate)
+    tb.register("mate", lambda k, a, b: gp.cx_one_point(k, a, b, ps))
+    tb.register("mutate", lambda k, t: gp.mut_uniform(
+        k, t, lambda kk: gen_mut(kk, 0, 2), ps))
+    tb.register("select", selection.sel_tournament, tournsize=3)
+
+    def generation(carry, _):
+        key, pop = carry
+        key, k_sel, k_var = jax.random.split(key, 3)
+        idx = tb.select(k_sel, pop.fitness, POP)
+        genome = jax.tree_util.tree_map(lambda x: x[idx], pop.genome)
+        genome, _ = vary_genome(k_var, genome, tb, 0.5, 0.1,
+                                pairing="halves")
+        off = base.Population(genome, base.Fitness.empty(POP, (-1.0,)))
+        off, _ = evaluate_population(tb, off)
+        return (key, off), jnp.min(off.fitness.values[:, 0])
+
+    def make_run(ngen):
+        @jax.jit
+        def run(key, pop):
+            return lax.scan(generation, (key, pop), None, length=ngen)
+        return run
+
+    key, k_init = jax.random.split(jax.random.PRNGKey(0))
+    keys = jax.random.split(k_init, POP)
+    codes, consts, lengths = jax.vmap(lambda k: gen_init(k, 1, 3))(keys)
+    pop = base.Population((codes, consts, lengths),
+                          base.Fitness.empty(POP, (-1.0,)))
+    pop, _ = evaluate_population(tb, pop)
+
+    def timed(ngen):
+        run = make_run(ngen)
+        _, best = run(key, pop)
+        np.asarray(best[-1:])
+        t0 = time.perf_counter()
+        _, best = run(key, pop)
+        best_host = np.asarray(best)
+        return time.perf_counter() - t0, float(best_host[-1])
+
+    t1, _ = timed(NGEN)
+    t2, best = timed(2 * NGEN)
+    ratio = t2 / t1
+    marginal = (t2 - t1) / NGEN
+    return 1.0 / marginal, ratio, best, jax.devices()[0].platform
+
+
+def measured_baseline():
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            measured = json.load(f).get("measured", {})
+        if (POP, NPOINTS) != (4096, 1024):
+            return None
+        return measured["gp_symbreg_pop4096_pts1024_gens_per_sec_serial"]
+    except (OSError, KeyError, ValueError):
+        return None
+
+
+def main():
+    gens_per_sec, ratio, best, platform = run_tpu()
+    linear_ok = 1.5 <= ratio <= 2.7
+    baseline = measured_baseline()
+    vs = (gens_per_sec / baseline) if (baseline and linear_ok) else -1.0
+    print(json.dumps({
+        "metric": f"gp_symbreg_pop{POP}_cap{CAP}_pts{NPOINTS}_gens_per_sec",
+        "value": round(gens_per_sec, 3) if linear_ok else -1,
+        "unit": "generations/sec",
+        "vs_baseline": round(vs, 1),
+        "extra": {
+            "platform": platform,
+            "timing_linearity": {"t2N_over_tN": round(ratio, 3),
+                                 "ok": linear_ok},
+            "best_mse_end": best,
+            "tree_evals_per_sec":
+                round(gens_per_sec * POP, 1) if linear_ok else -1,
+            "point_evals_per_sec":
+                round(gens_per_sec * POP * NPOINTS, 1) if linear_ok else -1,
+            "stock_deap_baseline_gens_per_sec": baseline,
+            "prng": os.environ.get("BENCH_PRNG", "rbg"),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Non-dominated-sort front-depth scaling evidence (round-2 verdict item 6).
+
+Measures ``nondominated_ranks`` — both the chunked count-peel and, at
+nobj=2, the exact O(n log n) staircase sweep — across the regimes that
+stress front depth:
+
+* ``zdt1``-shaped clouds (nobj=2, shallow fronts — the NSGA-II common case)
+* ``line`` (nobj=2, every point on one dominance chain: F = N fronts, the
+  peel's adversarial case the round-2 verdict called out)
+* ``dtlz2``-shaped clouds at nobj=5 (many-objective: few, huge fronts)
+
+Prints one JSON object with wall-clock per call (linearity-checked two-size
+timing like bench.py) for each (regime, n, method).  Not driver-run; this
+is the measurement behind the ``method="auto"`` dispatch in
+``deap_tpu/ops/emo.py`` and the numbers quoted in its docstring.
+
+Env: BENCH_SIZES (comma list, default "10000,100000"), BENCH_PRNG.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+SIZES = [int(s) for s in os.environ.get("BENCH_SIZES",
+                                        "10000,100000").split(",")]
+
+
+def make_data(regime: str, n: int, key):
+    import jax
+    import jax.numpy as jnp
+    if regime == "zdt1":
+        # anti-correlated front-ish cloud, shallow fronts
+        x = jax.random.uniform(key, (n,))
+        noise = jax.random.uniform(jax.random.fold_in(key, 1), (n,))
+        return jnp.stack([-x, -(1.0 - jnp.sqrt(x)) - noise], 1)
+    if regime == "line":
+        t = jnp.arange(n, dtype=jnp.float32)
+        return jnp.stack([t, t], 1)                   # F = N singleton fronts
+    if regime == "dtlz2_5d":
+        v = jax.random.uniform(key, (n, 5))
+        return -v / jnp.linalg.norm(v, axis=1, keepdims=True)
+    raise ValueError(regime)
+
+
+def time_call(fn, w):
+    import numpy as np
+    out = fn(w)
+    np.asarray(out[0][:1])                            # force completion
+    t0 = time.perf_counter()
+    out = fn(w)
+    np.asarray(out[0][:1])
+    return time.perf_counter() - t0
+
+
+def main():
+    import jax
+    if os.environ.get("BENCH_PRNG", "rbg") == "rbg":
+        try:
+            jax.config.update("jax_default_prng_impl", "rbg")
+        except Exception:
+            pass
+    from deap_tpu.ops.emo import nondominated_ranks
+
+    results = []
+    key = jax.random.PRNGKey(0)
+    for regime in ("zdt1", "line", "dtlz2_5d"):
+        for n in SIZES:
+            w = make_data(regime, n, jax.random.fold_in(key, n))
+            methods = ["peel"] if regime == "dtlz2_5d" else ["sweep2d", "peel"]
+            for method in methods:
+                if regime == "line" and method == "peel" and n > 20_000:
+                    # O(N^2 * chunk): hours at 1e5 — measured at 1e4 instead
+                    results.append(dict(regime=regime, n=n, method=method,
+                                        seconds=None,
+                                        note="skipped: projected hours "
+                                             "(see n=10000 scaling)"))
+                    continue
+                fn = jax.jit(lambda w, m=method: nondominated_ranks(
+                    w, method=m))
+                secs = time_call(fn, w)
+                nf = int(fn(w)[1])
+                results.append(dict(regime=regime, n=n, method=method,
+                                    seconds=round(secs, 4), n_fronts=nf))
+                print(f"# {regime} n={n} {method}: {secs:.4f}s "
+                      f"({nf} fronts)", file=sys.stderr, flush=True)
+    print(json.dumps({
+        "metric": "nondominated_ranks_front_depth_scaling",
+        "platform": jax.devices()[0].platform,
+        "results": results,
+    }))
+
+
+if __name__ == "__main__":
+    main()
