@@ -55,11 +55,13 @@ def initialize_cluster(coordinator_address: str | None = None,
     Priority: explicit args > ``DEAP_TPU_COORDINATOR`` / ``DEAP_TPU_NPROC``
     / ``DEAP_TPU_PROC_ID`` env vars > JAX's own auto-detection (TPU pod
     metadata).  The legacy spellings ``JAX_COORDINATOR``/``NPROC``/``PROC_ID``
-    are still read, but the generic ``NPROC``/``PROC_ID`` only when a
-    coordinator address is also present — a stray ``NPROC`` exported for
-    ``make -j$NPROC`` on a dev box must not turn a defensive no-arg call
-    into a hung/ raising multi-process join.  Safe to call twice (a second
-    call is a no-op), so library code can call it defensively.
+    are still read as a set: the generic ``NPROC``/``PROC_ID`` are honored
+    ONLY when ``JAX_COORDINATOR`` itself is set (not merely any coordinator
+    source) — a stray ``NPROC`` exported for ``make -j$NPROC`` on a dev box
+    must not leak into namespaced or explicit-argument launches.  Mixing
+    spellings (``DEAP_TPU_COORDINATOR`` + legacy ``NPROC``) is not
+    supported; migrate the whole set.  Safe to call twice (a second call
+    is a no-op), so library code can call it defensively.
     """
     # NB: must not touch jax.devices()/process_count() here — any backend
     # query initializes XLA and makes jax.distributed.initialize illegal
