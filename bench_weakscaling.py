@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Multi-device scaling *evidence* for the flagship GA (round-2 verdict
+item 1): run the real sharded generation on an 8-virtual-device CPU mesh
+and measure, instead of project.
+
+The bench host has ONE physical core, so 8 virtual devices cannot show a
+wall-clock speedup; what weak scaling means here is *work conservation*:
+with fixed population per device, a perfectly sharded program does exactly
+8x the single-shard work, so ideal wall time is ``t8 = 8*t1``.  The
+reported ``overhead = t8 / (8*t1)`` isolates what sharding itself adds —
+partitioner-inserted collectives and duplicated work — which is exactly
+the quantity the single-chip bench cannot see and the part of the "~8x on
+a real v5e-8" projection that needed evidence.  (On a real 8-chip pod the
+same script gives true weak-scaling efficiency; here it bounds the
+communication term.)
+
+Two layouts, matching the framework's two parallel axes (SURVEY §2.6):
+
+* ``pop``: the flagship generation sharded on the population axis.  The
+  rank tournament is a *global* sort, so this layout pays cross-shard
+  traffic in selection — the compiled collective inventory is reported so
+  the cost is attributable, not asserted away.
+* ``island``: one deme per device (the ``dryrun_multichip`` layout) with
+  ring migration every generation — migration's collective-permute is the
+  only communication (pinned by tests/test_parallel.py).
+
+Prints ONE JSON object; bench.py embeds it in its own output (the
+"BENCH_r03-adjacent" figure the verdict asked for).
+
+Env: BENCH_WEAK_POP (per-device population, default 16384),
+BENCH_WEAK_NGEN (default 8), BENCH_WEAK_DEVICES (default 8).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+POP_PER_DEV = int(os.environ.get("BENCH_WEAK_POP", 16384))
+NGEN = int(os.environ.get("BENCH_WEAK_NGEN", 8))
+N_DEV = int(os.environ.get("BENCH_WEAK_DEVICES", 8))
+DIM = 100
+
+
+def _collective_counts(txt: str) -> dict:
+    return {name: txt.count(name)
+            for name in ("collective-permute", "all-gather", "all-reduce",
+                         "all-to-all", "reduce-scatter")
+            if txt.count(name)}
+
+
+def _marginal(run, args, ngen):
+    """(t(2N) - t(N)) / N with forced completion, like bench.py."""
+    import numpy as np
+    times = {}
+    for n in (ngen, 2 * ngen):
+        out = run(n)(*args)
+        np.asarray(out[1][-1:])                   # warmup + force
+        t0 = time.perf_counter()
+        out = run(n)(*args)
+        np.asarray(out[1][-1:])
+        times[n] = time.perf_counter() - t0
+    return (times[2 * ngen] - times[ngen]) / ngen, times[2 * ngen] / times[ngen]
+
+
+def measure(layout: str, n_dev: int):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from deap_tpu import base, benchmarks
+    from deap_tpu.algorithms import vary_genome, var_and, evaluate_population
+    from deap_tpu.ops import crossover, mutation, selection
+    from deap_tpu.ops.migration import mig_ring_stacked
+
+    tb = base.Toolbox()
+    tb.register("evaluate", benchmarks.rastrigin)
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=0.3, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+
+    key = jax.random.PRNGKey(0)
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("d",))
+
+    if layout == "pop":
+        pop_size = POP_PER_DEV * n_dev
+        sh = NamedSharding(mesh, P("d"))
+        genome = jax.device_put(
+            jax.random.uniform(key, (pop_size, DIM), jnp.float32,
+                               -5.12, 5.12), sh)
+
+        def generation(carry, _):
+            k, g, fv = carry
+            k, k_sel, k_var = jax.random.split(k, 3)
+            fit = base.Fitness(values=fv, valid=jnp.ones(pop_size, bool),
+                               weights=(-1.0,))
+            idx = tb.select(k_sel, fit, pop_size)
+            g = g[idx]
+            g, _ = vary_genome(k_var, g, tb, 0.9, 0.5, pairing="halves")
+            fv = jax.vmap(lambda x: benchmarks.rastrigin(x)[0])(g)[:, None]
+            return (k, g, fv), jnp.min(fv)
+
+        fv0 = jax.vmap(lambda x: benchmarks.rastrigin(x)[0])(genome)[:, None]
+
+        def run(ngen):
+            @jax.jit
+            def r(key, g, fv):
+                return lax.scan(generation, (key, g, fv), None, length=ngen)
+            return r
+
+        args = (key, genome, fv0)
+        txt = run(NGEN).lower(*args).compile().as_text()
+        marginal, ratio = _marginal(run, args, NGEN)
+        return marginal, ratio, _collective_counts(txt)
+
+    # island layout: one deme per device, ring migration each generation
+    sh = NamedSharding(mesh, P("d"))
+    genome = jax.device_put(
+        jax.random.uniform(key, (n_dev, POP_PER_DEV, DIM), jnp.float32,
+                           -5.12, 5.12), sh)
+
+    def island_gen(k, pop):
+        k_sel, k_var = jax.random.split(k)
+        idx = tb.select(k_sel, pop.fitness, pop.size)
+        off = pop.take(idx)
+        off = var_and(k_var, off, tb, 0.9, 0.5)
+        off, _ = evaluate_population(tb, off)
+        return off
+
+    def generation(carry, _):
+        k, g, fv, valid = carry
+        k, k_gen, k_mig = jax.random.split(k, 3)
+        pops = base.Population(g, base.Fitness(values=fv, valid=valid,
+                                               weights=(-1.0,)))
+        keys = jax.random.split(k_gen, n_dev)
+        pops = jax.vmap(island_gen)(keys, pops)
+        bundle = dict(genome=pops.genome, values=pops.fitness.values,
+                      valid=pops.fitness.valid)
+        w = jax.vmap(lambda f: f.masked_wvalues())(pops.fitness)
+        nb, _ = mig_ring_stacked(k_mig, bundle, w, 5,
+                                 selection.sel_best)
+        return (k, nb["genome"], nb["values"], nb["valid"]), jnp.min(nb["values"])
+
+    fv0 = jax.vmap(jax.vmap(lambda x: benchmarks.rastrigin(x)[0]))(genome)[..., None]
+    valid0 = jnp.ones((n_dev, POP_PER_DEV), bool)
+
+    def run(ngen):
+        @jax.jit
+        def r(key, g, fv, valid):
+            return lax.scan(generation, (key, g, fv, valid), None,
+                            length=ngen)
+        return r
+
+    args = (key, genome, fv0, valid0)
+    txt = run(NGEN).lower(*args).compile().as_text()
+    marginal, ratio = _marginal(run, args, NGEN)
+    return marginal, ratio, _collective_counts(txt)
+
+
+def main():
+    import jax
+    if jax.default_backend() != "cpu" or len(jax.devices()) < N_DEV:
+        raise SystemExit(
+            "run under JAX_PLATFORMS=cpu with "
+            f"--xla_force_host_platform_device_count={N_DEV} "
+            f"(have {len(jax.devices())} {jax.default_backend()} devices)")
+    out = {"metric": "weak_scaling_fixed_pop_per_device",
+           "pop_per_device": POP_PER_DEV, "dim": DIM, "n_devices": N_DEV,
+           "note": ("single physical core: ideal tN = N*t1; overhead = "
+                    "tN/(N*t1) isolates sharding-added work/communication"),
+           "layouts": {}}
+    for layout in ("pop", "island"):
+        t1, r1, _ = measure(layout, 1)
+        tn, rn, colls = measure(layout, N_DEV)
+        out["layouts"][layout] = {
+            "t1_per_gen_ms": round(t1 * 1e3, 2),
+            f"t{N_DEV}_per_gen_ms": round(tn * 1e3, 2),
+            "overhead_factor": round(tn / (N_DEV * t1), 3),
+            "timing_linearity": {"t1": round(r1, 2), f"t{N_DEV}": round(rn, 2)},
+            "collectives_in_hlo": colls,
+        }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
