@@ -122,3 +122,22 @@ def test_core_surface_complete():
     # checkpointing (SURVEY §5)
     from deap_tpu.utils.checkpoint import (save_checkpoint, load_checkpoint,
                                            async_save_checkpoint)
+
+
+def test_api_reference_documented():
+    """Round-2 verdict item 8: every reference-parity name must appear in
+    the generated API reference (docs/api/, written by docs/gen_api.py).
+    A public-surface change without a docs regen fails here."""
+    import pathlib
+    root = pathlib.Path(__file__).resolve().parent.parent / "docs" / "api"
+    pages = sorted(root.glob("*.md"))
+    assert pages, "docs/api/ is empty — run python docs/gen_api.py"
+    corpus = "\n".join(p.read_text() for p in pages)
+    names = (REFERENCE_TOOLS + REFERENCE_GP + REFERENCE_CMA
+             + REFERENCE_BENCHMARKS
+             + [n for pair in REFERENCE_ALGORITHMS for n in pair])
+    missing = [n for n in names
+               if f"`{n}(" not in corpus      # function/class with signature
+               and f"`{n}`" not in corpus     # alias/re-export line
+               and f"`{n} " not in corpus]
+    assert not missing, f"parity names absent from docs/api: {missing}"
