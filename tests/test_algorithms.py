@@ -226,3 +226,28 @@ def test_segmented_streaming_matches_single_scan(capsys):
     lines = [l for l in out.splitlines() if l.startswith("gen=")]
     assert [l.split("\t")[0] for l in lines] == ["gen=4", "gen=8", "gen=11"]
     assert all("max=" in l for l in lines)
+
+
+def test_mo_cma_host_selection_scale():
+    """The host-driven MO-CMA selection must stay practical well past the
+    reference's mu=lambda=10 — pinned at mu=lambda=100 with every candidate
+    on a single front (worst case: truncation peels lambda contributors)."""
+    import time
+
+    def arc(rng, n):
+        t = np.sort(rng.uniform(0.05, np.pi / 2 - 0.05, n))
+        return np.stack([np.cos(t), np.sin(t)], 1)
+
+    mu = 100
+    rng = np.random.default_rng(0)
+    s = cma.StrategyMultiObjective(
+        rng.uniform(size=(mu, 10)), (-1.0, -1.0), 0.5,
+        values=arc(rng, mu), mu=mu, lambda_=mu)
+    off = s.generate(jax.random.PRNGKey(1))
+    s.update(off, arc(rng, mu))                   # warm the jitted ranks
+    t0 = time.perf_counter()
+    off = s.generate(jax.random.PRNGKey(2))
+    s.update(off, arc(rng, mu))
+    wall = time.perf_counter() - t0
+    assert s.parents.shape == (mu, 10)
+    assert wall < 2.0, f"mu=100 single-front generation took {wall:.2f}s"

@@ -52,6 +52,7 @@ SMOKE = {
     "examples.ga.evosn": (dict(pop_size=200, ngen=20),
                           lambda r: r[1][0] <= 6),
     "examples.ga.evoknn": (dict(ngen=20), lambda r: r[1][0] >= 0.9),
+    "examples.ga.evoknn_jmlr": (dict(ngen=25), lambda r: r[1][0] >= 0.9),
     # neuroevolution (BASELINE config 5): a pole balanced >=100 steps on
     # average over the fixed evaluation episodes
     "examples.ga.evopole": (dict(ngen=20, pop_size=128),
