@@ -3,7 +3,9 @@
 pop=4096, tree capacity 64, 1024 sample points — the reference's hottest
 path (``gp.compile`` string-build + Python ``eval`` + per-point Python
 arithmetic, /root/reference/deap/gp.py:460-485, SURVEY §3.4) against the
-vmapped prefix stack machine (``deap_tpu/gp/interp.py``).
+prefix stack machine — on TPU the Pallas kernel
+(``deap_tpu/gp/interp_pallas.py``: scalar opcode dispatch, stack in VMEM),
+registered population-wide via ``toolbox.evaluate_population``.
 
 Prints ONE JSON line like bench.py.  Metric is generations/sec of the full
 evolve loop (rank tournament, typed one-point subtree crossover, uniform
@@ -62,6 +64,7 @@ def run_tpu():
     target = X[0] ** 4 + X[0] ** 3 + X[0] ** 2 + X[0]
 
     ev = gp.make_evaluator(ps, CAP)
+    pop_ev = gp.make_population_evaluator(ps, CAP)     # Pallas kernel on TPU
     gen_init = gp.make_generator(ps, CAP, "half_and_half")
     gen_mut = gp.make_generator(ps, CAP, "full")
 
@@ -70,8 +73,15 @@ def run_tpu():
         mse = jnp.mean((out - target) ** 2)
         return (jnp.where(jnp.isfinite(mse), mse, 1e6),)
 
+    def evaluate_all(genome):
+        codes, consts, lengths = genome
+        out = pop_ev(codes, consts, lengths, X)        # (pop, n_points)
+        mse = jnp.mean((out - target[None, :]) ** 2, axis=1)
+        return jnp.where(jnp.isfinite(mse), mse, 1e6)[:, None]
+
     tb = base.Toolbox()
     tb.register("evaluate", evaluate)
+    tb.register("evaluate_population", evaluate_all)
     tb.register("mate", lambda k, a, b: gp.cx_one_point(k, a, b, ps))
     tb.register("mutate", lambda k, t: gp.mut_uniform(
         k, t, lambda kk: gen_mut(kk, 0, 2), ps))

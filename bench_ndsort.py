@@ -1,9 +1,9 @@
 #!/usr/bin/env python
 """Non-dominated-sort front-depth scaling evidence (round-2 verdict item 6).
 
-Measures ``nondominated_ranks`` — both the chunked count-peel and, at
-nobj=2, the exact O(n log n) staircase sweep — across the regimes that
-stress front depth:
+Measures ``nondominated_ranks`` — the chunked count-peel and, at nobj=2,
+both the parallel staircase peel (the default) and the serial O(n log n)
+staircase sweep — across the regimes that stress front depth:
 
 * ``zdt1``-shaped clouds (nobj=2, shallow fronts — the NSGA-II common case)
 * ``line`` (nobj=2, every point on one dominance chain: F = N fronts, the
@@ -70,7 +70,8 @@ def main():
     for regime in ("zdt1", "line", "dtlz2_5d"):
         for n in SIZES:
             w = make_data(regime, n, jax.random.fold_in(key, n))
-            methods = ["peel"] if regime == "dtlz2_5d" else ["sweep2d", "peel"]
+            methods = (["peel"] if regime == "dtlz2_5d"
+                       else ["staircase", "sweep2d", "peel"])
             for method in methods:
                 if regime == "line" and method == "peel" and n > 20_000:
                     # O(N^2 * chunk): hours at 1e5 — measured at 1e4 instead

@@ -52,7 +52,13 @@ def run_stack_machine(codes, consts, length, X, branches, arity, max_arity,
         res = lax.switch(c, branches, args, const)
         new_sp = jnp.where(active, sp - a + 1, sp)
         row = jnp.where(active, jnp.clip(new_sp - 1, 0, cap - 1), cap)
-        stack = stack.at[row].set(res)              # row `cap` = scratch
+        # dynamic_update_slice, NOT ``stack.at[row].set``: the batched
+        # scatter that ``.at[].set`` lowers to under vmap miscompiles on
+        # the axon TPU backend at batch >= 1024 (wrong rows written —
+        # found round 3; tests/test_gp_pallas.py::test_batch_size_invariance
+        # is the chunked-vs-full oracle, decisive when run on TPU).  DUS
+        # lowers to an in-place update and is correct at every batch size.
+        stack = lax.dynamic_update_slice(stack, res[None, :], (row, 0))
         return (stack, new_sp), None
 
     toks = (codes[::-1], consts[::-1], jnp.arange(cap)[::-1])
@@ -77,9 +83,29 @@ def make_evaluator(pset, cap: int) -> Callable:
     return evaluate
 
 
-def make_population_evaluator(pset, cap: int) -> Callable:
+def make_population_evaluator(pset, cap: int, *,
+                              backend: str = "auto") -> Callable:
     """``evaluate_pop(codes (pop,cap), consts (pop,cap), lengths (pop,), X
-    (n_args, n_points)) -> (pop, n_points)`` — the vmapped interpreter."""
+    (n_args, n_points)) -> (pop, n_points)``.
+
+    ``backend="auto"`` uses the Pallas kernel
+    (:mod:`deap_tpu.gp.interp_pallas`) — scalar opcode dispatch with the
+    stack in VMEM instead of vmapped compute-every-primitive-and-select —
+    when running on TPU and the pset has a kernel form (no ADF
+    placeholders); off-TPU (where the kernel would run in slow interpret
+    mode) and for ADF psets it uses the vmapped XLA interpreter.
+    ``backend="xla"`` / ``"pallas"`` force a path."""
+    if backend not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown backend {backend!r}")
+    use_pallas = (backend == "pallas" or
+                  (backend == "auto" and jax.default_backend() == "tpu"))
+    if use_pallas:
+        try:
+            from .interp_pallas import make_population_evaluator_pallas
+            return make_population_evaluator_pallas(pset, cap)
+        except ValueError:
+            if backend == "pallas":
+                raise
     ev = make_evaluator(pset, cap)
     return jax.vmap(ev, in_axes=(0, 0, 0, None))
 

@@ -1,0 +1,171 @@
+"""Pallas TPU kernel for GP tree evaluation — the hot-op fast path behind
+:func:`deap_tpu.gp.interp.make_population_evaluator`.
+
+Why a kernel: the XLA interpreter vmaps a stack machine over the
+population, and under ``vmap`` every ``lax.switch`` computes **every**
+primitive for **every** tree and selects per lane — cost factor =
+#primitives — while the ``(pop, cap+1, n_points)`` stack lives in HBM, so
+each of the ``cap`` scan steps pays full-population gather/scatter
+bandwidth.  But a tree's opcode at a given step is *uniform across its
+points*: inside a Pallas kernel the dispatch is **scalar** control flow
+(``lax.switch`` on an SMEM value — only the one live branch executes), the
+stack is a VMEM scratch buffer that never touches HBM, and the token loop
+runs ``length`` steps instead of ``cap``.  Per tree the work drops from
+``cap × n_prims`` full-width lane-selected ops with HBM round-trips to
+``length`` single VPU ops on resident data.
+
+The contract matches :func:`deap_tpu.gp.interp.run_stack_machine` exactly
+(same prefix encoding, same result), pinned by
+``tests/test_gp_pallas.py``; CPU CI runs the kernel in interpreter mode.
+
+Trees must be *valid* prefix programs (generators and variation preserve
+this): evaluation walks tokens ``length-1 → 0`` right-to-left, pushing
+terminals and folding primitives, so the stack never exceeds
+``cap//2 + 2`` rows for binary arities (we allocate ``cap + 1`` —
+VMEM is cheap at these shapes and malformed input then stays in-bounds).
+
+Reference parity: replaces ``gp.compile`` + per-point Python arithmetic
+(/root/reference/deap/gp.py:460-485), the reference's hottest path
+(SURVEY §3.4).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pset import Argument, Ephemeral, Primitive, Terminal, freeze_pset
+
+__all__ = ["make_population_evaluator_pallas"]
+
+_LANE = 128
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def make_population_evaluator_pallas(pset, cap: int, *,
+                                     block_trees: int = 8,
+                                     interpret: bool | None = None
+                                     ) -> Callable:
+    """Build ``evaluate_pop(codes (pop, cap), consts (pop, cap), lengths
+    (pop,), X (n_args, n_points)) -> (pop, n_points)`` running the prefix
+    stack machine as one Pallas kernel.
+
+    ``block_trees`` trees are handled per grid step (amortises grid
+    overhead); ``interpret=None`` auto-selects interpreter mode off-TPU so
+    the same evaluator runs in CPU tests.  Only float-valued, non-ADF
+    primitive sets are supported — callers fall back to the XLA
+    interpreter otherwise (``make_population_evaluator`` does this
+    automatically).
+    """
+    f = freeze_pset(pset)
+    if any(isinstance(n, Primitive) and n.func is None for n in f.pset.nodes):
+        raise ValueError("ADF placeholder primitives have no kernel form; "
+                         "use the XLA interpreter")
+    nodes = list(f.pset.nodes)
+    tb = block_trees
+
+    def step_branch(node):
+        """Per-opcode branch: pop arity args, apply, push result.  All
+        shapes static inside the branch — only ``sp``/row indices are
+        dynamic scalars."""
+        if isinstance(node, Primitive):
+            k, fn = node.arity, node.func
+
+            def branch(sp, const, stack_ref, x_ref):
+                args = [stack_ref[sp - 1 - j, :] for j in range(k)]
+                stack_ref[sp - k, :] = fn(*args)
+                return sp - k + 1
+        elif isinstance(node, Argument):
+            ai = node.index
+
+            def branch(sp, const, stack_ref, x_ref):
+                stack_ref[sp, :] = x_ref[ai, :]
+                return sp + 1
+        else:                       # Terminal / Ephemeral: stored constant
+
+            def branch(sp, const, stack_ref, x_ref):
+                stack_ref[sp, :] = jnp.full(
+                    (stack_ref.shape[1],), const, stack_ref.dtype)
+                return sp + 1
+        return branch
+
+    branches = [step_branch(n) for n in nodes]
+
+    def kernel(codes_ref, consts_ref, lengths_ref, x_ref, out_ref,
+               stack_ref):
+        def tree_body(i, _):
+            length = lengths_ref[i, 0]
+
+            def step(t_rev, sp):
+                t = length - 1 - t_rev
+                c = codes_ref[i, t]
+                const = consts_ref[i, t]
+                return lax.switch(
+                    c, [functools.partial(b, stack_ref=stack_ref,
+                                          x_ref=x_ref) for b in branches],
+                    sp, const)
+
+            lax.fori_loop(0, length, step, 0, unroll=False)
+            out_ref[i, :] = stack_ref[0, :]
+            return 0
+
+        lax.fori_loop(0, tb, tree_body, 0, unroll=False)
+
+    @jax.jit
+    def evaluate_pop(codes, consts, lengths, X):
+        pop = codes.shape[0]
+        n_args, n_points = X.shape
+        dtype = X.dtype
+        pop_pad = _round_up(max(pop, tb), tb)
+        pts_pad = _round_up(n_points, _LANE)
+        if pop_pad != pop:
+            pad = pop_pad - pop
+            codes = jnp.concatenate(
+                [codes, jnp.zeros((pad, cap), codes.dtype)], 0)
+            consts = jnp.concatenate(
+                [consts, jnp.zeros((pad, cap), consts.dtype)], 0)
+            # padded trees get length 0: the token loop runs zero steps,
+            # so no stack access happens (code 0 is a primitive, which at
+            # sp=0 would read/write negative rows); their out_ref row is
+            # stale scratch and is sliced off below
+            lengths = jnp.concatenate(
+                [lengths, jnp.zeros((pad,), lengths.dtype)], 0)
+        if pts_pad != n_points:
+            X = jnp.concatenate(
+                [X, jnp.zeros((n_args, pts_pad - n_points), dtype)], 1)
+
+        run = pl.pallas_call(
+            kernel,
+            grid=(pop_pad // tb,),
+            in_specs=[
+                pl.BlockSpec((tb, cap), lambda g: (g, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((tb, cap), lambda g: (g, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((tb, 1), lambda g: (g, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((n_args, pts_pad), lambda g: (0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((tb, pts_pad), lambda g: (g, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((pop_pad, pts_pad), dtype),
+            scratch_shapes=[pltpu.VMEM((cap + 1, pts_pad), dtype)],
+            interpret=(jax.default_backend() != "tpu"
+                       if interpret is None else interpret),
+        )
+        out = run(codes.astype(jnp.int32), consts.astype(dtype),
+                  lengths.astype(jnp.int32)[:, None], X)
+        return out[:pop, :n_points]
+
+    return evaluate_pop

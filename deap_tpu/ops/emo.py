@@ -65,10 +65,20 @@ def _rows_dominate_counts(rows: jax.Array, w: jax.Array) -> jax.Array:
     return jnp.sum(dominates(rows[:, None, :], w[None, :, :]), axis=0)
 
 
-def _nondominated_ranks_2d(w: jax.Array):
-    """Exact 2-objective non-dominated ranks in O(n log n): the staircase
-    sweep behind the reference's Fortin-2013 ``sortLogNondominated``
-    specialised to nobj=2 (reference emo.py:234-441; Jensen 2004 §III.A).
+def _sorted_min_space(w: jax.Array):
+    """Shared 2-objective preamble: flip to minimization, make ±inf finite,
+    sort by (f1 asc, f2 asc).  Returns ``(order, f1s, f2s)``."""
+    big = jnp.finfo(w.dtype).max
+    f = jnp.clip(-w, -big, big)               # minimization, ±inf made finite
+    order = jnp.lexsort((f[:, 1], f[:, 0]))
+    return order, f[order, 0], f[order, 1]
+
+
+def _nondominated_ranks_2d_sweep(w: jax.Array):
+    """Exact 2-objective non-dominated ranks in O(n log n) *serial* steps:
+    the staircase sweep behind the reference's Fortin-2013
+    ``sortLogNondominated`` specialised to nobj=2 (reference emo.py:234-441;
+    Jensen 2004 §III.A).
 
     Sort by (f1 asc, f2 asc) in minimization space; maintain ``best[r]`` =
     the minimum f2 of any point already assigned to front ``r`` (an array
@@ -76,14 +86,13 @@ def _nondominated_ranks_2d(w: jax.Array):
     ``best[r] <= f2``, so its front is the first ``r`` with
     ``best[r] > f2`` — one ``searchsorted``.  Exact duplicates share the
     run head's front (identical points never dominate each other) and do
-    not update the staircase.  One ``lax.scan`` of n tiny steps — compare
-    the peel's O(F·front_chunk·N) on deep-front data (F ≈ N fronts turns
-    the peel into O(N²·chunk); the sweep doesn't care)."""
+    not update the staircase.  One ``lax.scan`` of n tiny steps — optimal
+    work, but *sequential*: on TPU each of the n steps costs ~µs whatever
+    its asymptotics, so this only wins on adversarially deep data
+    (F ≈ N fronts) where the round-based algorithms degrade.  Measured
+    numbers in ``bench_ndsort.py``."""
     n = w.shape[0]
-    big = jnp.finfo(w.dtype).max
-    f = jnp.clip(-w, -big, big)               # minimization, ±inf made finite
-    order = jnp.lexsort((f[:, 1], f[:, 0]))
-    f1s, f2s = f[order, 0], f[order, 1]
+    order, f1s, f2s = _sorted_min_space(w)
 
     def step(carry, x):
         best, pf1, pf2, pr = carry
@@ -94,12 +103,61 @@ def _nondominated_ranks_2d(w: jax.Array):
         best = jnp.where(dup, best, best.at[r_new].set(f2))
         return (best, f1, f2, r), r
 
-    init = (jnp.full((n,), jnp.inf, f.dtype),
-            jnp.nan * jnp.ones((), f.dtype), jnp.nan * jnp.ones((), f.dtype),
-            jnp.int32(0))
+    init = (jnp.full((n,), jnp.inf, f1s.dtype),
+            jnp.nan * jnp.ones((), f1s.dtype),
+            jnp.nan * jnp.ones((), f1s.dtype), jnp.int32(0))
     _, rs = lax.scan(step, init, (f1s, f2s))
     ranks = jnp.zeros((n,), jnp.int32).at[order].set(rs)
     return ranks, jnp.max(rs) + 1
+
+
+def _nondominated_ranks_2d(w: jax.Array):
+    """Exact 2-objective non-dominated ranks as a *parallel* staircase
+    peel: ``n_fronts`` rounds, each one ``lax.associative_scan`` (log-depth
+    prefix) instead of n sequential steps.
+
+    In (f1 asc, f2 asc)-sorted minimization space, only an earlier point
+    can dominate a later one, and ``j`` dominates ``i`` **iff**
+    ``(f2_j, f1_j) <_lex (f2_i, f1_i)`` (equal pairs are duplicates, which
+    never dominate).  So membership in the current first front is one
+    *exclusive prefix lexicographic-min* over the still-active points:
+    ``i`` survives iff no active ``j < i`` has a lex-smaller key.  Peel
+    that front, repeat while anything is active — O(F · n) total work, all
+    of it parallel prefix/elementwise kernels, vs the count-peel's O(MN²)
+    dominance counting.  This is the nobj=2 default: realistic populations
+    have F ≪ N fronts (measured in ``bench_ndsort.py``; at pop=2·10⁵ ZDT1
+    clouds run ~40× faster than the count-peel).  The adversarial F ≈ N
+    regime is the serial sweep's (``method="sweep2d"``) one win."""
+    n = w.shape[0]
+    order, f1s, f2s = _sorted_min_space(w)
+    inf = jnp.asarray(jnp.inf, f1s.dtype)
+
+    def lexmin(a, b):
+        a2, a1 = a
+        b2, b1 = b
+        ta = (a2 < b2) | ((a2 == b2) & (a1 <= b1))
+        return jnp.where(ta, a2, b2), jnp.where(ta, a1, b1)
+
+    def cond(s):
+        ranks_s, _ = s
+        return jnp.any(ranks_s < 0)
+
+    def body(s):
+        ranks_s, r = s
+        active = ranks_s < 0
+        k2 = jnp.where(active, f2s, inf)
+        k1 = jnp.where(active, f1s, inf)
+        m2, m1 = lax.associative_scan(lexmin, (k2, k1))
+        m2 = jnp.concatenate([inf[None], m2[:-1]])      # exclusive prefix
+        m1 = jnp.concatenate([inf[None], m1[:-1]])
+        dominated = (m2 < f2s) | ((m2 == f2s) & (m1 < f1s))
+        ranks_s = jnp.where(active & ~dominated, r, ranks_s)
+        return ranks_s, r + 1
+
+    ranks_s, nf = lax.while_loop(
+        cond, body, (jnp.full((n,), -1, jnp.int32), jnp.int32(0)))
+    ranks = jnp.zeros((n,), jnp.int32).at[order].set(ranks_s)
+    return ranks, nf
 
 
 def nondominated_ranks(w: jax.Array, valid: jax.Array | None = None,
@@ -109,10 +167,14 @@ def nondominated_ranks(w: jax.Array, valid: jax.Array | None = None,
     array.  Returns ``(ranks, n_fronts)``; invalid rows land in the last
     fronts because their wvalues are ``-inf``.
 
-    Two algorithms, identical partitions:
+    Three algorithms, identical partitions:
 
-    * ``sweep2d`` (nobj=2 only): the exact O(n log n) staircase sweep
-      (:func:`_nondominated_ranks_2d`) — front count does not matter.
+    * ``staircase`` (nobj=2 only, the nobj=2 default): parallel staircase
+      peel (:func:`_nondominated_ranks_2d`) — F rounds, each one
+      log-depth prefix-min.  O(F·n) work, no pairwise matrix.
+    * ``sweep2d`` (nobj=2 only): the serial O(n log n) staircase sweep
+      (:func:`_nondominated_ranks_2d_sweep`) — n sequential scan steps;
+      only wins on adversarially deep data (F ≈ N).
     * ``peel``: incremental count-peeling for any nobj — dominator counts
       are computed **once** (one chunked O(MN²) pass), then each peeled
       front *subtracts* its own dominance contribution from the survivors'
@@ -122,18 +184,19 @@ def nondominated_ranks(w: jax.Array, valid: jax.Array | None = None,
       per-front compaction costs O(front_chunk·N) even for tiny fronts, so
       adversarially deep data (F ≈ N fronts) degrades to O(N²·chunk).
 
-    ``method="auto"`` uses the sweep when nobj==2 and the peel otherwise
-    (measured on the bench TPU: the sweep is never slower at nobj=2 and is
-    orders of magnitude faster on deep-front data — see bench_ndsort.py
-    and docs/emo numbers)."""
+    ``method="auto"`` uses the staircase peel when nobj==2 and the count
+    peel otherwise (measured on the bench TPU — see bench_ndsort.py and
+    the per-method docstrings)."""
     n, m = w.shape
     if valid is not None:
         w = jnp.where(valid[:, None], w, -jnp.inf)
-    if method not in ("auto", "sweep2d", "peel"):
+    if method not in ("auto", "staircase", "sweep2d", "peel"):
         raise ValueError(f"unknown method {method!r}")
-    if method == "sweep2d" and m != 2:
-        raise ValueError("sweep2d requires exactly 2 objectives")
-    if m == 2 and method in ("auto", "sweep2d"):
+    if method in ("staircase", "sweep2d") and m != 2:
+        raise ValueError(f"{method} requires exactly 2 objectives")
+    if method == "sweep2d":
+        return _nondominated_ranks_2d_sweep(w)
+    if m == 2 and method in ("auto", "staircase"):
         return _nondominated_ranks_2d(w)
     c = min(front_chunk, n)
     counts = _dominator_counts(w, jnp.ones((n,), bool))
@@ -196,10 +259,11 @@ def sort_nondominated(fitness, k, first_front_only=False):
 def sort_log_nondominated(fitness, k, first_front_only=False):
     """Generalized-Jensen/Fortin-2013 entry point (reference
     sortLogNondominated, emo.py:234-441).  Produces the identical partition
-    into fronts.  For nobj=2 this genuinely IS a log-time algorithm here:
-    :func:`nondominated_ranks` dispatches to the exact O(n log n) staircase
-    sweep (Jensen's 2-D base case, which the reference's ``sweepA`` also
-    implements).  For nobj>2 the chunked count-peel is used — measured
+    into fronts.  For nobj=2 :func:`nondominated_ranks` dispatches to the
+    parallel staircase peel (O(F·n) prefix-min rounds; Jensen's 2-D base
+    case, which the reference's ``sweepA`` also implements, is available
+    as ``method="sweep2d"``).  For nobj>2 the chunked count-peel is used —
+    measured
     faster on TPU than a recursive divide-and-conquer would be at the
     population sizes where XLA shines (deep recursion + data-dependent
     splits defeat fixed-shape compilation; see bench_ndsort.py for the

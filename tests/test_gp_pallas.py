@@ -1,0 +1,102 @@
+"""The Pallas GP evaluator must be bit-compatible with the vmapped XLA
+stack machine on every tree the generators can produce (CPU CI runs the
+kernel in interpreter mode; on TPU the same code compiles to Mosaic)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deap_tpu import gp
+from deap_tpu.gp.interp import make_population_evaluator
+from deap_tpu.gp.interp_pallas import make_population_evaluator_pallas
+
+
+def _symbreg_pset():
+    ps = gp.PrimitiveSet("MAIN", 1)
+    ps.add_primitive(jnp.add, 2, name="add")
+    ps.add_primitive(jnp.subtract, 2, name="sub")
+    ps.add_primitive(jnp.multiply, 2, name="mul")
+    ps.add_primitive(gp.protected_div, 2, name="div")
+    ps.add_primitive(jnp.negative, 1, name="neg")
+    ps.add_primitive(jnp.cos, 1, name="cos")
+    ps.add_terminal(0.5, name="half")
+    ps.add_ephemeral_constant(
+        "rand101",
+        lambda key: jax.random.randint(key, (), -1, 2).astype(jnp.float32))
+    return ps
+
+
+@pytest.mark.parametrize("n_points", [128, 100])   # aligned + padded lanes
+def test_pallas_matches_xla(n_points):
+    ps = _symbreg_pset()
+    cap = 32
+    pop = 37                                       # non-multiple of block
+    gen = gp.make_generator(ps, cap, "half_and_half")
+    keys = jax.random.split(jax.random.PRNGKey(0), pop)
+    codes, consts, lengths = jax.vmap(lambda k: gen(k, 1, 4))(keys)
+    X = jnp.linspace(-2, 2, n_points, dtype=jnp.float32)[None, :]
+
+    ref = make_population_evaluator(ps, cap, backend="xla")(
+        codes, consts, lengths, X)
+    out = make_population_evaluator_pallas(ps, cap, interpret=jax.
+                                           default_backend() != "tpu")(
+        codes, consts, lengths, X)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_pallas_two_arg_pset():
+    ps = gp.PrimitiveSet("MAIN", 2)
+    ps.add_primitive(jnp.add, 2, name="add")
+    ps.add_primitive(jnp.multiply, 2, name="mul")
+    ps.add_primitive(jnp.sin, 1, name="sin")
+    cap = 16
+    gen = gp.make_generator(ps, cap, "full")
+    keys = jax.random.split(jax.random.PRNGKey(3), 16)
+    codes, consts, lengths = jax.vmap(lambda k: gen(k, 1, 3))(keys)
+    X = jax.random.normal(jax.random.PRNGKey(4), (2, 256))
+
+    ref = make_population_evaluator(ps, cap, backend="xla")(
+        codes, consts, lengths, X)
+    out = make_population_evaluator_pallas(ps, cap)(
+        codes, consts, lengths, X)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_batch_size_invariance():
+    """Chunked-vs-full oracle: evaluating a population in one batch must
+    equal evaluating it in small chunks, for BOTH interpreters, at batch
+    sizes past 1024.  On CPU this is a plain invariant; on TPU it is the
+    decisive probe for the axon-backend batched-scatter miscompile that
+    ``.at[row].set`` triggered at batch >= 1024 (found round 3 — the XLA
+    stack machine now uses ``dynamic_update_slice`` instead)."""
+    ps = _symbreg_pset()
+    cap, pop = 16, 2048
+    gen = gp.make_generator(ps, cap, "half_and_half")
+    keys = jax.random.split(jax.random.PRNGKey(7), pop)
+    codes, consts, lengths = jax.vmap(lambda k: gen(k, 1, 3))(keys)
+    X = jnp.linspace(-1, 1, 8, dtype=jnp.float32)[None, :]
+    for make in (lambda: make_population_evaluator(ps, cap, backend="xla"),
+                 lambda: make_population_evaluator_pallas(ps, cap)):
+        ev = make()
+        chunked = np.concatenate(
+            [np.asarray(ev(codes[i:i + 256], consts[i:i + 256],
+                           lengths[i:i + 256], X))
+             for i in range(0, pop, 256)])
+        full = np.asarray(ev(codes, consts, lengths, X))
+        np.testing.assert_allclose(full, chunked, rtol=1e-6, atol=1e-6)
+
+
+def test_auto_backend_dispatch():
+    """auto → pallas for kernel-able psets; ADF psets fall back to XLA."""
+    ps = _symbreg_pset()
+    ev = make_population_evaluator(ps, 16)         # must not raise
+    codes = jnp.zeros((4, 16), jnp.int32)
+    # a lone ephemeral/terminal token per tree
+    codes = codes.at[:, 0].set(ps.freeze().code_of("half"))
+    consts = jnp.full((4, 16), 0.5, jnp.float32)
+    lengths = jnp.ones((4,), jnp.int32)
+    out = ev(codes, consts, lengths, jnp.zeros((1, 8), jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), 0.5)

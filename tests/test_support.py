@@ -135,10 +135,11 @@ def test_incremental_ranks_match_naive():
 
 
 def test_sweep2d_ranks_match_peel():
-    """The O(n log n) 2-objective staircase sweep (the default at nobj=2)
-    must produce the exact peel partition on every tricky regime: deep
-    fronts (F=N), one antichain, exact duplicates, first-objective ties,
-    and invalid (-inf) rows."""
+    """Both 2-objective specialisations — the parallel staircase peel (the
+    nobj=2 default) and the serial O(n log n) sweep — must produce the
+    exact count-peel partition on every tricky regime: deep fronts (F=N),
+    one antichain, exact duplicates, first-objective ties, and invalid
+    (-inf) rows."""
     rng = np.random.default_rng(1)
     line = np.stack([np.arange(80.0), np.arange(80.0)], 1)
     cases = [
@@ -153,11 +154,13 @@ def test_sweep2d_ranks_match_peel():
     ]
     for w in cases:
         w = jnp.asarray(np.asarray(w, np.float32))
-        r_sweep, nf_sweep = jax.jit(nondominated_ranks)(w)      # auto->sweep
         r_peel, nf_peel = jax.jit(
             lambda w: nondominated_ranks(w, method="peel"))(w)
-        np.testing.assert_array_equal(np.asarray(r_sweep), np.asarray(r_peel))
-        assert int(nf_sweep) == int(nf_peel)
+        for method in ("auto", "staircase", "sweep2d"):
+            r_m, nf_m = jax.jit(lambda w, m=method: nondominated_ranks(
+                w, method=m))(w)
+            np.testing.assert_array_equal(np.asarray(r_m), np.asarray(r_peel))
+            assert int(nf_m) == int(nf_peel)
 
 
 def test_spea2_chunked_matches_small_chunk():
