@@ -63,15 +63,9 @@ def run_tpu():
     X = jnp.linspace(-1, 1, NPOINTS, dtype=jnp.float32)[None, :]
     target = X[0] ** 4 + X[0] ** 3 + X[0] ** 2 + X[0]
 
-    ev = gp.make_evaluator(ps, CAP)
     pop_ev = gp.make_population_evaluator(ps, CAP)     # Pallas kernel on TPU
     gen_init = gp.make_generator(ps, CAP, "half_and_half")
     gen_mut = gp.make_generator(ps, CAP, "full")
-
-    def evaluate(tree):
-        out = ev(tree[0], tree[1], tree[2], X)
-        mse = jnp.mean((out - target) ** 2)
-        return (jnp.where(jnp.isfinite(mse), mse, 1e6),)
 
     def evaluate_all(genome):
         codes, consts, lengths = genome
@@ -80,7 +74,8 @@ def run_tpu():
         return jnp.where(jnp.isfinite(mse), mse, 1e6)[:, None]
 
     tb = base.Toolbox()
-    tb.register("evaluate", evaluate)
+    # population-level evaluate: algorithms.evaluate_population dispatches
+    # to this (the per-individual `evaluate` slot would be dead code here)
     tb.register("evaluate_population", evaluate_all)
     tb.register("mate", lambda k, a, b: gp.cx_one_point(k, a, b, ps))
     tb.register("mutate", lambda k, t: gp.mut_uniform(

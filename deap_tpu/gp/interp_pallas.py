@@ -18,11 +18,15 @@ The contract matches :func:`deap_tpu.gp.interp.run_stack_machine` exactly
 (same prefix encoding, same result), pinned by
 ``tests/test_gp_pallas.py``; CPU CI runs the kernel in interpreter mode.
 
-Trees must be *valid* prefix programs (generators and variation preserve
-this): evaluation walks tokens ``length-1 → 0`` right-to-left, pushing
-terminals and folding primitives, so the stack never exceeds
-``cap//2 + 2`` rows for binary arities (we allocate ``cap + 1`` —
-VMEM is cheap at these shapes and malformed input then stays in-bounds).
+Trees MUST be *valid* prefix programs — this is the kernel's input
+contract, and everything the generators and variation operators produce
+satisfies it.  A valid program keeps the stack pointer in (0, #terminals]
+throughout the right-to-left walk, so the ``cap + 1``-row scratch bounds
+every access.  A *malformed* program (e.g. a primitive token with too few
+operands below it) would drive ``sp`` negative and index out of bounds —
+unchecked in compiled Mosaic — so callers feeding hand-built token arrays
+must validate them first (the XLA interpreter clamps instead and is the
+safer path for untrusted trees).
 
 Reference parity: replaces ``gp.compile`` + per-point Python arithmetic
 (/root/reference/deap/gp.py:460-485), the reference's hottest path

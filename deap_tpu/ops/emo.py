@@ -125,9 +125,14 @@ def _nondominated_ranks_2d(w: jax.Array):
     that front, repeat while anything is active — O(F · n) total work, all
     of it parallel prefix/elementwise kernels, vs the count-peel's O(MN²)
     dominance counting.  This is the nobj=2 default: realistic populations
-    have F ≪ N fronts (measured in ``bench_ndsort.py``; at pop=2·10⁵ ZDT1
-    clouds run ~40× faster than the count-peel).  The adversarial F ≈ N
-    regime is the serial sweep's (``method="sweep2d"``) one win."""
+    have F ≪ N fronts.  Measured on the bench TPU (bench_ndsort.py,
+    2026-07-30): ZDT1-shaped clouds at n=10⁵ (393 fronts) sort in 0.23 s
+    vs 1.05 s count-peel / 3.57 s serial sweep, and the NSGA-II pop=10⁵
+    whole-generation bench went 0.65 → 4.61 gens/s when this replaced the
+    serial sweep.  The adversarial F ≈ N regime is the serial sweep's
+    (``method="sweep2d"``) one win: on a pure dominance chain at n=10⁵ the
+    sweep takes 3.5 s vs 32 s here (and the count-peel is off the chart —
+    projected hours)."""
     n = w.shape[0]
     order, f1s, f2s = _sorted_min_space(w)
     inf = jnp.asarray(jnp.inf, f1s.dtype)

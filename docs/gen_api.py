@@ -17,6 +17,7 @@ so a public-surface change without a docs regen fails CI.
 import importlib
 import inspect
 import os
+import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -46,7 +47,8 @@ PAGES = {
                       ["deap_tpu.ops.indicator", "deap_tpu.ops.hv"]),
     "gp": ("Genetic programming (deap_tpu.gp)",
            ["deap_tpu.gp", "deap_tpu.gp.pset", "deap_tpu.gp.generate",
-            "deap_tpu.gp.interp", "deap_tpu.gp.variation",
+            "deap_tpu.gp.interp", "deap_tpu.gp.interp_pallas",
+            "deap_tpu.gp.variation",
             "deap_tpu.gp.tree", "deap_tpu.gp.adf", "deap_tpu.gp.routine",
             "deap_tpu.gp.harm"]),
     "cma": ("CMA-ES strategies (deap_tpu.cma)", ["deap_tpu.cma"]),
@@ -83,9 +85,14 @@ def public_names(mod):
 
 def signature_of(obj):
     try:
-        return str(inspect.signature(obj))
+        sig = str(inspect.signature(obj))
     except (TypeError, ValueError):
         return "(...)"
+    # function-valued defaults repr with a memory address
+    # ("<function sel_best at 0x7f...>") — strip it so regens are
+    # deterministic and diffs carry only real changes
+    return re.sub(r"<function ([^ >]+) at 0x[0-9a-f]+>", r"<function \1>",
+                  sig)
 
 
 def render_entry(name, obj, lines):

@@ -8,6 +8,14 @@ here the world is an explicit state pytree and the program runs through
 :func:`deap_tpu.gp.make_routine_interpreter` — a ``lax.while_loop`` stack
 walker with true data-dependent branching — so whole populations of ants
 run as one XLA program.
+
+This also subsumes the reference's *fast* simulator — a hand-written C++
+CPython extension (examples/gp/ant/AntSimulatorFast.cpp, built by
+examples/gp/ant/buildAntSimFast.py) that replaces the Python
+``AntSimulator`` one ant at a time.  A host extension is the wrong shape
+for TPU: the compiled routine interpreter below evaluates the entire
+population's ants in parallel on device, which is what the C++ rewrite
+was approximating one process at a time.
 """
 
 import numpy as np

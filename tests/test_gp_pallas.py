@@ -100,3 +100,25 @@ def test_auto_backend_dispatch():
     lengths = jnp.ones((4,), jnp.int32)
     out = ev(codes, consts, lengths, jnp.zeros((1, 8), jnp.float32))
     np.testing.assert_allclose(np.asarray(out), 0.5)
+
+
+def test_adf_pset_falls_back():
+    """ADF placeholder primitives have no kernel form: backend='pallas'
+    must raise ValueError, and 'auto' must return a working XLA evaluator
+    instead of crashing — on every backend (the auto TPU branch catches
+    exactly this ValueError)."""
+    adf = gp.PrimitiveSet("ADF0", 1)
+    adf.add_primitive(jnp.add, 2, name="add")
+    main = gp.PrimitiveSet("MAIN", 1)
+    main.add_primitive(jnp.add, 2, name="add")
+    main.add_adf(adf)
+    with pytest.raises(ValueError):
+        make_population_evaluator_pallas(main, 16)
+    for backend in ("auto", "xla"):
+        ev = make_population_evaluator(main, 16, backend=backend)
+        f = main.freeze()
+        codes = jnp.full((2, 16), f.code_of("ARG0"), jnp.int32)
+        out = ev(codes, jnp.zeros((2, 16), jnp.float32),
+                 jnp.ones((2,), jnp.int32),
+                 jnp.full((1, 8), 2.0, jnp.float32))
+        np.testing.assert_allclose(np.asarray(out), 2.0)
