@@ -39,6 +39,10 @@ NGEN = int(os.environ.get("BENCH_NGEN", 3))
 SELECT = os.environ.get("BENCH_SELECT", "nsga2")
 if SELECT not in ("nsga2", "spea2"):
     raise SystemExit(f"BENCH_SELECT={SELECT!r}: expected 'nsga2' or 'spea2'")
+# spea2 peak memory is O(chunk * 2*POP) per pairwise block (distances +
+# top_k values/indices); the default chunk overflows HBM at POP=1e5 on a
+# 16 GB chip (observed worker crash) - scale it down with population
+CHUNK = int(os.environ.get("BENCH_CHUNK", max(64, min(1024, 10 ** 8 // (2 * POP)))))
 
 
 def run_tpu():
@@ -71,7 +75,7 @@ def run_tpu():
         off, _ = evaluate_population(tb, off)
         pool = pop.concat(off)
         if SELECT == "spea2":
-            sel = emo.sel_spea2(k_sel, pool.fitness, POP)
+            sel = emo.sel_spea2(k_sel, pool.fitness, POP, chunk=CHUNK)
         else:
             sel = emo.sel_nsga2(k_sel, pool.fitness, POP)
         new = pool.take(sel)

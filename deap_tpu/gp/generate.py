@@ -89,15 +89,25 @@ def make_generator(pset, cap: int, kind: str = "half_and_half") -> Callable:
             _, _, pos, _, _, sp, _ = state
             return (sp > 0) & (pos < cap)
 
+        # gather/scatter-free body: on the bench TPU backend a vmapped
+        # per-row gather or scatter costs ~80x an elementwise op, so every
+        # stack/table access below is a where/one-hot contraction over the
+        # small axis instead (helpers shared with the variation operators)
+        from .variation import _take1 as at_, _tbl as tbl_
+        st_rows = jnp.arange(cap + max_arity)
+        buf_rows = jnp.arange(cap)
+
         def body(state):
             codes, consts, pos, st_type, st_depth, sp, key = state
             key, k_term, k_pick, k_const = jax.random.split(key, 4)
-            t = st_type[sp - 1]
-            d = st_depth[sp - 1]
+            t = at_(st_type, sp - 1)
+            d = at_(st_depth, sp - 1)
             sp = sp - 1
 
-            has_prim = prim_cnt[t] > 0
-            has_term = term_cnt[t] > 0
+            t_term_cnt = tbl_(term_cnt, t)
+            t_prim_cnt = tbl_(prim_cnt, t)
+            has_prim = t_prim_cnt > 0
+            has_term = t_term_cnt > 0
             # reference genFull: terminal iff depth == height;
             # genGrow: depth == height or (depth >= min and u < ratio)
             at_bottom = d >= height
@@ -109,30 +119,39 @@ def make_generator(pset, cap: int, kind: str = "half_and_half") -> Callable:
             choose_term = (want_term & has_term) | must_term | ~has_prim
 
             tpick = jax.random.randint(k_pick, (), 0,
-                                       jnp.maximum(term_cnt[t], 1))
+                                       jnp.maximum(t_term_cnt, 1))
             ppick = jax.random.randint(k_pick, (), 0,
-                                       jnp.maximum(prim_cnt[t], 1))
-            code = jnp.where(choose_term, term_arr[t, tpick],
-                             prim_arr[t, ppick])
+                                       jnp.maximum(t_prim_cnt, 1))
+            hot_t = ((jnp.arange(term_arr.shape[0])[:, None] == t)
+                     & (jnp.arange(term_arr.shape[1])[None, :] == tpick))
+            hot_p = ((jnp.arange(prim_arr.shape[0])[:, None] == t)
+                     & (jnp.arange(prim_arr.shape[1])[None, :] == ppick))
+            code = jnp.where(choose_term,
+                             jnp.sum(jnp.where(hot_t, term_arr, 0)),
+                             jnp.sum(jnp.where(hot_p, prim_arr, 0)))
             const = lax.switch(code, const_fns, k_const)
-            codes = codes.at[pos].set(code)
-            consts = consts.at[pos].set(const)
+            codes = jnp.where(buf_rows == pos, code, codes)
+            consts = jnp.where(buf_rows == pos, const, consts)
 
             # push chosen primitive's argument types, right-to-left so the
             # leftmost child pops first (prefix order): reversed args occupy
             # rows sp .. sp+a-1 with types in_types[code, a-1-j]
-            a = arity[code]
+            a = tbl_(arity, code)
             j = jnp.arange(max_arity)
-            push_rows = sp + j
             real = j < a
-            arg_types_for_rows = in_types[code, jnp.clip(a - 1 - j, 0,
-                                                         max_arity - 1)]
-            st_type = st_type.at[jnp.where(real, push_rows,
-                                           cap + max_arity - 1)].set(
-                jnp.where(real, arg_types_for_rows, st_type[-1]))
-            st_depth = st_depth.at[jnp.where(real, push_rows,
-                                             cap + max_arity - 1)].set(
-                jnp.where(real, d + 1, st_depth[-1]))
+            # in_types row for `code`, then reversed into push order
+            ty_row = jnp.sum(
+                jnp.where(jnp.arange(in_types.shape[0])[:, None] == code,
+                          in_types, 0), axis=0)               # (max_arity,)
+            rev_ty = jnp.sum(
+                jnp.where(j[:, None] == jnp.clip(a - 1 - j, 0,
+                                                 max_arity - 1)[None, :],
+                          ty_row[:, None], 0), axis=0)
+            slot = st_rows[:, None] == (sp + j)[None, :]      # (cap+ma, ma)
+            write = slot & real[None, :]
+            st_type = jnp.sum(jnp.where(write, rev_ty[None, :], 0), axis=1) \
+                + jnp.where(jnp.any(write, axis=1), 0, st_type)
+            st_depth = jnp.where(jnp.any(write, axis=1), d + 1, st_depth)
             sp = sp + a
             return codes, consts, pos + 1, st_type, st_depth, sp, key
 

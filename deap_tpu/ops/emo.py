@@ -592,16 +592,51 @@ def sel_spea2(key, fitness, k, chunk: int = 1024):
     n, nobj = w.shape
     chunks, c, pad = _row_chunks(w, chunk)
 
-    # strength[i] = #dominated by i; raw[j] = sum of strengths of j's
-    # dominators (reference L699-714), both via one scan over row blocks
-    def strength_body(_, wi):
+    # strength[i] = #dominated by i (reference L699-706) and the k-NN
+    # density distance, FUSED into one scan over row blocks — both need
+    # the same (c, n) pairwise structure.
+    #
+    # Known limit on the axon TPU backend (round 3, reproduced
+    # deterministically): any single program combining TWO
+    # dominance-counting chunked scans with ONE wide top_k/sort-per-row
+    # kernel crashes the TPU worker at n = 2·10⁵ (every pair of those
+    # pieces runs fine, as does this full function at n ≤ 6·10⁴, measured:
+    # bench_nsga2 BENCH_SELECT=spea2 gives 2.08 gens/s at pop=10⁴ and
+    # 0.21 gens/s at pop=3·10⁴).  The structure below already uses the
+    # minimum number of pairwise passes (strength and raw need dominance
+    # twice by data dependence; density needs the kth distance), so the
+    # fault cannot be programmed around without changing semantics —
+    # SPEA2 at pop ≥ ~10⁵ on this backend awaits a backend fix (NSGA-II
+    # at those sizes is unaffected and O(F·n)).
+    #
+    # Density: kth smallest distance per row.  Deliberate deviation from
+    # the reference: we use the paper form 1/(sqrt(d2_k)+2) (Zitzler 2001
+    # eq. 4) where reference L716-719 uses 1/(d2_k+2) on the *squared*
+    # distance over a quirky half-filled distance vector — same ordering
+    # pressure, different numeric values, so bit-parity with stock DEAP's
+    # dominated-fill order is not expected
+    kth = min(int(np.sqrt(n)), n - 1) if n > 1 else 0
+    row_ids = jnp.arange(n + pad).reshape(-1, c)
+
+    def strength_knn_body(_, block):
+        wi, ri = block
         d = dominates(wi[:, None, :], w[None, :, :])       # (c, n)
-        return None, jnp.sum(d, axis=1).astype(w.dtype)
+        strength_blk = jnp.sum(d, axis=1).astype(w.dtype)
+        d2 = jnp.sum((wi[:, None, :] - w[None, :, :]) ** 2, axis=-1)
+        self_pair = ri[:, None] == jnp.arange(n)[None, :]
+        d2 = jnp.where(self_pair, jnp.inf, d2)             # self-distance out
+        neg_small, _ = lax.top_k(-d2, kth + 1)             # kth+1 smallest
+        return None, (strength_blk, -neg_small[:, kth])
 
-    _, s_blocks = lax.scan(strength_body, None, chunks)
+    _, (s_blocks, kd_blocks) = lax.scan(strength_knn_body, None,
+                                        (chunks, row_ids))
     strength = s_blocks.reshape(-1)[:n]
+    kth_dist = kd_blocks.reshape(-1)[:n]
 
+    # raw[j] = sum of strengths of j's dominators (reference L707-714):
+    # needs the complete strength vector, hence a second pass
     s_pad = jnp.concatenate([strength, jnp.zeros((pad,), w.dtype)])
+
     def raw_body(acc, block):
         wi, si = block
         d = dominates(wi[:, None, :], w[None, :, :])       # (c, n)
@@ -609,25 +644,6 @@ def sel_spea2(key, fitness, k, chunk: int = 1024):
 
     raw, _ = lax.scan(raw_body, jnp.zeros((n,), w.dtype),
                       (chunks, s_pad.reshape(-1, c)))
-
-    # k-NN density: kth smallest distance per row.  Deliberate deviation
-    # from the reference: we use the paper form 1/(sqrt(d2_k)+2) (Zitzler
-    # 2001 eq. 4) where reference L716-719 uses 1/(d2_k+2) on the *squared*
-    # distance over a quirky half-filled distance vector — same ordering
-    # pressure, different numeric values, so bit-parity with stock DEAP's
-    # dominated-fill order is not expected
-    kth = min(int(np.sqrt(n)), n - 1) if n > 1 else 0
-    row_ids = jnp.arange(n + pad).reshape(-1, c)
-    def knn_body(_, block):
-        wi, ri = block
-        d2 = jnp.sum((wi[:, None, :] - w[None, :, :]) ** 2, axis=-1)
-        self_pair = ri[:, None] == jnp.arange(n)[None, :]
-        d2 = jnp.where(self_pair, jnp.inf, d2)             # self-distance out
-        neg_small, _ = lax.top_k(-d2, kth + 1)             # kth+1 smallest
-        return None, -neg_small[:, kth]
-
-    _, kd_blocks = lax.scan(knn_body, None, (chunks, row_ids))
-    kth_dist = kd_blocks.reshape(-1)[:n]
     density = 1.0 / (jnp.sqrt(kth_dist) + 2.0)
     spea_fit = raw + density                               # reference L719
     nondom = raw < 1

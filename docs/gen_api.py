@@ -91,7 +91,7 @@ def signature_of(obj):
     # function-valued defaults repr with a memory address
     # ("<function sel_best at 0x7f...>") — strip it so regens are
     # deterministic and diffs carry only real changes
-    return re.sub(r"<function ([^ >]+) at 0x[0-9a-f]+>", r"<function \1>",
+    return re.sub(r"<function (.+?) at 0x[0-9a-f]+>", r"<function \1>",
                   sig)
 
 
