@@ -337,3 +337,29 @@ def test_rename_arguments_roundtrip(pset):
     assert gp.to_string(tree, ps) == "add(x, y)"
     with pytest.raises(ValueError):
         ps.rename_arguments(ARG7="z")
+
+
+def test_gather_free_helpers_exact():
+    """_take1/_tbl/_vgather must equal direct indexing bit-for-bit — they
+    exist because vmapped gathers are ~80x slower (and one scatter pattern
+    miscompiles) on the axon TPU backend, not to change semantics."""
+    import numpy as np
+    from deap_tpu.gp.variation import _take1, _tbl, _vgather
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(17,)).astype(np.float32))
+    xi = jnp.asarray(rng.integers(0, 100, size=(17,)), jnp.int32)
+    for i in [0, 5, 16]:
+        assert float(_take1(x, jnp.int32(i))) == float(x[i])
+        assert int(_take1(xi, jnp.int32(i))) == int(xi[i])
+    table = jnp.asarray(rng.integers(-5, 5, size=(9,)), jnp.int32)
+    idx = jnp.asarray(rng.integers(0, 9, size=(4, 6)), jnp.int32)
+    np.testing.assert_array_equal(np.asarray(_tbl(table, idx)),
+                                  np.asarray(table[idx]))
+    sc = jnp.int32(7)
+    assert int(_tbl(table, sc)) == int(table[7])
+    vidx = jnp.asarray(rng.integers(0, 17, size=(17,)), jnp.int32)
+    np.testing.assert_array_equal(np.asarray(_vgather(x, vidx)),
+                                  np.asarray(x[vidx]))
+    np.testing.assert_array_equal(np.asarray(_vgather(xi, vidx)),
+                                  np.asarray(xi[vidx]))
