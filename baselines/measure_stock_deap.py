@@ -20,13 +20,15 @@ Configs (BASELINE.json):
   5. GP symbreg       pop=4096, 1024 points, compile/eval per individual
                       (the reference's hottest path, gp.py:460-485)
   6. SPEA2 ZDT1       dim=30, pop=1k & 4k (selSPEA2 environmental selection)
+  7. Neuroevolution   CartPole MLP (4->16->2) as flat list genome, numpy
+                      rollout per episode, pop=256 (BASELINE config 5)
 
 Writes the measured numbers into BASELINE.json under "measured" (merged —
 existing keys survive) and prints them.
 
 Rerun all:        python baselines/measure_stock_deap.py
 Rerun a subset:   python baselines/measure_stock_deap.py gp spea2
-(subset names: onemax rastrigin cmaes nsga2 gp spea2)
+(subset names: onemax rastrigin cmaes nsga2 gp spea2 evopole)
 """
 
 import json
@@ -235,6 +237,63 @@ def config5_gp_symbreg(pop_size=4096, npoints=1024):
     return run
 
 
+def config7_evopole(pop_size=256, hidden=16, n_episodes=4, max_steps=500):
+    """Stock neuroevolution shaped like BASELINE config 5 / the framework's
+    examples/ga/evopole.py: MLP policy weights as a flat list-of-floats
+    individual, numpy CartPole-v1 dynamics rolled out per episode in a
+    Python loop, eaSimple driving blend crossover + Gaussian mutation."""
+    import numpy as np
+
+    random.seed(7)
+    n_w = 4 * hidden + hidden + hidden * 2 + 2
+    rng = np.random.default_rng(7)
+    starts = rng.uniform(-0.05, 0.05, size=(n_episodes, 4))
+
+    def rollout(w1, b1, w2, b2, s0):
+        x, x_dot, th, th_dot = s0
+        for t in range(max_steps):
+            obs = np.array([x, x_dot, th, th_dot])
+            h = np.tanh(obs @ w1 + b1)
+            a = int(np.argmax(h @ w2 + b2))
+            force = 10.0 if a == 1 else -10.0
+            cos_t, sin_t = np.cos(th), np.sin(th)
+            temp = (force + 0.05 * th_dot ** 2 * sin_t) / 1.1
+            th_acc = (9.8 * sin_t - cos_t * temp) / (
+                0.5 * (4.0 / 3.0 - 0.1 * cos_t ** 2 / 1.1))
+            x_acc = temp - 0.05 * th_acc * cos_t / 1.1
+            x, x_dot = x + 0.02 * x_dot, x_dot + 0.02 * x_acc
+            th, th_dot = th + 0.02 * th_dot, th_dot + 0.02 * th_acc
+            if abs(x) >= 2.4 or abs(th) >= 12 * 2 * np.pi / 360:
+                return t + 1
+        return max_steps
+
+    def evaluate(ind):
+        v = np.asarray(ind, dtype=np.float64)
+        w1 = v[:4 * hidden].reshape(4, hidden)
+        b1 = v[4 * hidden:5 * hidden]
+        w2 = v[5 * hidden:5 * hidden + hidden * 2].reshape(hidden, 2)
+        b2 = v[5 * hidden + hidden * 2:]
+        return (float(np.mean([rollout(w1, b1, w2, b2, s)
+                               for s in starts])),)
+
+    tb = base.Toolbox()
+    tb.register("attr", random.gauss, 0.0, 0.5)
+    tb.register("individual", tools.initRepeat, creator.IndMax, tb.attr, n_w)
+    tb.register("population", tools.initRepeat, list, tb.individual)
+    tb.register("evaluate", evaluate)
+    tb.register("mate", tools.cxBlend, alpha=0.5)
+    tb.register("mutate", tools.mutGaussian, mu=0.0, sigma=0.1, indpb=1.0)
+    tb.register("select", tools.selTournament, tournsize=3)
+    pop = tb.population(n=pop_size)
+    for ind, fit in zip(pop, map(tb.evaluate, pop)):
+        ind.fitness.values = fit
+
+    def run(ngen):
+        algorithms.eaSimple(pop, tb, cxpb=0.5, mutpb=0.8, ngen=ngen,
+                            verbose=False)
+    return run
+
+
 def config6_spea2(pop_size):
     random.seed(6)
     tb = base.Toolbox()
@@ -265,7 +324,8 @@ def config6_spea2(pop_size):
 
 
 def main():
-    known = {"onemax", "rastrigin", "cmaes", "nsga2", "gp", "spea2"}
+    known = {"onemax", "rastrigin", "cmaes", "nsga2", "gp", "spea2",
+             "evopole"}
     subset = set(sys.argv[1:]) or known
     unknown = subset - known
     if unknown:
@@ -302,6 +362,10 @@ def main():
     if "gp" in subset:
         results["gp_symbreg_pop4096_pts1024_gens_per_sec_serial"] = round(
             timed_gens(config5_gp_symbreg(), 2), 4)
+
+    if "evopole" in subset:
+        results["evopole_pop256_gens_per_sec_serial"] = round(
+            timed_gens(config7_evopole(), 2), 4)
 
     if "spea2" in subset:
         for pop in (1000, 4000):

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Neuroevolution benchmark (BASELINE config 5): MLP policy on pure-jax
+CartPole, genome = per-layer weight pytree, vmapped rollout as fitness.
+Prints ONE JSON line like bench.py.
+
+Reuses the example (examples/ga/evopole.py) wholesale: the generation
+body is ``ea_simple``'s — tournament selection, leaf-wise blend
+crossover, Gaussian weight mutation, then a ``vmap``(individuals ×
+episodes) rollout of 500 ``lax.scan`` steps of cart-pole dynamics.  The
+rollout dominates: every generation simulates pop × episodes × 500
+environment steps on device.
+
+``vs_baseline`` divides by the stock-DEAP measurement of the same shape
+(flat list genome, numpy rollout per episode through ``eaSimple`` —
+``baselines/measure_stock_deap.py evopole``, BASELINE.json
+measured.evopole_pop256_gens_per_sec_serial).  The comparison is
+conservative in stock's favour: the numpy rollout early-returns when the
+pole falls (cheap for the near-random policies it is timed on, and per-
+generation cost *grows* as policies improve), while the ``lax.scan``
+rollout here always simulates all MAX_STEPS — fixed shape, fixed cost.
+
+Timing honesty kit identical to bench.py.  Env overrides: BENCH_POP
+(256), BENCH_NGEN (200), BENCH_PRNG (rbg | threefry).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+POP = int(os.environ.get("BENCH_POP", 256))
+NGEN = int(os.environ.get("BENCH_NGEN", 200))
+
+
+def run_tpu():
+    import numpy as np
+    import jax
+
+    if os.environ.get("BENCH_PRNG", "rbg") == "rbg":
+        jax.config.update("jax_default_prng_impl", "rbg")
+
+    import jax.numpy as jnp
+    from jax import lax
+    from deap_tpu import base
+    from deap_tpu.algorithms import vary_genome, evaluate_population
+    from deap_tpu.ops import selection
+    from examples.ga.evopole import (MAX_STEPS, N_EPISODES,
+                                     init_population, make_evaluate,
+                                     mate_blend, mut_gaussian_tree)
+
+    key = jax.random.PRNGKey(0)
+    key, k_init, k_eps = jax.random.split(key, 3)
+    episode_keys = jax.random.split(k_eps, N_EPISODES)
+
+    tb = base.Toolbox()
+    tb.register("evaluate", make_evaluate(episode_keys))
+    tb.register("mate", mate_blend)
+    tb.register("mutate", mut_gaussian_tree)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+
+    def generation(carry, _):
+        k, pop = carry
+        k, k_sel, k_var = jax.random.split(k, 3)
+        idx = tb.select(k_sel, pop.fitness, POP)
+        genome = jax.tree_util.tree_map(lambda x: x[idx], pop.genome)
+        genome, _ = vary_genome(k_var, genome, tb, 0.5, 0.8)
+        off = base.Population(genome, base.Fitness.empty(POP, (1.0,)))
+        off, _ = evaluate_population(tb, off)
+        return (k, off), jnp.max(off.fitness.values[:, 0])
+
+    def make_run(ngen):
+        @jax.jit
+        def run(k, pop):
+            return lax.scan(generation, (k, pop), None, length=ngen)
+        return run
+
+    genome = init_population(k_init, POP)
+    pop = base.Population(genome, base.Fitness.empty(POP, (1.0,)))
+    pop, _ = evaluate_population(tb, pop)
+
+    def timed(ngen):
+        run = make_run(ngen)
+        _, best = run(key, pop)
+        np.asarray(best[-1:])
+        t0 = time.perf_counter()
+        _, best = run(key, pop)
+        best_host = np.asarray(best)
+        return time.perf_counter() - t0, float(best_host.max())
+
+    t1, _ = timed(NGEN)
+    t2, best = timed(2 * NGEN)
+    ratio = t2 / t1
+    marginal = (t2 - t1) / NGEN
+    return (1.0 / marginal, ratio, best, jax.devices()[0].platform,
+            N_EPISODES * MAX_STEPS)
+
+
+def measured_baseline():
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            measured = json.load(f).get("measured", {})
+        if POP != 256:
+            return None
+        return measured["evopole_pop256_gens_per_sec_serial"]
+    except (OSError, KeyError, ValueError):
+        return None
+
+
+def main():
+    gens_per_sec, ratio, best, platform, steps_per_ind = run_tpu()
+    linear_ok = 1.5 <= ratio <= 2.7
+    baseline = measured_baseline()
+    vs = (gens_per_sec / baseline) if (baseline and linear_ok) else -1.0
+    print(json.dumps({
+        "metric": f"evopole_pop{POP}_gens_per_sec",
+        "value": round(gens_per_sec, 2) if linear_ok else -1,
+        "unit": "generations/sec",
+        "vs_baseline": round(vs, 1),
+        "extra": {
+            "platform": platform,
+            "timing_linearity": {"t2N_over_tN": round(ratio, 3),
+                                 "ok": linear_ok},
+            "best_mean_episode_len": best,
+            "env_steps_per_sec":
+                round(gens_per_sec * POP * steps_per_ind, 0)
+                if linear_ok else -1,
+            "stock_deap_baseline_gens_per_sec": baseline,
+            "prng": os.environ.get("BENCH_PRNG", "rbg"),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""OneMax GA benchmark (BASELINE config 1): 100-bit individuals, pop=300,
+the reference README's canonical example at its exact shape.  Prints ONE
+JSON line like bench.py.
+
+At this size the device is idle almost all the time — the point of the
+config is the *small-population* regime where the reference is most
+competitive (stock DEAP measured 91.6 gens/s here, its best ratio by
+far).  The whole run is still one ``lax.scan``, so the marginal
+per-generation cost is dominated by kernel launch latency, not work —
+which is exactly what the number should show.
+
+Timing honesty kit identical to bench.py: marginal (t(2N)-t(N))/N with a
+linearity self-check forced through host-materialised, data-dependent
+output.
+
+Env overrides: BENCH_POP (300), BENCH_BITS (100), BENCH_NGEN (20000 —
+generations are ~20 µs, so the linearity gate needs many of them),
+BENCH_PRNG (rbg | threefry).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+POP = int(os.environ.get("BENCH_POP", 300))
+BITS = int(os.environ.get("BENCH_BITS", 100))
+NGEN = int(os.environ.get("BENCH_NGEN", 20000))   # gens are ~20 µs: the
+# linearity gate needs enough of them to dominate dispatch overhead
+
+
+def run_tpu():
+    import numpy as np
+    import jax
+
+    if os.environ.get("BENCH_PRNG", "rbg") == "rbg":
+        jax.config.update("jax_default_prng_impl", "rbg")
+
+    import jax.numpy as jnp
+    from jax import lax
+    from deap_tpu import base
+    from deap_tpu.algorithms import vary_genome, evaluate_population
+    from deap_tpu.ops import crossover, mutation, selection
+
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: (jnp.sum(g),))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+
+    def generation(carry, _):
+        key, pop = carry
+        key, k_sel, k_var = jax.random.split(key, 3)
+        idx = tb.select(k_sel, pop.fitness, POP)
+        genome = pop.genome[idx]
+        genome, _ = vary_genome(k_var, genome, tb, 0.5, 0.2)
+        off = base.Population(genome, base.Fitness.empty(POP, (1.0,)))
+        off, _ = evaluate_population(tb, off)
+        return (key, off), jnp.max(off.fitness.values[:, 0])
+
+    def make_run(ngen):
+        @jax.jit
+        def run(key, pop):
+            return lax.scan(generation, (key, pop), None, length=ngen)
+        return run
+
+    key = jax.random.PRNGKey(0)
+    genome = jax.random.bernoulli(key, 0.5, (POP, BITS)).astype(jnp.float32)
+    pop = base.Population(genome, base.Fitness.empty(POP, (1.0,)))
+    pop, _ = evaluate_population(tb, pop)
+
+    def timed(ngen):
+        run = make_run(ngen)
+        _, best = run(key, pop)
+        np.asarray(best[-1:])
+        t0 = time.perf_counter()
+        _, best = run(key, pop)
+        best_host = np.asarray(best)
+        return time.perf_counter() - t0, float(best_host.max())
+
+    t1, _ = timed(NGEN)
+    t2, best = timed(2 * NGEN)
+    ratio = t2 / t1
+    marginal = (t2 - t1) / NGEN
+    return 1.0 / marginal, ratio, best, jax.devices()[0].platform
+
+
+def measured_baseline():
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            measured = json.load(f).get("measured", {})
+        if (POP, BITS) != (300, 100):
+            return None
+        return measured["onemax_pop300_gens_per_sec_serial"]
+    except (OSError, KeyError, ValueError):
+        return None
+
+
+def main():
+    gens_per_sec, ratio, best, platform = run_tpu()
+    linear_ok = 1.5 <= ratio <= 2.7
+    baseline = measured_baseline()
+    vs = (gens_per_sec / baseline) if (baseline and linear_ok) else -1.0
+    print(json.dumps({
+        "metric": f"onemax_ga_pop{POP}_bits{BITS}_gens_per_sec",
+        "value": round(gens_per_sec, 1) if linear_ok else -1,
+        "unit": "generations/sec",
+        "vs_baseline": round(vs, 1),
+        "extra": {
+            "platform": platform,
+            "timing_linearity": {"t2N_over_tN": round(ratio, 3),
+                                 "ok": linear_ok},
+            "best_fitness_seen": best,
+            "stock_deap_baseline_gens_per_sec": baseline,
+            "prng": os.environ.get("BENCH_PRNG", "rbg"),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()
