@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .pset import Primitive, freeze_pset
-from .variation import _all_subtree_ends
+from .variation import _all_subtree_ends, _take1, _tbl
 
 __all__ = ["make_routine_interpreter"]
 
@@ -96,12 +96,19 @@ def make_routine_interpreter(pset, cap: int, actions: Mapping[str, Callable],
 
         # traversal stack of node indices
         stack0 = jnp.zeros((cap,), jnp.int32)
+        rows_all = jnp.arange(cap)
 
         def child_starts(i):
-            """Start index of each child of node i (prefix layout)."""
+            """Start index of each child of node i (prefix layout).  All
+            indexing is gather/scatter-free (``_take1``-style one-hot
+            contractions): vmapped per-row gathers and scalar scatters are
+            ~80x an elementwise op on the bench TPU backend, and the
+            ``.at[].set`` scatter pattern miscompiles there at batch >=
+            1024 (see deap_tpu/gp/interp.py)."""
             starts = [i + 1]
             for _ in range(max_arity - 1):
-                starts.append(ends[jnp.clip(starts[-1], 0, cap - 1)])
+                starts.append(_take1(ends, jnp.clip(starts[-1], 0,
+                                                    cap - 1)))
             return jnp.stack(starts)
 
         def cond(carry):
@@ -112,13 +119,13 @@ def make_routine_interpreter(pset, cap: int, actions: Mapping[str, Callable],
             state, stack, sp, steps = carry
             # empty stack -> restart the routine from the root
             restart = sp == 0
-            stack = jnp.where(restart, stack.at[0].set(0), stack)
+            stack = jnp.where(restart & (rows_all == 0), 0, stack)
             sp = jnp.where(restart, 1, sp)
 
-            i = stack[sp - 1]
+            i = _take1(stack, sp - 1)
             sp = sp - 1
-            c = codes[i]
-            kind = kinds[c]
+            c = _take1(codes, i)
+            kind = _tbl(kinds, c)
 
             # action: apply the state transformer
             state_act = lax.switch(c, act_fns, state)
@@ -127,20 +134,24 @@ def make_routine_interpreter(pset, cap: int, actions: Mapping[str, Callable],
                 state_act, state)
 
             starts = child_starts(i)
-            a = arity[c]
+            a = _tbl(arity, c)
             # conditional: push exactly one child by predicate
             pred = lax.switch(c, cond_fns, state)
             chosen = jnp.where(pred, starts[0],
                                starts[jnp.minimum(1, max_arity - 1)])
-            push_cond = stack.at[jnp.clip(sp, 0, cap - 1)].set(chosen)
+            push_cond = jnp.where(rows_all == jnp.clip(sp, 0, cap - 1),
+                                  chosen, stack)
             sp_cond = sp + 1
-            # sequencer: push children right-to-left so leftmost pops first
+            # sequencer: push children right-to-left so leftmost pops
+            # first: row sp+j receives starts[a-1-j] for j < a
             j = jnp.arange(max_arity)
-            rows = sp + j
-            real = j < a
-            rev = starts[jnp.clip(a - 1 - j, 0, max_arity - 1)]
-            push_seq = stack.at[jnp.where(real, rows, cap - 1)].set(
-                jnp.where(real, rev, stack[cap - 1]))
+            rev = _tbl(starts, jnp.clip(a - 1 - j, 0, max_arity - 1))
+            write = ((rows_all[:, None] == (sp + j)[None, :])
+                     & (j < a)[None, :])                   # (cap, ma)
+            push_seq = jnp.where(jnp.any(write, axis=1),
+                                 jnp.sum(jnp.where(write, rev[None, :], 0),
+                                         axis=1),
+                                 stack)
             sp_seq = sp + a
 
             is_cond = kind == kind_cond

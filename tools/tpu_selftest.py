@@ -133,6 +133,32 @@ def main():
                  k, (a0, a1, a2), ps))),
              keys, *t1, failures=failures)
 
+    # routine interpreter (control-flow GP: explicit-stack while loop)
+    ant_ps = gp.PrimitiveSet("ANT", 0)
+    ant_ps.add_primitive(None, 2, name="if_sense")
+    ant_ps.add_primitive(None, 2, name="prog2")
+    ant_ps.add_terminal(0.0, name="act_inc")
+    ant_ps.add_terminal(0.0, name="act_dec")
+    run_rt = gp.make_routine_interpreter(
+        ant_ps, 16,
+        actions={"act_inc": lambda s: {"v": s["v"] + 1.0,
+                                       "budget": s["budget"] - 1},
+                 "act_dec": lambda s: {"v": s["v"] - 0.5,
+                                       "budget": s["budget"] - 1}},
+        conds={"if_sense": lambda s: s["v"] < 3.0},
+        continue_fn=lambda s: s["budget"] > 0)
+    rt_gen = gp.make_generator(ant_ps, 16, "half_and_half")
+    rt_trees = jax.vmap(lambda k: rt_gen(k, 1, 3))(
+        jax.random.split(jax.random.fold_in(key, 5), POP))
+    state0 = {"v": jnp.zeros(()), "budget": jnp.full((), 40, jnp.int32)}
+
+    def rt_run(c0, c1, l):
+        return jax.vmap(lambda a, b, c: run_rt(
+            (a, b, c), state0))(c0, c1, l)
+
+    _compare("gp routine interpreter", jax.jit(rt_run), *rt_trees,
+             failures=failures)
+
     # XLA stack machine (the original finding, now fixed via DUS)
     X = jnp.linspace(-1, 1, 64, dtype=jnp.float32)[None, :]
     ev = gp.make_population_evaluator(ps, cap, backend="xla")
