@@ -64,9 +64,15 @@ def make_population_evaluator_pallas(pset, cap: int, *,
     (pop,), X (n_args, n_points)) -> (pop, n_points)`` running the prefix
     stack machine as one Pallas kernel.
 
-    ``block_trees`` trees are handled per grid step (amortises grid
-    overhead); ``interpret=None`` auto-selects interpreter mode off-TPU so
-    the same evaluator runs in CPU tests.  Only float-valued, non-ADF
+    ``block_trees`` trees are handled per grid step (rounded up to a
+    multiple of 8 for Mosaic's SMEM sublane tiling).  Measured at
+    pop=4096/cap=64/1024 pts: 32 is ~4× faster than 8 for *standalone*
+    back-to-back evaluation (0.04 vs 0.18 ms/eval), while the full
+    scanned symbreg bench is statistically indistinguishable between the
+    two (run variance from bloat dynamics dominates) — the default stays
+    8; tune upward for standalone-evaluation workloads.
+    ``interpret=None`` auto-selects interpreter mode off-TPU so the same
+    evaluator runs in CPU tests.  Only float-valued, non-ADF
     primitive sets are supported — callers fall back to the XLA
     interpreter otherwise (``make_population_evaluator`` does this
     automatically).
@@ -76,7 +82,10 @@ def make_population_evaluator_pallas(pset, cap: int, *,
         raise ValueError("ADF placeholder primitives have no kernel form; "
                          "use the XLA interpreter")
     nodes = list(f.pset.nodes)
-    tb = block_trees
+    if block_trees < 1:
+        raise ValueError(f"block_trees must be >= 1, got {block_trees}")
+    # Mosaic SMEM blocks need the sublane dim divisible by 8
+    tb = _round_up(block_trees, 8)
 
     def step_branch(node):
         """Per-opcode branch: pop arity args, apply, push result.  All
