@@ -19,7 +19,11 @@ point-evals/sec.  Timing honesty kit identical to bench.py: marginal
 written by ``baselines/measure_stock_deap.py gp``).
 
 Env overrides: BENCH_POP (4096), BENCH_CAP (64), BENCH_POINTS (1024),
-BENCH_NGEN (10), BENCH_PRNG (rbg | threefry).
+BENCH_NGEN (200), BENCH_PRNG (threefry | rbg — unlike the other
+harnesses this defaults to the *deterministic* PRNG: tree-bloat dynamics
+couple per-generation cost to the random stream, so the hardware RNG
+makes the measurement itself nondeterministic, observed 63–78 gens/s
+across rbg runs vs a reproducible 67.8 under threefry).
 """
 
 import json
@@ -32,14 +36,14 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 POP = int(os.environ.get("BENCH_POP", 4096))
 CAP = int(os.environ.get("BENCH_CAP", 64))
 NPOINTS = int(os.environ.get("BENCH_POINTS", 1024))
-NGEN = int(os.environ.get("BENCH_NGEN", 10))
+NGEN = int(os.environ.get("BENCH_NGEN", 200))
 
 
 def run_tpu():
     import numpy as np
     import jax
 
-    if os.environ.get("BENCH_PRNG", "rbg") == "rbg":
+    if os.environ.get("BENCH_PRNG", "threefry") == "rbg":
         jax.config.update("jax_default_prng_impl", "rbg")
 
     import jax.numpy as jnp
@@ -155,7 +159,7 @@ def main():
             "point_evals_per_sec":
                 round(gens_per_sec * POP * NPOINTS, 1) if linear_ok else -1,
             "stock_deap_baseline_gens_per_sec": baseline,
-            "prng": os.environ.get("BENCH_PRNG", "rbg"),
+            "prng": os.environ.get("BENCH_PRNG", "threefry"),
         },
     }))
 
