@@ -84,7 +84,9 @@ class Strategy:
         elif rweights == "equal":
             w = np.ones(self.mu)
         else:
-            raise RuntimeError(f"Unknown weights : {rweights}")
+            raise RuntimeError(
+                f"unrecognized recombination weighting {rweights!r}: "
+                "expected 'superlinear', 'linear' or 'equal'")
         w = w / np.sum(w)
         self.weights = jnp.asarray(w, jnp.float32)
         self.mueff = float(1.0 / np.sum(w ** 2))

@@ -81,6 +81,12 @@ def make_population_evaluator_pallas(pset, cap: int, *,
     if any(isinstance(n, Primitive) and n.func is None for n in f.pset.nodes):
         raise ValueError("ADF placeholder primitives have no kernel form; "
                          "use the XLA interpreter")
+    if not f.pset.arguments:
+        # a 0-argument pset would give X a zero-sized (0, pts_pad) block —
+        # rejected here (a build-time ValueError) so backend="auto" falls
+        # back to the XLA interpreter instead of crashing at call time
+        raise ValueError("0-argument primitive sets have no kernel form "
+                         "(zero-sized X block); use the XLA interpreter")
     nodes = list(f.pset.nodes)
     if block_trees < 1:
         raise ValueError(f"block_trees must be >= 1, got {block_trees}")
@@ -134,6 +140,12 @@ def make_population_evaluator_pallas(pset, cap: int, *,
 
         lax.fori_loop(0, tb, tree_body, 0, unroll=False)
 
+    # VMEM is ~16 MB/core; the kernel never blocks over the points axis,
+    # so its live buffers scale with pts_pad.  Checked per call (shapes are
+    # static at trace time) with a descriptive error instead of the opaque
+    # Mosaic allocation failure the advisor flagged.
+    _VMEM_BUDGET = 12 * 1024 * 1024
+
     @jax.jit
     def evaluate_pop(codes, consts, lengths, X):
         pop = codes.shape[0]
@@ -141,6 +153,17 @@ def make_population_evaluator_pallas(pset, cap: int, *,
         dtype = X.dtype
         pop_pad = _round_up(max(pop, tb), tb)
         pts_pad = _round_up(n_points, _LANE)
+        itemsize = jnp.dtype(dtype).itemsize
+        # stack scratch + resident X + double-buffered out blocks
+        vmem = (cap + 1 + n_args + 2 * tb) * pts_pad * itemsize
+        if vmem > _VMEM_BUDGET:
+            raise ValueError(
+                f"Pallas GP evaluator needs ~{vmem / 2**20:.0f} MiB of VMEM "
+                f"(cap={cap}, n_args={n_args}, n_points={n_points} padded "
+                f"to {pts_pad}) but only ~{_VMEM_BUDGET / 2**20:.0f} MiB is "
+                "available: the kernel keeps the whole points axis "
+                "resident.  Evaluate in point chunks, or build the "
+                'evaluator with backend="xla".')
         if pop_pad != pop:
             pad = pop_pad - pop
             codes = jnp.concatenate(

@@ -39,7 +39,12 @@ __all__ = ["subtree_bounds", "node_depths", "tree_height",
 
 
 def _take1(x, i):
-    """``x[i]`` for a traced scalar index, without a gather."""
+    """``x[i]`` for a traced scalar index, without a gather.
+
+    Precondition: ``0 <= i < x.shape[0]``.  Out-of-range indices return 0
+    (no term matches the one-hot), NOT the clamped-edge element that plain
+    jnp indexing would give — callers must clip or guard first, as every
+    call site here does."""
     idx = jnp.arange(x.shape[0])
     shape = (x.shape[0],) + (1,) * (x.ndim - 1)
     return jnp.sum(jnp.where((idx == i).reshape(shape), x, 0), axis=0)
@@ -47,7 +52,10 @@ def _take1(x, i):
 
 def _tbl(table, idx):
     """``table[idx]`` for a small static table and any-shape traced ``idx``,
-    without a gather (one-hot contraction over the table axis)."""
+    without a gather (one-hot contraction over the table axis).
+
+    Precondition: ``0 <= idx < table.shape[0]`` elementwise; out-of-range
+    entries yield 0, not jnp's clamp — clip or guard at the call site."""
     m = table.shape[0]
     oh = idx[..., None] == jnp.arange(m).reshape((1,) * idx.ndim + (m,))
     return jnp.sum(jnp.where(oh, table.reshape((1,) * idx.ndim + (m,)), 0),
@@ -56,7 +64,10 @@ def _tbl(table, idx):
 
 def _vgather(x, idx):
     """``x[idx]`` for same-length 1-D ``x`` and traced index vector, without
-    a gather: (cap, cap) one-hot contraction."""
+    a gather: (cap, cap) one-hot contraction.
+
+    Precondition: ``0 <= idx < x.shape[0]`` elementwise; out-of-range
+    entries yield 0, not jnp's clamp — clip or guard at the call site."""
     oh = idx[:, None] == jnp.arange(x.shape[0])[None, :]
     return jnp.sum(jnp.where(oh, x[None, :], 0), axis=1)
 

@@ -52,7 +52,7 @@ def sel_worst(key, fitness, k):
     return lex_sort_indices(_wv(fitness), descending=False)[:k]
 
 
-def sel_tournament(key, fitness, k, tournsize):
+def sel_tournament(key, fitness, k, tournsize, tie_break="random"):
     """``k`` tournaments of ``tournsize`` uniform aspirants each, keeping the
     lexicographic best (reference selection.py:51-69).
 
@@ -65,19 +65,32 @@ def sel_tournament(key, fitness, k, tournsize):
     to the gather-and-argmax formulation while replacing a ``(k·tournsize,)``
     random scalar gather (the measured hot spot at pop=10⁶ on TPU — gathers
     are the expensive primitive, sorts are cheap) with one sort plus a
-    ``(k,)`` gather.  Ties: individuals tied on fitness occupy adjacent ranks
-    and split the block's probability by sort order instead of uniformly.
-    This is a *deterministic index* bias (under the reversed stable lexsort
-    the later original index always gets the better rank of a tied block),
-    not a random O(1/n) one — aspirant sampling would break such ties
-    uniformly.  It carries no selection-pressure consequence, but when
-    exact tie neutrality matters (e.g. discrete fitness with huge tied
-    blocks), shuffle the population first or use a selector that samples
-    aspirants explicitly (:func:`sel_double_tournament` with
-    ``parsimony_size=1``)."""
+    ``(k,)`` gather.
+
+    Ties: individuals tied on fitness occupy adjacent ranks, and the rank
+    each one gets decides its share of the block's selection probability.
+    ``tie_break="random"`` (default) appends one keyed uniform draw per
+    individual as the least-significant sort key, so tied blocks are
+    uniformly permuted every call — the same uniform tie law as aspirant
+    sampling (the reference's ``max`` over randomly-drawn aspirants), at
+    the cost of one extra operand in the (single, variadic) sort.
+    ``tie_break="rank"`` skips the draw and splits tied blocks by the
+    deterministic stable sort order — fine for continuous fitness (ties
+    are measure-zero) and marginally cheaper, but biased for discrete
+    fitness with large tied blocks (OneMax-class workloads)."""
     w = _wv(fitness)
     n = w.shape[0]
-    order = lex_sort_indices(w, descending=True)          # best rank first
+    if tie_break == "random":
+        key, k_tie = jax.random.split(key)
+        jitter = jax.random.uniform(k_tie, (n,))
+        # lexsort: LAST key is primary; jitter first = least significant
+        keys = [jitter] + [w[:, j] for j in range(w.shape[1] - 1, -1, -1)]
+        order = jnp.lexsort(keys)[::-1]                   # best rank first
+    elif tie_break == "rank":
+        order = lex_sort_indices(w, descending=True)      # best rank first
+    else:
+        raise ValueError(f"tie_break {tie_break!r}: expected 'random' or "
+                         "'rank'")
     u = jax.random.uniform(key, (k,))
     # best rank among tournsize iid uniforms: F(r) = 1 - (1 - r/n)^ts
     pos = jnp.floor(n * -jnp.expm1(jnp.log1p(-u) / tournsize)).astype(jnp.int32)

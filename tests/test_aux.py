@@ -227,3 +227,45 @@ def test_tools_facade_aliases():
     assert tools.initRepeat is init.init_repeat
     # the façade keeps the support classes too
     assert tools.Statistics is not None and tools.Logbook is not None
+
+
+def test_tournament_tie_break_uniform():
+    """Discrete two-valued fitness: the default keyed tie-jitter must split
+    a tied block's selection mass uniformly across its members (the
+    reference's aspirant sampling breaks ties uniformly), while
+    tie_break="rank" concentrates it by deterministic sort order."""
+    from deap_tpu.ops.selection import sel_tournament
+    n, k, calls = 64, 64, 400
+    w = jnp.concatenate([jnp.ones((n // 2, 1)),
+                         jnp.zeros((n // 2, 1))], 0)      # 32-way tied top
+
+    def counts(tie_break):
+        def one(kk):
+            idx = sel_tournament(kk, w, k, tournsize=4, tie_break=tie_break)
+            return jnp.bincount(idx, length=n)
+        keys = jax.random.split(jax.random.PRNGKey(0), calls)
+        return np.asarray(jnp.sum(jax.vmap(one)(keys), axis=0))
+
+    c_rand = counts("random")
+    top = c_rand[:n // 2].astype(float)
+    # top block takes almost all mass, split evenly: each of the 32 tied
+    # members expects ~1/32 of it (std ~3% of mean at these counts)
+    assert top.sum() / c_rand.sum() > 0.9
+    assert top.max() / top.mean() < 1.25
+    assert top.min() / top.mean() > 0.75
+
+    c_rank = counts("rank")
+    top_rank = c_rank[:n // 2].astype(float)
+    # deterministic ranks: the tied block's best rank always goes to the
+    # same member, which hoards the block's high-pressure mass
+    assert top_rank.max() / top_rank.mean() > 2.0
+
+
+def test_tournament_tie_break_pressure_intact():
+    """Distinct fitness: jitter must not perturb who wins — with a huge
+    tournament size the best individual dominates the draw."""
+    from deap_tpu.ops.selection import sel_tournament
+    w = jnp.linspace(0.0, 1.0, 64)[:, None]
+    idx = sel_tournament(jax.random.PRNGKey(3), w, 512, tournsize=50)
+    frac_best = float(jnp.mean(idx == 63))
+    assert frac_best > 0.4                        # E = 1-(1-1/64)^50 ~ 0.54

@@ -381,3 +381,104 @@ def test_async_checkpoint_roundtrip(tmp_path):
     loaded = load_checkpoint(path)
     np.testing.assert_array_equal(loaded["a"], np.arange(5))
     assert loaded["s"] == "meta"
+
+
+# ---------------------------------------------------------------------------
+# Sharded checkpoint (per-shard save + restore with resharding)
+# ---------------------------------------------------------------------------
+
+
+def _mesh(n, name="pop"):
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:n]), (name,))
+
+
+def test_sharded_checkpoint_roundtrip_and_reshard(tmp_path):
+    """Per-shard save on an 8-device mesh, restore (a) onto the same mesh,
+    (b) onto a 4-device mesh, (c) fully replicated, (d) to a single device
+    — all bit-identical in value, no full gather required at save time."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deap_tpu.utils.checkpoint import (save_sharded_checkpoint,
+                                           load_sharded_checkpoint)
+    m8 = _mesh(8)
+    sh8 = NamedSharding(m8, P("pop"))
+    rep8 = NamedSharding(m8, P())
+    x = jnp.arange(64 * 40, dtype=jnp.float32).reshape(64, 40)
+    xs = jax.device_put(x, sh8)
+    w = jax.device_put(jnp.arange(16.0), rep8)        # replicated leaf
+    key = jax.random.PRNGKey(123)
+    state = {"genome": xs, "weights": w, "key": key,
+             "gen": 7, "note": "hello"}
+    save_sharded_checkpoint(tmp_path / "ck", state)
+
+    # placeholders must be real leaves (None is an empty pytree node)
+    like_same = {"genome": xs, "weights": w, "key": key,
+                 "gen": 0, "note": ""}
+    r = load_sharded_checkpoint(tmp_path / "ck", like_same)
+    np.testing.assert_array_equal(np.asarray(r["genome"]), np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(r["weights"]), np.asarray(w))
+    np.testing.assert_array_equal(np.asarray(r["key"]), np.asarray(key))
+    assert r["gen"] == 7 and r["note"] == "hello"
+    assert r["genome"].sharding == sh8
+
+    m4 = _mesh(4, "d")
+    sh4 = NamedSharding(m4, P("d"))
+    like_4 = dict(like_same,
+                  genome=jax.ShapeDtypeStruct((64, 40), jnp.float32,
+                                              sharding=sh4))
+    r4 = load_sharded_checkpoint(tmp_path / "ck", like_4)
+    assert r4["genome"].sharding == sh4
+    np.testing.assert_array_equal(np.asarray(r4["genome"]), np.asarray(x))
+
+    like_rep = dict(like_same,
+                    genome=jax.ShapeDtypeStruct((64, 40), jnp.float32,
+                                                sharding=rep8))
+    rr = load_sharded_checkpoint(tmp_path / "ck", like_rep)
+    np.testing.assert_array_equal(np.asarray(rr["genome"]), np.asarray(x))
+
+    like_one = dict(like_same, genome=jnp.zeros((64, 40), jnp.float32))
+    r1 = load_sharded_checkpoint(tmp_path / "ck", like_one)
+    np.testing.assert_array_equal(np.asarray(r1["genome"]), np.asarray(x))
+
+
+def test_sharded_checkpoint_exact_resume_sharded_ea(tmp_path):
+    """The round-3 verdict's acceptance test: a pop-sharded ``ea_simple``
+    run checkpointed per-shard mid-run and restored onto the same mesh
+    resumes bit-identically to the uninterrupted segmented run."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deap_tpu.utils.checkpoint import (save_sharded_checkpoint,
+                                           load_sharded_checkpoint)
+    tb, pop, key = _onemax_setup()
+    m8 = _mesh(8)
+    sh = NamedSharding(m8, P("pop"))
+
+    def shard_pop(p):
+        return base.Population(
+            genome=jax.device_put(p.genome, sh),
+            fitness=base.Fitness(
+                values=jax.device_put(p.fitness.values, sh),
+                valid=jax.device_put(p.fitness.valid, sh),
+                weights=p.fitness.weights))
+
+    spop = shard_pop(pop)
+    ref_pop = _run_segmented(tb, spop, key, [4, 4])
+
+    key2, k_seg1 = jax.random.split(key)
+    mid, _ = algorithms.ea_simple(k_seg1, spop, tb, 0.6, 0.3, 4)
+    save_sharded_checkpoint(tmp_path / "ck", {"population": mid,
+                                              "key": key2})
+    # the key restores replicated over the mesh (a single-device committed
+    # key cannot enter a jit with mesh-sharded operands)
+    state = load_sharded_checkpoint(
+        tmp_path / "ck",
+        {"population": mid,
+         "key": jax.ShapeDtypeStruct(key2.shape, key2.dtype,
+                                     sharding=NamedSharding(m8, P()))})
+    assert state["population"].genome.sharding == sh
+    _, k_seg2 = jax.random.split(state["key"])
+    out, _ = algorithms.ea_simple(k_seg2, state["population"], tb,
+                                  0.6, 0.3, 4)
+    np.testing.assert_array_equal(np.asarray(out.genome),
+                                  np.asarray(ref_pop.genome))
+    np.testing.assert_array_equal(np.asarray(out.fitness.values),
+                                  np.asarray(ref_pop.fitness.values))

@@ -1,0 +1,487 @@
+#!/usr/bin/env python
+"""Stage-level probes for the flagship GA generation on the bench TPU.
+
+Round-3 left the flagship at 41 gens/sec (24 ms/gen marginal at pop=1M,
+dim=100) with a stage budget measured from *XLA-generated* kernels:
+fitness sort ~5 ms, winner-index gather ~7 ms, genome row-gather ~8 ms,
+fused variation+evaluation ~6-8 ms.  The round-3 verdict's core objection:
+the same backend ran Pallas 194x faster than XLA on the GP interpreter, so
+none of those numbers is evidence about the *chip* until a hand kernel has
+tried.  This probe measures each stage both ways:
+
+XLA probes (variants exercise lax.GatherScatterMode hints):
+  sort          argsort of (pop,) f32 keys; int32 sort for reference
+  gidx          order[pos]: 1M scalar gathers from a 4 MB table
+                (plain / promise_in_bounds / sorted+hint)
+  grow          genome[idx]: 1M row-gathers of dim*4 B rows
+                (plain / promise_in_bounds / dim=128 / bf16)
+  varveval      the fused crossover+mutation+rastrigin chain (no gathers)
+
+Pallas probes (what the hardware does when we schedule it):
+  stream        tile copy of (pop,128) f32 -> r+w GB/s ceiling
+  chain         copy + 24 fused multiply-adds -> element-rate vs BW bound
+  rng           in-kernel PRNG (prng_random_bits) + Box-Muller, write out
+  rast          read tile, rastrigin row-reduce -> read+reduce GB/s
+  lookup        dynamic-index scalar reads from a VMEM-resident 4 MB
+                table (the in-kernel form of `gidx`)
+  dmagather     per-row make_async_copy gathers from an HBM-resident
+                genome (the in-kernel form of `grow`), W copies in flight
+
+Timing: every probe runs its op k and 2k times inside one jitted
+``lax.scan`` with a data dependence between iterations (no CSE/hoisting),
+reports the marginal (t2k - tk)/k, and carries the t2k/tk linearity ratio
+so a wedged measurement is visible (expect ~2.0).  One TPU process at a
+time; run subsets via argv, e.g. ``python tools/pallas_probe_ga.py stream
+chain rng``.  Results feed docs/performance.md's roofline re-derivation.
+"""
+
+import functools
+import json
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+POP = 1 << 20          # 1,048,576 -- the flagship population
+DIM = 100
+LANE = 128
+K_ITERS = 48           # enough iterations to swamp ~40 ms dispatch noise
+
+_ON_TPU = None
+
+
+def on_tpu():
+    global _ON_TPU
+    if _ON_TPU is None:
+        _ON_TPU = jax.default_backend() == "tpu"
+    return _ON_TPU
+
+
+def marginal(make_run, init, k=None):
+    """(t(2k)-t(k))/k for a scan-of-op program; returns (sec, ratio).
+
+    The clock stops on an ``np.asarray`` of the last per-iteration output
+    (data-dependent on every iteration) — ``block_until_ready`` is not
+    trusted on the axon backend (the round-1 broken-sync lesson)."""
+    k = k or K_ITERS
+    r1, r2 = jax.jit(make_run(k)), jax.jit(make_run(2 * k))
+
+    def run(r):
+        _, ys = r(init)
+        return np.asarray(jax.tree_util.tree_leaves(ys)[-1][-1:])
+
+    run(r1)                                  # compile + warm
+    run(r2)
+    t0 = time.perf_counter()
+    run(r1)
+    t1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    run(r2)
+    t2 = time.perf_counter() - t0
+    return (t2 - t1) / k, t2 / t1
+
+
+def report(name, sec, ratio, **extra):
+    print(json.dumps({"probe": name, "ms": round(sec * 1e3, 3),
+                      "linearity_t2k_over_tk": round(ratio, 2),
+                      **extra}), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# XLA stage probes
+# ---------------------------------------------------------------------------
+
+
+def probe_sort():
+    keys = jax.random.uniform(jax.random.PRNGKey(0), (POP,), jnp.float32)
+
+    def make(n):
+        def body(c, _):
+            order = jnp.argsort(c)
+            return c + order[0].astype(jnp.float32) * 1e-30, order[0]
+        return lambda x: lax.scan(body, x, None, length=n)
+
+    sec, r = marginal(make, keys)
+    report("xla_sort_argsort_f32_1m", sec, r)
+
+    ints = jax.random.randint(jax.random.PRNGKey(1), (POP,), 0, POP)
+
+    def make_i(n):
+        def body(c, _):
+            s = jnp.sort(c)
+            return (c + s[0] % 2 + 1) % POP, s[0]
+        return lambda x: lax.scan(body, x, None, length=n)
+
+    sec, r = marginal(make_i, ints)
+    report("xla_sort_i32_1m", sec, r)
+
+
+def probe_gidx():
+    kp, ko = jax.random.split(jax.random.PRNGKey(0))
+    order = jax.random.permutation(ko, POP).astype(jnp.int32)
+    pos = jax.random.randint(kp, (POP,), 0, POP, jnp.int32)
+
+    def variant(name, get):
+        def make(n):
+            def body(p, _):
+                out = get(p)
+                return (p + out + 1) % POP, out[0]
+            return lambda x: lax.scan(body, x, None, length=n)
+        sec, r = marginal(make, pos)
+        report(name, sec, r)
+
+    variant("xla_gidx_plain", lambda p: order[p])
+    variant("xla_gidx_pib",
+            lambda p: order.at[p].get(mode="promise_in_bounds"))
+
+    def make_sorted(n):
+        def body(p, _):
+            ps = jnp.sort(p)
+            out = order.at[ps].get(mode="promise_in_bounds",
+                                   indices_are_sorted=True)
+            return (p + out + 1) % POP, out[0]
+        return lambda x: lax.scan(body, x, None, length=n)
+
+    sec, r = marginal(make_sorted, pos)
+    report("xla_gidx_sorted_incl_sort", sec, r,
+           note="subtract xla_sort_i32_1m for the gather alone")
+
+
+def probe_grow():
+    kg, ki = jax.random.split(jax.random.PRNGKey(0))
+
+    def variant(name, dim, dtype, mode):
+        genome = jax.random.uniform(kg, (POP, dim)).astype(dtype)
+        idx = jax.random.randint(ki, (POP,), 0, POP, jnp.int32)
+
+        def make(n):
+            def body(c, _):
+                g, p = c
+                rows = (g.at[p].get(mode=mode) if mode else g[p])
+                p2 = (p + 1 + (rows[:, 0] > 0.5)) % POP
+                return (rows, p2), rows[0, 0]
+            return lambda x: lax.scan(body, x, None, length=n)
+
+        sec, r = marginal(make, (genome, idx))
+        gb = POP * dim * np.dtype(dtype).itemsize * 2 / 1e9
+        report(name, sec, r, eff_gbps=round(gb / sec, 1))
+
+    variant("xla_grow_plain_d100", DIM, jnp.float32, None)
+    variant("xla_grow_pib_d100", DIM, jnp.float32, "promise_in_bounds")
+    variant("xla_grow_pib_d128", LANE, jnp.float32, "promise_in_bounds")
+    variant("xla_grow_pib_d100_bf16", DIM, jnp.bfloat16,
+            "promise_in_bounds")
+
+
+def rastrigin_rows(x):
+    return 10.0 * x.shape[-1] + jnp.sum(
+        x * x - 10.0 * jnp.cos(2.0 * jnp.pi * x), axis=-1)
+
+
+def probe_varveval():
+    genome = jax.random.uniform(jax.random.PRNGKey(0), (POP, DIM),
+                                jnp.float32, -5.12, 5.12)
+    n2 = POP // 2
+
+    def make(n):
+        def body(c, i):
+            g, key = c
+            key, kc, kx, km, kn = jax.random.split(key, 5)
+            ga, gb = g[:n2], g[n2:]
+            do_cx = jax.random.bernoulli(kc, 0.9, (n2, 1))
+            c1 = jax.random.randint(kx, (n2, 1), 1, DIM + 1)
+            c2 = jax.random.randint(jax.random.fold_in(kx, 1), (n2, 1),
+                                    1, DIM)
+            c2 = jnp.where(c2 >= c1, c2 + 1, c2)
+            lo, hi = jnp.minimum(c1, c2), jnp.maximum(c1, c2)
+            cols = jnp.arange(DIM)[None, :]
+            sw = do_cx & (cols >= lo) & (cols < hi)
+            na = jnp.where(sw, gb, ga)
+            nb = jnp.where(sw, ga, gb)
+            g2 = jnp.concatenate([na, nb], 0)
+            mrow = jax.random.bernoulli(km, 0.5, (POP, 1))
+            mgen = jax.random.bernoulli(jax.random.fold_in(km, 1), 0.05,
+                                        (POP, DIM))
+            noise = 0.3 * jax.random.normal(kn, (POP, DIM))
+            g2 = jnp.where(mrow & mgen, g2 + noise, g2)
+            fit = rastrigin_rows(g2)
+            return (g2, key), jnp.min(fit)
+        return lambda x: lax.scan(body, x, None, length=n)
+
+    for prng in ("threefry2x32", "rbg"):
+        with jax.default_prng_impl(prng):
+            sec, r = marginal(make, (genome,
+                                     jax.random.PRNGKey(7)))
+            report(f"xla_varveval_{prng}", sec, r)
+
+
+# ---------------------------------------------------------------------------
+# Pallas probes
+# ---------------------------------------------------------------------------
+
+
+def _tiled_call(kernel, rows, n_in=1, n_out=1, dtype=jnp.float32,
+                out_lanes=LANE, scratch=(), in_lanes=None):
+    """pallas_call over (POP, LANE)-shaped operands in (rows, LANE) tiles."""
+    in_lanes = in_lanes or [LANE] * n_in
+    return pl.pallas_call(
+        kernel,
+        grid=(POP // rows,),
+        in_specs=[pl.BlockSpec((rows, il), lambda g: (g, 0),
+                               memory_space=pltpu.VMEM)
+                  for il in in_lanes],
+        out_specs=(pl.BlockSpec((rows, out_lanes), lambda g: (g, 0),
+                                memory_space=pltpu.VMEM)
+                   if n_out == 1 else
+                   [pl.BlockSpec((rows, out_lanes), lambda g: (g, 0),
+                                 memory_space=pltpu.VMEM)] * n_out),
+        out_shape=(jax.ShapeDtypeStruct((POP, out_lanes), dtype)
+                   if n_out == 1 else
+                   [jax.ShapeDtypeStruct((POP, out_lanes), dtype)] * n_out),
+        scratch_shapes=list(scratch),
+        interpret=not on_tpu(),
+    )
+
+
+def probe_stream():
+    x = jax.random.uniform(jax.random.PRNGKey(0), (POP, LANE), jnp.float32)
+
+    def kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:]
+
+    for rows in (512, 2048, 8192):
+        run = _tiled_call(kernel, rows)
+
+        def make(n, run=run):
+            def body(c, _):
+                out = run(c)
+                return out, out[0, 0]
+            return lambda v: lax.scan(body, v, None, length=n)
+
+        sec, r = marginal(make, x)
+        gb = POP * LANE * 4 * 2 / 1e9
+        report(f"pallas_stream_rows{rows}", sec, r,
+               eff_gbps=round(gb / sec, 1))
+
+
+def probe_chain():
+    x = jax.random.uniform(jax.random.PRNGKey(0), (POP, LANE), jnp.float32)
+
+    def kernel(x_ref, o_ref):
+        v = x_ref[:]
+        for i in range(24):
+            v = v * 1.0000001 + 1e-7
+        o_ref[:] = v
+
+    run = _tiled_call(kernel, 2048)
+
+    def make(n):
+        def body(c, _):
+            out = run(c)
+            return out, out[0, 0]
+        return lambda v: lax.scan(body, v, None, length=n)
+
+    sec, r = marginal(make, x)
+    elems = POP * LANE * 24
+    report("pallas_chain24", sec, r,
+           g_elem_ops_per_s=round(elems / sec / 1e9, 1))
+
+
+def probe_rng():
+    def kernel(seed_ref, o_ref):
+        pltpu.prng_seed(seed_ref[0], pl.program_id(0))
+        bits = pltpu.prng_random_bits(o_ref.shape)
+        u1 = (bits >> 8).astype(jnp.float32) * (1.0 / (1 << 24)) + 1e-7
+        bits2 = pltpu.prng_random_bits(o_ref.shape)
+        u2 = (bits2 >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+        radius = jnp.sqrt(-2.0 * jnp.log(u1))
+        o_ref[:] = radius * jnp.cos(2.0 * jnp.pi * u2)
+
+    rows = 2048
+    run = pl.pallas_call(
+        kernel,
+        grid=(POP // rows,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec((rows, LANE), lambda g: (g, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((POP, LANE), jnp.float32),
+        interpret=not on_tpu(),
+    )
+
+    def make(n):
+        def body(s, _):
+            out = run(s)
+            return s + 1 + (out[0, 0] > 0), out[0, 0]
+        return lambda s: lax.scan(body, s, None, length=n)
+
+    sec, r = marginal(make, jnp.zeros((1,), jnp.int32))
+    report("pallas_rng_normal_1m_x128", sec, r,
+           g_normals_per_s=round(POP * LANE / sec / 1e9, 1))
+
+
+def probe_rast():
+    x = jax.random.uniform(jax.random.PRNGKey(0), (POP, LANE), jnp.float32)
+
+    def kernel(x_ref, o_ref):
+        v = x_ref[:]
+        lanes = lax.broadcasted_iota(jnp.int32, v.shape, 1)
+        term = jnp.where(lanes < DIM,
+                         v * v - 10.0 * jnp.cos(2.0 * jnp.pi * v) + 10.0,
+                         0.0)
+        o_ref[:] = jnp.sum(term, axis=1, keepdims=True)
+
+    run = _tiled_call(kernel, 2048, out_lanes=1)
+
+    def make(n):
+        def body(c, _):
+            out = run(c)
+            return c * 1.0000001, out[0, 0]
+        return lambda v: lax.scan(body, v, None, length=n)
+
+    sec, r = marginal(make, x)
+    report("pallas_rastrigin_reduce", sec, r,
+           eff_read_gbps=round(POP * LANE * 4 / sec / 1e9, 1))
+
+
+def probe_lookup():
+    """Dynamic lookups from a VMEM-resident 4 MB table, stored (POP//128,
+    128): per query, one dynamic-sublane row read + one-hot lane extract —
+    the in-kernel replacement candidate for the XLA order[pos] gather."""
+    tab_rows = POP // LANE
+    table = jax.random.permutation(jax.random.PRNGKey(0), POP
+                                   ).astype(jnp.int32).reshape(tab_rows,
+                                                               LANE)
+    pos = jax.random.randint(jax.random.PRNGKey(1), (POP,), 0, POP,
+                             jnp.int32)
+    rows = 256
+
+    def kernel(pos_ref, table_ref, o_ref):
+        lanes = lax.broadcasted_iota(jnp.int32, (1, LANE), 1)
+
+        def body(r, _):
+            p = pos_ref[r, 0]
+            row = table_ref[p // LANE, :].reshape(1, LANE)
+            o_ref[r, 0] = jnp.sum(jnp.where(lanes == p % LANE, row, 0))
+            return 0
+        lax.fori_loop(0, rows, body, 0, unroll=False)
+
+    run = pl.pallas_call(
+        kernel,
+        grid=(POP // rows,),
+        in_specs=[
+            pl.BlockSpec((rows, 1), lambda g: (g, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((tab_rows, LANE), lambda g: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rows, 1), lambda g: (g, 0),
+                               memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((POP, 1), jnp.int32),
+        interpret=not on_tpu(),
+    )
+
+    def make(n):
+        def body(p, _):
+            out = run(p[:, None], table)[:, 0]
+            return (p + out + 1) % POP, out[0]
+        return lambda p: lax.scan(body, p, None, length=n)
+
+    sec, r = marginal(make, pos, k=4)
+    report("pallas_lookup_vmem_scalar", sec, r,
+           m_lookups_per_s=round(POP / sec / 1e6, 1))
+
+
+def probe_dmagather(rows=512, window=16):
+    """Per-row dynamic DMAs from an HBM-resident (POP, LANE) genome —
+    the in-kernel replacement candidate for the XLA row gather."""
+    genome = jax.random.uniform(jax.random.PRNGKey(0), (POP, LANE),
+                                jnp.float32)
+    idx = jax.random.randint(jax.random.PRNGKey(1), (POP,), 0, POP,
+                             jnp.int32)
+
+    def kernel(idx_ref, g_ref, o_ref, sems):
+        def issue(r):
+            pltpu.make_async_copy(
+                g_ref.at[pl.ds(idx_ref[r, 0], 1), :],
+                o_ref.at[pl.ds(r, 1), :],
+                sems.at[r % window]).start()
+
+        def wait(r):
+            pltpu.make_async_copy(
+                g_ref.at[pl.ds(idx_ref[r, 0], 1), :],
+                o_ref.at[pl.ds(r, 1), :],
+                sems.at[r % window]).wait()
+
+        def body(r, _):
+            issue(r)
+            lax.cond(r >= window, lambda: wait(r - window), lambda: None)
+            return 0
+        lax.fori_loop(0, rows, body, 0, unroll=False)
+
+        def drain(r, _):
+            wait(r)
+            return 0
+        lax.fori_loop(rows - window, rows, drain, 0, unroll=False)
+
+    run = pl.pallas_call(
+        kernel,
+        grid=(POP // rows,),
+        in_specs=[
+            pl.BlockSpec((rows, 1), lambda g: (g, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((rows, LANE), lambda g: (g, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((POP, LANE), jnp.float32),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((window,))],
+        interpret=not on_tpu(),
+    )
+
+    def make(n):
+        def body(c, _):
+            g, p = c
+            rows_out = run(p[:, None], g)
+            p2 = (p + 1 + (rows_out[:, 0] > 0.5)) % POP
+            return (rows_out, p2), rows_out[0, 0]
+        return lambda x: lax.scan(body, x, None, length=n)
+
+    sec, r = marginal(make, (genome, idx), k=4)
+    report(f"pallas_dmagather_rows{rows}_w{window}", sec, r,
+           m_rows_per_s=round(POP / sec / 1e6, 1),
+           eff_gbps=round(POP * LANE * 4 * 2 / sec / 1e9, 1))
+
+
+PROBES = {
+    "sort": probe_sort,
+    "gidx": probe_gidx,
+    "grow": probe_grow,
+    "varveval": probe_varveval,
+    "stream": probe_stream,
+    "chain": probe_chain,
+    "rng": probe_rng,
+    "rast": probe_rast,
+    "lookup": probe_lookup,
+    "dmagather": probe_dmagather,
+}
+
+
+def main(argv):
+    names = argv or list(PROBES)
+    print(json.dumps({"platform": jax.devices()[0].platform,
+                      "pop": POP, "dim": DIM}), flush=True)
+    for n in names:
+        try:
+            PROBES[n]()
+        except Exception as e:                      # keep probing
+            print(json.dumps({"probe": n, "error": f"{type(e).__name__}: "
+                              f"{str(e)[:300]}"}), flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
