@@ -21,24 +21,42 @@ sync once inflated this number ~40,000x):
 * ``timing_linearity`` is included in the output for the record; a run
   whose ratio falls outside the window reports ``"value": -1``.
 
-Measured roofline on the bench chip (TPU v5e, one core, via axon): a fused
-elementwise pass over the (1M, 100) f32 population sustains ~160-190 GB/s
-r+w (element-rate-bound at ~20 G elem/s — bf16 is no faster); a 1M-row
-gather ~100 GB/s (8 ms); a 1M-key sort ~5 ms; a 1M random scalar gather
-~7 ms.  The loop's irreducible primitives are one fitness sort (rank
-tournament, 5 ms) + one winner-index gather (7 ms) + one genome row-gather
-(8 ms) + at least one full fused variation+evaluation pass with its random
-bits (~6-8 ms) ≈ 26-28 ms; the measured marginal cost is ~24 ms/generation
-(41 gens/sec) — XLA fuses the crossover/mutation/evaluation chain tighter
-than the individually-timed stages suggest, and nothing is left on the
-table at the >20% level.  Relative to round 1 this is a 4x honest speedup
-(batched single-key operators, inverse-CDF rank tournament replacing the
-3M-scalar gather, gather-free re-evaluation, half-block pairing).  The 10k
-gens/sec north star at pop=1M is a multi-chip number: per chip it implies
-~2 GB of population traffic in 100 us = 20 TB/s, 100x this chip's measured
-streaming bandwidth; on the v5e-8 the north star names, the pop-sharded
+Measured roofline on the bench chip (TPU v5e, one core, via axon;
+``tools/pallas_probe_ga.py``, round 4 — every number below from its
+committed probe set).  Round 3 argued the ceiling from XLA-generated
+microkernels (fused pass "160-190 GB/s, element-rate-bound at ~20 G
+elem/s"); round 4's Pallas probes REFUTE that framing: a Pallas tile copy
+sustains **320-350 GB/s** r+w and a 24-op fused chain **639 G elem-ops/s**
+— XLA's elementwise codegen, not the chip, was the 20 G elem/s wall.  What
+the probes confirm instead is that this loop is bound by **random-access
+issue rate** and **RNG rate**, which are hardware:
+
+* 1M-row genome gather: 12.8 ms (82 M rows/s) — identical for bf16
+  (34 GB/s eff), dim=128, and even fully *sorted* indices (12.9 ms), so
+  it is gather-issue-rate-bound, not bandwidth- or locality-bound.
+  Per-row Pallas DMAs are 3x slower (27.7 M rows/s: ~36 ns DMA issue),
+  and in-kernel VMEM table lookups 13x slower (6.4 M/s) — XLA's gather
+  is the best available engine for this access pattern.
+* 1M winner-index gather (4 MB table): 7.4-8.4 ms (125 M idx/s), same
+  story.
+* Fused crossover+mutation+rastrigin with its random bits: 8.4 ms under
+  the rbg hardware PRNG — at the combined floor of its ~2.7·10⁸ PRNG
+  words (Pallas generates 62 G words/s = 4.3 ms alone) plus 0.8 GB of
+  population IO (2.3 ms at the Pallas streaming rate), so a hand kernel
+  has no headroom here either.
+* Fitness argsort: 1.6 ms (cheap — round 3 overestimated it 3x).
+
+Stage sum 30 ms, measured marginal 24 ms/generation (41 gens/sec): XLA
+overlaps the chain, and the loop sits at ~85% of the stage-floor ceiling
+(~20-22 ms) that the measured gather and PRNG rates impose on ANY exact
+implementation of this algorithm — each child must fetch 1-2
+uniformly-random 400 B parent rows per generation, and sorted-order /
+DMA / in-kernel alternatives were all probed slower.  The 10k gens/sec
+north star at pop=1M is therefore a multi-chip number: per chip it
+implies ~10⁷ random row fetches in 100 us = 10¹¹ rows/s, 1000x the
+measured issue rate; on the v5e-8 the north star names, the pop-sharded
 path (validated by ``dryrun_multichip``) projects ~8x this figure
-(~300 gens/sec) since every per-generation primitive shards on the pop
+(~330 gens/sec) since every per-generation primitive shards on the pop
 axis with no cross-chip traffic except the stats reduction.
 
 ``vs_baseline``: stock-DEAP CPU gens/sec measured on BASELINE config 2
@@ -103,7 +121,10 @@ def run_tpu():
     tb.register("mate", crossover.cx_two_point)
     tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=0.3,
                 indpb=INDPB)
-    tb.register("select", selection.sel_tournament, tournsize=TOURNSIZE)
+    # rastrigin fitness is continuous (ties measure-zero): the rank
+    # tie-break skips the default tie-jitter's extra sort operand
+    tb.register("select", selection.sel_tournament, tournsize=TOURNSIZE,
+                tie_break="rank")
 
     def generation(carry, _):
         key, pop = carry

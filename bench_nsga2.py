@@ -22,8 +22,16 @@ incremental (O(N²) once + O(excess·N) maintenance instead of the round-2
 O(N³)-flavored recompute-per-removal) — so SPEA2 gets measured at the same
 populations as NSGA-II instead of being excluded.
 
+Round-3 verdict follow-up: the named sub-configs get measured.
+``BENCH_PROBLEM=dtlz2`` runs the 3-objective DTLZ2 (12 vars, the standard
+nobj + k - 1 with k=10; reference benchmarks/__init__.py:523) instead of
+ZDT1, and ``BENCH_SELECT=nsga3`` swaps in ``sel_nsga3`` with Das-Dennis
+reference points (reference emo.py:479-561) — p=12 divisions at nobj=3
+(91 lines), p=99 at nobj=2 (100 lines).
+
 Env overrides: BENCH_POP (default 100_000), BENCH_NGEN (3 timed gens),
-BENCH_SELECT (nsga2 | spea2).
+BENCH_SELECT (nsga2 | nsga3 | spea2), BENCH_PROBLEM (zdt1 | dtlz2),
+BENCH_ND (auto | peel | grid — the nondominated-sort method).
 """
 
 import json
@@ -34,11 +42,17 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 POP = int(os.environ.get("BENCH_POP", 100_000))
-NDIM = 30
+PROBLEM = os.environ.get("BENCH_PROBLEM", "zdt1")
+if PROBLEM not in ("zdt1", "dtlz2"):
+    raise SystemExit(f"BENCH_PROBLEM={PROBLEM!r}: expected 'zdt1' or 'dtlz2'")
+NOBJ = 2 if PROBLEM == "zdt1" else 3
+NDIM = 30 if PROBLEM == "zdt1" else 12        # dtlz2: nobj + k - 1, k = 10
 NGEN = int(os.environ.get("BENCH_NGEN", 3))
 SELECT = os.environ.get("BENCH_SELECT", "nsga2")
-if SELECT not in ("nsga2", "spea2"):
-    raise SystemExit(f"BENCH_SELECT={SELECT!r}: expected 'nsga2' or 'spea2'")
+ND = os.environ.get("BENCH_ND", "auto")
+if SELECT not in ("nsga2", "nsga3", "spea2"):
+    raise SystemExit(f"BENCH_SELECT={SELECT!r}: expected 'nsga2', 'nsga3' "
+                     "or 'spea2'")
 # spea2 peak memory is O(chunk * 2*POP) per pairwise block (distances +
 # top_k values/indices); the default chunk overflows HBM at POP=1e5 on a
 # 16 GB chip (observed worker crash) - scale it down with population
@@ -59,12 +73,17 @@ def run_tpu():
     from deap_tpu.ops import crossover, mutation, emo
 
     tb = base.Toolbox()
-    tb.register("evaluate", benchmarks.zdt1)
+    if PROBLEM == "zdt1":
+        tb.register("evaluate", benchmarks.zdt1)
+    else:
+        tb.register("evaluate", benchmarks.dtlz2, obj=NOBJ)
     tb.register("mate", crossover.cx_simulated_binary_bounded,
                 low=0.0, up=1.0, eta=20.0)
     tb.register("mutate", mutation.mut_polynomial_bounded,
                 low=0.0, up=1.0, eta=20.0, indpb=1.0 / NDIM)
-    weights = (-1.0, -1.0)
+    weights = (-1.0,) * NOBJ
+    ref_points = (jnp.asarray(emo.uniform_reference_points(
+        NOBJ, 12 if NOBJ == 3 else 99)) if SELECT == "nsga3" else None)
 
     def generation(carry, _):
         key, pop = carry
@@ -76,8 +95,10 @@ def run_tpu():
         pool = pop.concat(off)
         if SELECT == "spea2":
             sel = emo.sel_spea2(k_sel, pool.fitness, POP, chunk=CHUNK)
+        elif SELECT == "nsga3":
+            sel = emo.sel_nsga3(k_sel, pool.fitness, POP, ref_points)
         else:
-            sel = emo.sel_nsga2(k_sel, pool.fitness, POP)
+            sel = emo.sel_nsga2(k_sel, pool.fitness, POP, nd=ND)
         new = pool.take(sel)
         return (key, new), jnp.min(new.fitness.values[:, 0])
 
@@ -114,7 +135,7 @@ def measured_baseline():
     try:
         with open(path) as f:
             measured = json.load(f).get("measured", {})
-        gps4k = measured[f"{SELECT}_zdt1_pop4000_gens_per_sec_serial"]
+        gps4k = measured[f"{SELECT}_{PROBLEM}_pop4000_gens_per_sec_serial"]
     except (OSError, KeyError, ValueError):
         return None
     return gps4k / (POP / 4000) ** 2      # conservative quadratic scaling
@@ -126,7 +147,7 @@ def main():
     baseline = measured_baseline()
     vs = (gens_per_sec / baseline) if (baseline and linear_ok) else -1.0
     print(json.dumps({
-        "metric": f"{SELECT}_zdt1_pop{POP}_gens_per_sec",
+        "metric": f"{SELECT}_{PROBLEM}_pop{POP}_gens_per_sec",
         "value": round(gens_per_sec, 4) if linear_ok else -1,
         "unit": "generations/sec",
         "vs_baseline": round(vs, 1),

@@ -311,10 +311,15 @@ def sel_nsga2(key, fitness, k, nd="standard"):
     """NSGA-II selection (reference selNSGA2, emo.py:15-50): whole Pareto
     fronts in order, the split front truncated by descending crowding
     distance.  Implemented as one composite sort by (rank asc, crowding
-    desc).  ``key`` unused (deterministic, like the reference)."""
-    del key, nd
+    desc).  ``key`` unused (deterministic, like the reference).
+
+    ``nd``: the reference's ``'standard'``/``'log'`` both map to the
+    measured-best method per shape (``method="auto"``); any
+    :func:`nondominated_ranks` method name is also accepted directly."""
+    del key
+    method = "auto" if nd in ("standard", "log") else nd
     w, values = _wv_values(fitness)
-    ranks, _ = nondominated_ranks(w)
+    ranks, _ = nondominated_ranks(w, method=method)
     dist = assign_crowding_dist(values, ranks)
     order = jnp.lexsort((-dist, ranks))
     return order[:k]

@@ -177,6 +177,26 @@ def probe_grow():
     variant("xla_grow_pib_d100_bf16", DIM, jnp.bfloat16,
             "promise_in_bounds")
 
+    # monotone (sorted, with repeats) row gather — the access pattern of
+    # the rank-expansion trick: selection by sorted order statistics reads
+    # a rank-ordered genome near-sequentially
+    genome = jax.random.uniform(kg, (POP, LANE), jnp.float32)
+    sidx = jnp.sort(jax.random.randint(ki, (POP,), 0, POP, jnp.int32))
+
+    def make_sorted(n):
+        def body(c, _):
+            g, p = c
+            rows = g.at[p].get(mode="promise_in_bounds",
+                               indices_are_sorted=True)
+            # perturb without disturbing sortedness: shift all by one
+            p2 = jnp.minimum(p + 1 + (rows[:, 0] > 2.0), POP - 1)
+            return (rows, p2), rows[0, 0]
+        return lambda x: lax.scan(body, x, None, length=n)
+
+    sec, r = marginal(make_sorted, (genome, sidx))
+    report("xla_grow_sorted_d128", sec, r,
+           eff_gbps=round(POP * LANE * 4 * 2 / 1e9 / sec, 1))
+
 
 def rastrigin_rows(x):
     return 10.0 * x.shape[-1] + jnp.sum(

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Stage profile of the GP symbreg generation at the bench shape
+(pop=4096, cap=64, 1024 points): which of selection / tree-gather /
+crossover / generator / mutation / evaluation owns the ~13-15 ms.
+
+Uses the same scan-marginal timing as tools/pallas_probe_ga.py (results
+feed the round-4 decision of what to move into a Pallas kernel).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pallas_probe_ga import marginal, report
+
+POP, CAP, NPOINTS = 4096, 64, 1024
+K = 64
+
+
+def main():
+    from deap_tpu import base, gp
+    from deap_tpu.ops import selection
+
+    ps = gp.PrimitiveSet("MAIN", 1)
+    ps.add_primitive(jnp.add, 2, name="add")
+    ps.add_primitive(jnp.subtract, 2, name="sub")
+    ps.add_primitive(jnp.multiply, 2, name="mul")
+    ps.add_primitive(gp.protected_div, 2, name="div")
+    ps.add_primitive(jnp.negative, 1, name="neg")
+    ps.add_primitive(jnp.cos, 1, name="cos")
+    ps.add_primitive(jnp.sin, 1, name="sin")
+    ps.add_ephemeral_constant(
+        "rand101",
+        lambda key: jax.random.randint(key, (), -1, 2).astype(jnp.float32))
+
+    gen_init = gp.make_generator(ps, CAP, "half_and_half")
+    gen_mut = gp.make_generator(ps, CAP, "full")
+    pop_ev = gp.make_population_evaluator(ps, CAP)
+    X = jnp.linspace(-1, 1, NPOINTS, dtype=jnp.float32)[None, :]
+
+    key = jax.random.PRNGKey(0)
+    keys = jax.random.split(key, POP)
+    codes, consts, lengths = jax.vmap(lambda k: gen_init(k, 1, 3))(keys)
+    fit = jax.random.uniform(key, (POP, 1))
+
+    # -- selection ---------------------------------------------------------
+    def make_sel(n):
+        def body(c, i):
+            k = jax.random.fold_in(key, i)
+            idx = selection.sel_tournament(k, c, POP, tournsize=3)
+            return c + 1e-9 * idx[0], idx[0]
+        return lambda f: lax.scan(body, f, jnp.arange(n))
+    sec, r = marginal(make_sel, fit, k=K)
+    report("gp_sel_tournament", sec, r)
+
+    # -- tree gather by selection indices ---------------------------------
+    def make_gather(n):
+        def body(c, i):
+            cds, cst, ln = c
+            k = jax.random.fold_in(key, i)
+            idx = jax.random.randint(k, (POP,), 0, POP)
+            out = (cds[idx], cst[idx], ln[idx])
+            return out, out[2][0]
+        return lambda c: lax.scan(body, c, jnp.arange(n))
+    sec, r = marginal(make_gather, (codes, consts, lengths), k=K)
+    report("gp_tree_gather", sec, r)
+
+    # -- crossover (2048 pairs, vmapped) -----------------------------------
+    n2 = POP // 2
+    cx = jax.vmap(lambda k, a1, a2, a3, b1, b2, b3:
+                  gp.cx_one_point(k, (a1, a2, a3), (b1, b2, b3), ps))
+
+    def make_cx(n):
+        def body(c, i):
+            cds, cst, ln = c
+            ks = jax.random.split(jax.random.fold_in(key, i), n2)
+            (t1, t2) = cx(ks, cds[:n2], cst[:n2], ln[:n2],
+                          cds[n2:], cst[n2:], ln[n2:])
+            out = tuple(jnp.concatenate([a, b]) for a, b in zip(t1, t2))
+            return out, out[2][0]
+        return lambda c: lax.scan(body, c, jnp.arange(n))
+    sec, r = marginal(make_cx, (codes, consts, lengths), k=K)
+    report("gp_cx_one_point", sec, r)
+
+    # -- generator alone (4096 trees) --------------------------------------
+    def make_gen(n):
+        def body(s, i):
+            ks = jax.random.split(jax.random.fold_in(key, i), POP)
+            c, k2, l = jax.vmap(lambda kk: gen_mut(kk, 0, 2))(ks)
+            return s + l[0], l[0]
+        return lambda s: lax.scan(body, s, jnp.arange(n))
+    sec, r = marginal(make_gen, jnp.int32(0), k=K)
+    report("gp_generator_full02", sec, r)
+
+    # -- mutation (incl generator, 4096 trees) -----------------------------
+    mut = jax.vmap(lambda k, a1, a2, a3: gp.mut_uniform(
+        k, (a1, a2, a3), lambda kk: gen_mut(kk, 0, 2), ps))
+
+    def make_mut(n):
+        def body(c, i):
+            cds, cst, ln = c
+            ks = jax.random.split(jax.random.fold_in(key, i), POP)
+            out = mut(ks, cds, cst, ln)
+            return out, out[2][0]
+        return lambda c: lax.scan(body, c, jnp.arange(n))
+    sec, r = marginal(make_mut, (codes, consts, lengths), k=K)
+    report("gp_mut_uniform_incl_gen", sec, r)
+
+    # -- evaluation (Pallas) -----------------------------------------------
+    def make_ev(n):
+        def body(c, i):
+            cds, cst, ln = c
+            out = pop_ev(cds, cst, ln, X)
+            mse = jnp.mean(out * out, axis=1)
+            ln2 = jnp.where(mse[0] > -1.0, ln, ln)      # data dependence
+            return (cds, cst, ln2), mse[0]
+        return lambda c: lax.scan(body, c, jnp.arange(n))
+    sec, r = marginal(make_ev, (codes, consts, lengths), k=K)
+    report("gp_eval_pallas", sec, r)
+
+
+if __name__ == "__main__":
+    print(json.dumps({"platform": jax.devices()[0].platform}), flush=True)
+    main()
