@@ -31,7 +31,9 @@ reference points (reference emo.py:479-561) — p=12 divisions at nobj=3
 
 Env overrides: BENCH_POP (default 100_000), BENCH_NGEN (3 timed gens),
 BENCH_SELECT (nsga2 | nsga3 | spea2), BENCH_PROBLEM (zdt1 | dtlz2),
-BENCH_ND (auto | peel | grid — the nondominated-sort method).
+BENCH_ND (auto | peel | staircase | sweep2d | grid — the
+nondominated-sort method passed through ``sel_nsga2``; validated at
+startup).
 """
 
 import json
@@ -53,6 +55,12 @@ ND = os.environ.get("BENCH_ND", "auto")
 if SELECT not in ("nsga2", "nsga3", "spea2"):
     raise SystemExit(f"BENCH_SELECT={SELECT!r}: expected 'nsga2', 'nsga3' "
                      "or 'spea2'")
+if ND not in ("auto", "peel", "staircase", "sweep2d", "grid"):
+    raise SystemExit(f"BENCH_ND={ND!r}: expected 'auto', 'peel', "
+                     "'staircase', 'sweep2d' or 'grid'")
+if ND in ("staircase", "sweep2d") and NOBJ != 2:
+    raise SystemExit(f"BENCH_ND={ND!r} requires a 2-objective problem "
+                     f"(BENCH_PROBLEM={PROBLEM!r} has {NOBJ})")
 # spea2 peak memory is O(chunk * 2*POP) per pairwise block (distances +
 # top_k values/indices); the default chunk overflows HBM at POP=1e5 on a
 # 16 GB chip (observed worker crash) - scale it down with population

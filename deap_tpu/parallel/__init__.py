@@ -11,7 +11,8 @@ propagation without further user action.
 
 from .mapper import (tpu_map, default_mesh, shard_population,
                      population_sharding)  # noqa: F401
-from .islands import ea_simple_islands  # noqa: F401
+from .islands import (ea_simple_islands, stack_populations,
+                      unstack_populations)  # noqa: F401
 from .multihost import (initialize_cluster, cluster_mesh,
                         distribute_population, fetch_global,
                         process_index, process_count)  # noqa: F401

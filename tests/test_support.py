@@ -482,3 +482,58 @@ def test_sharded_checkpoint_exact_resume_sharded_ea(tmp_path):
                                   np.asarray(ref_pop.genome))
     np.testing.assert_array_equal(np.asarray(out.fitness.values),
                                   np.asarray(ref_pop.fitness.values))
+
+
+def test_grid_ranks_match_peel():
+    """The grid dominator counts (histogram + slab bands + tie window)
+    must reproduce the exact count-peel partition on every tricky nobj>=3
+    regime: random continuous, exact duplicates, single-coordinate ties
+    (discrete values), one antichain, deep chains, invalid rows, and
+    nobj=4."""
+    from deap_tpu.ops.emo import _grid_dominator_counts, _dominator_counts
+    rng = np.random.default_rng(7)
+    t = np.arange(120.0)
+    cases = [
+        rng.normal(size=(300, 3)),
+        np.repeat(rng.normal(size=(40, 3)), 3, axis=0),      # duplicates
+        rng.integers(0, 6, size=(250, 3)).astype(float),     # heavy ties
+        np.stack([t, -t, rng.normal(size=120)], 1),          # wide front
+        np.stack([t, t, t], 1),                              # F = N chain
+        np.concatenate([rng.normal(size=(60, 3)),
+                        np.full((6, 3), -np.inf)], 0),       # invalid rows
+        rng.normal(size=(200, 4)),                           # nobj = 4
+        rng.integers(0, 3, size=(150, 4)).astype(float),     # 4-obj ties
+    ]
+    for w in cases:
+        w = jnp.asarray(np.asarray(w, np.float32))
+        r_peel, nf_peel = jax.jit(
+            lambda w: nondominated_ranks(w, method="peel"))(w)
+        r_g, nf_g = jax.jit(
+            lambda w: nondominated_ranks(w, method="grid"))(w)
+        np.testing.assert_array_equal(np.asarray(r_g), np.asarray(r_peel))
+        assert int(nf_g) == int(nf_peel)
+        # the counts themselves (not just the partition) must agree when
+        # the tie window suffices
+        cnt, ok = jax.jit(_grid_dominator_counts)(w)
+        ref = jax.jit(lambda w: _dominator_counts(
+            w, jnp.ones((w.shape[0],), bool)))(w)
+        if bool(ok):
+            np.testing.assert_array_equal(np.asarray(cnt), np.asarray(ref))
+
+
+def test_grid_tie_overflow_falls_back():
+    """> tie_window repeats of one objective value must trip exact_ok and
+    the lax.cond fallback, keeping the partition exact."""
+    from deap_tpu.ops.emo import _grid_dominator_counts
+    rng = np.random.default_rng(3)
+    w = np.stack([np.zeros(200),                 # 200-way tie > window 64
+                  rng.normal(size=200),
+                  rng.normal(size=200)], 1).astype(np.float32)
+    w = jnp.asarray(w)
+    _, ok = jax.jit(_grid_dominator_counts)(w)
+    assert not bool(ok)
+    r_peel, nf_p = jax.jit(
+        lambda w: nondominated_ranks(w, method="peel"))(w)
+    r_g, nf_g = jax.jit(lambda w: nondominated_ranks(w, method="grid"))(w)
+    np.testing.assert_array_equal(np.asarray(r_g), np.asarray(r_peel))
+    assert int(nf_g) == int(nf_p)
