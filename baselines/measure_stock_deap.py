@@ -65,6 +65,8 @@ creator.create("FitnessMin", base.Fitness, weights=(-1.0,))
 creator.create("IndMin", list, fitness=creator.FitnessMin)
 creator.create("FitnessMO", base.Fitness, weights=(-1.0, -1.0))
 creator.create("IndMO", list, fitness=creator.FitnessMO)
+creator.create("FitnessMO3", base.Fitness, weights=(-1.0, -1.0, -1.0))
+creator.create("IndMO3", list, fitness=creator.FitnessMO3)
 
 
 def eval_onemax(ind):
@@ -81,6 +83,10 @@ def eval_sphere(ind):
 
 def eval_zdt1(ind):
     return benchmarks.zdt1(ind)
+
+
+def eval_dtlz2(ind):
+    return benchmarks.dtlz2(ind, 3)
 
 
 def timed_gens(loop, ngen):
@@ -142,31 +148,47 @@ def config3_cmaes():
     return run
 
 
-def config4_nsga2(pop_size):
+def config4_nsga2(pop_size, problem="zdt1", select="nsga2"):
+    """ZDT1 (2-obj, 30 vars) or DTLZ2 (3-obj, 12 vars) through the
+    reference's own selNSGA2/selNSGA3 — the BASELINE config-4 named
+    sub-configs (round-3 verdict item 3)."""
     random.seed(4)
+    ndim = 30 if problem == "zdt1" else 12
+    ind_cls = creator.IndMO if problem == "zdt1" else creator.IndMO3
     tb = base.Toolbox()
     tb.register("attr", random.random)
-    tb.register("individual", tools.initRepeat, creator.IndMO, tb.attr, 30)
+    tb.register("individual", tools.initRepeat, ind_cls, tb.attr, ndim)
     tb.register("population", tools.initRepeat, list, tb.individual)
-    tb.register("evaluate", eval_zdt1)
+    tb.register("evaluate", eval_zdt1 if problem == "zdt1" else eval_dtlz2)
     tb.register("mate", tools.cxSimulatedBinaryBounded, low=0.0, up=1.0,
                 eta=20.0)
     tb.register("mutate", tools.mutPolynomialBounded, low=0.0, up=1.0,
-                eta=20.0, indpb=1.0 / 30)
-    tb.register("select", tools.selNSGA2)
+                eta=20.0, indpb=1.0 / ndim)
+    if select == "nsga3":
+        nobj = 2 if problem == "zdt1" else 3
+        ref = tools.uniform_reference_points(nobj, 12 if nobj == 3 else 99)
+        tb.register("select", tools.selNSGA3, ref_points=ref)
+    else:
+        tb.register("select", tools.selNSGA2)
     pop = tb.population(n=pop_size)
     for ind, fit in zip(pop, map(tb.evaluate, pop)):
         ind.fitness.values = fit
-    pop = tb.select(pop, len(pop))
+    if select == "nsga2":
+        pop = tb.select(pop, len(pop))    # assigns crowding_dist for DCD
 
     def run(ngen):
         nonlocal pop
         for _ in range(ngen):
-            offspring = tools.selTournamentDCD(pop, len(pop))
+            if select == "nsga2":
+                offspring = tools.selTournamentDCD(pop, len(pop))
+            else:
+                # reference examples/nsga3.py: varAnd over the population
+                # (selNSGA3 assigns no crowding_dist, so no DCD tournament)
+                offspring = pop
             # clone preserving fitness (reference toolbox.clone = deepcopy),
             # so varAnd's invalidation decides who gets re-evaluated
             offspring = [tb.clone(ind) for ind in offspring]
-            offspring = algorithms.varAnd(offspring, tb, 0.9, 1.0 / 30)
+            offspring = algorithms.varAnd(offspring, tb, 0.9, 1.0 / ndim)
             invalid = [ind for ind in offspring if not ind.fitness.valid]
             for ind, fit in zip(invalid, map(tb.evaluate, invalid)):
                 ind.fitness.values = fit
@@ -324,8 +346,8 @@ def config6_spea2(pop_size):
 
 
 def main():
-    known = {"onemax", "rastrigin", "cmaes", "nsga2", "gp", "spea2",
-             "evopole"}
+    known = {"onemax", "rastrigin", "cmaes", "nsga2", "dtlz2", "gp",
+             "spea2", "evopole"}
     subset = set(sys.argv[1:]) or known
     unknown = subset - known
     if unknown:
@@ -358,6 +380,14 @@ def main():
             "stock sortNondominated is O(N^2); pop=100k would need ~10^10 "
             "dominance comparisons per generation (hours/gen) — measured at "
             "1k/4k instead; observed scaling recorded by the two sizes")
+
+    if "dtlz2" in subset:
+        for pop in (1000, 4000):
+            results["nsga2_dtlz2_pop%d_gens_per_sec_serial" % pop] = round(
+                timed_gens(config4_nsga2(pop, problem="dtlz2"), 3), 4)
+            results["nsga3_dtlz2_pop%d_gens_per_sec_serial" % pop] = round(
+                timed_gens(config4_nsga2(pop, problem="dtlz2",
+                                         select="nsga3"), 3), 4)
 
     if "gp" in subset:
         results["gp_symbreg_pop4096_pts1024_gens_per_sec_serial"] = round(

@@ -65,6 +65,18 @@ def _marginal(run, args, ngen):
     return (times[2 * ngen] - times[ngen]) / ngen, times[2 * ngen] / times[ngen]
 
 
+def _marginal_gated(run, args, ngen, max_ngen=512):
+    """Round-3 verdict: a measurement whose own linearity gate fails is an
+    artifact, not evidence — double NGEN until t(2N)/t(N) lands in
+    [1.5, 2.7] (fixed overhead no longer dominates) or the cap is hit.
+    Returns (marginal, ratio, ngen_used)."""
+    while True:
+        m, r = _marginal(run, args, ngen)
+        if 1.5 <= r <= 2.7 or 2 * ngen > max_ngen:
+            return m, r, ngen
+        ngen *= 2
+
+
 def measure(layout: str, n_dev: int):
     import numpy as np
     import jax
@@ -80,7 +92,8 @@ def measure(layout: str, n_dev: int):
     tb.register("evaluate", benchmarks.rastrigin)
     tb.register("mate", crossover.cx_two_point)
     tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=0.3, indpb=0.05)
-    tb.register("select", selection.sel_tournament, tournsize=3)
+    tb.register("select", selection.sel_tournament, tournsize=3,
+                tie_break="rank")             # continuous fitness, as bench.py
 
     key = jax.random.PRNGKey(0)
     mesh = Mesh(np.array(jax.devices()[:n_dev]), ("d",))
@@ -113,8 +126,8 @@ def measure(layout: str, n_dev: int):
 
         args = (key, genome, fv0)
         txt = run(NGEN).lower(*args).compile().as_text()
-        marginal, ratio = _marginal(run, args, NGEN)
-        return marginal, ratio, _collective_counts(txt)
+        marginal, ratio, used = _marginal_gated(run, args, NGEN)
+        return marginal, ratio, used, _collective_counts(txt)
 
     # island layout: one deme per device, ring migration each generation
     sh = NamedSharding(mesh, P("d"))
@@ -156,8 +169,8 @@ def measure(layout: str, n_dev: int):
 
     args = (key, genome, fv0, valid0)
     txt = run(NGEN).lower(*args).compile().as_text()
-    marginal, ratio = _marginal(run, args, NGEN)
-    return marginal, ratio, _collective_counts(txt)
+    marginal, ratio, used = _marginal_gated(run, args, NGEN)
+    return marginal, ratio, used, _collective_counts(txt)
 
 
 def main():
@@ -173,13 +186,15 @@ def main():
                     "tN/(N*t1) isolates sharding-added work/communication"),
            "layouts": {}}
     for layout in ("pop", "island"):
-        t1, r1, _ = measure(layout, 1)
-        tn, rn, colls = measure(layout, N_DEV)
+        t1, r1, n1, _ = measure(layout, 1)
+        tn, rn, nn, colls = measure(layout, N_DEV)
+        ok = (1.5 <= r1 <= 2.7) and (1.5 <= rn <= 2.7)
         out["layouts"][layout] = {
             "t1_per_gen_ms": round(t1 * 1e3, 2),
             f"t{N_DEV}_per_gen_ms": round(tn * 1e3, 2),
-            "overhead_factor": round(tn / (N_DEV * t1), 3),
-            "timing_linearity": {"t1": round(r1, 2), f"t{N_DEV}": round(rn, 2)},
+            "overhead_factor": round(tn / (N_DEV * t1), 3) if ok else -1,
+            "timing_linearity": {"t1": round(r1, 2), f"t{N_DEV}": round(rn, 2),
+                                 "ngen_used": [n1, nn], "ok": ok},
             "collectives_in_hlo": colls,
         }
     print(json.dumps(out))

@@ -94,28 +94,37 @@ def make_population_evaluator_pallas(pset, cap: int, *,
     tb = _round_up(block_trees, 8)
 
     def step_branch(node):
-        """Per-opcode branch: pop arity args, apply, push result.  All
-        shapes static inside the branch — only ``sp``/row indices are
-        dynamic scalars."""
+        """Per-opcode branch with the stack TOP carried as a loop value
+        (measured round 4: the naive all-through-VMEM form spends most of
+        its ~60 cycles/token on stack row loads/stores; keeping
+        ``stack[sp-1]`` in the carry makes binary ops one row-read, unary
+        ops zero, pushes one row-write).  Invariant: ``top`` holds
+        ``stack[sp-1]``; VMEM rows ``0..sp-2`` hold the rest.  All shapes
+        static inside a branch — only ``sp``/row indices are dynamic
+        scalars."""
         if isinstance(node, Primitive):
             k, fn = node.arity, node.func
 
-            def branch(sp, const, stack_ref, x_ref):
-                args = [stack_ref[sp - 1 - j, :] for j in range(k)]
-                stack_ref[sp - k, :] = fn(*args)
-                return sp - k + 1
+            def branch(sp, top, const, stack_ref, x_ref):
+                args = [top] + [stack_ref[sp - 2 - j, :]
+                                for j in range(k - 1)]
+                return sp - k + 1, fn(*args)
         elif isinstance(node, Argument):
             ai = node.index
 
-            def branch(sp, const, stack_ref, x_ref):
-                stack_ref[sp, :] = x_ref[ai, :]
-                return sp + 1
+            def branch(sp, top, const, stack_ref, x_ref):
+                # push: spill the old top.  At sp == 0 the clamped row-0
+                # write stores an uninitialized top, but every read of a
+                # row happens only after the push that brought sp past it
+                # rewrote it — see the invariant above.
+                stack_ref[jnp.maximum(sp - 1, 0), :] = top
+                return sp + 1, x_ref[ai, :]
         else:                       # Terminal / Ephemeral: stored constant
 
-            def branch(sp, const, stack_ref, x_ref):
-                stack_ref[sp, :] = jnp.full(
-                    (stack_ref.shape[1],), const, stack_ref.dtype)
-                return sp + 1
+            def branch(sp, top, const, stack_ref, x_ref):
+                stack_ref[jnp.maximum(sp - 1, 0), :] = top
+                return sp + 1, jnp.full((stack_ref.shape[1],), const,
+                                        stack_ref.dtype)
         return branch
 
     branches = [step_branch(n) for n in nodes]
@@ -125,17 +134,20 @@ def make_population_evaluator_pallas(pset, cap: int, *,
         def tree_body(i, _):
             length = lengths_ref[i, 0]
 
-            def step(t_rev, sp):
+            def step(t_rev, carry):
+                sp, top = carry
                 t = length - 1 - t_rev
                 c = codes_ref[i, t]
                 const = consts_ref[i, t]
                 return lax.switch(
                     c, [functools.partial(b, stack_ref=stack_ref,
                                           x_ref=x_ref) for b in branches],
-                    sp, const)
+                    sp, top, const)
 
-            lax.fori_loop(0, length, step, 0, unroll=False)
-            out_ref[i, :] = stack_ref[0, :]
+            top0 = jnp.zeros((stack_ref.shape[1],), stack_ref.dtype)
+            _, top = lax.fori_loop(0, length, step, (0, top0),
+                                   unroll=False)
+            out_ref[i, :] = top
             return 0
 
         lax.fori_loop(0, tb, tree_body, 0, unroll=False)

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Kernel-mix fault probe + workaround search (axon TPU backend).
+
+Round-3 finding (docs/performance.md "Backend caveats"): ONE compiled
+program combining TWO dominance-counting chunked scans with ONE wide
+``top_k``/row-sort kernel deterministically crashes the TPU worker at
+n = 2·10⁵ — the SPEA2 shape.  Every pair of those pieces works; 3-4
+dominance scans alone work; order/fusion/chunk size don't matter.
+
+This probe reproduces the shape and tests the two workaround candidates
+the round-3 verdict asked for (split/narrow the top_k):
+
+  base     the faulting shape: 2 dominance scans + one (chunk, n) top_k
+           (EXPECT worker crash at n=2e5 — run it LAST, it wedges the
+           tunnel for minutes)
+  blocked  the same program with the kth-smallest distance computed by
+           column-blocked partial top_k: per 8192-wide block take the
+           (kth+1) smallest, then reduce the (chunk, nblocks*(kth+1))
+           candidate matrix — every top_k is ≥18x narrower at n=2e5
+  bisect   no top_k at all: kth smallest per row by 24 rounds of
+           binary search on the f32 distance bits (count-below passes)
+
+Exactness: both variants compute the identical kth distance (blocked:
+the global kth+1 smallest are a subset of the per-block kth+1 smallest;
+bisect: f32 ordering == sign-adjusted int ordering, converging to the
+exact bit pattern).  Verified against plain top_k at small n where the
+base shape is safe.
+
+Usage: python tools/kernelmix_probe.py blocked bisect [base]  [N]
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def kth_topk(d2, kth):
+    neg, _ = lax.top_k(-d2, kth + 1)
+    return -neg[:, kth]
+
+
+def kth_blocked(d2, kth, block=8192):
+    c, n = d2.shape
+    padn = (-n) % block
+    d2p = jnp.concatenate(
+        [d2, jnp.full((c, padn), jnp.inf, d2.dtype)], 1)
+    blocks = d2p.reshape(c, -1, block)
+    kk = min(kth + 1, block)
+    neg, _ = lax.top_k(-blocks, kk)          # (c, nb, kk) block candidates
+    cand = neg.reshape(c, -1)
+    neg2, _ = lax.top_k(cand, kth + 1)
+    return -neg2[:, kth]
+
+
+def kth_bisect(d2, kth, iters=32):
+    """kth smallest per row via binary search on monotone int32 keys
+    (f32 bits with sign fold; distances are >= 0 so the fold is the
+    identity on the used range)."""
+    keys = jax.lax.bitcast_convert_type(d2.astype(jnp.float32), jnp.int32)
+    # nonneg floats: int bits are order-isomorphic already
+    lo = jnp.zeros((d2.shape[0],), jnp.int32)
+    hi = jnp.full((d2.shape[0],), jnp.int32(2147483647))
+
+    def body(_, state):
+        lo, hi = state
+        mid = lo + (hi - lo) // 2
+        cnt = jnp.sum(keys <= mid[:, None], axis=1)
+        take = cnt >= kth + 1
+        return jnp.where(take, lo, mid + 1), jnp.where(take, mid, hi)
+
+    lo, hi = lax.fori_loop(0, iters, body, (lo, hi))
+    return jax.lax.bitcast_convert_type(lo, jnp.float32)
+
+
+def spea2_shape(w, kth_fn, chunk=512):
+    """The faulting program shape: strength+knn scan (dominance + kth
+    kernel), then the raw scan (second dominance)."""
+    n, m = w.shape
+    pad = (-n) % chunk
+    wp = jnp.concatenate([w, jnp.full((pad, m), -jnp.inf, w.dtype)], 0)
+    chunks = wp.reshape(-1, chunk, m)
+    row_ids = jnp.arange(n + pad).reshape(-1, chunk)
+    kth = min(int(np.sqrt(n)), n - 1)
+
+    def dominates(a, b):
+        return jnp.all(a >= b, -1) & jnp.any(a > b, -1)
+
+    def body1(_, blk):
+        wi, ri = blk
+        d = dominates(wi[:, None, :], w[None, :, :])
+        s = jnp.sum(d, 1).astype(w.dtype)
+        d2 = jnp.sum((wi[:, None, :] - w[None, :, :]) ** 2, -1)
+        d2 = jnp.where(ri[:, None] == jnp.arange(n)[None, :], jnp.inf, d2)
+        return None, (s, kth_fn(d2, kth))
+
+    _, (s_blocks, kd_blocks) = lax.scan(body1, None, (chunks, row_ids))
+    strength = s_blocks.reshape(-1)[:n]
+    s_pad = jnp.concatenate([strength, jnp.zeros((pad,), w.dtype)])
+
+    def body2(acc, blk):
+        wi, si = blk
+        d = dominates(wi[:, None, :], w[None, :, :])
+        return acc + si @ d.astype(w.dtype), None
+
+    raw, _ = lax.scan(body2, jnp.zeros((n,), w.dtype),
+                      (chunks, s_pad.reshape(-1, chunk)))
+    return raw + 1.0 / (jnp.sqrt(kd_blocks.reshape(-1)[:n]) + 2.0)
+
+
+FNS = {"base": kth_topk, "blocked": kth_blocked, "bisect": kth_bisect}
+
+
+def main(argv):
+    names = [a for a in argv if a in FNS] or ["blocked", "bisect"]
+    n = next((int(a) for a in argv if a.isdigit()), 200_000)
+    w = jax.random.normal(jax.random.PRNGKey(0), (n, 2))
+
+    # exactness cross-check at a safe size
+    ws = w[:2048]
+    ref = np.asarray(jax.jit(lambda w: spea2_shape(w, kth_topk))(ws))
+    for name in names:
+        got = np.asarray(jax.jit(
+            lambda w, f=FNS[name]: spea2_shape(w, f))(ws))
+        exact = bool(np.allclose(ref, got, rtol=1e-6, atol=1e-6))
+        print(json.dumps({"probe": f"exact_{name}_n2048", "ok": exact}),
+              flush=True)
+
+    for name in names:
+        t0 = time.time()
+        try:
+            out = np.asarray(jax.jit(
+                lambda w, f=FNS[name]: spea2_shape(w, f))(w))
+            print(json.dumps({
+                "probe": f"{name}_n{n}", "ok": True,
+                "sec": round(time.time() - t0, 1),
+                "checksum": float(out.sum())}), flush=True)
+        except Exception as e:
+            print(json.dumps({
+                "probe": f"{name}_n{n}", "ok": False,
+                "error": f"{type(e).__name__}: {str(e)[:200]}"}),
+                flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -51,6 +51,49 @@ def main():
     codes, consts, lengths = jax.vmap(lambda k: gen_init(k, 1, 3))(keys)
     fit = jax.random.uniform(key, (POP, 1))
 
+    # profile at STEADY STATE: evolve 300 generations first so tree
+    # lengths carry the bench's real bloat, not the (1,3)-depth init
+    from deap_tpu import algorithms
+    from deap_tpu.base import Population, Fitness
+
+    tb = base.Toolbox()
+    xs = jnp.linspace(-1, 1, NPOINTS)
+    target = xs ** 4 + xs ** 3 + xs ** 2 + xs      # the bench's quartic
+
+    def evaluate_all(genome):
+        c, k2, l = genome
+        out = pop_ev(c, k2, l, X)
+        mse = jnp.mean((out - target[None, :]) ** 2, axis=1)
+        return jnp.where(jnp.isfinite(mse), mse, 1e6)[:, None]
+
+    tb.register("evaluate_population", evaluate_all)
+    tb.register("mate", lambda k, a, b: gp.cx_one_point(k, a, b, ps))
+    tb.register("mutate", lambda k, t: gp.mut_uniform(
+        k, t, lambda kk: gen_mut(kk, 0, 2), ps))
+    tb.register("select", selection.sel_tournament, tournsize=3)
+
+    def generation(carry, _):
+        k, pop = carry
+        k, k_sel, k_var = jax.random.split(k, 3)
+        idx = tb.select(k_sel, pop.fitness, POP)
+        genome = jax.tree_util.tree_map(lambda x: x[idx], pop.genome)
+        genome, _ = algorithms.vary_genome(k_var, genome, tb, 0.5, 0.1,
+                                           pairing="halves")
+        off = Population(genome, Fitness.empty(POP, (-1.0,)))
+        off, _ = algorithms.evaluate_population(tb, off)
+        return (k, off), 0
+
+    pop0 = Population((codes, consts, lengths), Fitness.empty(POP, (-1.0,)))
+    pop0, _ = algorithms.evaluate_population(tb, pop0)
+    (key, pop_ss), _ = jax.jit(lambda c: lax.scan(generation, c, None,
+                                                  length=300))((key, pop0))
+    codes, consts, lengths = jax.tree_util.tree_map(
+        jnp.asarray, pop_ss.genome)
+    fit = pop_ss.fitness.values
+    import numpy as _np
+    print(json.dumps({"steady_state_mean_len":
+                      float(_np.asarray(lengths).mean())}), flush=True)
+
     # -- selection ---------------------------------------------------------
     def make_sel(n):
         def body(c, i):
