@@ -8,7 +8,9 @@ staircase sweep — across the regimes that stress front depth:
 * ``zdt1``-shaped clouds (nobj=2, shallow fronts — the NSGA-II common case)
 * ``line`` (nobj=2, every point on one dominance chain: F = N fronts, the
   peel's adversarial case the round-2 verdict called out)
-* ``dtlz2``-shaped clouds at nobj=5 (many-objective: few, huge fronts)
+* ``dtlz2``-shaped clouds at nobj=3 and nobj=5 (many-objective: few,
+  huge fronts) — where the round-4 ``grid`` method (histogram + slab
+  bands; see ``_grid_dominator_counts``) competes with the count peel
 
 Prints one JSON object with wall-clock per call (linearity-checked two-size
 timing like bench.py) for each (regime, n, method).  Not driver-run; this
@@ -40,6 +42,9 @@ def make_data(regime: str, n: int, key):
     if regime == "line":
         t = jnp.arange(n, dtype=jnp.float32)
         return jnp.stack([t, t], 1)                   # F = N singleton fronts
+    if regime == "dtlz2_3d":
+        v = jax.random.uniform(key, (n, 3))
+        return -v / jnp.linalg.norm(v, axis=1, keepdims=True)
     if regime == "dtlz2_5d":
         v = jax.random.uniform(key, (n, 5))
         return -v / jnp.linalg.norm(v, axis=1, keepdims=True)
@@ -67,12 +72,21 @@ def main():
 
     results = []
     key = jax.random.PRNGKey(0)
-    for regime in ("zdt1", "line", "dtlz2_5d"):
+    for regime in ("zdt1", "line", "dtlz2_3d", "dtlz2_5d"):
         for n in SIZES:
             w = make_data(regime, n, jax.random.fold_in(key, n))
-            methods = (["peel"] if regime == "dtlz2_5d"
+            methods = (["peel", "grid"] if regime.startswith("dtlz2")
                        else ["staircase", "sweep2d", "peel"])
             for method in methods:
+                if (regime.startswith("dtlz2") and method == "peel"
+                        and n > 20_000):
+                    # the O(MN^2) wall the grid method exists to break:
+                    # ~1e11 pair ops at n=1e5 — measured at 1e4 instead
+                    results.append(dict(regime=regime, n=n, method=method,
+                                        seconds=None,
+                                        note="skipped: projected O(MN^2) "
+                                             "minutes (see n=10000)"))
+                    continue
                 if regime == "line" and method == "peel" and n > 20_000:
                     # O(N^2 * chunk): hours at 1e5 — measured at 1e4 instead
                     results.append(dict(regime=regime, n=n, method=method,

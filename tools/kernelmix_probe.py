@@ -30,8 +30,11 @@ Usage: python tools/kernelmix_probe.py blocked bisect [base]  [N]
 """
 
 import json
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 import jax
@@ -112,7 +115,22 @@ def spea2_shape(w, kth_fn, chunk=512):
     return raw + 1.0 / (jnp.sqrt(kd_blocks.reshape(-1)[:n]) + 2.0)
 
 
-FNS = {"base": kth_topk, "blocked": kth_blocked, "bisect": kth_bisect}
+def kth_reblocked(d2, kth):
+    """The repo's production form: iteratively re-blocked partial top_k
+    (deap_tpu.ops.emo._kth_smallest_blocked) — every top_k ≤ 8192 wide."""
+    from deap_tpu.ops.emo import _kth_smallest_blocked
+    return _kth_smallest_blocked(d2, kth)
+
+
+def kth_none(d2, kth):
+    """Control: no kth at all — row min stands in (NOT the SPEA2 value;
+    isolates whether the two dominance scans alone fault at this n)."""
+    del kth
+    return jnp.min(d2, axis=1)
+
+
+FNS = {"base": kth_topk, "blocked": kth_blocked, "bisect": kth_bisect,
+       "reblocked": kth_reblocked, "nokth": kth_none}
 
 
 def main(argv):
@@ -124,6 +142,8 @@ def main(argv):
     ws = w[:2048]
     ref = np.asarray(jax.jit(lambda w: spea2_shape(w, kth_topk))(ws))
     for name in names:
+        if name == "nokth":
+            continue                    # control variant: not the SPEA2 value
         got = np.asarray(jax.jit(
             lambda w, f=FNS[name]: spea2_shape(w, f))(ws))
         exact = bool(np.allclose(ref, got, rtol=1e-6, atol=1e-6))
