@@ -29,6 +29,14 @@ ZDT1, and ``BENCH_SELECT=nsga3`` swaps in ``sel_nsga3`` with Das-Dennis
 reference points (reference emo.py:479-561) — p=12 divisions at nobj=3
 (91 lines), p=99 at nobj=2 (100 lines).
 
+``BENCH_STAGED=1`` (spea2 only) drives generations from the host with
+the TWO-DISPATCH staged SPEA2 (``sel_spea2_staged``): stage 1 (dominance
+scans + top_k-free bisect kth) and stage 2 (truncation) compile as
+separate programs — the only shape the axon backend runs at pool ≥
+2·10⁵ (tools/kernelmix_probe.py fault map).  Trajectory is identical to
+the scanned form (same law; deterministic selection); the cost is one
+extra dispatch per generation.
+
 Env overrides: BENCH_POP (default 100_000), BENCH_NGEN (3 timed gens),
 BENCH_SELECT (nsga2 | nsga3 | spea2), BENCH_PROBLEM (zdt1 | dtlz2),
 BENCH_ND (auto | peel | staircase | sweep2d | grid — the
@@ -51,6 +59,7 @@ NOBJ = 2 if PROBLEM == "zdt1" else 3
 NDIM = 30 if PROBLEM == "zdt1" else 12        # dtlz2: nobj + k - 1, k = 10
 NGEN = int(os.environ.get("BENCH_NGEN", 3))
 SELECT = os.environ.get("BENCH_SELECT", "nsga2")
+STAGED = os.environ.get("BENCH_STAGED", "0") == "1"
 ND = os.environ.get("BENCH_ND", "auto")
 if SELECT not in ("nsga2", "nsga3", "spea2"):
     raise SystemExit(f"BENCH_SELECT={SELECT!r}: expected 'nsga2', 'nsga3' "
@@ -58,6 +67,8 @@ if SELECT not in ("nsga2", "nsga3", "spea2"):
 if ND not in ("auto", "peel", "staircase", "sweep2d", "grid"):
     raise SystemExit(f"BENCH_ND={ND!r}: expected 'auto', 'peel', "
                      "'staircase', 'sweep2d' or 'grid'")
+if STAGED and SELECT != "spea2":
+    raise SystemExit("BENCH_STAGED=1 requires BENCH_SELECT=spea2")
 if ND in ("staircase", "sweep2d") and NOBJ != 2:
     raise SystemExit(f"BENCH_ND={ND!r} requires a 2-objective problem "
                      f"(BENCH_PROBLEM={PROBLEM!r} has {NOBJ})")
@@ -115,6 +126,37 @@ def run_tpu():
         def run(key, pop):
             return lax.scan(generation, (key, pop), None, length=ngen)
         return run
+
+    if STAGED:
+        from deap_tpu.ops.emo import (_spea2_fitness_stage,
+                                      _spea2_select_stage)
+
+        @jax.jit
+        def stage_a(key, pop):
+            key, k_var = jax.random.split(key)
+            genome, _ = vary_genome(k_var, pop.genome, tb, 0.9, 1.0,
+                                    pairing="halves")
+            off = base.Population(genome, base.Fitness.empty(POP, weights))
+            off, _ = evaluate_population(tb, off)
+            pool = pop.concat(off)
+            w = pool.fitness.masked_wvalues()
+            spea_fit, nondom = _spea2_fitness_stage(w, CHUNK, "bisect")
+            return key, pool, w, spea_fit, nondom
+
+        @jax.jit
+        def stage_b(pool, w, spea_fit, nondom):
+            sel = _spea2_select_stage(w, spea_fit, nondom, POP, CHUNK)
+            new = pool.take(sel)
+            return new, jnp.min(new.fitness.values[:, 0])
+
+        def make_run(ngen):                       # host-driven generations
+            def run(key, pop):
+                best = None
+                for _ in range(ngen):
+                    key, pool, w, f, nd = stage_a(key, pop)
+                    pop, best = stage_b(pool, w, f, nd)
+                return (key, pop), jnp.stack([best])
+            return run
 
     key = jax.random.PRNGKey(0)
     genome = jax.random.uniform(key, (POP, NDIM), jnp.float32)

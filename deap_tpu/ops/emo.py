@@ -28,7 +28,7 @@ __all__ = [
     "nondominated_ranks", "sort_nondominated", "sort_log_nondominated",
     "assign_crowding_dist", "sel_nsga2", "sel_tournament_dcd",
     "uniform_reference_points", "sel_nsga3", "SelNSGA3WithMemory",
-    "sel_spea2",
+    "sel_spea2", "sel_spea2_staged",
 ]
 
 
@@ -814,7 +814,71 @@ def _kth_smallest_blocked(d2, kth, block: int = 8192):
     return vals[:, kth]
 
 
-def sel_spea2(key, fitness, k, chunk: int = 1024):
+def _kth_smallest_bisect(d2, kth, iters: int = 32):
+    """Per-row (kth+1)-smallest of nonnegative ``(c, n)`` distances with
+    NO top_k at all: binary search on the f32 bit pattern (nonnegative
+    floats are order-isomorphic to their int32 bits), ``iters`` counting
+    passes converging to the exact value.  ~10× the arithmetic of one
+    pairwise pass, but the only form probed green alongside the dominance
+    scans at pool = 4·10⁵ on the axon backend (tools/kernelmix_probe.py:
+    scans + ANY-width top_k crash there)."""
+    keys = lax.bitcast_convert_type(d2.astype(jnp.float32), jnp.int32)
+    lo = jnp.zeros((d2.shape[0],), jnp.int32)
+    hi = jnp.full((d2.shape[0],), jnp.iinfo(jnp.int32).max)
+
+    def body(_, state):
+        lo, hi = state
+        mid = lo + (hi - lo) // 2
+        cnt = jnp.sum(keys <= mid[:, None], axis=1)
+        take = cnt >= kth + 1
+        return jnp.where(take, lo, mid + 1), jnp.where(take, mid, hi)
+
+    lo, _ = lax.fori_loop(0, iters, body, (lo, hi))
+    return lax.bitcast_convert_type(lo, jnp.float32)
+
+
+def _spea2_fitness_stage(w, chunk: int, kth_method: str):
+    """SPEA2 stage 1: the two dominance scans + density kth → per-point
+    SPEA2 fitness and the nondominated mask.  Split out so the staged
+    variant can dispatch it as its own program (axon kernel-mix fault)."""
+    n, nobj = w.shape
+    chunks, c, pad = _row_chunks(w, chunk)
+    kth = min(int(np.sqrt(n)), n - 1) if n > 1 else 0
+    row_ids = jnp.arange(n + pad).reshape(-1, c)
+    kth_fn = (_kth_smallest_bisect if kth_method == "bisect"
+              else _kth_smallest_blocked)
+
+    def strength_knn_body(_, block):
+        wi, ri = block
+        d = dominates(wi[:, None, :], w[None, :, :])       # (c, n)
+        strength_blk = jnp.sum(d, axis=1).astype(w.dtype)
+        d2 = jnp.sum((wi[:, None, :] - w[None, :, :]) ** 2, axis=-1)
+        self_pair = ri[:, None] == jnp.arange(n)[None, :]
+        d2 = jnp.where(self_pair, jnp.inf, d2)             # self-distance out
+        return None, (strength_blk, kth_fn(d2, kth))
+
+    _, (s_blocks, kd_blocks) = lax.scan(strength_knn_body, None,
+                                        (chunks, row_ids))
+    strength = s_blocks.reshape(-1)[:n]
+    kth_dist = kd_blocks.reshape(-1)[:n]
+
+    # raw[j] = sum of strengths of j's dominators (reference L707-714):
+    # needs the complete strength vector, hence a second pass
+    s_pad = jnp.concatenate([strength, jnp.zeros((pad,), w.dtype)])
+
+    def raw_body(acc, block):
+        wi, si = block
+        d = dominates(wi[:, None, :], w[None, :, :])       # (c, n)
+        return acc + si @ d.astype(w.dtype), None
+
+    raw, _ = lax.scan(raw_body, jnp.zeros((n,), w.dtype),
+                      (chunks, s_pad.reshape(-1, c)))
+    density = 1.0 / (jnp.sqrt(kth_dist) + 2.0)
+    return raw + density, raw < 1                          # reference L719
+
+
+def sel_spea2(key, fitness, k, chunk: int = 1024,
+              kth_method: str = "blocked"):
     """SPEA2 environmental selection (reference selSPEA2, emo.py:689-805,
     Zitzler 2001): strength/raw fitness from the dominance structure,
     k-NN density, then either fill with best dominated individuals or
@@ -839,65 +903,43 @@ def sel_spea2(key, fitness, k, chunk: int = 1024):
     nearest-list prefix — deeper float-distance ties are probability-zero
     (exact-duplicate clusters may resolve in list order, as the
     reference's own quickselect ties do).  ``key`` unused
-    (deterministic)."""
+    (deterministic).
+
+    ``kth_method``: ``"blocked"`` (default — re-blocked partial top_k) or
+    ``"bisect"`` (top_k-free; see :func:`_kth_smallest_bisect`).  For
+    pool ≥ 2·10⁵ on the axon backend use :func:`sel_spea2_staged`."""
     del key
     w, _ = _wv_values(fitness)
+    spea_fit, nondom = _spea2_fitness_stage(w, chunk, kth_method)
+    return _spea2_select_stage(w, spea_fit, nondom, k, chunk)
+
+
+def sel_spea2_staged(key, fitness, k, chunk: int = 1024):
+    """SPEA2 as TWO separately-jitted dispatches — the pool ≥ 2·10⁵ path
+    on the axon backend, where stage 1's dominance scans and stage 2's
+    (narrow) top_k kernels crash the worker when compiled into ONE
+    program (tools/kernelmix_probe.py fault map).  Stage 1 uses the
+    top_k-free bisect kth.  Host-level only (two dispatches cannot live
+    inside a caller's ``lax.scan``; drive generations from the host, as
+    ``stream_mode="segmented"`` already does for streaming)."""
+    del key
+    w, _ = _wv_values(fitness)
+    spea_fit, nondom = jax.jit(
+        _spea2_fitness_stage, static_argnums=(1, 2))(w, chunk, "bisect")
+    # two jit calls are two XLA programs by construction — no further
+    # separation needed
+    return jax.jit(
+        _spea2_select_stage, static_argnums=(3, 4))(w, spea_fit, nondom,
+                                                    int(k), chunk)
+
+
+def _spea2_select_stage(w, spea_fit, nondom, k, chunk: int = 1024):
+    """SPEA2 stage 2: environmental fill/truncation given per-point
+    fitness (no dominance scans — splittable from stage 1)."""
     n, nobj = w.shape
     chunks, c, pad = _row_chunks(w, chunk)
 
-    # strength[i] = #dominated by i (reference L699-706) and the k-NN
-    # density distance, FUSED into one scan over row blocks — both need
-    # the same (c, n) pairwise structure.
-    #
-    # The kth-smallest distance is computed by COLUMN-BLOCKED partial
-    # top_k (see _kth_smallest_blocked).  Round 3 found that one program
-    # combining two dominance-counting chunked scans with one full-width
-    # (c, n) top_k deterministically crashes the axon TPU worker at
-    # n = 2·10⁵, and concluded the fault could not be programmed around;
-    # round 4's tools/kernelmix_probe.py refuted that: narrowing every
-    # top_k below the block width (or replacing it with a bitwise binary
-    # search) runs the identical program shape at n = 2·10⁵ — and the
-    # blocked form is also measurably faster off-TPU, so it is simply the
-    # default.  The former n ≈ 6·10⁴ cap is lifted.
-    #
-    # Density: kth smallest distance per row.  Deliberate deviation from
-    # the reference: we use the paper form 1/(sqrt(d2_k)+2) (Zitzler 2001
-    # eq. 4) where reference L716-719 uses 1/(d2_k+2) on the *squared*
-    # distance over a quirky half-filled distance vector — same ordering
-    # pressure, different numeric values, so bit-parity with stock DEAP's
-    # dominated-fill order is not expected
-    kth = min(int(np.sqrt(n)), n - 1) if n > 1 else 0
     row_ids = jnp.arange(n + pad).reshape(-1, c)
-
-    def strength_knn_body(_, block):
-        wi, ri = block
-        d = dominates(wi[:, None, :], w[None, :, :])       # (c, n)
-        strength_blk = jnp.sum(d, axis=1).astype(w.dtype)
-        d2 = jnp.sum((wi[:, None, :] - w[None, :, :]) ** 2, axis=-1)
-        self_pair = ri[:, None] == jnp.arange(n)[None, :]
-        d2 = jnp.where(self_pair, jnp.inf, d2)             # self-distance out
-        return None, (strength_blk, _kth_smallest_blocked(d2, kth))
-
-    _, (s_blocks, kd_blocks) = lax.scan(strength_knn_body, None,
-                                        (chunks, row_ids))
-    strength = s_blocks.reshape(-1)[:n]
-    kth_dist = kd_blocks.reshape(-1)[:n]
-
-    # raw[j] = sum of strengths of j's dominators (reference L707-714):
-    # needs the complete strength vector, hence a second pass
-    s_pad = jnp.concatenate([strength, jnp.zeros((pad,), w.dtype)])
-
-    def raw_body(acc, block):
-        wi, si = block
-        d = dominates(wi[:, None, :], w[None, :, :])       # (c, n)
-        return acc + si @ d.astype(w.dtype), None
-
-    raw, _ = lax.scan(raw_body, jnp.zeros((n,), w.dtype),
-                      (chunks, s_pad.reshape(-1, c)))
-    density = 1.0 / (jnp.sqrt(kth_dist) + 2.0)
-    spea_fit = raw + density                               # reference L719
-    nondom = raw < 1
-
     n_nondom = jnp.sum(nondom)
 
     # Case A: too few nondominated → fill with best dominated by spea_fit

@@ -537,3 +537,26 @@ def test_grid_tie_overflow_falls_back():
     r_g, nf_g = jax.jit(lambda w: nondominated_ranks(w, method="grid"))(w)
     np.testing.assert_array_equal(np.asarray(r_g), np.asarray(r_peel))
     assert int(nf_g) == int(nf_p)
+
+
+def test_spea2_staged_matches_single_program():
+    """The two-dispatch staged SPEA2 (axon pool>=2e5 path) must select
+    exactly what the single-program form selects, in both the fill and
+    the truncation regimes, with either kth method."""
+    from deap_tpu.ops.emo import sel_spea2, sel_spea2_staged
+    rng = np.random.default_rng(11)
+    t = np.linspace(0.0, 1.0, 120, dtype=np.float32)
+    cases = [
+        # random cloud, few nondominated -> FILL branch
+        (rng.normal(size=(120, 2)).astype(np.float32), 90),
+        # anti-correlated front, all 120 nondominated -> TRUNCATION branch
+        (np.stack([t, 1.0 - t], 1) + 0.01 * rng.normal(
+            size=(120, 2)).astype(np.float32), 30),
+    ]
+    for w, k in cases:
+        w = jnp.asarray(w)
+        ref = np.asarray(sel_spea2(None, w, k))
+        stg = np.asarray(sel_spea2_staged(None, w, k))
+        np.testing.assert_array_equal(np.sort(ref), np.sort(stg))
+        bis = np.asarray(sel_spea2(None, w, k, kth_method="bisect"))
+        np.testing.assert_array_equal(np.sort(ref), np.sort(bis))
