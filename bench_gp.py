@@ -37,6 +37,7 @@ POP = int(os.environ.get("BENCH_POP", 4096))
 CAP = int(os.environ.get("BENCH_CAP", 64))
 NPOINTS = int(os.environ.get("BENCH_POINTS", 1024))
 NGEN = int(os.environ.get("BENCH_NGEN", 200))
+BLOCK_TREES = int(os.environ.get("BENCH_BLOCK_TREES", 8))
 
 
 def run_tpu():
@@ -67,7 +68,8 @@ def run_tpu():
     X = jnp.linspace(-1, 1, NPOINTS, dtype=jnp.float32)[None, :]
     target = X[0] ** 4 + X[0] ** 3 + X[0] ** 2 + X[0]
 
-    pop_ev = gp.make_population_evaluator(ps, CAP)     # Pallas kernel on TPU
+    pop_ev = gp.make_population_evaluator(
+        ps, CAP, block_trees=BLOCK_TREES)              # Pallas kernel on TPU
     gen_init = gp.make_generator(ps, CAP, "half_and_half")
     gen_mut = gp.make_generator(ps, CAP, "full")
 

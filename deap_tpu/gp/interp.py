@@ -84,7 +84,8 @@ def make_evaluator(pset, cap: int) -> Callable:
 
 
 def make_population_evaluator(pset, cap: int, *,
-                              backend: str = "auto") -> Callable:
+                              backend: str = "auto",
+                              block_trees: int = 8) -> Callable:
     """``evaluate_pop(codes (pop,cap), consts (pop,cap), lengths (pop,), X
     (n_args, n_points)) -> (pop, n_points)``.
 
@@ -94,15 +95,24 @@ def make_population_evaluator(pset, cap: int, *,
     when running on TPU and the pset has a kernel form (no ADF
     placeholders); off-TPU (where the kernel would run in slow interpret
     mode) and for ADF psets it uses the vmapped XLA interpreter.
-    ``backend="xla"`` / ``"pallas"`` force a path."""
+    ``backend="xla"`` / ``"pallas"`` force a path.  ``block_trees`` is
+    the Pallas kernel's trees-per-grid-step (rounded up to a multiple of
+    8; see :func:`make_population_evaluator_pallas` for tuning — ignored
+    on the XLA path)."""
     if backend not in ("auto", "xla", "pallas"):
         raise ValueError(f"unknown backend {backend!r}")
+    if block_trees < 1:
+        # validated HERE, not inside the pallas builder: auto's
+        # ValueError fallback would silently demote a misconfiguration
+        # to the ~3x-slower XLA interpreter
+        raise ValueError(f"block_trees must be >= 1, got {block_trees}")
     use_pallas = (backend == "pallas" or
                   (backend == "auto" and jax.default_backend() == "tpu"))
     if use_pallas:
         try:
             from .interp_pallas import make_population_evaluator_pallas
-            return make_population_evaluator_pallas(pset, cap)
+            return make_population_evaluator_pallas(pset, cap,
+                                                    block_trees=block_trees)
         except ValueError:
             if backend == "pallas":
                 raise
