@@ -478,7 +478,7 @@ def assign_crowding_dist(values: jax.Array, ranks: jax.Array) -> jax.Array:
     return jnp.where(boundary > 0, jnp.inf, dist)
 
 
-def sel_nsga2(key, fitness, k, nd="standard"):
+def sel_nsga2(key, fitness, k, nd="standard", front_chunk: int = 1024):
     """NSGA-II selection (reference selNSGA2, emo.py:15-50): whole Pareto
     fronts in order, the split front truncated by descending crowding
     distance.  Implemented as one composite sort by (rank asc, crowding
@@ -486,11 +486,13 @@ def sel_nsga2(key, fitness, k, nd="standard"):
 
     ``nd``: the reference's ``'standard'``/``'log'`` both map to the
     measured-best method per shape (``method="auto"``); any
-    :func:`nondominated_ranks` method name is also accepted directly."""
+    :func:`nondominated_ranks` method name is also accepted directly.
+    ``front_chunk`` forwards to the peel (bigger chunks = fewer subtract
+    rounds per wide front; the 3-objective large-n knob)."""
     del key
     method = "auto" if nd in ("standard", "log") else nd
     w, values = _wv_values(fitness)
-    ranks, _ = nondominated_ranks(w, method=method)
+    ranks, _ = nondominated_ranks(w, method=method, front_chunk=front_chunk)
     dist = assign_crowding_dist(values, ranks)
     order = jnp.lexsort((-dist, ranks))
     return order[:k]
