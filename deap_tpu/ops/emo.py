@@ -263,7 +263,7 @@ def _nondominated_ranks_2d_sweep(w: jax.Array):
     return ranks, jnp.max(rs) + 1
 
 
-def _nondominated_ranks_2d(w: jax.Array):
+def _nondominated_ranks_2d(w: jax.Array, stop_at_k: int | None = None):
     """Exact 2-objective non-dominated ranks as a *parallel* staircase
     peel: ``n_fronts`` rounds, each one ``lax.associative_scan`` (log-depth
     prefix) instead of n sequential steps.
@@ -295,9 +295,12 @@ def _nondominated_ranks_2d(w: jax.Array):
         ta = (a2 < b2) | ((a2 == b2) & (a1 <= b1))
         return jnp.where(ta, a2, b2), jnp.where(ta, a1, b1)
 
+    stop = n if stop_at_k is None else min(int(stop_at_k), n)
+
     def cond(s):
         ranks_s, _ = s
-        return jnp.any(ranks_s < 0)
+        unranked = jnp.sum(ranks_s < 0)
+        return (unranked > 0) & (n - unranked < stop)
 
     def body(s):
         ranks_s, r = s
@@ -313,12 +316,14 @@ def _nondominated_ranks_2d(w: jax.Array):
 
     ranks_s, nf = lax.while_loop(
         cond, body, (jnp.full((n,), -1, jnp.int32), jnp.int32(0)))
+    ranks_s = jnp.where(ranks_s < 0, n, ranks_s)    # unpeeled tail sentinel
     ranks = jnp.zeros((n,), jnp.int32).at[order].set(ranks_s)
     return ranks, nf
 
 
 def nondominated_ranks(w: jax.Array, valid: jax.Array | None = None,
-                       front_chunk: int = 1024, method: str = "auto"):
+                       front_chunk: int = 1024, method: str = "auto",
+                       stop_at_k: int | None = None):
     """Pareto front index for every individual (0 = first front) — the
     partition of reference ``sortNondominated`` (emo.py:53-117) as a rank
     array.  Returns ``(ranks, n_fronts)``; invalid rows land in the last
@@ -355,7 +360,16 @@ def nondominated_ranks(w: jax.Array, valid: jax.Array | None = None,
     inputs where most points sit on distinct fronts (F ≈ N), the
     staircase peel's F rounds make it ~10× slower than the serial sweep
     at n=10⁵ — callers on such data should pass ``method="sweep2d"``
-    explicitly."""
+    explicitly.
+
+    ``stop_at_k``: stop peeling once ``k`` individuals are ranked (the
+    front containing the k-th is always completed); every unpeeled point
+    gets the sentinel rank ``n``, which sorts after all real ranks.
+    Environmental selection needs nothing deeper — measured round 4 at
+    DTLZ2 pool 2·10⁵ (42 fronts, selection reached in ~8), the full peel
+    was 98% of `sel_nsga2`'s 1.9 s.  ``n_fronts`` becomes the number of
+    fronts actually peeled.  (``sweep2d`` computes all ranks directly
+    and ignores it.)"""
     n, m = w.shape
     if valid is not None:
         w = jnp.where(valid[:, None], w, -jnp.inf)
@@ -366,7 +380,7 @@ def nondominated_ranks(w: jax.Array, valid: jax.Array | None = None,
     if method == "sweep2d":
         return _nondominated_ranks_2d_sweep(w)
     if m == 2 and method in ("auto", "staircase"):
-        return _nondominated_ranks_2d(w)
+        return _nondominated_ranks_2d(w, stop_at_k)
     c = min(front_chunk, n)
     if method == "grid" or (method == "auto" and m >= 3 and n >= 16384):
         # ±inf wvalues break the grid's value comparisons no worse than
@@ -400,9 +414,12 @@ def nondominated_ranks(w: jax.Array, valid: jax.Array | None = None,
         counts, _ = lax.while_loop(sub_cond, sub_body, (counts, todo))
         return counts
 
+    stop = n if stop_at_k is None else min(int(stop_at_k), n)
+
     def cond(state):
         _, _, active, _ = state
-        return jnp.any(active)
+        n_active = jnp.sum(active)
+        return (n_active > 0) & (n - n_active < stop)
 
     def body(state):
         ranks, counts, active, r = state
@@ -418,12 +435,19 @@ def nondominated_ranks(w: jax.Array, valid: jax.Array | None = None,
     return ranks, nf
 
 
+# module-level jitted entry: stable function identity keeps JAX's jit
+# cache warm across host-side per-generation calls (a fresh partial per
+# call would retrace + recompile every time)
+_jit_ranks = jax.jit(nondominated_ranks,
+                     static_argnames=("stop_at_k", "method", "front_chunk"))
+
+
 def sort_nondominated(fitness, k, first_front_only=False):
     """Host-side convenience matching the reference's list-of-fronts return
     (emo.py:53-117): fronts as numpy index arrays covering at least the
     first ``k`` individuals."""
     w, _ = _wv_values(fitness)
-    ranks, nf = jax.jit(nondominated_ranks)(w)
+    ranks, nf = _jit_ranks(w, stop_at_k=int(k))
     ranks = np.asarray(ranks)
     fronts = []
     total = 0
@@ -492,7 +516,8 @@ def sel_nsga2(key, fitness, k, nd="standard", front_chunk: int = 1024):
     del key
     method = "auto" if nd in ("standard", "log") else nd
     w, values = _wv_values(fitness)
-    ranks, _ = nondominated_ranks(w, method=method, front_chunk=front_chunk)
+    ranks, _ = nondominated_ranks(w, method=method, front_chunk=front_chunk,
+                                  stop_at_k=k)
     dist = assign_crowding_dist(values, ranks)
     order = jnp.lexsort((-dist, ranks))
     return order[:k]
@@ -614,7 +639,7 @@ def sel_nsga3(key, fitness, k, ref_points, ideal_override=None,
     w, _ = _wv_values(fitness)
     n = w.shape[0]
     obj = -w                                             # minimization space
-    ranks, _ = nondominated_ranks(w)
+    ranks, _ = nondominated_ranks(w, stop_at_k=k)
 
     # split-front rank L: rank of the k-th individual in rank order
     rank_sorted = jnp.sort(ranks)

@@ -560,3 +560,33 @@ def test_spea2_staged_matches_single_program():
         np.testing.assert_array_equal(np.sort(ref), np.sort(stg))
         bis = np.asarray(sel_spea2(None, w, k, kth_method="bisect"))
         np.testing.assert_array_equal(np.sort(ref), np.sort(bis))
+
+
+def test_stop_at_k_peeling_exact():
+    """Early-stopped peeling must agree with the full partition on every
+    rank up to the cutoff front, give the sentinel n beyond it, and leave
+    sel_nsga2's selection (which drives it) bit-identical."""
+    from deap_tpu.ops.emo import nondominated_ranks, sel_nsga2
+    rng = np.random.default_rng(5)
+    for nobj, method in [(2, "staircase"), (3, "peel"), (3, "grid")]:
+        w = jnp.asarray(rng.normal(size=(400, nobj)).astype(np.float32))
+        k = 120
+        full, _ = jax.jit(lambda w, m=method: nondominated_ranks(
+            w, method=m))(w)
+        part, nf = jax.jit(lambda w, m=method: nondominated_ranks(
+            w, method=m, stop_at_k=k))(w)
+        full, part = np.asarray(full), np.asarray(part)
+        # the fronts actually peeled match the full partition exactly
+        peeled = part < 400
+        assert peeled.sum() >= k
+        np.testing.assert_array_equal(part[peeled], full[peeled])
+        # the peeled set is exactly the first nf full fronts
+        assert set(np.unique(full[peeled])) == set(range(int(nf)))
+        assert np.all(full[~peeled] >= int(nf))
+        # selection BIT-identical with and without the early stop:
+        # rebuild the full-peel pipeline explicitly and compare indices
+        from deap_tpu.ops.emo import assign_crowding_dist
+        dist = jax.jit(assign_crowding_dist)(w, jnp.asarray(full))
+        ref_idx = np.asarray(jnp.lexsort((-dist, jnp.asarray(full)))[:k])
+        i_stop = np.asarray(sel_nsga2(None, w, k))       # uses stop_at_k=k
+        np.testing.assert_array_equal(i_stop, ref_idx)

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Stage profile of the 3-objective NSGA-II generation at DTLZ2
+pop=10⁵ (pool 2·10⁵): grid counts vs peel rounds vs crowding vs
+variation+evaluation — measured on a STEADY-STATE pool (20 generations
+evolved first; front structure, which drives the peel's round count,
+differs wildly between random and evolved populations).
+
+Same scan-marginal timing as tools/pallas_probe_ga.py.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pallas_probe_ga import marginal, report
+
+POP = int(os.environ.get("PROF_POP", 100_000))
+NDIM, NOBJ = 12, 3
+K = int(os.environ.get("PROF_K", 4))
+
+
+def main():
+    from deap_tpu import base, benchmarks
+    from deap_tpu.algorithms import evaluate_population, vary_genome
+    from deap_tpu.ops import crossover, mutation, emo
+    from deap_tpu.ops.emo import (_grid_dominator_counts, _grid_tie_ok,
+                                  nondominated_ranks, assign_crowding_dist,
+                                  sel_nsga2, _wv_values)
+
+    tb = base.Toolbox()
+    tb.register("evaluate", benchmarks.dtlz2, obj=NOBJ)
+    tb.register("mate", crossover.cx_simulated_binary_bounded,
+                low=0.0, up=1.0, eta=20.0)
+    tb.register("mutate", mutation.mut_polynomial_bounded,
+                low=0.0, up=1.0, eta=20.0, indpb=1.0 / NDIM)
+    weights = (-1.0,) * NOBJ
+
+    def generation(carry, _):
+        key, pop = carry
+        key, k_var, k_sel = jax.random.split(key, 3)
+        genome, _ = vary_genome(k_var, pop.genome, tb, 0.9, 1.0,
+                                pairing="halves")
+        off = base.Population(genome, base.Fitness.empty(POP, weights))
+        off, _ = evaluate_population(tb, off)
+        pool = pop.concat(off)
+        sel = emo.sel_nsga2(k_sel, pool.fitness, POP)
+        new = pool.take(sel)
+        return (key, new), jnp.min(new.fitness.values[:, 0])
+
+    key = jax.random.PRNGKey(0)
+    genome = jax.random.uniform(key, (POP, NDIM), jnp.float32)
+    pop = base.Population(genome, base.Fitness.empty(POP, weights))
+    pop, _ = evaluate_population(tb, pop)
+    (key, pop), _ = jax.jit(lambda c: lax.scan(generation, c, None,
+                                               length=20))((key, pop))
+
+    # the steady-state POOL this generation selects from
+    k_var = jax.random.fold_in(key, 1)
+    genome, _ = vary_genome(k_var, pop.genome, tb, 0.9, 1.0,
+                            pairing="halves")
+    off = base.Population(genome, base.Fitness.empty(POP, weights))
+    off, _ = evaluate_population(tb, off)
+    pool = pop.concat(off)
+    w = pool.fitness.masked_wvalues()
+    ranks, nf = jax.jit(nondominated_ranks)(w)
+    print(json.dumps({"pool": int(w.shape[0]),
+                      "n_fronts": int(nf),
+                      "front0": int(jnp.sum(ranks == 0))}), flush=True)
+
+    def perturb(x, out):
+        return x * (1.0 + 1e-12 * (out.astype(jnp.float32) % 3))
+
+    # (a) grid dominator counts alone
+    def make_counts(n):
+        def body(ww, _):
+            cnt, _ = _grid_dominator_counts(ww)
+            return perturb(ww, cnt[0]), cnt[0]
+        return lambda x: lax.scan(body, x, None, length=n)
+    sec, r = marginal(make_counts, w, k=K)
+    report("grid_counts", sec, r)
+
+    # (b) full nondominated ranks (counts + peel rounds)
+    def make_ranks(n):
+        def body(ww, _):
+            rk, _ = nondominated_ranks(ww)
+            return perturb(ww, rk[0]), rk[0]
+        return lambda x: lax.scan(body, x, None, length=n)
+    sec, r = marginal(make_ranks, w, k=K)
+    report("nondominated_ranks_full", sec, r)
+
+    # (c) crowding given ranks
+    vals = pool.fitness.values
+
+    def make_crowd(n):
+        def body(c, _):
+            vv, rk = c
+            d = assign_crowding_dist(vv, rk)
+            return (perturb(vv, d[0] < 1e30), rk), d[0]
+        return lambda x: lax.scan(body, x, None, length=n)
+    sec, r = marginal(make_crowd, (vals, ranks), k=K)
+    report("crowding", sec, r)
+
+    # (d) full sel_nsga2
+    def make_sel(n):
+        def body(ww, _):
+            idx = sel_nsga2(None, ww, POP)
+            return perturb(ww, idx[0]), idx[0]
+        return lambda x: lax.scan(body, x, None, length=n)
+    sec, r = marginal(make_sel, w, k=K)
+    report("sel_nsga2_full", sec, r)
+
+    # (e) variation + evaluation + concat
+    def make_var(n):
+        def body(c, i):
+            g, = c
+            kk = jax.random.fold_in(key, i)
+            g2, _ = vary_genome(kk, g, tb, 0.9, 1.0, pairing="halves")
+            offp = base.Population(g2, base.Fitness.empty(POP, weights))
+            offp, _ = evaluate_population(tb, offp)
+            return (g2,), offp.fitness.values[0, 0]
+        return lambda x: lax.scan(body, x, None, length=n)
+    sec, r = marginal(make_var, (pop.genome,), k=K)
+    report("vary_plus_eval", sec, r)
+
+
+if __name__ == "__main__":
+    print(json.dumps({"platform": jax.devices()[0].platform, "pop": POP}),
+          flush=True)
+    main()
