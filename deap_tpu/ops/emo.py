@@ -699,26 +699,42 @@ def sel_nsga3(key, fitness, k, ref_points, ideal_override=None,
     total = jax.ops.segment_sum(candidates.astype(jnp.int32), niche,
                                 num_segments=nref)
     n_base = jnp.sum(base)
-    intmax = jnp.iinfo(jnp.int32).max
 
-    def pick_step(i, state):
-        taken, counts, picked = state
-        need = n_base + picked < k
-        avail_n = taken < total
-        masked = jnp.where(avail_n, counts, intmax)
-        min_count = jnp.min(masked)
-        tied = avail_n & (counts == min_count)
-        # uniform choice among tied niches (reference niching,
-        # emo.py:624-658)
-        u = jax.random.uniform(jax.random.fold_in(k_loop, i), (nref,))
-        j = jnp.argmax(jnp.where(tied, u, -1.0))
-        taken = jnp.where(need, taken.at[j].add(1), taken)
-        counts = jnp.where(need, counts.at[j].add(1), counts)
-        return taken, counts, picked + need
+    # The per-niche pick COUNTS in closed form: "repeatedly increment a
+    # minimum-count niche (uniform among ties, skip exhausted)" is
+    # integer WATER-FILLING — counts rise together to a common level
+    # L* = max{L : Σ_j clip(L - counts0_j, 0, total_j) ≤ k_fill} (found
+    # by binary search over (nref,) sums), and the remainder r lands on
+    # a uniformly-random size-r subset of the niches still fillable at
+    # the boundary level (each boundary unit goes to a distinct niche —
+    # once bumped to L*+1 a niche is no longer minimal while others
+    # remain at L* — and each choice is uniform among the rest, which is
+    # exactly a uniform subset).  Same law as the reference's sequential
+    # loop (emo.py:624-658) with zero sequential steps; the k-iteration
+    # fori this replaces was itself the round-4 O(nref)-per-step fix and
+    # still cost ~3 µs × k on TPU.
+    k_fill = k - n_base
 
-    taken, _, _ = lax.fori_loop(
-        0, k, pick_step,
-        (jnp.zeros((nref,), jnp.int32), counts0, jnp.int32(0)))
+    def sum_at(L):
+        return jnp.sum(jnp.clip(L - counts0, 0, total))
+
+    def bisect_level(_, state):
+        lo, hi = state                     # invariant: sum(lo) <= k_fill
+        mid = lo + (hi - lo) // 2
+        ok = sum_at(mid) <= k_fill
+        return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid)
+
+    hi0 = jnp.int32(k) + jnp.max(counts0) + 2
+    level, _ = lax.fori_loop(0, 32, bisect_level,
+                             (jnp.int32(0), hi0))
+    taken = jnp.clip(level - counts0, 0, total)
+    r = k_fill - jnp.sum(taken)
+    elig = (counts0 <= level) & (taken < total)
+    u_tie = jax.random.uniform(k_loop, (nref,))
+    score_ord = jnp.argsort(jnp.where(elig, -u_tie, jnp.inf))
+    extra = jnp.zeros((nref,), jnp.int32).at[score_ord].set(
+        (jnp.arange(nref) < r).astype(jnp.int32))
+    taken = taken + jnp.where(elig, extra, 0)
     selected = base | (candidates & (pick_rank < taken[niche]))
     order = jnp.argsort(~selected, stable=True)           # selected first
     if return_memory:

@@ -16,7 +16,6 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -32,9 +31,9 @@ def main():
     from deap_tpu import base, benchmarks
     from deap_tpu.algorithms import evaluate_population, vary_genome
     from deap_tpu.ops import crossover, mutation, emo
-    from deap_tpu.ops.emo import (_grid_dominator_counts, _grid_tie_ok,
+    from deap_tpu.ops.emo import (_grid_dominator_counts,
                                   nondominated_ranks, assign_crowding_dist,
-                                  sel_nsga2, _wv_values)
+                                  sel_nsga2)
 
     tb = base.Toolbox()
     tb.register("evaluate", benchmarks.dtlz2, obj=NOBJ)
@@ -122,12 +121,12 @@ def main():
     def make_var(n):
         def body(c, i):
             g, = c
-            kk = jax.random.fold_in(key, i)
+            kk = jax.random.fold_in(key, i)          # xs = arange below
             g2, _ = vary_genome(kk, g, tb, 0.9, 1.0, pairing="halves")
             offp = base.Population(g2, base.Fitness.empty(POP, weights))
             offp, _ = evaluate_population(tb, offp)
             return (g2,), offp.fitness.values[0, 0]
-        return lambda x: lax.scan(body, x, None, length=n)
+        return lambda x: lax.scan(body, x, jnp.arange(n))
     sec, r = marginal(make_var, (pop.genome,), k=K)
     report("vary_plus_eval", sec, r)
 
