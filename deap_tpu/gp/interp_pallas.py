@@ -102,11 +102,15 @@ def make_population_evaluator_pallas(pset, cap: int, *,
         ``stack[sp-1]``; VMEM rows ``0..sp-2`` hold the rest.  All shapes
         static inside a branch — only ``sp``/row indices are dynamic
         scalars."""
+        # ``top`` is carried as a (1, pts_pad) rank-2 value: Mosaic's
+        # layout inference rejects a rank-1 fori_loop carry at some
+        # widths ("arr.size() >= layout_rank" check abort, seen at
+        # pts_pad=128 — found by tools/tpu_selftest.py, not the bench)
         if isinstance(node, Primitive):
             k, fn = node.arity, node.func
 
             def branch(sp, top, const, stack_ref, x_ref):
-                args = [top] + [stack_ref[sp - 2 - j, :]
+                args = [top] + [stack_ref[sp - 2 - j, :][None, :]
                                 for j in range(k - 1)]
                 return sp - k + 1, fn(*args)
         elif isinstance(node, Argument):
@@ -117,13 +121,13 @@ def make_population_evaluator_pallas(pset, cap: int, *,
                 # write stores an uninitialized top, but every read of a
                 # row happens only after the push that brought sp past it
                 # rewrote it — see the invariant above.
-                stack_ref[jnp.maximum(sp - 1, 0), :] = top
-                return sp + 1, x_ref[ai, :]
+                stack_ref[jnp.maximum(sp - 1, 0), :] = top[0, :]
+                return sp + 1, x_ref[ai, :][None, :]
         else:                       # Terminal / Ephemeral: stored constant
 
             def branch(sp, top, const, stack_ref, x_ref):
-                stack_ref[jnp.maximum(sp - 1, 0), :] = top
-                return sp + 1, jnp.full((stack_ref.shape[1],), const,
+                stack_ref[jnp.maximum(sp - 1, 0), :] = top[0, :]
+                return sp + 1, jnp.full((1, stack_ref.shape[1]), const,
                                         stack_ref.dtype)
         return branch
 
@@ -144,10 +148,10 @@ def make_population_evaluator_pallas(pset, cap: int, *,
                                           x_ref=x_ref) for b in branches],
                     sp, top, const)
 
-            top0 = jnp.zeros((stack_ref.shape[1],), stack_ref.dtype)
+            top0 = jnp.zeros((1, stack_ref.shape[1]), stack_ref.dtype)
             _, top = lax.fori_loop(0, length, step, (0, top0),
                                    unroll=False)
-            out_ref[i, :] = top
+            out_ref[i, :] = top[0, :]
             return 0
 
         lax.fori_loop(0, tb, tree_body, 0, unroll=False)
