@@ -967,13 +967,13 @@ def sel_spea2_staged(key, fitness, k, chunk: int = 1024):
     ``stream_mode="segmented"`` already does for streaming)."""
     del key
     w, _ = _wv_values(fitness)
-    spea_fit, nondom = jax.jit(
-        _spea2_fitness_stage, static_argnums=(1, 2))(w, chunk, "bisect")
+    # module-level jitted entries (not per-call jax.jit wrappers) so the
+    # Python-side dispatch cache stays warm across generations, like
+    # _jit_ranks
+    spea_fit, nondom = _jit_spea2_fitness(w, chunk, "bisect")
     # two jit calls are two XLA programs by construction — no further
     # separation needed
-    return jax.jit(
-        _spea2_select_stage, static_argnums=(3, 4))(w, spea_fit, nondom,
-                                                    int(k), chunk)
+    return _jit_spea2_select(w, spea_fit, nondom, int(k), chunk)
 
 
 def _spea2_select_stage(w, spea_fit, nondom, k, chunk: int = 1024):
@@ -1065,3 +1065,10 @@ def _spea2_select_stage(w, spea_fit, nondom, k, chunk: int = 1024):
                          jnp.where(n_nondom > k, truncated, nondom))
     order = jnp.argsort(~selected, stable=True)
     return order[:k]
+
+
+# module-level jitted entries for sel_spea2_staged: the Python dispatch
+# cache attaches to these (one wrapper per process), not to per-call
+# jax.jit objects that would retrace-check from scratch each generation
+_jit_spea2_fitness = jax.jit(_spea2_fitness_stage, static_argnums=(1, 2))
+_jit_spea2_select = jax.jit(_spea2_select_stage, static_argnums=(3, 4))

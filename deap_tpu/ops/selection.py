@@ -71,9 +71,16 @@ def sel_tournament(key, fitness, k, tournsize, tie_break="random"):
     each one gets decides its share of the block's selection probability.
     ``tie_break="random"`` (default) appends one keyed uniform draw per
     individual as the least-significant sort key, so tied blocks are
-    uniformly permuted every call — the same uniform tie law as aspirant
-    sampling (the reference's ``max`` over randomly-drawn aspirants), at
-    the cost of one extra operand in the (single, variadic) sort.
+    uniformly permuted every call — the *marginal* tie law of each slot
+    matches aspirant sampling (the reference's ``max`` over
+    randomly-drawn aspirants), at the cost of one extra operand in the
+    (single, variadic) sort.  The permutation is drawn once per call and
+    shared by all ``k`` tournaments, so picks within a call are
+    correlated: on heavily-tied discrete fitness this raises the variance
+    of per-member copy counts relative to true aspirant sampling.
+    Callers needing independent per-tournament tie-breaking should use an
+    aspirant-sampling selector (e.g. ``sel_random`` + argmax over drawn
+    aspirants) instead.
     ``tie_break="rank"`` skips the draw and splits tied blocks by the
     deterministic stable sort order — fine for continuous fitness (ties
     are measure-zero) and marginally cheaper, but biased for discrete

@@ -26,6 +26,8 @@ Two tiers:
 from __future__ import annotations
 
 import pickle
+import re
+import shutil
 import threading
 from pathlib import Path
 from typing import Any
@@ -91,6 +93,39 @@ def _is_prng_key(x) -> bool:
         x.dtype, jax.dtypes.prng_key)
 
 
+def _barrier(tag: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(tag)
+
+
+def _read_commit(d: Path):
+    """Parse ``COMMIT`` → ``(version, nproc)``, ``(None, nproc)`` for the
+    legacy flat layout, or ``None`` if absent.  Raises on corrupt content —
+    a half-written marker must refuse, not silently skip validation."""
+    try:
+        txt = (d / "COMMIT").read_text().strip()
+    except FileNotFoundError:
+        return None
+    toks = txt.split()
+    if len(toks) == 2 and toks[0].startswith("v") and toks[0][1:].isdigit() \
+            and toks[1].isdigit():
+        return int(toks[0][1:]), int(toks[1])
+    if len(toks) == 1 and toks[0].isdigit():      # legacy flat layout
+        return None, int(toks[0])
+    raise ValueError(
+        f"{d}: corrupt COMMIT marker {txt!r} — refusing to load")
+
+
+def _prune_versions(d: Path, keep: Path | None) -> None:
+    """Remove every ``v<digits>`` checkpoint subdirectory except ``keep``.
+    Anchored to the exact version-dir name shape so sibling user
+    directories that merely start with 'v' are never touched."""
+    for sub in d.glob("v*"):
+        if sub != keep and sub.is_dir() and re.fullmatch(r"v\d+", sub.name):
+            shutil.rmtree(sub, ignore_errors=True)
+
+
 def save_sharded_checkpoint(dirpath, state: Any) -> None:
     """Write ``state`` under directory ``dirpath``, one ``.npz`` of shard
     chunks plus one manifest fragment per process.
@@ -98,14 +133,41 @@ def save_sharded_checkpoint(dirpath, state: Any) -> None:
     Each process stores the replica-0 addressable shards of every
     ``jax.Array`` leaf (so a fully-replicated leaf is written exactly once,
     by the process owning its replica 0) tagged with the shard's global
-    index box; non-array leaves pickle into process 0's manifest.  The
-    write is atomic per process (tmp + rename); a ``COMMIT`` marker by
-    process 0 — after a cross-process barrier when distributed — marks the
-    checkpoint complete, and :func:`load_sharded_checkpoint` refuses a
-    directory without it."""
+    index box; non-array leaves pickle into process 0's manifest.
+
+    Saves are *versioned* (the orbax step-directory pattern): fragments go
+    into a fresh ``v{N}/`` subdirectory, and only after a cross-process
+    barrier does process 0 atomically swing the ``COMMIT`` marker — which
+    records the active version and the writing process count — onto the new
+    version, then delete superseded ones.  A crash at ANY point before the
+    marker swing leaves the previous checkpoint fully loadable; a crash
+    after it leaves the new one loadable.  There is no window in which the
+    directory mixes shards from different saves or holds no restorable
+    state (advisor round-4 finding).  :func:`load_sharded_checkpoint`
+    refuses a directory without a marker, with a corrupt marker, or whose
+    fragment count disagrees with the recorded process count."""
     d = Path(dirpath)
     d.mkdir(parents=True, exist_ok=True)
     pid = jax.process_index()
+    # every process derives the same next version from the committed one
+    # (the end-of-save barrier guarantees they all see the same COMMIT).
+    # A corrupt marker means "nothing restorable here" for a *saver* —
+    # this save supersedes the directory, so start from version 0 rather
+    # than bricking the training loop's periodic checkpointing.
+    try:
+        cur = _read_commit(d)
+    except ValueError:
+        cur = None
+    version = 0 if cur is None or cur[0] is None else cur[0] + 1
+    vd = d / f"v{version}"
+    if pid == 0:
+        # clear debris of crashed attempts (uncommitted version dirs) so
+        # nothing stale can alias the new write
+        committed = None if cur is None or cur[0] is None \
+            else d / f"v{cur[0]}"
+        _prune_versions(d, keep=committed)
+    _barrier("deap_tpu_ckpt_clean")
+    vd.mkdir(parents=True, exist_ok=True)
     chunks: dict[str, np.ndarray] = {}
     meta: dict[str, Any] = {"leaves": {}, "chunks": []}
     flat, _ = jax.tree_util.tree_flatten_with_path(state)
@@ -135,20 +197,28 @@ def save_sharded_checkpoint(dirpath, state: Any) -> None:
             other[key] = leaf
     meta["other"] = other
 
-    np_tmp = d / f"shards_p{pid}.npz.tmp"
+    np_tmp = vd / f"shards_p{pid}.npz.tmp"
     with open(np_tmp, "wb") as f:       # handle, not path: savez would
         np.savez(f, **chunks)           # append .npz to the tmp name
-    np_tmp.replace(d / f"shards_p{pid}.npz")
-    mf_tmp = d / f"manifest_p{pid}.pkl.tmp"
+    np_tmp.replace(vd / f"shards_p{pid}.npz")
+    mf_tmp = vd / f"manifest_p{pid}.pkl.tmp"
     with open(mf_tmp, "wb") as f:
         pickle.dump(meta, f, protocol=pickle.HIGHEST_PROTOCOL)
-    mf_tmp.replace(d / f"manifest_p{pid}.pkl")
+    mf_tmp.replace(vd / f"manifest_p{pid}.pkl")
 
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("deap_tpu_ckpt_save")
+    _barrier("deap_tpu_ckpt_save")
     if pid == 0:
-        (d / "COMMIT").write_text(str(jax.process_count()))
+        # atomic marker swing: the old checkpoint stays loadable until
+        # this single rename, the new one is loadable right after it
+        c_tmp = d / "COMMIT.tmp"
+        c_tmp.write_text(f"v{version} {jax.process_count()}")
+        c_tmp.replace(d / "COMMIT")
+        _prune_versions(d, keep=vd)
+        for stale in (*d.glob("shards_p*"), *d.glob("manifest_p*")):
+            stale.unlink(missing_ok=True)  # superseded legacy flat layout
+    # no process may start the NEXT save (and re-read COMMIT) before the
+    # marker swing lands
+    _barrier("deap_tpu_ckpt_commit")
 
 
 def load_sharded_checkpoint(dirpath, like: Any) -> Any:
@@ -163,11 +233,19 @@ def load_sharded_checkpoint(dirpath, like: Any) -> Any:
     Returns the restored pytree; array contents are bit-identical to what
     was saved."""
     d = Path(dirpath)
-    if not (d / "COMMIT").exists():
+    commit = _read_commit(d)             # raises ValueError if corrupt
+    if commit is None:
         raise FileNotFoundError(
             f"{d} has no COMMIT marker: incomplete or not a sharded "
             "checkpoint")
-    frags = sorted(d.glob("manifest_p*.pkl"))
+    version, nproc = commit
+    frag_dir = d if version is None else d / f"v{version}"
+    frags = sorted(frag_dir.glob("manifest_p*.pkl"))
+    if len(frags) != nproc:
+        raise ValueError(
+            f"{frag_dir}: COMMIT records {nproc} writer process(es) but "
+            f"{len(frags)} manifest fragment(s) present — mixed or "
+            "partially-cleaned checkpoint")
     leaves_meta: dict[str, Any] = {}
     chunk_index: dict[str, list] = {}
     other: dict[str, Any] = {}
@@ -177,8 +255,8 @@ def load_sharded_checkpoint(dirpath, like: Any) -> Any:
             meta = pickle.load(f)
         leaves_meta.update(meta["leaves"])
         other.update(meta.get("other", {}))
-        npz = d / frag.name.replace("manifest_", "shards_"
-                                    ).replace(".pkl", ".npz")
+        npz = frag.with_name(frag.name.replace("manifest_", "shards_"
+                                               ).replace(".pkl", ".npz"))
         for c in meta["chunks"]:
             chunk_index.setdefault(c["leaf"], []).append((npz, c))
 

@@ -4,6 +4,7 @@ equivalence to a naive recount — the reference's test surface for these is
 tests/test_logbook.py + doc/tutorials/advanced/checkpoint.rst."""
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -439,6 +440,69 @@ def test_sharded_checkpoint_roundtrip_and_reshard(tmp_path):
     like_one = dict(like_same, genome=jnp.zeros((64, 40), jnp.float32))
     r1 = load_sharded_checkpoint(tmp_path / "ck", like_one)
     np.testing.assert_array_equal(np.asarray(r1["genome"]), np.asarray(x))
+
+
+def test_sharded_checkpoint_resave_versioned_atomicity(tmp_path):
+    """Re-save must never create a window where the directory holds no
+    loadable checkpoint or mixes shards from different saves (advisor
+    round-4 medium finding): saves are versioned subdirectories and the
+    COMMIT marker swings atomically, so (a) planted fragments from a
+    larger process set are refused, (b) a crash mid-re-save (new version
+    dir written, marker not yet swung) leaves the OLD checkpoint fully
+    loadable, (c) a completed re-save removes superseded versions."""
+    from deap_tpu.utils.checkpoint import (save_sharded_checkpoint,
+                                           load_sharded_checkpoint)
+    import shutil
+    d = tmp_path / "ck"
+    state_v1 = {"x": jnp.arange(8.0), "gen": 1}
+    save_sharded_checkpoint(d, state_v1)
+    vd = d / "v0"
+    assert vd.is_dir() and (d / "COMMIT").read_text().startswith("v0 ")
+
+    # (a) fragment-count validation: plant fragments as if written by a
+    # 2-process set; COMMIT records 1
+    shutil.copy(vd / "shards_p0.npz", vd / "shards_p1.npz")
+    shutil.copy(vd / "manifest_p0.pkl", vd / "manifest_p1.pkl")
+    with pytest.raises(ValueError, match="fragment"):
+        load_sharded_checkpoint(d, state_v1)
+    (vd / "shards_p1.npz").unlink()
+    (vd / "manifest_p1.pkl").unlink()
+
+    # (b) crash mid-re-save: a new uncommitted version dir (even garbage)
+    # must not affect loading the committed one
+    junk = d / "v1"
+    junk.mkdir()
+    (junk / "manifest_p0.pkl").write_bytes(b"partial write")
+    r = load_sharded_checkpoint(d, state_v1)
+    np.testing.assert_array_equal(np.asarray(r["x"]),
+                                  np.asarray(state_v1["x"]))
+
+    # (c) full re-save: junk attempt cleared, version advances, old gone
+    state_v2 = {"x": jnp.arange(8.0) * 10, "gen": 2}
+    save_sharded_checkpoint(d, state_v2)
+    assert (d / "COMMIT").read_text().startswith("v1 ")
+    assert not (d / "v0").exists()
+    r = load_sharded_checkpoint(d, state_v1)
+    np.testing.assert_array_equal(np.asarray(r["x"]),
+                                  np.asarray(state_v2["x"]))
+    assert r["gen"] == 2
+
+    # a non-version sibling directory in the checkpoint dir must survive
+    # the prune sweeps (the glob is anchored to v<digits>)
+    (d / "vectors").mkdir()
+    (d / "vectors" / "keep.txt").write_text("user data")
+
+    # corrupt marker: load refuses rather than skipping validation, but a
+    # subsequent SAVE recovers (supersedes the directory from version 0)
+    (d / "COMMIT").write_text("garbage !!")
+    with pytest.raises(ValueError, match="corrupt"):
+        load_sharded_checkpoint(d, state_v1)
+    state_v3 = {"x": jnp.arange(8.0) + 5, "gen": 3}
+    save_sharded_checkpoint(d, state_v3)
+    r = load_sharded_checkpoint(d, state_v1)
+    np.testing.assert_array_equal(np.asarray(r["x"]),
+                                  np.asarray(state_v3["x"]))
+    assert (d / "vectors" / "keep.txt").read_text() == "user data"
 
 
 def test_sharded_checkpoint_exact_resume_sharded_ea(tmp_path):
