@@ -126,6 +126,15 @@ def _prune_versions(d: Path, keep: Path | None) -> None:
             shutil.rmtree(sub, ignore_errors=True)
 
 
+def _next_version(d: Path) -> int:
+    """One past the highest existing ``v<digits>`` subdirectory — so a new
+    save can never alias a directory holding committed *or* crashed-attempt
+    fragments, whatever state the COMMIT marker is in."""
+    vers = [int(m.group(1)) for sub in d.glob("v*")
+            if sub.is_dir() and (m := re.fullmatch(r"v(\d+)", sub.name))]
+    return max(vers, default=-1) + 1
+
+
 def save_sharded_checkpoint(dirpath, state: Any) -> None:
     """Write ``state`` under directory ``dirpath``, one ``.npz`` of shard
     chunks plus one manifest fragment per process.
@@ -149,24 +158,18 @@ def save_sharded_checkpoint(dirpath, state: Any) -> None:
     d = Path(dirpath)
     d.mkdir(parents=True, exist_ok=True)
     pid = jax.process_index()
-    # every process derives the same next version from the committed one
-    # (the end-of-save barrier guarantees they all see the same COMMIT).
-    # A corrupt marker means "nothing restorable here" for a *saver* —
-    # this save supersedes the directory, so start from version 0 rather
-    # than bricking the training loop's periodic checkpointing.
-    try:
-        cur = _read_commit(d)
-    except ValueError:
-        cur = None
-    version = 0 if cur is None or cur[0] is None else cur[0] + 1
+    # Process 0 decides the next version (one past any existing version
+    # dir, committed or crashed — a corrupt COMMIT marker therefore never
+    # blocks saving, and NOTHING is deleted before the new marker lands,
+    # so even a manually-recoverable wreck stays recoverable) and
+    # broadcasts it: agreement must not rest on every process re-reading
+    # the shared filesystem, whose caches can serve stale COMMIT content.
+    version = _next_version(d) if pid == 0 else 0
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        version = int(multihost_utils.broadcast_one_to_all(
+            np.int32(version)))
     vd = d / f"v{version}"
-    if pid == 0:
-        # clear debris of crashed attempts (uncommitted version dirs) so
-        # nothing stale can alias the new write
-        committed = None if cur is None or cur[0] is None \
-            else d / f"v{cur[0]}"
-        _prune_versions(d, keep=committed)
-    _barrier("deap_tpu_ckpt_clean")
     vd.mkdir(parents=True, exist_ok=True)
     chunks: dict[str, np.ndarray] = {}
     meta: dict[str, Any] = {"leaves": {}, "chunks": []}

@@ -477,11 +477,12 @@ def test_sharded_checkpoint_resave_versioned_atomicity(tmp_path):
     np.testing.assert_array_equal(np.asarray(r["x"]),
                                   np.asarray(state_v1["x"]))
 
-    # (c) full re-save: junk attempt cleared, version advances, old gone
+    # (c) full re-save: the version skips past the crashed attempt (never
+    # aliasing its dir), then the post-commit prune clears both old dirs
     state_v2 = {"x": jnp.arange(8.0) * 10, "gen": 2}
     save_sharded_checkpoint(d, state_v2)
-    assert (d / "COMMIT").read_text().startswith("v1 ")
-    assert not (d / "v0").exists()
+    assert (d / "COMMIT").read_text().startswith("v2 ")
+    assert not (d / "v0").exists() and not (d / "v1").exists()
     r = load_sharded_checkpoint(d, state_v1)
     np.testing.assert_array_equal(np.asarray(r["x"]),
                                   np.asarray(state_v2["x"]))

@@ -1,185 +1,16 @@
 #!/usr/bin/env python
-"""Backend-correctness self-test: batch-size invariance of every
-scatter-heavy vmapped operator.
-
-Why this exists: the axon TPU backend miscompiles the batched scatter
-that ``x.at[i].set(v)`` lowers to under ``vmap`` once the batch reaches
-~1024 — found in round 3 when the GP stack machine silently produced
-wrong fitness at pop >= 1024 on TPU while every CPU test passed (the
-fix: ``lax.dynamic_update_slice``; see deap_tpu/gp/interp.py).  Any
-vmapped operator built on per-individual ``.at[].set`` index arithmetic
-(permutation crossovers, shuffle mutation, GP tree variation, the
-routine interpreter) is exposed to the same class of bug.
-
-This script runs each such operator at batch 4096 and compares against
-the same inputs evaluated in chunks of 256 (small batches are known
-good).  Run it ON THE TARGET BACKEND:
-
-    python tools/tpu_selftest.py            # whatever jax.devices() gives
-    JAX_PLATFORMS=cpu python tools/tpu_selftest.py
-
-Exit code 0 = all invariant; 1 = at least one operator differs between
-full-batch and chunked execution (a backend miscompile — report which).
-CPU CI keeps the operators *algorithmically* honest; this tool is the
-deployment-time probe for the compiled path the tests cannot reach.
-"""
+"""Thin shim: the backend self-test now lives in the package
+(``deap_tpu/selftest.py``; console script ``deap-tpu-selftest``) so an
+installed framework carries its own deployment-time probe.  This path is
+kept so existing ``python tools/tpu_selftest.py`` invocations keep
+working from a source checkout."""
 
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
-
-
-POP = int(os.environ.get("SELFTEST_POP", 4096))
-CHUNK = 256
-
-
-def _compare(name, fn, *args, failures=None):
-    """fn is already vmapped: fn(keys, *args) -> pytree. Compare full batch
-    vs chunked."""
-    import jax
-    full = jax.tree_util.tree_map(np.asarray, fn(*args))
-    chunks = []
-    n = args[0].shape[0]
-    for i in range(0, n, CHUNK):
-        part = fn(*(a[i:i + CHUNK] for a in args))
-        chunks.append(jax.tree_util.tree_map(np.asarray, part))
-    leaves_f = jax.tree_util.tree_leaves(full)
-    leaves_c = [np.concatenate(x) for x in
-                zip(*(jax.tree_util.tree_leaves(c) for c in chunks))]
-    ok = all(np.allclose(a, b, rtol=1e-5, atol=1e-5, equal_nan=True)
-             for a, b in zip(leaves_f, leaves_c))
-    status = "ok" if ok else "MISMATCH"
-    nbad = 0 if ok else int(sum(
-        (~np.isclose(a, b, rtol=1e-5, atol=1e-5, equal_nan=True))
-        .reshape(len(a), -1).any(1).sum()
-        for a, b in zip(leaves_f, leaves_c)))
-    print(f"  {name:38s} {status}" + ("" if ok else f"  ({nbad} rows)"))
-    if not ok:
-        failures.append(name)
-
-
-def main():
-    import jax
-    import jax.numpy as jnp
-    from deap_tpu.ops import crossover, mutation
-    from deap_tpu import gp
-
-    print(f"backend={jax.default_backend()} devices={jax.devices()} "
-          f"pop={POP}")
-    failures = []
-    key = jax.random.PRNGKey(0)
-    keys = jax.random.split(key, POP)
-
-    # permutation genomes
-    perm = jax.vmap(lambda k: jax.random.permutation(k, 16))(
-        jax.random.split(jax.random.fold_in(key, 1), POP))
-    perm2 = jax.vmap(lambda k: jax.random.permutation(k, 16))(
-        jax.random.split(jax.random.fold_in(key, 2), POP))
-
-    _compare("cx_partialy_matched",
-             jax.jit(jax.vmap(crossover.cx_partialy_matched)),
-             keys, perm, perm2, failures=failures)
-    _compare("cx_uniform_partialy_matched",
-             jax.jit(jax.vmap(
-                 lambda k, a, b: crossover.cx_uniform_partialy_matched(
-                     k, a, b, 0.3))),
-             keys, perm, perm2, failures=failures)
-    _compare("cx_ordered", jax.jit(jax.vmap(crossover.cx_ordered)),
-             keys, perm, perm2, failures=failures)
-    _compare("mut_shuffle_indexes",
-             jax.jit(jax.vmap(
-                 lambda k, a: mutation.mut_shuffle_indexes(k, a, 0.3))),
-             keys, perm.astype(jnp.float32), failures=failures)
-
-    # GP trees
-    ps = gp.PrimitiveSet("MAIN", 1)
-    ps.add_primitive(jnp.add, 2, name="add")
-    ps.add_primitive(jnp.subtract, 2, name="sub")
-    ps.add_primitive(jnp.multiply, 2, name="mul")
-    ps.add_primitive(jnp.negative, 1, name="neg")
-    ps.add_ephemeral_constant(
-        "rand101",
-        lambda k: jax.random.randint(k, (), -1, 2).astype(jnp.float32))
-    cap = 32
-    gen = gp.make_generator(ps, cap, "half_and_half")
-    t1 = jax.vmap(lambda k: gen(k, 1, 4))(
-        jax.random.split(jax.random.fold_in(key, 3), POP))
-    t2 = jax.vmap(lambda k: gen(k, 1, 4))(
-        jax.random.split(jax.random.fold_in(key, 4), POP))
-
-    _compare("gp.cx_one_point",
-             jax.jit(jax.vmap(lambda k, a0, a1, a2, b0, b1, b2:
-                              gp.cx_one_point(k, (a0, a1, a2),
-                                              (b0, b1, b2), ps))),
-             keys, *t1, *t2, failures=failures)
-    gen_mut = gp.make_generator(ps, cap, "full")
-    _compare("gp.mut_uniform",
-             jax.jit(jax.vmap(lambda k, a0, a1, a2: gp.mut_uniform(
-                 k, (a0, a1, a2), lambda kk: gen_mut(kk, 0, 2), ps))),
-             keys, *t1, failures=failures)
-    _compare("gp.mut_node_replacement",
-             jax.jit(jax.vmap(lambda k, a0, a1, a2: gp.mut_node_replacement(
-                 k, (a0, a1, a2), ps))),
-             keys, *t1, failures=failures)
-    _compare("gp.mut_insert",
-             jax.jit(jax.vmap(lambda k, a0, a1, a2: gp.mut_insert(
-                 k, (a0, a1, a2), ps))),
-             keys, *t1, failures=failures)
-    _compare("gp.mut_shrink",
-             jax.jit(jax.vmap(lambda k, a0, a1, a2: gp.mut_shrink(
-                 k, (a0, a1, a2), ps))),
-             keys, *t1, failures=failures)
-
-    # routine interpreter (control-flow GP: explicit-stack while loop)
-    ant_ps = gp.PrimitiveSet("ANT", 0)
-    ant_ps.add_primitive(None, 2, name="if_sense")
-    ant_ps.add_primitive(None, 2, name="prog2")
-    ant_ps.add_terminal(0.0, name="act_inc")
-    ant_ps.add_terminal(0.0, name="act_dec")
-    run_rt = gp.make_routine_interpreter(
-        ant_ps, 16,
-        actions={"act_inc": lambda s: {"v": s["v"] + 1.0,
-                                       "budget": s["budget"] - 1},
-                 "act_dec": lambda s: {"v": s["v"] - 0.5,
-                                       "budget": s["budget"] - 1}},
-        conds={"if_sense": lambda s: s["v"] < 3.0},
-        continue_fn=lambda s: s["budget"] > 0)
-    rt_gen = gp.make_generator(ant_ps, 16, "half_and_half")
-    rt_trees = jax.vmap(lambda k: rt_gen(k, 1, 3))(
-        jax.random.split(jax.random.fold_in(key, 5), POP))
-    state0 = {"v": jnp.zeros(()), "budget": jnp.full((), 40, jnp.int32)}
-
-    def rt_run(c0, c1, l):
-        return jax.vmap(lambda a, b, c: run_rt(
-            (a, b, c), state0))(c0, c1, l)
-
-    _compare("gp routine interpreter", jax.jit(rt_run), *rt_trees,
-             failures=failures)
-
-    # XLA stack machine (the original finding, now fixed via DUS)
-    X = jnp.linspace(-1, 1, 64, dtype=jnp.float32)[None, :]
-    ev = gp.make_population_evaluator(ps, cap, backend="xla")
-    _compare("gp stack machine (xla)",
-             lambda c0, c1, l: ev(c0, c1, l, X), *t1, failures=failures)
-    try:
-        from deap_tpu.gp.interp_pallas import make_population_evaluator_pallas
-        pev = make_population_evaluator_pallas(ps, cap)
-        _compare("gp stack machine (pallas)",
-                 lambda c0, c1, l: pev(c0, c1, l, X), *t1,
-                 failures=failures)
-    except Exception as e:                                # noqa: BLE001
-        print(f"  gp stack machine (pallas)              skipped ({e})")
-
-    if failures:
-        print(f"FAILED: {len(failures)} operator(s) are batch-size "
-              f"dependent on this backend: {failures}")
-        return 1
-    print("all operators batch-size invariant on this backend")
-    return 0
-
+from deap_tpu.selftest import main
 
 if __name__ == "__main__":
     sys.exit(main())
