@@ -55,7 +55,11 @@ def run_tpu():
     episode_keys = jax.random.split(k_eps, N_EPISODES)
 
     tb = base.Toolbox()
-    tb.register("evaluate", make_evaluate(episode_keys))
+    # BENCH_MASKED=1 -> while_loop rollout (generation cost = batch-max
+    # episode length, the stock-DEAP early-termination economy); default
+    # stays the fixed-cost scan so vs_baseline remains conservative
+    tb.register("evaluate", make_evaluate(
+        episode_keys, masked=os.environ.get("BENCH_MASKED", "0") == "1"))
     tb.register("mate", mate_blend)
     tb.register("mutate", mut_gaussian_tree)
     tb.register("select", selection.sel_tournament, tournsize=3)

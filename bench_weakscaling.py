@@ -1,34 +1,42 @@
 #!/usr/bin/env python
-"""Multi-device scaling *evidence* for the flagship GA (round-2 verdict
-item 1): run the real sharded generation on an 8-virtual-device CPU mesh
-and measure, instead of project.
+"""Multi-device scaling *evidence* for the flagship GA and the sharded
+NSGA-II selection (round-4 verdict item 1): run the real sharded programs
+on an 8-virtual-device CPU mesh and measure, instead of project.
 
 The bench host has ONE physical core, so 8 virtual devices cannot show a
-wall-clock speedup; what weak scaling means here is *work conservation*:
-with fixed population per device, a perfectly sharded program does exactly
-8x the single-shard work, so ideal wall time is ``t8 = 8*t1``.  The
-reported ``overhead = t8 / (8*t1)`` isolates what sharding itself adds —
-partitioner-inserted collectives and duplicated work — which is exactly
-the quantity the single-chip bench cannot see and the part of the "~8x on
-a real v5e-8" projection that needed evidence.  (On a real 8-chip pod the
-same script gives true weak-scaling efficiency; here it bounds the
-communication term.)
+wall-clock speedup; what IS measurable is **partition overhead**: the same
+total-size program is timed on an 8-device mesh and on a 1-device mesh —
+same shapes, same total work, the only difference being the partitioner's
+inserted collectives and any work duplication.  ``overhead = t_mesh8 /
+t_mesh1`` is therefore ≥ 1 up to measurement noise by construction (the
+round-4 harness compared *different-size* programs — per-device population
+on a 1-device mesh vs 8× that on 8 devices — whose different fusion
+choices and per-generation fixed costs produced a physically-impossible
+0.724 "overhead"; this formulation is the round-4 verdict's prescribed
+fix: the t1 baseline is the *same partitioned program* on a 1-device
+mesh).  On a real 8-chip pod, per-chip efficiency ≈ 1/overhead and
+throughput ≈ n_chips/overhead × single-chip.
 
-Two layouts, matching the framework's two parallel axes (SURVEY §2.6):
+Timing discipline: marginal time per generation ((t(2N) − t(N))/N, both
+linearity-gated), each point the **min of ≥3 repeats** with the relative
+spread of the repeats reported — single-sample numbers on a timeshared
+core are noise (round-4 weak #1).
 
-* ``pop``: the flagship generation sharded on the population axis.  The
-  rank tournament is a *global* sort, so this layout pays cross-shard
-  traffic in selection — the compiled collective inventory is reported so
-  the cost is attributable, not asserted away.
-* ``island``: one deme per device (the ``dryrun_multichip`` layout) with
-  ring migration every generation — migration's collective-permute is the
-  only communication (pinned by tests/test_parallel.py).
+Three layouts, matching the framework's parallel axes (SURVEY §2.6):
 
-Prints ONE JSON object; bench.py embeds it in its own output (the
-"BENCH_r03-adjacent" figure the verdict asked for).
+* ``pop``: the flagship generation sharded on the population axis — the
+  rank tournament's global sort pays cross-shard traffic in selection.
+* ``island``: one deme per device with ring migration each generation —
+  migration's collective-permute is the only communication.
+* ``mo``: ``sel_nsga2_sharded`` (deap_tpu/parallel/emo_sharded.py) — the
+  O(N²) dominance counting column-sharded with all-gathered row blocks
+  and psum-replicated peel decisions.
+
+Prints ONE JSON object; bench.py embeds it in its own output.
 
 Env: BENCH_WEAK_POP (per-device population, default 16384),
-BENCH_WEAK_NGEN (default 8), BENCH_WEAK_DEVICES (default 8).
+BENCH_WEAK_NGEN (default 8), BENCH_WEAK_DEVICES (default 8),
+BENCH_WEAK_REPEATS (default 3), BENCH_WEAK_MO_POP (default 8192).
 """
 
 import json
@@ -41,6 +49,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 POP_PER_DEV = int(os.environ.get("BENCH_WEAK_POP", 16384))
 NGEN = int(os.environ.get("BENCH_WEAK_NGEN", 8))
 N_DEV = int(os.environ.get("BENCH_WEAK_DEVICES", 8))
+REPEATS = int(os.environ.get("BENCH_WEAK_REPEATS", 3))
+MO_POP = int(os.environ.get("BENCH_WEAK_MO_POP", 8192))
 DIM = 100
 
 
@@ -51,33 +61,43 @@ def _collective_counts(txt: str) -> dict:
             if txt.count(name)}
 
 
-def _marginal(run, args, ngen):
-    """(t(2N) - t(N)) / N with forced completion, like bench.py."""
+def _marginal(run, args, ngen, repeats=REPEATS):
+    """((min t(2N)) - (min t(N))) / N over ``repeats`` timed runs each,
+    with forced completion.  Returns (marginal, linearity_ratio, spread)
+    where spread is the worst relative (max-min)/min across the two
+    timing sets."""
     import numpy as np
-    times = {}
-    for n in (ngen, 2 * ngen):
-        out = run(n)(*args)
-        np.asarray(out[1][-1:])                   # warmup + force
-        t0 = time.perf_counter()
-        out = run(n)(*args)
-        np.asarray(out[1][-1:])
-        times[n] = time.perf_counter() - t0
-    return (times[2 * ngen] - times[ngen]) / ngen, times[2 * ngen] / times[ngen]
+    fns = {n: run(n) for n in (ngen, 2 * ngen)}
+    for n, f in fns.items():                       # compile + warm caches
+        np.asarray(f(*args)[1][-1:])
+    times = {n: [] for n in fns}
+    for _ in range(repeats):
+        for n, f in fns.items():
+            t0 = time.perf_counter()
+            np.asarray(f(*args)[1][-1:])
+            times[n].append(time.perf_counter() - t0)
+    tn, t2n = min(times[ngen]), min(times[2 * ngen])
+    spread = max((max(v) - min(v)) / min(v) for v in times.values())
+    return (t2n - tn) / ngen, t2n / tn, spread
 
 
 def _marginal_gated(run, args, ngen, max_ngen=512):
     """Round-3 verdict: a measurement whose own linearity gate fails is an
     artifact, not evidence — double NGEN until t(2N)/t(N) lands in
     [1.5, 2.7] (fixed overhead no longer dominates) or the cap is hit.
-    Returns (marginal, ratio, ngen_used)."""
+    Returns (marginal, ratio, spread, ngen_used)."""
     while True:
-        m, r = _marginal(run, args, ngen)
+        m, r, s = _marginal(run, args, ngen)
         if 1.5 <= r <= 2.7 or 2 * ngen > max_ngen:
-            return m, r, ngen
+            return m, r, s, ngen
         ngen *= 2
 
 
 def measure(layout: str, n_dev: int):
+    """Marginal per-generation time for ``layout`` at the FIXED total size
+    (POP_PER_DEV * N_DEV individuals / N_DEV islands / MO_POP points),
+    partitioned over an ``n_dev``-device mesh.  n_dev=1 is the comparable
+    baseline: identical program, trivial mesh."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -97,10 +117,46 @@ def measure(layout: str, n_dev: int):
 
     key = jax.random.PRNGKey(0)
     mesh = Mesh(np.array(jax.devices()[:n_dev]), ("d",))
+    sh = NamedSharding(mesh, P("d"))
+
+    if layout == "mo":
+        from deap_tpu.parallel.emo_sharded import sel_nsga2_sharded
+        k_sel = MO_POP // 2
+        x = jax.random.uniform(key, (MO_POP, 3))
+        w = -jnp.stack([x[:, 0], x[:, 1] * (1.5 - x[:, 0]),
+                        x[:, 2] * (1.5 - x[:, 0])], axis=1)
+        w = jax.device_put(w, NamedSharding(mesh, P("d", None)))
+
+        fc = max(64, MO_POP // 16)     # fewer peel sub-rounds -> fewer
+                                       # per-round collectives
+
+        def sel_step(carry, _):
+            # thread w through the carry with a below-ulp perturbation
+            # derived from the previous selection, so XLA cannot hoist
+            # the loop-invariant selection out of the timed scan (the
+            # add rounds away bitwise: |acc|*1e-30 << f32 ulp of w)
+            wc, acc = carry
+            idx = sel_nsga2_sharded(None, wc, k_sel, mesh, axis="d",
+                                    front_chunk=fc)
+            acc = acc + jnp.sum(idx)
+            wc = wc + acc.astype(wc.dtype) * 1e-30
+            return (wc, acc), None
+
+        def run(ncalls):
+            @jax.jit
+            def r(w_):
+                (w_, acc), _ = lax.scan(sel_step, (w_, jnp.int32(0)),
+                                        None, length=ncalls)
+                return w_, acc[None]
+            return r
+
+        args = (w,)
+        txt = run(NGEN).lower(*args).compile().as_text()
+        marginal, ratio, spread, used = _marginal_gated(run, args, max(NGEN // 4, 2))
+        return marginal, ratio, spread, used, _collective_counts(txt)
 
     if layout == "pop":
-        pop_size = POP_PER_DEV * n_dev
-        sh = NamedSharding(mesh, P("d"))
+        pop_size = POP_PER_DEV * N_DEV           # total fixed, mesh varies
         genome = jax.device_put(
             jax.random.uniform(key, (pop_size, DIM), jnp.float32,
                                -5.12, 5.12), sh)
@@ -126,13 +182,12 @@ def measure(layout: str, n_dev: int):
 
         args = (key, genome, fv0)
         txt = run(NGEN).lower(*args).compile().as_text()
-        marginal, ratio, used = _marginal_gated(run, args, NGEN)
-        return marginal, ratio, used, _collective_counts(txt)
+        marginal, ratio, spread, used = _marginal_gated(run, args, NGEN)
+        return marginal, ratio, spread, used, _collective_counts(txt)
 
-    # island layout: one deme per device, ring migration each generation
-    sh = NamedSharding(mesh, P("d"))
+    # island layout: N_DEV demes total, stacked axis sharded over the mesh
     genome = jax.device_put(
-        jax.random.uniform(key, (n_dev, POP_PER_DEV, DIM), jnp.float32,
+        jax.random.uniform(key, (N_DEV, POP_PER_DEV, DIM), jnp.float32,
                            -5.12, 5.12), sh)
 
     def island_gen(k, pop):
@@ -148,7 +203,7 @@ def measure(layout: str, n_dev: int):
         k, k_gen, k_mig = jax.random.split(k, 3)
         pops = base.Population(g, base.Fitness(values=fv, valid=valid,
                                                weights=(-1.0,)))
-        keys = jax.random.split(k_gen, n_dev)
+        keys = jax.random.split(k_gen, N_DEV)
         pops = jax.vmap(island_gen)(keys, pops)
         bundle = dict(genome=pops.genome, values=pops.fitness.values,
                       valid=pops.fitness.valid)
@@ -158,7 +213,7 @@ def measure(layout: str, n_dev: int):
         return (k, nb["genome"], nb["values"], nb["valid"]), jnp.min(nb["values"])
 
     fv0 = jax.vmap(jax.vmap(lambda x: benchmarks.rastrigin(x)[0]))(genome)[..., None]
-    valid0 = jnp.ones((n_dev, POP_PER_DEV), bool)
+    valid0 = jnp.ones((N_DEV, POP_PER_DEV), bool)
 
     def run(ngen):
         @jax.jit
@@ -169,8 +224,8 @@ def measure(layout: str, n_dev: int):
 
     args = (key, genome, fv0, valid0)
     txt = run(NGEN).lower(*args).compile().as_text()
-    marginal, ratio, used = _marginal_gated(run, args, NGEN)
-    return marginal, ratio, used, _collective_counts(txt)
+    marginal, ratio, spread, used = _marginal_gated(run, args, NGEN)
+    return marginal, ratio, spread, used, _collective_counts(txt)
 
 
 def main():
@@ -180,20 +235,25 @@ def main():
             "run under JAX_PLATFORMS=cpu with "
             f"--xla_force_host_platform_device_count={N_DEV} "
             f"(have {len(jax.devices())} {jax.default_backend()} devices)")
-    out = {"metric": "weak_scaling_fixed_pop_per_device",
-           "pop_per_device": POP_PER_DEV, "dim": DIM, "n_devices": N_DEV,
-           "note": ("single physical core: ideal tN = N*t1; overhead = "
-                    "tN/(N*t1) isolates sharding-added work/communication"),
+    out = {"metric": "partition_overhead_fixed_total_size",
+           "pop_total": POP_PER_DEV * N_DEV, "mo_pop": MO_POP, "dim": DIM,
+           "n_devices": N_DEV, "repeats": REPEATS,
+           "note": ("same total-size program on an N-device vs 1-device "
+                    "mesh, one physical core: overhead = tN/t1 isolates "
+                    "partitioner-inserted collectives + duplicated work; "
+                    "real-pod efficiency ~ 1/overhead"),
            "layouts": {}}
-    for layout in ("pop", "island"):
-        t1, r1, n1, _ = measure(layout, 1)
-        tn, rn, nn, colls = measure(layout, N_DEV)
+    for layout in ("pop", "island", "mo"):
+        t1, r1, s1, n1, _ = measure(layout, 1)
+        tn, rn, sn, nn, colls = measure(layout, N_DEV)
         ok = (1.5 <= r1 <= 2.7) and (1.5 <= rn <= 2.7)
         out["layouts"][layout] = {
-            "t1_per_gen_ms": round(t1 * 1e3, 2),
-            f"t{N_DEV}_per_gen_ms": round(tn * 1e3, 2),
-            "overhead_factor": round(tn / (N_DEV * t1), 3) if ok else -1,
-            "timing_linearity": {"t1": round(r1, 2), f"t{N_DEV}": round(rn, 2),
+            "t1dev_per_gen_ms": round(t1 * 1e3, 2),
+            f"t{N_DEV}dev_per_gen_ms": round(tn * 1e3, 2),
+            "overhead_factor": round(tn / t1, 3) if ok else -1,
+            "repeat_spread": {"t1dev": round(s1, 3), f"t{N_DEV}dev": round(sn, 3)},
+            "timing_linearity": {"t1dev": round(r1, 2),
+                                 f"t{N_DEV}dev": round(rn, 2),
                                  "ngen_used": [n1, nn], "ok": ok},
             "collectives_in_hlo": colls,
         }

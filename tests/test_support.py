@@ -604,6 +604,46 @@ def test_grid_tie_overflow_falls_back():
     assert int(nf_g) == int(nf_p)
 
 
+def test_densegrid_ranks_match_peel():
+    """The dense value-rank grid (the discrete-objective exact path) must
+    reproduce the count-peel partition on integer objectives of every
+    shape — including duplicates, nobj=2/3/4, invalid rows — and fall
+    back (exactly) on continuous or high-cardinality axes."""
+    from deap_tpu.ops.emo import (_dense_value_grid_counts, _dense_value_ok,
+                                  _dominator_counts)
+    rng = np.random.default_rng(11)
+    cases = [
+        rng.integers(0, 6, size=(300, 3)).astype(np.float32),
+        rng.integers(0, 3, size=(200, 4)).astype(np.float32),
+        rng.integers(0, 9, size=(250, 2)).astype(np.float32),
+        np.repeat(rng.integers(0, 5, size=(50, 3)), 4, 0).astype(np.float32),
+        np.concatenate([rng.integers(0, 4, size=(80, 3)).astype(np.float32),
+                        np.full((8, 3), -np.inf, np.float32)], 0),
+    ]
+    for w in cases:
+        w = jnp.asarray(w)
+        m = w.shape[1]
+        vmax = max(2, min(512, int(round((2 ** 24) ** (1.0 / m)))))
+        cnt, ok = jax.jit(_dense_value_grid_counts,
+                          static_argnums=1)(w, vmax)
+        assert bool(ok)
+        ref = jax.jit(lambda w: _dominator_counts(
+            w, jnp.ones((w.shape[0],), bool)))(w)
+        np.testing.assert_array_equal(np.asarray(cnt), np.asarray(ref))
+        r_p, nf_p = jax.jit(
+            lambda w: nondominated_ranks(w, method="peel"))(w)
+        r_d, nf_d = jax.jit(
+            lambda w: nondominated_ranks(w, method="densegrid"))(w)
+        np.testing.assert_array_equal(np.asarray(r_d), np.asarray(r_p))
+        assert int(nf_d) == int(nf_p)
+    # continuous data must trip the precondition and fall back, exactly
+    w = jnp.asarray(rng.uniform(size=(300, 3)).astype(np.float32))
+    assert not bool(jax.jit(_dense_value_ok, static_argnums=1)(w, 256))
+    r_p, _ = jax.jit(lambda w: nondominated_ranks(w, method="peel"))(w)
+    r_d, _ = jax.jit(lambda w: nondominated_ranks(w, method="densegrid"))(w)
+    np.testing.assert_array_equal(np.asarray(r_d), np.asarray(r_p))
+
+
 def test_spea2_staged_matches_single_program():
     """The two-dispatch staged SPEA2 (axon pool>=2e5 path) must select
     exactly what the single-program form selects, in both the fill and

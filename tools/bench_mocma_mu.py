@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""MO-CMA-ES selection μ-sweep (round-4 verdict missing #2 "done"
+criterion): per-generation wall time of ``StrategyMultiObjective``'s
+generate+update at μ=λ ∈ {100, 1000, 3000, 10000}, device vs host
+selection backend, on the worst-case input (every candidate on ONE
+front, so environmental selection peels λ least-HV-contributors per
+generation — the regime where the host path pays λ device syncs).
+
+The reference supports arbitrary μ (/root/reference/deap/cma.py:328-547)
+but its per-individual Python loops make large μ impractical; stock
+published configs stop at μ=100.  Feeds docs/performance.md's MO-CMA row.
+
+Usage: python tools/bench_mocma_mu.py [mu ...]    (default sweep)
+Env: MOCMA_BACKENDS=device,host  MOCMA_REPS=2
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+MUS = [int(a) for a in sys.argv[1:]] or [100, 1000, 3000, 10000]
+BACKENDS = os.environ.get("MOCMA_BACKENDS", "device,host").split(",")
+REPS = int(os.environ.get("MOCMA_REPS", 2))
+DIM = 10
+# the host peel is ~quadratic in mu with a device sync per removal;
+# anything past this takes minutes per generation — skip, note why
+HOST_MU_CAP = int(os.environ.get("MOCMA_HOST_CAP", 1000))
+
+
+def arc(rng, n):
+    """n points on a quarter circle: one mutually-nondominated front."""
+    t = np.sort(rng.uniform(0.05, np.pi / 2 - 0.05, n))
+    return np.stack([np.cos(t), np.sin(t)], 1)
+
+
+def time_one(mu: int, backend: str):
+    from deap_tpu import cma
+    rng = np.random.default_rng(0)
+    s = cma.StrategyMultiObjective(
+        rng.uniform(size=(mu, DIM)), (-1.0, -1.0), 0.5,
+        values=arc(rng, mu), mu=mu, lambda_=mu,
+        select_backend={"device": "auto", "host": "host"}[backend])
+    off = s.generate(jax.random.PRNGKey(1))
+    s.update(off, arc(rng, mu))                   # warm jits
+    times = []
+    for rep in range(REPS):
+        off = s.generate(jax.random.PRNGKey(2 + rep))
+        vals = arc(rng, mu)
+        t0 = time.perf_counter()
+        s.update(off, vals)
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def main():
+    out = {"metric": "mocma_update_worst_case_s_per_gen", "dim": DIM,
+           "platform": jax.devices()[0].platform, "rows": []}
+    for mu in MUS:
+        row = {"mu": mu}
+        for backend in BACKENDS:
+            if backend == "host" and mu > HOST_MU_CAP:
+                row["host_s"] = None
+                row["host_note"] = f"skipped: >~quadratic past mu={HOST_MU_CAP}"
+                continue
+            t = time_one(mu, backend)
+            row[f"{backend}_s"] = round(t, 4)
+            print(f"  mu={mu} {backend}: {t:.3f}s/gen", file=sys.stderr)
+        out["rows"].append(row)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,270 @@
+#!/usr/bin/env python
+"""Roofline probes for the Pallas GP stack machine (round-4 verdict weak
+#2: the GA got a hand-probe floor, the GP kernel never did — "done" is
+unproven until the measured gens/s is placed against a demonstrated
+per-token floor).
+
+The kernel's work unit is a *token*: one scalar SMEM opcode read, one
+``lax.switch`` dispatch, one VPU op over the resident (1, pts_pad) top
+row, and (for pushes/binary ops) one VMEM stack-row access
+(deap_tpu/gp/interp_pallas.py).  These probes strip that loop down and
+add the costs back one at a time, at the steady-state shape of bench_gp
+(pop=4096, cap=64, 1024 points, mean tree length ≈ 63):
+
+  noswitch   the bare token loop: scalar length/const SMEM reads + one
+             (1, pts_pad) VPU op per token, NO dispatch, NO stack — the
+             floor of the loop machinery itself
+  dispatch   + ``lax.switch`` over the bench pset's 9 distinct branches
+             (opcode-dependent compute is semantically required; this is
+             the honest floor for any per-token interpreter)
+  stackrw    + one VMEM stack-row read or write per token (the real
+             kernel's traffic under the top-in-carry scheme)
+  real63     the ACTUAL production evaluator on full binary trees of
+             exactly 63 tokens (well-defined token count; binary prims
+             exercise the one-row-read path that dominates at steady
+             state)
+
+Each probe reports ns/token and Mtok/s; ``real63 / stackrw`` is the
+fraction-of-demonstrated-floor figure the verdict asks for, and
+``dispatch − noswitch`` prices the scalar dispatch that round 4 estimated
+at ~40 cycles/token.  Variants: tb (trees per grid step, the
+``block_trees`` knob) and loop unroll.
+
+Timing: k and 2k back-to-back evaluations inside one jitted ``lax.scan``
+with a data dependence through X between iterations (no CSE), marginal
+(t2k−tk)/k, linearity ratio carried.  One TPU process at a time.
+
+Usage: python tools/pallas_probe_gp.py [probe ...]   (default: all)
+"""
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+POP = int(os.environ.get("PROBE_POP", 4096))
+CAP = int(os.environ.get("PROBE_CAP", 64))
+NPTS = int(os.environ.get("PROBE_POINTS", 1024))
+LEN = 63                     # full binary tree of depth 5
+K_ITERS = int(os.environ.get("PROBE_ITERS", 32))
+LANE = 128
+
+
+def _round_up(n, m):
+    return (n + m - 1) // m * m
+
+
+def bench_pset():
+    """The bench_gp primitive set (9 dispatch targets after freezing)."""
+    from deap_tpu import gp
+    ps = gp.PrimitiveSet("MAIN", 1)
+    ps.add_primitive(jnp.add, 2, name="add")
+    ps.add_primitive(jnp.subtract, 2, name="sub")
+    ps.add_primitive(jnp.multiply, 2, name="mul")
+    ps.add_primitive(gp.protected_div, 2, name="div")
+    ps.add_primitive(jnp.negative, 1, name="neg")
+    ps.add_primitive(jnp.cos, 1, name="cos")
+    ps.add_primitive(jnp.sin, 1, name="sin")
+    ps.add_ephemeral_constant(
+        "rand101",
+        lambda key: jax.random.randint(key, (), -1, 2).astype(jnp.float32))
+    return ps
+
+
+def full_binary_trees(pset, rng):
+    """(codes, consts, lengths): POP valid prefix programs, each a full
+    depth-5 tree of binary primitives over the argument/ephemeral leaves —
+    exactly LEN tokens, so the probe's token count is exact."""
+    from deap_tpu.gp.pset import (Argument, Ephemeral, Primitive,
+                                  freeze_pset)
+    nodes = list(freeze_pset(pset).pset.nodes)
+    bin_codes = [i for i, n in enumerate(nodes)
+                 if isinstance(n, Primitive) and n.arity == 2]
+    arg_codes = [i for i, n in enumerate(nodes) if isinstance(n, Argument)]
+    eph_codes = [i for i, n in enumerate(nodes) if isinstance(n, Ephemeral)]
+    leaf_codes = arg_codes + eph_codes
+
+    def one_tree():
+        codes, consts = [], []
+
+        def rec(d):
+            if d == 0:
+                c = leaf_codes[rng.integers(len(leaf_codes))]
+                codes.append(c)
+                consts.append(float(rng.integers(-1, 2))
+                              if c in eph_codes else 0.0)
+            else:
+                codes.append(bin_codes[rng.integers(len(bin_codes))])
+                consts.append(0.0)
+                rec(d - 1)
+                rec(d - 1)
+
+        rec(5)
+        pad = CAP - len(codes)
+        return codes + [0] * pad, consts + [0.0] * pad
+
+    cc = [one_tree() for _ in range(POP)]
+    codes = jnp.asarray(np.array([c for c, _ in cc], np.int32))
+    consts = jnp.asarray(np.array([k for _, k in cc], np.float32))
+    lengths = jnp.full((POP,), LEN, jnp.int32)
+    return codes, consts, lengths
+
+
+def make_probe_kernel(mode: str, n_branches: int, tb: int, unroll):
+    """A stripped stack-machine kernel: same block plumbing as the real
+    one, per-token work controlled by ``mode``."""
+    pts_pad = _round_up(NPTS, LANE)
+
+    def make_branch(j):
+        scale = np.float32(1.0 + j * 1e-7)     # distinct bodies: no CSE
+
+        if mode == "stackrw":
+            if j % 2 == 0:                     # binary-like: one row read
+                def branch(sp, top, const, stack_ref):
+                    other = stack_ref[jnp.maximum(sp - 2, 0), :][None, :]
+                    return sp, top * scale + other + const
+            else:                              # push-like: one row write
+                def branch(sp, top, const, stack_ref):
+                    stack_ref[jnp.maximum(sp - 1, 0), :] = top[0, :]
+                    return sp, top * scale + const
+        else:
+            def branch(sp, top, const, stack_ref):
+                return sp, top * scale + const
+        return branch
+
+    branches = [make_branch(j) for j in range(n_branches)]
+
+    def kernel(codes_ref, consts_ref, lengths_ref, out_ref, stack_ref):
+        def tree_body(i, _):
+            length = lengths_ref[i, 0]
+
+            def step(t_rev, carry):
+                sp, top = carry
+                t = length - 1 - t_rev
+                c = codes_ref[i, t]
+                const = consts_ref[i, t]
+                if mode == "noswitch":
+                    return sp, top + const
+                return lax.switch(
+                    c, [functools.partial(b, stack_ref=stack_ref)
+                        for b in branches], sp, top, const)
+
+            top0 = jnp.zeros((1, pts_pad), jnp.float32)
+            _, top = lax.fori_loop(0, length, step, (0, top0),
+                                   unroll=unroll)
+            out_ref[i, :] = top[0, :]
+            return 0
+
+        lax.fori_loop(0, tb, tree_body, 0, unroll=False)
+
+    pop_pad = _round_up(POP, tb)
+
+    @jax.jit
+    def run(codes, consts, lengths, x):
+        # x folds into consts so successive iterations depend on the
+        # previous result (the scan below feeds it back)
+        consts = consts + x[0, 0] * 1e-30
+        out = pl.pallas_call(
+            kernel,
+            grid=(pop_pad // tb,),
+            in_specs=[
+                pl.BlockSpec((tb, CAP), lambda g: (g, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((tb, CAP), lambda g: (g, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((tb, 1), lambda g: (g, 0),
+                             memory_space=pltpu.SMEM),
+            ],
+            out_specs=pl.BlockSpec((tb, pts_pad), lambda g: (g, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((pop_pad, pts_pad), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((CAP + 1, pts_pad), jnp.float32)],
+            interpret=jax.default_backend() != "tpu",
+        )(codes, consts, lengths[:, None])
+        return out[:POP, :NPTS]
+
+    return run
+
+
+def timed_loop(fn, args, x0, iters):
+    """fn(*args, x) -> (pop, npts); scan it ``iters`` times with x fed
+    back; returns seconds (forced)."""
+    @jax.jit
+    def loop(x):
+        def body(x, _):
+            out = fn(*args, x)
+            return x + out[:1, :1] * 1e-30, out[0, 0]
+        _, ys = lax.scan(body, x, None, length=iters)
+        return ys
+
+    np.asarray(loop(x0))                       # compile + warm
+    t0 = time.perf_counter()
+    np.asarray(loop(x0))
+    return time.perf_counter() - t0
+
+
+def marginal_tokens(fn, args, total_tokens_per_eval):
+    x0 = jnp.ones((1, 1), jnp.float32)
+    tk = timed_loop(fn, args, x0, K_ITERS)
+    t2k = timed_loop(fn, args, x0, 2 * K_ITERS)
+    marginal = (t2k - tk) / K_ITERS            # s per eval
+    ratio = t2k / tk
+    ns_per_token = marginal / total_tokens_per_eval * 1e9
+    return {"ns_per_token": round(ns_per_token, 3),
+            "mtok_per_s": round(total_tokens_per_eval / marginal / 1e6, 1),
+            "eval_ms": round(marginal * 1e3, 3),
+            "linearity": round(ratio, 2)}
+
+
+def main(argv):
+    from deap_tpu.gp.interp_pallas import make_population_evaluator_pallas
+    ps = bench_pset()
+    rng = np.random.default_rng(0)
+    codes, consts, lengths = full_binary_trees(ps, rng)
+    tokens = POP * LEN
+
+    all_probes = ["noswitch", "dispatch", "stackrw", "real63",
+                  "noswitch_tb32", "dispatch_tb32", "real63_tb32",
+                  "dispatch_unroll2", "stackrw_unroll2"]
+    want = argv[1:] or all_probes
+    out = {"shape": {"pop": POP, "cap": CAP, "points": NPTS, "len": LEN},
+           "platform": jax.devices()[0].platform, "probes": {}}
+    n_branches = 9
+
+    for name in want:
+        base_name = name.split("_")[0]
+        tb = 32 if name.endswith("tb32") else 8
+        unroll = 2 if name.endswith("unroll2") else False
+        if base_name == "real63":
+            ev = make_population_evaluator_pallas(ps, CAP, block_trees=tb)
+            X = jnp.linspace(-1, 1, NPTS, jnp.float32)[None, :]
+
+            def fn(codes, consts, lengths, x, ev=ev, X=X):
+                return ev(codes, consts, lengths, X + x * 1e-30)
+
+            res = marginal_tokens(fn, (codes, consts, lengths), tokens)
+        else:
+            run = make_probe_kernel(base_name, n_branches, tb, unroll)
+            res = marginal_tokens(run, (codes, consts, lengths), tokens)
+        out["probes"][name] = res
+        print(f"  {name:20s} {res}", file=sys.stderr)
+
+    pr = out["probes"]
+    if "real63" in pr and "stackrw" in pr:
+        out["fraction_of_floor"] = round(
+            pr["stackrw"]["ns_per_token"] / pr["real63"]["ns_per_token"], 3)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main(sys.argv)

@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Roofline decomposition for the neuroevolution rollout (round-4 verdict
+weak #5: the 2.7–3.0·10⁸ env-steps/s plateau is asserted, not derived).
+
+Per environment step the rollout does, for every (individual, episode)
+lane: a 4→16 and a 16→2 per-individual matmul, a tanh, an argmax, and
+~30 flops of cart-pole physics.  Candidate bounds:
+
+  physics    the Euler update alone (fixed action) — the floor any
+             policy form shares
+  matmul     the production policy as written: per-lane ``obs @ w1``
+             batched by vmap into (B, 1, 4) @ (B, 4, 16) batched
+             matmuls — each padded to MXU tiles, ~1000× FLOP waste at
+             these shapes
+  bcast      the same math as broadcast-multiply-reduce
+             (``sum(obs[:, None] * w1, 0)``) — pure VPU, no MXU tiles
+  full       physics + policy, both policy forms
+  masked     the ``lax.while_loop`` rollout (vmap turns its condition
+             into "any lane alive", so the loop runs to the BATCH max
+             episode length, not MAX_STEPS) on near-random policies,
+             where episodes die in tens of steps — the early-termination
+             economy stock DEAP gets per-episode, recovered batch-wide
+
+Each probe scans ``STEPS`` env steps over a (POP × EPISODES) lane batch
+and reports ns/env-step and env-steps/s, marginal over k vs 2k scans.
+
+Usage: python tools/probe_evopole.py [probe ...]
+Env: PROBE_POP (16384), PROBE_EPISODES (4), PROBE_STEPS (500).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+POP = int(os.environ.get("PROBE_POP", 16384))
+EPS = int(os.environ.get("PROBE_EPISODES", 4))
+STEPS = int(os.environ.get("PROBE_STEPS", 500))
+K = int(os.environ.get("PROBE_ITERS", 4))
+
+from examples.ga.evopole import (env_step, init_population, MAX_STEPS,
+                                 X_LIMIT, THETA_LIMIT, HIDDEN)
+
+
+def policy_matmul(genome, obs):
+    h = jnp.tanh(obs @ genome["w1"] + genome["b1"])
+    return jnp.argmax(h @ genome["w2"] + genome["b2"])
+
+
+def policy_bcast(genome, obs):
+    h = jnp.tanh(jnp.sum(obs[:, None] * genome["w1"], 0) + genome["b1"])
+    logits = jnp.sum(h[:, None] * genome["w2"], 0) + genome["b2"]
+    return jnp.argmax(logits)
+
+
+def make_scan_rollout(policy):
+    def rollout(genome, key):
+        state0 = jax.random.uniform(key, (4,), jnp.float32, -0.05, 0.05)
+
+        def step(carry, _):
+            state, alive = carry
+            action = policy(genome, state)
+            state = env_step(state, action)
+            alive = alive & (jnp.abs(state[0]) < X_LIMIT) \
+                          & (jnp.abs(state[2]) < THETA_LIMIT)
+            return (state, alive), alive
+
+        (_, _), alive_trace = lax.scan(
+            step, (state0, jnp.bool_(True)), None, length=STEPS)
+        return jnp.sum(alive_trace.astype(jnp.float32))
+    return rollout
+
+
+def make_masked_rollout(policy):
+    def rollout(genome, key):
+        state0 = jax.random.uniform(key, (4,), jnp.float32, -0.05, 0.05)
+
+        def cond(c):
+            _, alive, t, _ = c
+            return alive & (t < STEPS)
+
+        def body(c):
+            state, alive, t, total = c
+            action = policy(genome, state)
+            state = env_step(state, action)
+            alive = alive & (jnp.abs(state[0]) < X_LIMIT) \
+                          & (jnp.abs(state[2]) < THETA_LIMIT)
+            return state, alive, t + 1, total + alive.astype(jnp.float32)
+
+        _, _, _, total = lax.while_loop(
+            cond, body, (state0, jnp.bool_(True), jnp.int32(0),
+                         jnp.float32(0.0)))
+        return total
+    return rollout
+
+
+def physics_only_rollout(genome, key):
+    state0 = jax.random.uniform(key, (4,), jnp.float32, -0.05, 0.05)
+
+    def step(carry, _):
+        state, alive = carry
+        action = (state[3] > 0).astype(jnp.int32)   # fixed cheap policy
+        state = env_step(state, action)
+        alive = alive & (jnp.abs(state[0]) < X_LIMIT) \
+                      & (jnp.abs(state[2]) < THETA_LIMIT)
+        return (state, alive), alive
+
+    (_, _), alive_trace = lax.scan(
+        step, (state0, jnp.bool_(True)), None, length=STEPS)
+    return jnp.sum(alive_trace.astype(jnp.float32))
+
+
+def timed(rollout_fn, genome, ep_keys, iters):
+    @jax.jit
+    def run(genome, s):
+        def body(s, _):
+            f = jax.vmap(lambda g: jnp.mean(jax.vmap(
+                lambda k: rollout_fn(g, k))(ep_keys)))(genome)
+            # fold the result into a scalar carried dependence
+            return s + jnp.sum(f) * 1e-20, jnp.max(f)
+        _, ys = lax.scan(body, s, None, length=iters)
+        return ys
+
+    np.asarray(run(genome, jnp.float32(0.0)))      # compile + warm
+    t0 = time.perf_counter()
+    np.asarray(run(genome, jnp.float32(0.0)))
+    return time.perf_counter() - t0
+
+
+def marginal(rollout_fn, genome, ep_keys):
+    tk = timed(rollout_fn, genome, ep_keys, K)
+    t2k = timed(rollout_fn, genome, ep_keys, 2 * K)
+    m = (t2k - tk) / K                              # s per full-batch eval
+    return m, t2k / tk
+
+
+def main(argv):
+    key = jax.random.PRNGKey(0)
+    k_init, k_eps = jax.random.split(key)
+    genome = init_population(k_init, POP)
+    ep_keys = jax.random.split(k_eps, EPS)
+    lanes = POP * EPS
+    full_steps = lanes * STEPS
+
+    probes = {
+        "physics": (physics_only_rollout, full_steps),
+        "matmul": (make_scan_rollout(policy_matmul), full_steps),
+        "bcast": (make_scan_rollout(policy_bcast), full_steps),
+        "masked_bcast": (make_masked_rollout(policy_bcast), None),
+        "masked_matmul": (make_masked_rollout(policy_matmul), None),
+    }
+    want = argv[1:] or list(probes)
+    out = {"shape": {"pop": POP, "episodes": EPS, "steps": STEPS},
+           "platform": jax.devices()[0].platform, "probes": {}}
+    for name in want:
+        fn, denom = probes[name]
+        m, ratio = marginal(fn, genome, ep_keys)
+        row = {"eval_ms": round(m * 1e3, 2), "linearity": round(ratio, 2)}
+        if denom:
+            row["env_steps_per_s"] = round(denom / m / 1e6, 1)
+            row["unit"] = "Msteps/s"
+        else:
+            # masked rollouts run to the batch-max episode length; report
+            # wall only (near-random policies die early, so this shows
+            # the early-termination economy, not a steps/s rate)
+            row["note"] = "runs to batch-max episode length"
+        out["probes"][name] = row
+        print(f"  {name:14s} {row}", file=sys.stderr)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main(sys.argv)
