@@ -65,7 +65,8 @@ def _rows_dominate_counts(rows: jax.Array, w: jax.Array) -> jax.Array:
     return jnp.sum(dominates(rows[:, None, :], w[None, :, :]), axis=0)
 
 
-def _grid_dominator_counts(w: jax.Array, bucket_cells: int = 2 ** 24,
+def _grid_dominator_counts(w: jax.Array, src: jax.Array | None = None,
+                           bucket_cells: int = 2 ** 24,
                            tie_window: int = 64, slab_chunk: int = 8):
     """Sub-quadratic dominator counts for any nobj — the O(MN²) killer the
     round-3 verdict asked for (reference ships Fortin-2013 divide-and-
@@ -104,9 +105,24 @@ def _grid_dominator_counts(w: jax.Array, bucket_cells: int = 2 ** 24,
     back to the count-peel — continuous objectives never trip this).
     :func:`_grid_tie_ok` computes the same flag standalone so callers can
     gate on it *before* paying for the grid (see ``nondominated_ranks``'s
-    ``lax.cond``)."""
+    ``lax.cond``).
+
+    ``src`` (optional bool ``(n,)``) restricts the *sources*: counts
+    become "dominators among the masked rows" while queries stay all
+    rows.  This powers the recompute peel (:func:`_grid_recount_ranks`),
+    which re-derives counts against the still-active set each round
+    instead of incrementally subtracting peeled fronts."""
     n, m = w.shape
-    B = max(2, int(round(bucket_cells ** (1.0 / m))))
+    if src is None:
+        src = jnp.ones((n,), bool)
+    # Bucket count per axis: capped by bucket_cells, but also scaled down
+    # with n (cells ≈ 128·n) so small inputs don't pay a 2²⁴-cell
+    # histogram + cumsum per call — this matters for the recompute peel
+    # (:func:`_grid_recount_ranks`), which runs one counts pass PER FRONT:
+    # on F≈N chain inputs a fixed 16.7M-cell pass per round is pure waste
+    # (at n=2·10⁵, nobj=3 the scaled form still reaches B=256 = the cap).
+    B = max(2, min(int(round(bucket_cells ** (1.0 / m))),
+                   int(round((128.0 * n) ** (1.0 / m)))))
     T = -(-n // B)                                    # slab size
     n_pad = B * T
     pad = n_pad - n
@@ -120,7 +136,7 @@ def _grid_dominator_counts(w: jax.Array, bucket_cells: int = 2 ** 24,
     lin = b[0]
     for c in range(1, m):
         lin = lin * B + b[c]
-    hist = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), lin,
+    hist = jax.ops.segment_sum(src.astype(jnp.int32), lin,
                                num_segments=B ** m)
     H = hist.reshape((B,) * m)
     for ax in range(m):                               # suffix-inclusive sums
@@ -144,23 +160,26 @@ def _grid_dominator_counts(w: jax.Array, bucket_cells: int = 2 ** 24,
         Pv = pad_to(pos[:, idx].T, -1)                # (n_pad, m) int
         Bv = pad_to(b[:, idx].T, -1)                  # (n_pad, m) int
         Vv = pad_to(jnp.ones((n,), bool), False)      # (n_pad,)
+        Sv = pad_to(src[idx], False)                  # (n_pad,) sources,
+        #                                               in THIS AXIS'S sorted
+        #                                               view like Wv/Pv/Bv
 
         # bands: within-slab tile×tile pos-comparisons, slab_chunk slabs
         # per scan step to bound the (chunk, T, T) temporaries
         def band_step(_, tiles, c=c):
-            tp, tb, tv = tiles                        # (sc, T, ...)
+            tp, tb, ts = tiles                        # (sc, T, ...)
             ge = jnp.all(tp[:, None, :, :] >= tp[:, :, None, :], -1)
             first = jnp.ones_like(ge)
             for c2 in range(c):                       # dedup: first equal axis
                 first &= tb[:, None, :, c2] != tb[:, :, None, c2]
-            cnt = jnp.sum(ge & first & tv[:, None, :], axis=2)
+            cnt = jnp.sum(ge & first & ts[:, None, :], axis=2)
             return None, cnt                          # (sc, T) per-query
 
         sc = slab_chunk
         while B % sc:
             sc -= 1
         tiles = tuple(x.reshape((B // sc, sc, T) + x.shape[1:])
-                      for x in (Pv, Bv, Vv))
+                      for x in (Pv, Bv, Sv))
         _, band = lax.scan(band_step, None, tiles)
         counts = counts + band.reshape(-1)[pos[c]]    # unsort via gather
 
@@ -169,20 +188,19 @@ def _grid_dominator_counts(w: jax.Array, bucket_cells: int = 2 ** 24,
         wc = Wv[:, c]
         V = min(tie_window, n_pad - 1)
         exact_ok &= ~jnp.any(Vv[V:] & Vv[:-V] & (wc[V:] == wc[:-V]))
-        counts = counts + _tie_pass_delta(Wv, Pv, Vv, Vv, c, V)[pos[c]]
+        counts = counts + _tie_pass_delta(Wv, Pv, Sv, Vv, c, V)[pos[c]]
 
     # --- duplicates: exact-equal rows never dominate ---------------------
     full_ord, gid, inv_full = _dup_groups(w)
-    gsize = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), gid,
-                                num_segments=n)[gid]
-    counts = counts - gsize[inv_full]
+    gsrc = jax.ops.segment_sum(src[full_ord].astype(jnp.int32), gid,
+                               num_segments=n)[gid]
+    counts = counts - gsrc[inv_full]
     return counts, exact_ok
 
 
 def _tie_pass_delta(Wv, Pv, src_mask, query_mask, c: int, V: int):
-    """Rolled tie-window pass for axis ``c``, shared by the grid counts
-    (sources = every valid row) and the grid-assisted peel subtraction
-    (sources = the peeled front): counts, per sorted-view query row, the
+    """Rolled tie-window pass for axis ``c``, used by the (optionally
+    source-masked) grid counts: counts, per sorted-view query row, the
     ``src_mask`` sources value-≥ everywhere whose value TIES the query
     on axis ``c`` with a lower position — the pairs position-space
     counting misses — deduplicated by "first such axis".  A fori_loop
@@ -210,9 +228,8 @@ def _tie_pass_delta(Wv, Pv, src_mask, query_mask, c: int, V: int):
 def _dup_groups(w: jax.Array):
     """Exact-duplicate row groups: ``(full_ord, gid, inv_full)`` where
     ``gid`` labels each row of ``w[full_ord]`` with its duplicate group
-    and ``inv_full`` maps back to original row order.  Shared by the
-    grid counts and the grid-assisted peel (equal rows satisfy
-    ≥-everywhere but never dominate)."""
+    and ``inv_full`` maps back to original row order.  Used by the grid
+    counts (equal rows satisfy ≥-everywhere but never dominate)."""
     n, m = w.shape
     full_ord = jnp.lexsort(tuple(w[:, c] for c in range(m - 1, -1, -1)))
     ws = w[full_ord]
@@ -420,14 +437,16 @@ def nondominated_ranks(w: jax.Array, valid: jax.Array | None = None,
       ``(C, N)`` kernel.  Total ~2·O(MN²) on shallow-front data, but the
       per-front compaction costs O(front_chunk·N) even for tiny fronts, so
       adversarially deep data (F ≈ N fronts) degrades to O(N²·chunk).
-    * ``grid`` (any nobj ≥ 2, the nobj≥3 large-n default): the initial
-      counts come from :func:`_grid_dominator_counts` — histogram +
-      suffix-cumsum for cross-slab pairs, within-slab tile compares and a
-      rolled tie window for the rest, O(nobj·N²/B) pair work instead of
-      O(nobj·N²) — then the same incremental peel.  Exact for all inputs;
-      an objective value repeated > 64 times trips the built-in fallback
-      (one ``lax.cond`` chain, all branches compiled) to ``densegrid``,
-      and only if that also declines to the count-peel.
+    * ``grid`` (any nobj ≥ 2, the nobj≥3 large-n default): the
+      *recompute peel* (:func:`_grid_recount_ranks`) — each round
+      re-derives dominator counts against the still-active set with the
+      source-masked grid pass (:func:`_grid_dominator_counts`:
+      histogram + suffix-cumsum for cross-slab pairs, within-slab tile
+      compares and a rolled tie window for the rest, O(nobj·N²/B) pair
+      work instead of O(nobj·N²)) and peels ``count == 0``.  Exact for
+      all inputs; an objective value repeated > 64 times trips the
+      built-in ``lax.cond`` fallback to the count-peel (``densegrid``
+      stays an explicit method — see below).
     * ``densegrid`` (any nobj ≥ 2): exact counts for *discrete*
       objectives via :func:`_dense_value_grid_counts` — dense value-rank
       histogram + suffix cumsum, O(N + V^nobj), exact for any tie
@@ -489,12 +508,12 @@ def nondominated_ranks(w: jax.Array, valid: jax.Array | None = None,
         # a third complete peel program in the hot path would lengthen
         # every large-n compile (a documented pitfall on this backend)
         # to cover data that callers know they have.  Under the grid,
-        # the PEEL's subtraction is grid-assisted too (round-4 weak #3:
-        # the per-front exact subtract re-paid the O(MN²) the grid
-        # counts had saved).
+        # the PEEL is the recompute form — one source-masked counts
+        # pass per round (round-4 weak #3: the per-front exact subtract
+        # re-paid the O(MN²) the grid counts had saved).
         return lax.cond(
             _grid_tie_ok(w),
-            lambda: _grid_assisted_ranks(w, stop_at_k, c),
+            lambda: _grid_recount_ranks(w, stop_at_k, c),
             lambda: _peel_from_counts(
                 w, _dominator_counts(w, jnp.ones((n,), bool)),
                 stop_at_k, c))
@@ -502,18 +521,14 @@ def nondominated_ranks(w: jax.Array, valid: jax.Array | None = None,
     return _peel_from_counts(w, counts, stop_at_k, c)
 
 
-def _peel_from_counts(w: jax.Array, counts: jax.Array,
-                      stop_at_k: int | None, front_chunk: int,
-                      subtract_front=None):
-    """The incremental front peel shared by every counts source: peel the
-    zero-count front, subtract its dominance contribution from the
-    survivors' counts, repeat.  ``subtract_front(counts, front) ->
-    counts`` may be supplied (the grid-assisted form); the default is the
-    chunked exact-dominance subtraction."""
+def _make_exact_subtract(w: jax.Array, c: int):
+    """Chunked exact front subtraction shared by :func:`_peel_from_counts`
+    and the hybrid peel's thin-front branch: compact the front into sized
+    ``(c,)`` index buffers and subtract its dominance contribution with
+    ``(C, N)`` kernels.  Sentinel row ``n``: -inf rows dominate nothing,
+    and the sentinel slot of the todo mask absorbs out-of-range scatter
+    indices harmlessly."""
     n, m = w.shape
-    c = front_chunk
-    # sentinel row n: -inf rows dominate nothing, and the sentinel slot of
-    # the todo mask absorbs out-of-range scatter indices harmlessly
     wp = jnp.concatenate([w, jnp.full((1, m), -jnp.inf, w.dtype)], 0)
 
     def subtract_front_exact(counts, front):
@@ -532,8 +547,21 @@ def _peel_from_counts(w: jax.Array, counts: jax.Array,
         counts, _ = lax.while_loop(sub_cond, sub_body, (counts, todo))
         return counts
 
+    return subtract_front_exact
+
+
+def _peel_from_counts(w: jax.Array, counts: jax.Array,
+                      stop_at_k: int | None, front_chunk: int,
+                      subtract_front=None):
+    """The incremental front peel shared by every counts source: peel the
+    zero-count front, subtract its dominance contribution from the
+    survivors' counts, repeat.  ``subtract_front(counts, front) ->
+    counts`` may be supplied; the default is the chunked exact-dominance
+    subtraction."""
+    n, m = w.shape
+    c = front_chunk
     if subtract_front is None:
-        subtract_front = subtract_front_exact
+        subtract_front = _make_exact_subtract(w, c)
 
     stop = n if stop_at_k is None else min(int(stop_at_k), n)
 
@@ -556,131 +584,81 @@ def _peel_from_counts(w: jax.Array, counts: jax.Array,
     return ranks, nf
 
 
-def _grid_assisted_ranks(w: jax.Array, stop_at_k: int | None,
-                         front_chunk: int, sub_cells: int = 2 ** 18,
-                         tie_window: int = 64, member_chunk: int = 512):
-    """Front peel whose per-front subtraction is grid-decomposed — the
-    round-4 "sketched, not built" lever (docs/performance.md): the exact
-    chunked subtract re-pays O(M·N²) over the whole peel (every point is
-    subtracted against every column exactly once — 1.3 s of the 3-obj
-    pop=10⁵ generation's 1.5 s), while this form pays
+def _grid_recount_ranks(w: jax.Array, stop_at_k: int | None,
+                        front_chunk: int = 1024,
+                        bucket_cells: int = 2 ** 24, tie_window: int = 64,
+                        slab_chunk: int = 8,
+                        recount_min_front: int | None = None):
+    """Hybrid front peel: carried dominator counts, with each round's
+    update chosen by the peeled front's width (one ``lax.cond``):
 
-    * per front: one value-grid histogram + suffix cumsum over
-      ``B^nobj ≈ sub_cells`` cells (strictly-above-cell sources), one
-      rolled ``tie_window`` pass per axis (value ties crossing the
-      position order), one duplicate-group correction, and
-    * per member: a tile×member compare against the member's own
-      position slab on each axis — Σ front sizes = N members total, so
-      the whole peel's band work is O(N·T·nobj), not O(N²·nobj).
+    * **thin front** (< ``recount_min_front``, default 4·``front_chunk``)
+      — exact incremental subtraction: compact the front into
+      ``(front_chunk,)`` buffers and subtract its dominance contribution
+      with chunked ``(C, N)`` kernels, cost ∝ front width (~10 ms per
+      1024-row chunk at N=2·10⁵ on the bench chip).
+    * **fat front** — *recompute*: one source-masked grid pass
+      (:func:`_grid_dominator_counts` with ``src`` = the remaining
+      active set) re-derives every count in O(N·(nobj·N/B +
+      nobj·tie_window) + B^nobj) — flat in front width (≈ the 41 ms
+      initial-counts cost at N=2·10⁵, nobj=3).
 
-    Decomposition identical to :func:`_grid_dominator_counts` (sources =
-    the peeled front instead of "all points"): strict-bucket + same-slab
-    band (dedup by first equal-bucket axis) + tie correction counts
-    sources value-≥ everywhere; subtracting the front members
-    value-EQUAL to each point (which never dominate) leaves exactly the
-    front's dominance contribution.  Exactness needs the caller's
-    ``_grid_tie_ok`` gate (no value repeated > ``tie_window`` times),
-    the same gate the initial grid counts need.
+    Both update rules yield counts-vs-active for every still-active
+    point, so they compose freely round to round; the switch makes the
+    peel cost ``min(front·N, flat)`` per round.  This matters because
+    front width is regime-dependent: random pools peel hundreds of
+    thin fronts (exact subtraction wins), converged steady-state pools
+    peel a handful of 10⁴-wide fronts (recompute wins ~4×, measured —
+    round-4 weak #3).
 
-    The slab tiles are fetched by one-hot matmul over the bucket axis,
-    not gather — gathers are index-rate-bound on the axon backend (~82 M
-    rows/s; a gathered fetch here measured as the bottleneck) while the
-    MXU does the equivalent contraction essentially for free."""
+    A per-member incremental *grid* subtract (one-hot slab fetch +
+    scatter-add inside the peel loop) was built first and is
+    asymptotically cheaper on paper — O(N·T·nobj) band work *total* —
+    but its nested while_loop + scatter-add program deterministically
+    crashes the axon TPU worker at N = 2·10⁵ even though every piece
+    passes alone (the backend's kernel-mix fault class;
+    tools/probe_gridpeel.py is the bisect harness and records the fault
+    map).  Both branches here use only program shapes the chip
+    demonstrably runs inside a peel loop.
+
+    Exactness needs the caller's ``_grid_tie_ok`` gate, like the counts
+    pass itself.  Invalid (-inf) rows are dominated by every finite row,
+    so they peel last, preserving ``nondominated_ranks`` semantics."""
     n, m = w.shape
-    counts0, _ = _grid_dominator_counts(w)        # exact under caller's gate
+    c = min(front_chunk, n)
+    if recount_min_front is None:
+        recount_min_front = 4 * c
+    stop = n if stop_at_k is None else min(int(stop_at_k), n)
 
-    B = max(2, int(round(sub_cells ** (1.0 / m))))
-    T = -(-n // B)
-    n_pad = B * T
-    pad = n_pad - n
-    perm = [jnp.argsort(w[:, c], stable=True) for c in range(m)]
-    pos = jnp.stack([jnp.argsort(p) for p in perm])      # (m, n)
-    b = (pos // T).astype(jnp.int32)                     # (m, n)
+    counts0, _ = _grid_dominator_counts(
+        w, bucket_cells=bucket_cells, tie_window=tie_window,
+        slab_chunk=slab_chunk)
 
-    def pad_to(x, fill):
-        return jnp.concatenate(
-            [x, jnp.full((pad,) + x.shape[1:], fill, x.dtype)], 0)
+    subtract_exact = _make_exact_subtract(w, c)
 
-    Pv = [pad_to(pos[:, perm[c]].T, -1) for c in range(m)]   # (n_pad, m)
-    Bv = [pad_to(b[:, perm[c]].T, -1) for c in range(m)]
-    Wv = [pad_to(w[perm[c]], 0) for c in range(m)]
-    Vv = pad_to(jnp.ones((n,), bool), False)
-    # (B, T*(2m)) f32 tile tables for the one-hot slab fetch; positions
-    # and buckets are < 2^24 so f32 roundtrips exactly
-    tiles = [jnp.concatenate([Pv[c], Bv[c]], 1)
-             .reshape(B, T * 2 * m).astype(jnp.float32) for c in range(m)]
+    def cond(state):
+        _, _, active, _ = state
+        n_active = jnp.sum(active)
+        return (n_active > 0) & (n - n_active < stop)
 
-    lin = b[0]
-    for c in range(1, m):
-        lin = lin * B + b[c]
-    lin_up = b[0] + 1
-    for c in range(1, m):
-        lin_up = lin_up * (B + 1) + (b[c] + 1)
+    def body(state):
+        ranks, counts, active, r = state
+        front = active & (counts == 0)
+        ranks = jnp.where(front, r, ranks)
+        new_active = active & ~front
+        counts = lax.cond(
+            jnp.sum(front) >= recount_min_front,
+            lambda: _grid_dominator_counts(
+                w, src=new_active, bucket_cells=bucket_cells,
+                tie_window=tie_window, slab_chunk=slab_chunk)[0],
+            lambda: subtract_exact(counts, front))
+        return ranks, counts, new_active, r + 1
 
-    full_ord, gid, inv_full = _dup_groups(w)
-
-    C = min(member_chunk, n)
-    V = min(tie_window, n_pad - 1)
-
-    def subtract_front(counts, front):
-        # strict: front sources in cells strictly above on every axis
-        hist = jax.ops.segment_sum(front.astype(jnp.int32), lin,
-                                   num_segments=B ** m)
-        H = hist.reshape((B,) * m)
-        for ax in range(m):
-            H = jnp.flip(jnp.cumsum(jnp.flip(H, ax), ax), ax)
-        Hp = jnp.pad(H, [(0, 1)] * m)
-        sub = Hp.reshape(-1)[lin_up]
-
-        # duplicates: front members value-equal to each point (self
-        # included) satisfy ≥-everywhere but dominate nothing
-        gfront = jax.ops.segment_sum(front[full_ord].astype(jnp.int32),
-                                     gid, num_segments=n)[gid]
-        sub = sub - gfront[inv_full]
-
-        # ties: front sources value-≥ everywhere whose position order
-        # disagrees on a tied axis (the same shared rolled pass as the
-        # count grid, sources masked to the front)
-        for c in range(m):
-            Fv = pad_to(front[perm[c]], False)
-            sub = sub + _tie_pass_delta(Wv[c], Pv[c], Fv, Vv, c, V)[pos[c]]
-        counts = counts - sub
-
-        # band: per front member, same-slab pairs on each axis (bucket
-        # equal on c, strictly above on axes < c, pos-≥ everywhere)
-        def bcond(s):
-            return jnp.any(s[1])
-
-        def bbody(s):
-            counts, todo = s
-            idx = jnp.nonzero(todo, size=C, fill_value=n)[0]
-            valid = idx < n
-            idx_c = jnp.minimum(idx, n - 1)
-            mpos = pos[:, idx_c].T                       # (C, m)
-            mb = b[:, idx_c].T                           # (C, m)
-            for c in range(m):
-                onehot = ((mb[:, c][:, None] == jnp.arange(B)[None, :])
-                          & valid[:, None]).astype(jnp.float32)
-                tile = (onehot @ tiles[c]).reshape(C, T, 2 * m)
-                tP = tile[:, :, :m].astype(jnp.int32)
-                tB = tile[:, :, m:].astype(jnp.int32)
-                hit = jnp.all(mpos[:, None, :] >= tP, -1)
-                for c2 in range(c):
-                    hit &= mb[:, None, c2] != tB[:, :, c2]
-                hit &= valid[:, None]
-                flat = mb[:, c][:, None] * T + jnp.arange(T)[None, :]
-                flat = jnp.where(valid[:, None], flat, n_pad)
-                band = jax.ops.segment_sum(
-                    hit.reshape(-1).astype(jnp.int32), flat.reshape(-1),
-                    num_segments=n_pad + 1)
-                counts = counts - band[pos[c]]
-            return counts, todo.at[idx].set(False, mode="drop")
-
-        counts, _ = lax.while_loop(bcond, bbody, (counts, front))
-        return counts
-
-    return _peel_from_counts(w, counts0, stop_at_k, front_chunk,
-                             subtract_front)
+    ranks0 = jnp.full((n,), n, jnp.int32)
+    active0 = jnp.ones((n,), bool)
+    ranks, _, _, nf = lax.while_loop(
+        cond, body, (ranks0, counts0, active0, jnp.int32(0)))
+    return ranks, nf
 
 
 # module-level jitted entry: stable function identity keeps JAX's jit

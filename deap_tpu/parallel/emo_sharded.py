@@ -41,11 +41,10 @@ from __future__ import annotations
 
 from functools import partial
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..base import dominates
 from ..ops.emo import _wv_values, _rows_dominate_counts, assign_crowding_dist

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Bisect harness for the axon worker crash first seen in bench_nsga2
+BENCH_PROBLEM=dtlz2 BENCH_POP=1e5 (round 5), kept as the fault map for
+the backend's kernel-mix class.
+
+Findings (each step one fresh process, n=2·10⁵ nobj=3 unless noted):
+
+  counts    grid dominator counts alone                       -> OK 81 s
+  peel      grid counts + exact chunked subtract (round-4)    -> OK 87 s
+  sub       counts + ONE full grid-decomposed subtraction
+            (hist + dup + tie + member-band) in one program   -> OK 138 s
+  sub-hist / sub-dup / sub-tie / sub-band (each piece alone)  -> all OK
+  [old] member-band subtract inside the peel while_loop       -> CRASH,
+            at n=2·10⁴ AND 2·10⁵ — every piece passes alone;
+            the nested while_loop + scatter-add mix is the trigger
+
+Consequence: the per-member incremental subtract was replaced by the
+recompute peel (_grid_recount_ranks — source-masked counts per round,
+single-level loop, only chip-proven program shapes).  Current steps:
+
+  counts    grid dominator counts (src=None)
+  masked    source-masked counts (random half of the rows as sources)
+  ranks     full _grid_recount_ranks with stop_at_k = n/2
+  peel      grid counts + exact chunked subtract (reference point)
+  sel       full sel_nsga2 nd="grid"
+
+Usage: python tools/probe_gridpeel.py STEP [N] [NOBJ]
+One TPU process at a time; a crash needs a fresh process anyway.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+STEP = sys.argv[1] if len(sys.argv) > 1 else "ranks"
+N = int(sys.argv[2]) if len(sys.argv) > 2 else 200_000
+NOBJ = int(sys.argv[3]) if len(sys.argv) > 3 else 3
+
+
+def main():
+    from deap_tpu.ops import emo
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(N, NOBJ)).astype(np.float32))
+    t0 = time.time()
+    if STEP == "counts":
+        out = jax.jit(emo._grid_dominator_counts)(w)[0]
+    elif STEP == "masked":
+        src = jnp.asarray(rng.random(N) < 0.5)
+        out = jax.jit(emo._grid_dominator_counts)(w, src)[0]
+    elif STEP == "ranks":
+        out = jax.jit(lambda w: emo._grid_recount_ranks(w, N // 2))(w)[0]
+    elif STEP == "peel":
+        out = jax.jit(lambda w: emo._peel_from_counts(
+            w, emo._grid_dominator_counts(w)[0], N // 2, 1024))(w)[0]
+    elif STEP == "sel":
+        from deap_tpu import base
+        fit = base.Fitness(values=-w, valid=jnp.ones((N,), bool),
+                           weights=(-1.0,) * NOBJ)
+        out = jax.jit(lambda fit: emo.sel_nsga2(
+            jax.random.PRNGKey(0), fit, N // 2, nd="grid"))(fit)
+    else:
+        raise SystemExit(f"unknown step {STEP}")
+    out = jax.block_until_ready(out)
+    t1 = time.time()
+    print(f"OK step={STEP} n={N} nobj={NOBJ} wall={t1 - t0:.2f}s "
+          f"result_sum={int(np.sum(np.asarray(out)))}")
+
+
+if __name__ == "__main__":
+    main()

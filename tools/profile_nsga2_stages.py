@@ -96,6 +96,24 @@ def main():
     sec, r = marginal(make_ranks, w, k=K)
     report("nondominated_ranks_full", sec, r)
 
+    # (b2) ranks with the selection's stop_at_k (what sel_nsga2 pays)
+    def make_ranks_stop(n):
+        def body(ww, _):
+            rk, _ = nondominated_ranks(ww, stop_at_k=POP)
+            return perturb(ww, rk[0]), rk[0]
+        return lambda x: lax.scan(body, x, None, length=n)
+    sec, r = marginal(make_ranks_stop, w, k=K)
+    report("ranks_stop_at_k", sec, r)
+
+    # (b3) the exact count-peel at the same stop (round-4 baseline)
+    def make_ranks_peel(n):
+        def body(ww, _):
+            rk, _ = nondominated_ranks(ww, stop_at_k=POP, method="peel")
+            return perturb(ww, rk[0]), rk[0]
+        return lambda x: lax.scan(body, x, None, length=n)
+    sec, r = marginal(make_ranks_peel, w, k=K)
+    report("ranks_stop_at_k_peel", sec, r)
+
     # (c) crowding given ranks
     vals = pool.fitness.values
 
