@@ -147,10 +147,14 @@ def make_probe_kernel(mode: str, n_branches: int, tb: int, unroll):
     def kernel(codes_ref, consts_ref, lengths_ref, out_ref, stack_ref):
         def tree_body(i, _):
             length = lengths_ref[i, 0]
+            # unroll needs static bounds; probe trees are all LEN tokens,
+            # so the static form is the same trip count (the dynamic
+            # `length` read above stays for plumbing parity)
+            last = (LEN - 1) if unroll else (length - 1)
 
             def step(t_rev, carry):
                 sp, top = carry
-                t = length - 1 - t_rev
+                t = last - t_rev
                 c = codes_ref[i, t]
                 const = consts_ref[i, t]
                 if mode == "noswitch":
@@ -160,8 +164,11 @@ def make_probe_kernel(mode: str, n_branches: int, tb: int, unroll):
                         for b in branches], sp, top, const)
 
             top0 = jnp.zeros((1, pts_pad), jnp.float32)
-            _, top = lax.fori_loop(0, length, step, (0, top0),
-                                   unroll=unroll)
+            if unroll:
+                _, top = lax.fori_loop(0, LEN, step, (0, top0),
+                                       unroll=unroll)
+            else:
+                _, top = lax.fori_loop(0, length, step, (0, top0))
             out_ref[i, :] = top[0, :]
             return 0
 
@@ -235,7 +242,7 @@ def main(argv):
 
     all_probes = ["noswitch", "dispatch", "stackrw", "real63",
                   "noswitch_tb32", "dispatch_tb32", "real63_tb32",
-                  "dispatch_unroll2", "stackrw_unroll2"]
+                  "dispatch_unrollfull", "stackrw_unrollfull"]
     want = argv[1:] or all_probes
     out = {"shape": {"pop": POP, "cap": CAP, "points": NPTS, "len": LEN},
            "platform": jax.devices()[0].platform, "probes": {}}
@@ -243,8 +250,12 @@ def main(argv):
 
     for name in want:
         base_name = name.split("_")[0]
-        tb = 32 if name.endswith("tb32") else 8
-        unroll = 2 if name.endswith("unroll2") else False
+        tb = 8
+        for part in name.split("_")[1:]:
+            if part.startswith("tb"):
+                tb = int(part[2:])
+        # pallas fori_loop supports only unroll=1 or full unroll
+        unroll = LEN if name.endswith("unrollfull") else False
         if base_name == "real63":
             ev = make_population_evaluator_pallas(ps, CAP, block_trees=tb)
             X = jnp.linspace(-1, 1, NPTS, jnp.float32)[None, :]

@@ -50,15 +50,15 @@ def main():
     w = jnp.asarray(rng.normal(size=(N, NOBJ)).astype(np.float32))
     t0 = time.time()
     if STEP == "counts":
-        out = jax.jit(emo._grid_dominator_counts)(w)[0]
+        out = jax.jit(emo._grid_dominator_counts)(w)
     elif STEP == "masked":
         src = jnp.asarray(rng.random(N) < 0.5)
-        out = jax.jit(emo._grid_dominator_counts)(w, src)[0]
+        out = jax.jit(emo._grid_dominator_counts)(w, src)
     elif STEP == "ranks":
         out = jax.jit(lambda w: emo._grid_recount_ranks(w, N // 2))(w)[0]
     elif STEP == "peel":
         out = jax.jit(lambda w: emo._peel_from_counts(
-            w, emo._grid_dominator_counts(w)[0], N // 2, 1024))(w)[0]
+            w, emo._grid_dominator_counts(w), N // 2, 1024))(w)[0]
     elif STEP == "sel":
         from deap_tpu import base
         fit = base.Fitness(values=-w, valid=jnp.ones((N,), bool),

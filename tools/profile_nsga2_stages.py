@@ -81,7 +81,7 @@ def main():
     # (a) grid dominator counts alone
     def make_counts(n):
         def body(ww, _):
-            cnt, _ = _grid_dominator_counts(ww)
+            cnt = _grid_dominator_counts(ww)
             return perturb(ww, cnt[0]), cnt[0]
         return lambda x: lax.scan(body, x, None, length=n)
     sec, r = marginal(make_counts, w, k=K)
