@@ -608,6 +608,39 @@ def test_grid_counts_source_masked():
         np.testing.assert_array_equal(np.asarray(cnt), ref)
 
 
+def test_grid_method_nobj2():
+    """method="grid" is reachable at nobj=2 (the staircase is the
+    default there, but the grid must stay exact if asked for)."""
+    rng = np.random.default_rng(9)
+    for w in [rng.normal(size=(200, 2)),
+              rng.integers(0, 5, size=(150, 2)).astype(float)]:
+        w = jnp.asarray(np.asarray(w, np.float32))
+        r_g, nf_g = jax.jit(
+            lambda w: nondominated_ranks(w, method="grid"))(w)
+        r_p, nf_p = jax.jit(
+            lambda w: nondominated_ranks(w, method="peel"))(w)
+        np.testing.assert_array_equal(np.asarray(r_g), np.asarray(r_p))
+        assert int(nf_g) == int(nf_p)
+
+
+def test_hybrid_peel_both_branches_exact():
+    """The hybrid peel's two update rules (exact subtract for thin
+    fronts, source-masked recount for fat ones) must compose to the same
+    partition whichever fires: force each branch via recount_min_front
+    and compare to the exact peel."""
+    from deap_tpu.ops.emo import _grid_recount_ranks
+    rng = np.random.default_rng(13)
+    w = jnp.asarray(rng.normal(size=(400, 3)).astype(np.float32))
+    r_ref, nf_ref = jax.jit(
+        lambda w: nondominated_ranks(w, method="peel"))(w)
+    for rmf in (1, 10 ** 9):          # always-recount / always-exact
+        r_h, nf_h = jax.jit(
+            lambda w, rmf=rmf: _grid_recount_ranks(
+                w, None, recount_min_front=rmf))(w)
+        np.testing.assert_array_equal(np.asarray(r_h), np.asarray(r_ref))
+        assert int(nf_h) == int(nf_ref)
+
+
 def test_grid_exact_on_massive_ties():
     """Round 4's tie gate tripped on any value repeated > 64 times and
     silently demoted the whole workload to the O(MN²) peel — measured
