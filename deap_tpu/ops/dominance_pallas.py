@@ -1,0 +1,115 @@
+"""Pallas TPU kernel for the chunked dominance-count subtraction — the
+per-round cost of the thin-front exact peel (reference emo.py:53-117's
+dominance test, batched).
+
+``rows_dominate_counts(rows, w)`` counts, for every column point ``w[j]``,
+how many of the ``C`` front rows dominate it (maximization wvalue space:
+``all(row >= w_j) & any(row > w_j)``).  The XLA formulation
+(:func:`deap_tpu.ops.emo._rows_dominate_counts`) materializes
+``(C, n)``-shaped broadcast compares and measures ~200 G elem-ops/s on
+the bench chip — a third of the Pallas-demonstrated VPU rate
+(tools/pallas_probe_ga.py: 639 G elem-ops/s).  This kernel closes part
+of that gap with the two layout choices the probes motivated:
+
+* ``w`` is streamed TRANSPOSED ``(m, n)`` so the big axis lies along
+  lanes — an ``(n, m=3)`` layout would pad 3 -> 128 lanes and waste 40×
+  of every vector op;
+* front rows are SMEM scalars, consumed in blocks of ``ROW_UNROLL``
+  per loop step (a Python-unrolled inner block) so the scalar loop
+  machinery (~10 ns/step, measured by the GP probes) amortizes over 8
+  rows of vector work.
+
+Exactness notes: a row compared against itself is not counted
+(``any(>)`` fails on equality), and all-(-inf) sentinel rows dominate
+nothing — both properties the exact peel relies on, inherited from the
+dominance test itself.  The public entry falls back to the XLA form off
+TPU and for shapes the kernel does not cover; equivalence is pinned by
+``tests/test_support.py::test_pallas_dominance_counts_matches_xla``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128
+ROW_UNROLL = 8
+TILE_N = 1024
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _counts_pallas(rows: jax.Array, wT: jax.Array, interpret: bool = False):
+    """rows (C, m) f32, wT (m, n_pad) f32 with n_pad % TILE_N == 0 —
+    returns (n_pad,) int32 dominator-counts contribution."""
+    C, m = rows.shape
+    n_pad = wT.shape[1]
+    assert C % ROW_UNROLL == 0
+
+    def kernel(rows_ref, w_ref, out_ref):
+        w_cols = [w_ref[c, :] for c in range(m)]       # (TILE_N,) each
+        acc0 = jnp.zeros((TILE_N,), jnp.int32)
+
+        def block(b, acc):
+            for u in range(ROW_UNROLL):
+                i = b * ROW_UNROLL + u
+                ge = None
+                gt = None
+                for c in range(m):
+                    r = rows_ref[i, c]
+                    gec = r >= w_cols[c]
+                    gtc = r > w_cols[c]
+                    ge = gec if ge is None else (ge & gec)
+                    gt = gtc if gt is None else (gt | gtc)
+                acc = acc + (ge & gt).astype(jnp.int32)
+            return acc
+
+        out_ref[0, :] = lax.fori_loop(0, C // ROW_UNROLL, block, acc0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // TILE_N,),
+        in_specs=[
+            pl.BlockSpec((C, m), lambda g: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((m, TILE_N), lambda g: (0, g),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, TILE_N), lambda g: (0, g),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
+        interpret=interpret,
+    )(rows, wT)
+    return out[0]
+
+
+def rows_dominate_counts_pallas(rows: jax.Array, w: jax.Array,
+                                interpret: bool | None = None):
+    """Drop-in for :func:`deap_tpu.ops.emo._rows_dominate_counts` on TPU:
+    pads ``rows`` to a ROW_UNROLL multiple with -inf sentinels (dominate
+    nothing) and ``w`` columns to a TILE_N multiple with +inf sentinels
+    (dominated by nothing; the pad is sliced off anyway)."""
+    C, m = rows.shape
+    n = w.shape[0]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    C_pad = _round_up(C, ROW_UNROLL)
+    if C_pad != C:
+        rows = jnp.concatenate(
+            [rows, jnp.full((C_pad - C, m), -jnp.inf, rows.dtype)], 0)
+    n_pad = _round_up(n, TILE_N)
+    wT = w.T
+    if n_pad != n:
+        wT = jnp.concatenate(
+            [wT, jnp.full((m, n_pad - n), jnp.inf, w.dtype)], 1)
+    out = _counts_pallas(rows.astype(jnp.float32), wT.astype(jnp.float32),
+                         interpret=interpret)
+    return out[:n]

@@ -509,9 +509,22 @@ def _make_exact_subtract(w: jax.Array, c: int):
     ``(c,)`` index buffers and subtract its dominance contribution with
     ``(C, N)`` kernels.  Sentinel row ``n``: -inf rows dominate nothing,
     and the sentinel slot of the todo mask absorbs out-of-range scatter
-    indices harmlessly."""
+    indices harmlessly.
+
+    On TPU the ``(C, N)`` dominance count runs as a Pallas kernel
+    (:mod:`deap_tpu.ops.dominance_pallas` — transposed-w lanes layout +
+    unrolled SMEM front-row blocks, measured 2.1× the XLA broadcast form
+    at C=1024, N=2·10⁵: 4.7 vs 10.0 ms/call); off TPU the XLA form is
+    used (Pallas interpret mode would crawl in CPU tests, and the
+    equality is pinned by
+    ``tests/test_support.py::test_pallas_dominance_counts_matches_xla``)."""
     n, m = w.shape
     wp = jnp.concatenate([w, jnp.full((1, m), -jnp.inf, w.dtype)], 0)
+    if jax.default_backend() == "tpu":
+        from .dominance_pallas import rows_dominate_counts_pallas
+        dom_counts = rows_dominate_counts_pallas
+    else:
+        dom_counts = _rows_dominate_counts
 
     def subtract_front_exact(counts, front):
         todo = jnp.concatenate([front, jnp.zeros((1,), bool)])
@@ -523,7 +536,7 @@ def _make_exact_subtract(w: jax.Array, c: int):
         def sub_body(s):
             counts, todo = s
             idx = jnp.nonzero(todo[:n], size=c, fill_value=n)[0]
-            counts = counts - _rows_dominate_counts(wp[idx], w)
+            counts = counts - dom_counts(wp[idx], w)
             return counts, todo.at[idx].set(False)
 
         counts, _ = lax.while_loop(sub_cond, sub_body, (counts, todo))

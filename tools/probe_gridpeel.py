@@ -37,6 +37,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 STEP = sys.argv[1] if len(sys.argv) > 1 else "ranks"
 N = int(sys.argv[2]) if len(sys.argv) > 2 else 200_000
@@ -59,6 +60,30 @@ def main():
     elif STEP == "peel":
         out = jax.jit(lambda w: emo._peel_from_counts(
             w, emo._grid_dominator_counts(w), N // 2, 1024))(w)[0]
+    elif STEP == "pdom":
+        # Pallas vs XLA chunked dominance-count kernel at the peel's
+        # real shape: C=1024 front rows vs all n columns, marginal over
+        # 16 chained calls (data dependence prevents CSE)
+        from deap_tpu.ops.dominance_pallas import rows_dominate_counts_pallas
+        from deap_tpu.ops.emo import _rows_dominate_counts
+        rows = jnp.asarray(rng.normal(size=(1024, NOBJ)).astype(np.float32))
+
+        for name, fn in (("pallas", rows_dominate_counts_pallas),
+                         ("xla", _rows_dominate_counts)):
+            @jax.jit
+            def loop(rows, w, fn=fn):
+                def body(r, _):
+                    out = fn(r, w)
+                    return r + out[:1, None].astype(r.dtype) * 1e-30, out[0]
+                return lax.scan(body, rows, None, length=16)[1]
+
+            np.asarray(loop(rows, w))              # compile + warm
+            t0 = time.time()
+            np.asarray(loop(rows, w))
+            t1 = time.time()
+            print(f"{name}: {(t1 - t0) / 16 * 1e3:.3f} ms/call "
+                  f"(16-call loop, host-forced)", flush=True)
+        out = rows
     elif STEP == "sel":
         from deap_tpu import base
         fit = base.Fitness(values=-w, valid=jnp.ones((N,), bool),
