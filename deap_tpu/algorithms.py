@@ -128,7 +128,13 @@ def evaluate_population(toolbox, population: Population):
     reference's invalid-only economy: the GP stack machine, whose cost is
     per-token, zeroes the skipped rows' lengths and runs zero steps for
     them (measured round 4: evaluation is the steady-state GP
-    bottleneck, and ~45% of rows per generation are untouched)."""
+    bottleneck, and ~45% of rows per generation are untouched).
+
+    A ``toolbox.quarantine`` attribute (a
+    :class:`deap_tpu.resilience.Quarantine`, or anything with an
+    ``apply(population, newly=mask)`` method) is applied to the freshly
+    assigned rows: NaN/Inf from a user evaluator would otherwise poison
+    every downstream comparison silently."""
     invalid = ~population.fitness.valid
     if hasattr(toolbox, "evaluate_population"):
         tool = toolbox.evaluate_population
@@ -141,7 +147,11 @@ def evaluate_population(toolbox, population: Population):
     else:
         values = jax.vmap(_norm_eval(toolbox.evaluate))(population.genome)
     nevals = jnp.sum(invalid)
-    return population.evaluated(values, where=invalid), nevals
+    population = population.evaluated(values, where=invalid)
+    quarantine = getattr(toolbox, "quarantine", None)
+    if quarantine is not None:
+        population = quarantine.apply(population, newly=invalid)
+    return population, nevals
 
 
 def var_and(key, population: Population, toolbox, cxpb: float, mutpb: float,
@@ -256,10 +266,40 @@ def var_or(key, population: Population, toolbox, lambda_: int,
 # ---------------------------------------------------------------------------
 
 
+def _hof_state_compatible(state, population) -> bool:
+    """The carried archive can only continue onto a population whose
+    individuals have the same genome structure/shapes/dtypes and the same
+    objective count — otherwise the update kernels would concatenate
+    mismatched arrays."""
+    s_leaves = jax.tree_util.tree_structure(state.genome)
+    p_leaves = jax.tree_util.tree_structure(population.genome)
+    if s_leaves != p_leaves:
+        return False
+    for s, p in zip(jax.tree_util.tree_leaves(state.genome),
+                    jax.tree_util.tree_leaves(population.genome)):
+        if s.shape[1:] != p.shape[1:] or s.dtype != p.dtype:
+            return False
+    return (state.values.shape[1] == population.fitness.nobj
+            and state.weights == population.fitness.weights)
+
+
 def _hof_setup(halloffame, sample_population):
+    """Archive state + update kernel for a loop.  An archive that already
+    carries state keeps it (the reference's hall-of-fame accumulates
+    across successive ``eaSimple`` calls, support.py:517-540 — and the
+    resumable driver depends on it to thread the archive through
+    checkpointed segments); call ``halloffame.clear()`` for a fresh one.
+    State shaped for a *different* problem (other genome shape/dtype or
+    objective count) is discarded and re-initialized rather than crashing
+    the update kernels mid-scan."""
     if halloffame is None:
         return None, None
-    state = halloffame.init_state(sample_population)
+    state = halloffame.state
+    if state is not None and not _hof_state_compatible(
+            state, sample_population):
+        state = None
+    if state is None:
+        state = halloffame.init_state(sample_population)
     if isinstance(halloffame, ParetoFront):
         upd = pareto_update
     else:

@@ -149,12 +149,14 @@ def make_population_evaluator_pallas(pset, cap: int, *,
                     sp, top, const)
 
             top0 = jnp.zeros((1, stack_ref.shape[1]), stack_ref.dtype)
-            _, top = lax.fori_loop(0, length, step, (0, top0),
-                                   unroll=False)
+            # no explicit unroll: jax 0.4.x rejects ANY unroll argument
+            # (even False) when the trip count is dynamic, and rolled is
+            # the default everywhere
+            _, top = lax.fori_loop(0, length, step, (0, top0))
             out_ref[i, :] = top[0, :]
             return 0
 
-        lax.fori_loop(0, tb, tree_body, 0, unroll=False)
+        lax.fori_loop(0, tb, tree_body, 0)
 
     # VMEM is ~16 MB/core; the kernel never blocks over the points axis,
     # so its live buffers scale with pts_pad.  Checked per call (shapes are

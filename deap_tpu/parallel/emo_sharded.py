@@ -49,6 +49,17 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..base import dominates
 from ..ops.emo import _wv_values, _rows_dominate_counts, assign_crowding_dist
 
+# jax >= 0.6 promotes shard_map to jax.shard_map; 0.4.x still ships it
+# under experimental, where the replication checker has no rule for
+# while_loop and must be disabled (the kernel keeps every loop condition
+# psum-uniform by construction, so the check adds nothing here)
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:
+    from functools import partial as _partial
+    from jax.experimental.shard_map import shard_map as _xshard_map
+    _shard_map = _partial(_xshard_map, check_rep=False)
+
 __all__ = ["nondominated_ranks_sharded", "sel_nsga2_sharded"]
 
 
@@ -87,8 +98,13 @@ def nondominated_ranks_sharded(w: jax.Array, mesh: Mesh, axis: str = "pop",
 
     def kernel(w_local):                          # (n_loc, m) per device
         # constant-initialized loop carries must be typed as varying over
-        # the mesh axis (jax's VMA tracking) since their updates are
-        vary = lambda x: lax.pcast(x, (axis,), to="varying")  # noqa: E731
+        # the mesh axis (jax's VMA tracking) since their updates are; on
+        # jax builds without pcast (< 0.7) shard_map has no VMA typing and
+        # everything inside the kernel is already per-device
+        if hasattr(lax, "pcast"):
+            vary = lambda x: lax.pcast(x, (axis,), to="varying")  # noqa: E731
+        else:
+            vary = lambda x: x                                    # noqa: E731
         # one population gather: every device needs all rows to count its
         # columns' dominators
         w_full = lax.all_gather(w_local, axis, axis=0, tiled=True)
@@ -145,7 +161,7 @@ def nondominated_ranks_sharded(w: jax.Array, mesh: Mesh, axis: str = "pop",
         return ranks, nf[None]                        # nf: per-shard copy
 
     spec = P(axis)
-    ranks_pad, nf = jax.shard_map(
+    ranks_pad, nf = _shard_map(
         kernel, mesh=mesh, in_specs=(spec,), out_specs=(spec, P(axis)))(wp)
     return ranks_pad[:n], nf[0]
 

@@ -87,10 +87,20 @@ def ea_simple_islands(key, populations: Population, toolbox, cxpb: float,
                             valid=new_bundle["valid"],
                             weights=pops.fitness.weights))
 
+    # per-island key fan-outs stay replicated: computing threefry splits is
+    # trivially cheap on every device, while letting the partitioner shard
+    # the (n_isl, 2) key array costs a collective-permute INSIDE the
+    # generation body — migration must stay the only cross-device traffic
+    if mesh is not None:
+        rep = NamedSharding(mesh, P())
+        keep_replicated = lambda x: lax.with_sharding_constraint(x, rep)  # noqa: E731
+    else:
+        keep_replicated = lambda x: x                                     # noqa: E731
+
     def gen_step(carry, gen):
         key, pops = carry
         key, k_gen, k_mig = jax.random.split(key, 3)
-        keys = jax.random.split(k_gen, n_isl)
+        keys = keep_replicated(jax.random.split(k_gen, n_isl))
         pops, nevals = jax.vmap(island_gen)(keys, pops)
         do_mig = (mig_freq > 0) & ((gen % mig_freq) == 0)
         pops = lax.cond(do_mig, lambda p: migrate(k_mig, p), lambda p: p, pops)

@@ -49,7 +49,9 @@ __all__ = ["initialize_cluster", "cluster_mesh", "distribute_population",
 def initialize_cluster(coordinator_address: str | None = None,
                        num_processes: int | None = None,
                        process_id: int | None = None,
-                       local_device_ids=None) -> None:
+                       local_device_ids=None,
+                       connect_attempts: int | None = None,
+                       connect_backoff: float = 1.0) -> None:
     """Join the cluster: wraps ``jax.distributed.initialize``.
 
     Priority: explicit args > ``DEAP_TPU_COORDINATOR`` / ``DEAP_TPU_NPROC``
@@ -62,6 +64,13 @@ def initialize_cluster(coordinator_address: str | None = None,
     spellings (``DEAP_TPU_COORDINATOR`` + legacy ``NPROC``) is not
     supported; migrate the whole set.  Safe to call twice (a second call
     is a no-op), so library code can call it defensively.
+
+    ``connect_attempts`` (default from ``DEAP_TPU_CONNECT_ATTEMPTS``, else
+    1) retries the coordinator connection with exponential backoff
+    (``connect_backoff`` seconds, doubling) — after a pod preemption the
+    restarted workers routinely come up before the coordinator does, and
+    one transient ``RuntimeError`` must not kill the relaunch.
+    Configuration errors (``ValueError``) are never retried.
     """
     # NB: must not touch jax.devices()/process_count() here — any backend
     # query initializes XLA and makes jax.distributed.initialize illegal
@@ -90,12 +99,78 @@ def initialize_cluster(coordinator_address: str | None = None,
         if process_id is None and "PROC_ID" in os.environ:
             process_id = int(os.environ["PROC_ID"])
     explicit = coordinator_address is not None or process_id is not None
+    if connect_attempts is None:
+        connect_attempts = int(os.environ.get(
+            "DEAP_TPU_CONNECT_ATTEMPTS", "1"))
+
+    # Multi-process CPU clusters (the CI analogue of a pod) need a CPU
+    # collectives backend; XLA:CPU's default refuses multiprocess programs
+    # outright.  Select gloo before the backend initializes, but only when
+    # the platform is pinned to cpu and the user hasn't chosen one — and
+    # ROLL IT BACK if joining fails: gloo without a distributed client
+    # crashes the very next single-process backend initialization.
+    multiproc = (coordinator_address is not None
+                 or num_processes not in (None, 1))
+    gloo_prev, gloo_changed = None, False
     try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-            local_device_ids=local_device_ids)
+        if (multiproc
+                and jax.config.values.get("jax_platforms") == "cpu"
+                and jax.config.values.get(
+                    "jax_cpu_collectives_implementation") in (None, "none")):
+            gloo_prev = jax.config.values.get(
+                "jax_cpu_collectives_implementation")
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            gloo_changed = True
+    except (AttributeError, KeyError, ValueError):
+        pass          # older/newer builds without the flag (or gloo): the
+                      # subsequent initialize reports the real capability
+
+    def _undo_gloo():
+        # keyed on an explicit changed-flag: the unset value is None on
+        # some builds, so gloo_prev alone cannot mark "never touched"
+        if gloo_changed:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              gloo_prev)
+
+    class _NonTransient(Exception):
+        """Carrier for RuntimeErrors that must not be retried (the
+        'should only be called once' / backend-already-initialized class:
+        repeating those can never succeed and would stall the documented
+        safe-to-call-twice no-op behind the full backoff schedule)."""
+
+    def _connect():
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                local_device_ids=local_device_ids)
+        except RuntimeError as e:
+            # match jax's exact phrasings, not bare 'already': a
+            # coordinator-side 'address already in use' (old socket in
+            # TIME_WAIT after a preemption relaunch) IS transient and is
+            # precisely what the retry schedule exists for
+            msg = str(e).lower()
+            if "only be called once" in msg or "must be called before" in msg:
+                raise _NonTransient() from e
+            raise
+
+    if connect_attempts > 1:
+        # lazy import: parallel is imported by the top-level package before
+        # resilience exists on it
+        from ..resilience.retry import with_retries, RetriesExhausted
+        _connect = with_retries(
+            _connect, retries=connect_attempts - 1, backoff=connect_backoff,
+            retry_on=(RuntimeError, OSError, ConnectionError))
+    else:
+        RetriesExhausted = ()                  # nothing extra to catch
+    try:
+        try:
+            _connect()
+        except RetriesExhausted as e:          # unwrap for the fallback path
+            raise e.last from e
+        except _NonTransient as e:
+            raise e.__cause__ from None
     except (RuntimeError, ValueError) as e:
         # RuntimeError: backend already initialized (library use inside a
         # session that touched devices first).  ValueError: no coordinator
@@ -104,11 +179,18 @@ def initialize_cluster(coordinator_address: str | None = None,
         # that names a coordinator or a multi-process layout must not
         # silently run single-process.  The failure does not latch
         # ``_done``, so a later properly-configured call still initializes.
+        _undo_gloo()          # no distributed client: gloo must not leak
         if explicit or num_processes not in (None, 1):
             raise
         import warnings
         warnings.warn(f"single-process fallback: {e}")
         return
+    except BaseException:
+        # ANY other failed join (incl. OSError/ConnectionError from
+        # exhausted retries, which the fallback above does not handle)
+        # must also roll the gloo selection back before propagating
+        _undo_gloo()
+        raise
     initialize_cluster._done = True
 
 

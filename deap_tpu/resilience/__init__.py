@@ -1,0 +1,35 @@
+"""Resilient evolution runtime.
+
+Long runs on preemptible TPU slices fail in three boring, fatal ways: the
+pod is preempted (SIGTERM, then gone), a user evaluator emits NaN/Inf and
+silently poisons selection, or the shared filesystem flakes during a
+checkpoint write.  This package makes all three survivable — and, per the
+round-3 lesson, *provably* so: every recovery path is driven by the
+deterministic fault-injection harness in :mod:`.faultinject`
+(tests/test_resilience.py, ``deap-tpu-faultdrill``).
+
+* :func:`run_resumable` — segment-and-checkpoint driver for the
+  ``ea_simple`` family with SIGTERM-triggered saves, cross-host
+  agreement, and bit-exact resume (:mod:`.runner`).
+* :class:`Quarantine` — non-finite fitness policies (``penalize`` /
+  ``resample`` / ``raise``) honored by
+  :func:`deap_tpu.algorithms.evaluate_population` via
+  ``toolbox.quarantine`` (:mod:`.quarantine`).
+* :func:`with_retries` — bounded exponential-backoff retry used for
+  checkpoint I/O and the cluster coordinator connection (:mod:`.retry`).
+* :class:`FaultPlan` / :class:`FaultInjector` — declarative fault
+  schedules for tests and drills (:mod:`.faultinject`).
+"""
+
+from .retry import with_retries, RetriesExhausted  # noqa: F401
+from .quarantine import (Quarantine, NonFiniteFitnessError,  # noqa: F401
+                         nonfinite_rows)
+from .faultinject import FaultPlan, FaultInjector, VirtualClock  # noqa: F401
+from .runner import run_resumable, Preempted  # noqa: F401
+
+__all__ = [
+    "run_resumable", "Preempted",
+    "Quarantine", "NonFiniteFitnessError", "nonfinite_rows",
+    "with_retries", "RetriesExhausted",
+    "FaultPlan", "FaultInjector", "VirtualClock",
+]

@@ -1,0 +1,185 @@
+"""Recovery drill: exercise every resilience path ON THE TARGET BACKEND.
+
+The CPU test suite keeps the recovery logic algorithmically honest; this
+tool is the deployment-time probe (the resilience sibling of
+``deap-tpu-selftest``): it runs checkpoint/restore, preemption-resume,
+non-finite quarantine and retried-I/O drills against whatever
+``jax.devices()`` gives, and exits non-zero if ANY recovery path fails.
+
+    deap-tpu-faultdrill                       # target backend
+    JAX_PLATFORMS=cpu deap-tpu-faultdrill
+    python -m deap_tpu.resilience.faultdrill  # equivalent module form
+
+Each drill injects its fault through
+:mod:`deap_tpu.resilience.faultinject` — a drill whose fault never fired
+counts as a FAILURE, not a pass.
+"""
+
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+
+POP = int(os.environ.get("FAULTDRILL_POP", 64))
+NGEN = int(os.environ.get("FAULTDRILL_NGEN", 12))
+
+
+def _setup():
+    import jax
+    import jax.numpy as jnp
+    from deap_tpu import base
+    from deap_tpu.ops import crossover, mutation, selection
+
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: (jnp.sum(g),))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    key = jax.random.PRNGKey(7)
+    g = jax.random.bernoulli(key, 0.5, (POP, 32)).astype(jnp.float32)
+    pop = base.Population(genome=g, fitness=base.Fitness.empty(POP, (1.0,)))
+    return tb, pop, jax.random.fold_in(key, 1)
+
+
+def _check(name, fn, failures):
+    try:
+        fn()
+    except Exception as e:                                 # noqa: BLE001
+        print(f"  {name:44s} FAILED  ({type(e).__name__}: {e})")
+        failures.append(name)
+    else:
+        print(f"  {name:44s} ok")
+
+
+def _drill_preempt_resume(root: Path):
+    """Kill mid-run (injected preemption), resume, compare against an
+    uninterrupted run — must be bitwise identical."""
+    from deap_tpu.resilience import (run_resumable, Preempted, FaultPlan,
+                                     FaultInjector)
+    kw = dict(loop_kwargs=dict(cxpb=0.6, mutpb=0.3), checkpoint_every=4)
+
+    tb, pop, key = _setup()
+    ref_pop, ref_lb = run_resumable(key, pop, tb, NGEN,
+                                    ckpt_path=root / "ref.ckpt", **kw)
+
+    tb, pop, key = _setup()
+    inj = FaultInjector(FaultPlan(preempt_at_gen=NGEN // 2))
+    try:
+        run_resumable(key, pop, tb, NGEN, ckpt_path=root / "cut.ckpt",
+                      faults=inj, **kw)
+        raise AssertionError("injected preemption never fired")
+    except Preempted:
+        pass
+    tb2, pop2, key2 = _setup()
+    res_pop, res_lb = run_resumable(key2, pop2, tb2, NGEN,
+                                    ckpt_path=root / "cut.ckpt", **kw)
+
+    np.testing.assert_array_equal(np.asarray(ref_pop.genome),
+                                  np.asarray(res_pop.genome))
+    np.testing.assert_array_equal(np.asarray(ref_pop.fitness.values),
+                                  np.asarray(res_pop.fitness.values))
+    assert ref_lb.select("nevals") == res_lb.select("nevals"), \
+        "resumed logbook diverged"
+
+
+def _drill_retry_flaky_writes(root: Path):
+    """Checkpoint writes failing twice must succeed on the third try
+    without real sleeping and leave a loadable checkpoint."""
+    from deap_tpu.resilience import run_resumable, FaultPlan, FaultInjector
+    from deap_tpu.utils.checkpoint import load_checkpoint
+
+    tb, pop, key = _setup()
+    inj = FaultInjector(FaultPlan(ckpt_fail_times=2))
+    run_resumable(key, pop, tb, 4, ckpt_path=root / "flaky.ckpt",
+                  checkpoint_every=4, loop_kwargs=dict(cxpb=0.6, mutpb=0.3),
+                  faults=inj, io_retries=3,
+                  io_sleep=inj.clock.sleep, io_clock=inj.clock.time)
+    assert inj.saves_failed == 2, "fault never fired"
+    assert load_checkpoint(root / "flaky.ckpt")["gen"] == 4
+
+
+def _drill_quarantine(root: Path):
+    """A NaN evaluation mid-run must not poison selection under either
+    recovery policy, and must abort loudly under 'raise'."""
+    import jax
+    from deap_tpu.resilience import (run_resumable, Quarantine, FaultPlan,
+                                     FaultInjector, NonFiniteFitnessError)
+    from deap_tpu.algorithms import evaluate_population
+
+    for policy in ("penalize", "resample"):
+        tb, pop, key = _setup()
+        tb.quarantine = Quarantine(policy)
+        inj = FaultInjector(FaultPlan(nan_at_gen=3, nan_rows=(0, 1)))
+        out, lb = run_resumable(key, pop, tb, 6,
+                                ckpt_path=root / f"q_{policy}.ckpt",
+                                checkpoint_every=3,
+                                loop_kwargs=dict(cxpb=0.6, mutpb=0.3),
+                                faults=inj)
+        assert inj.gens_poisoned == [3], "fault never fired"
+        assert np.isfinite(np.asarray(out.fitness.values)).all(), \
+            f"{policy}: non-finite fitness leaked through"
+
+    tb, pop, key = _setup()
+    tb.quarantine = Quarantine("raise")
+    tb.register("evaluate",
+                lambda g: (jax.numpy.sum(g) / 0.0,))     # all rows +inf
+    try:
+        evaluate_population(tb, pop)
+        raise AssertionError("'raise' policy did not raise")
+    except NonFiniteFitnessError:
+        pass
+
+
+def _drill_sharded_restore(root: Path):
+    """Sharded save must restore bit-identically onto a single-device
+    (smaller) mesh — the post-preemption degraded topology."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from deap_tpu.utils.checkpoint import (save_sharded_checkpoint,
+                                           load_sharded_checkpoint)
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), ("pop",))
+    x = jnp.arange(len(devs) * 16, dtype=jnp.float32).reshape(-1, 2)
+    xs = jax.device_put(x, NamedSharding(mesh, P("pop")))
+    save_sharded_checkpoint(root / "shard", {"x": xs, "gen": 3})
+
+    small = Mesh(np.array(devs[:1]), ("pop",))
+    like = {"x": jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                      sharding=NamedSharding(small, P("pop"))),
+            "gen": 0}
+    r = load_sharded_checkpoint(root / "shard", like)
+    np.testing.assert_array_equal(np.asarray(r["x"]), np.asarray(x))
+    assert r["gen"] == 3
+
+
+def main() -> int:
+    import jax
+
+    print(f"backend={jax.default_backend()} devices={len(jax.devices())} "
+          f"pop={POP} ngen={NGEN}")
+    failures: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="deap_tpu_faultdrill_") as td:
+        root = Path(td)
+        _check("preempt mid-run -> resume bitwise-exact",
+               lambda: _drill_preempt_resume(root), failures)
+        _check("checkpoint writes fail twice -> retry",
+               lambda: _drill_retry_flaky_writes(root), failures)
+        _check("NaN quarantine (penalize/resample/raise)",
+               lambda: _drill_quarantine(root), failures)
+        _check("sharded restore onto smaller mesh",
+               lambda: _drill_sharded_restore(root), failures)
+    if failures:
+        print(f"FAILED: {len(failures)} recovery path(s) broken on this "
+              f"backend: {failures}")
+        return 1
+    print("all recovery paths intact on this backend")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,11 +56,67 @@ def save_checkpoint(path, state: Any) -> None:
     tmp.replace(path)
 
 
-def async_save_checkpoint(path, state: Any) -> threading.Thread:
+class _AsyncSave(threading.Thread):
+    """Writer thread that keeps its exception instead of losing it to the
+    default thread excepthook.  ``result()`` joins and re-raises — the
+    orbax ``AsyncCheckpointer.wait_until_finished`` contract."""
+
+    def __init__(self, write_fn):
+        super().__init__(daemon=True, name="deap-tpu-async-ckpt")
+        self._write_fn = write_fn
+        self.exc: BaseException | None = None
+
+    def run(self):
+        try:
+            self._write_fn()
+        except BaseException as e:          # noqa: BLE001 — must not vanish
+            # traceback frames pin the write closure (and with it the full
+            # host-side state copy); keep the exception, drop the frames
+            self.exc = e.with_traceback(None)
+        finally:
+            # the closure holds the full host-side state copy; a finished
+            # writer must not keep a checkpoint-sized buffer alive via the
+            # module-global handle
+            self._write_fn = None
+
+    def result(self, timeout: float | None = None) -> None:
+        self.join(timeout)
+        if self.is_alive():
+            raise TimeoutError(
+                f"async checkpoint write still running after {timeout}s")
+        if self.exc is not None:
+            exc, self.exc = self.exc, None      # consume: report once —
+            raise exc                           # not again from the next
+                                                # async_save_checkpoint call
+
+
+_async_registry_lock = threading.Lock()
+# per-path serialization cells: {"lock": Lock, "handle": previous writer}.
+# Entries are never removed — the registry grows by one small cell per
+# DISTINCT checkpoint path (handles drop their payloads when done), and
+# not deleting them is what makes the per-path locking race-free.
+_async_saves: dict[str, dict] = {}
+
+
+def async_save_checkpoint(path, state: Any) -> _AsyncSave:
     """Device→host transfer happens synchronously (cheap), serialization in
     a background thread — the orbax-style async pattern, so the training
-    loop never blocks on disk."""
+    loop never blocks on disk.
+
+    Overlapping saves **to the same path** are serialized: a new call
+    first joins that path's previous writer (two concurrent writers would
+    race on the ``.tmp`` file and could commit a stale state over a newer
+    one).  A failure in the writer thread is never silently lost — it
+    re-raises either from the returned handle's ``result()`` or, if
+    nobody joined, from the *next* ``async_save_checkpoint`` call for
+    that path (before the new write starts, so the caller can react while
+    the previous checkpoint on disk is still intact).  Independent
+    checkpoint streams to different paths neither block nor poison each
+    other."""
     host_state = _to_host(state)
+    # canonical key: two spellings of one file (relative vs absolute,
+    # symlinked dirs) must land in the same serialization cell
+    key = str(Path(path).expanduser().resolve())
 
     def _write():
         path_ = Path(path)
@@ -69,8 +125,27 @@ def async_save_checkpoint(path, state: Any) -> threading.Thread:
             pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
         tmp.replace(path_)
 
-    t = threading.Thread(target=_write, daemon=True)
-    t.start()
+    # The registry lock only guards the dict; the PER-PATH lock covers the
+    # whole pop-join-register sequence, so two callers racing on one path
+    # cannot both see no predecessor and spawn concurrent writers on the
+    # same .tmp, while saves to other paths proceed without waiting on
+    # this stream's disk.  (Writer threads never take either lock, so
+    # joining under the path lock cannot deadlock.)
+    with _async_registry_lock:
+        cell = _async_saves.setdefault(
+            key, {"lock": threading.Lock(), "handle": None})
+    with cell["lock"]:
+        prev, cell["handle"] = cell["handle"], None
+        if prev is not None:
+            prev.join()
+            if prev.exc is not None:
+                exc, prev.exc = prev.exc, None      # report once
+                raise RuntimeError(
+                    f"previous async_save_checkpoint to {key} failed; the "
+                    "new save was not started") from exc
+        t = _AsyncSave(_write)
+        cell["handle"] = t
+        t.start()
     return t
 
 

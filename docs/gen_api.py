@@ -58,6 +58,11 @@ PAGES = {
     "parallel": ("Distribution (deap_tpu.parallel)",
                  ["deap_tpu.parallel.mapper", "deap_tpu.parallel.islands",
                   "deap_tpu.parallel.multihost"]),
+    "resilience": ("Resilient runtime (deap_tpu.resilience)",
+                   ["deap_tpu.resilience.runner",
+                    "deap_tpu.resilience.quarantine",
+                    "deap_tpu.resilience.retry",
+                    "deap_tpu.resilience.faultinject"]),
     "support": ("Observability & persistence (deap_tpu.utils)",
                 ["deap_tpu.utils.support", "deap_tpu.utils.checkpoint"]),
     "benchmarks": ("Problem library (deap_tpu.benchmarks)",

@@ -142,6 +142,8 @@ def test_many_objective_dtlz2(nobj, p, gd_gate):
     assert np.all(f > -1e-6)                        # objectives stay >= 0
 
 
+@pytest.mark.slow  # ~25s; the parametrized test_many_objective_dtlz2
+                   # runs keep the grid ND-sort covered in tier-1
 def test_many_objective_grid_sort_loop():
     """A full NSGA-II loop at nobj=4 with the grid ND-sort forced
     (nd="grid") must stay exact end-to-end: same trajectory as the exact

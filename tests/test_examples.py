@@ -60,19 +60,27 @@ SMOKE = {
     # --- gp ---
     "examples.gp.symbreg": (dict(ngen=25), None),
     "examples.gp.symbreg_epsilon_lexicase": (dict(ngen=15), None),
-    "examples.gp.symbreg_harm": (dict(ngen=10), None),
-    "examples.gp.adf_symbreg": (dict(ngen=10), None),
+    # HARM re-triages deciles host-side every generation (~12s/gen on this
+    # 2-core CI box): 3 generations exercise the full path at a fraction
+    # of the 10-gen smoke that dominated the tier-1 budget
+    "examples.gp.symbreg_harm": (dict(ngen=3), None),
+    "examples.gp.adf_symbreg": (dict(ngen=5), None),
     "examples.gp.multiplexer": (dict(ngen=25), lambda r: r >= 56),
     "examples.gp.parity": (dict(ngen=10), lambda r: r >= 8),
     "examples.gp.spambase": (dict(ngen=8), lambda r: r >= 0.6),
-    "examples.gp.ant": (dict(ngen=8), lambda r: r >= 20),
+    # the ant routine-interpreter smoke is ~6s/gen; 3 gens still clears
+    # the food gate (31 eaten on this stream)
+    "examples.gp.ant": (dict(ngen=3), lambda r: r >= 20),
     # --- es ---
     "examples.es.cma_minfct": (dict(), lambda r: r < 1e-8),
     "examples.es.cma_one_plus_lambda": (dict(), lambda r: r < 30.0),
     # rastrigin: BIPOP restarts reach the global basin's rim (~0.99)
     "examples.es.cma_bipop": (dict(), lambda r: r < 2.0),
     "examples.es.cma_mo": (dict(ngen=120), lambda r: r > 116.0),
-    "examples.es.cma_plotting": (dict(ngen=60, out_png="/tmp/cma_plot_test.png"),
+    # rastrigin N=10 needs ~75 gens to leave the outer basins on this RNG
+    # stream; 85 keeps slack across jax versions (the example's own
+    # default is the reference's 125)
+    "examples.es.cma_plotting": (dict(ngen=85, out_png="/tmp/cma_plot_test.png"),
                                  lambda r: r < 10.0),
     "examples.es.fctmin": (dict(), lambda r: r[1] < 1.0),
     "examples.es.onefifth": (dict(), lambda r: r < 1e-4),
@@ -118,7 +126,36 @@ def test_every_example_covered():
     assert not stale, f"smoke entries with no file: {sorted(stale)}"
 
 
-@pytest.mark.parametrize("name", sorted(SMOKE))
+# The heaviest smokes (10-30s each on the 2-core CI box, ~3.5 min
+# together) run outside the tier-1 gate: the 870s budget was overflowing
+# (with high box-to-box variance), and these exercise paths tier-1
+# already covers through the unit suites (test_gp/test_gp_pallas for the
+# GP stack, test_pso_de_eda, test_coev, benchmark kernels).
+# `pytest -m slow` runs them.
+SLOW_SMOKE = {
+    "examples.gp.symbreg",
+    "examples.gp.symbreg_epsilon_lexicase",
+    "examples.gp.adf_symbreg",
+    "examples.gp.multiplexer",
+    "examples.gp.parity",
+    "examples.gp.spambase",
+    "examples.ga.evopole",
+    "examples.es.cma_bipop",
+    "examples.es.cma_mo",
+    "examples.de.sphere",
+    "examples.coev.symbreg",
+    "examples.coev.coop_adapt",
+    "examples.coev.coop_niche",
+    "examples.bbob",
+}
+# NOT in SLOW_SMOKE: symbreg_harm and ant — their ngen trims above exist
+# precisely so the HARM and routine-interpreter end-to-end paths stay
+# inside the tier-1 gate at affordable cost.
+
+
+@pytest.mark.parametrize(
+    "name", [pytest.param(n, marks=pytest.mark.slow) if n in SLOW_SMOKE
+             else n for n in sorted(SMOKE)])
 def test_example(name):
     kwargs, check = SMOKE[name]
     mod = _mod(name)

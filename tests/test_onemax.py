@@ -37,8 +37,12 @@ def test_onemax_converges():
     stats.register("avg", jnp.mean)
     hof = HallOfFame(1)
 
+    # the reference gate is "reaches 100 within <= 1000 generations,
+    # typically ~40" (BASELINE.md); 120 leaves slack for RNG-stream
+    # differences across jax versions without real cost (one scan)
+    ngen = 120
     pop, logbook = algorithms.ea_simple(
-        k_run, pop, toolbox, cxpb=0.5, mutpb=0.2, ngen=60,
+        k_run, pop, toolbox, cxpb=0.5, mutpb=0.2, ngen=ngen,
         stats=stats, halloffame=hof)
 
     best = float(np.max(np.asarray(pop.fitness.values[:, 0])))
@@ -47,10 +51,10 @@ def test_onemax_converges():
     genome, values = hof[0]
     assert values[0] == 100.0
     assert np.asarray(genome).sum() == 100
-    # logbook has gen 0..60 with nevals
-    assert len(logbook) == 61
+    # logbook has gen 0..ngen with nevals
+    assert len(logbook) == ngen + 1
     assert logbook[0]["gen"] == 0
-    assert logbook[-1]["gen"] == 60
+    assert logbook[-1]["gen"] == ngen
     maxes = logbook.select("max")
     assert maxes[-1] == 100.0
     assert maxes[0] <= maxes[-1]

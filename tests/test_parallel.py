@@ -275,7 +275,11 @@ def test_island_generation_body_is_collective_free():
             off = algorithms.var_and(k2, off, tb, 0.5, 0.2)
             off, _ = algorithms.evaluate_population(tb, off)
             return off.genome, off.fitness.values, off.fitness.valid
-        keys = jax.random.split(key, n_isl)
+        # pin the key fan-out replicated (the islands driver does the
+        # same): threefry splits are trivial on every device, and letting
+        # the partitioner shard them costs a collective-permute
+        keys = jax.lax.with_sharding_constraint(
+            jax.random.split(key, n_isl), NamedSharding(mesh, P()))
         return jax.vmap(one)(keys, g, vals, valid)
 
     txt = (jax.jit(gen, in_shardings=(None, sh, sh, sh))

@@ -692,6 +692,8 @@ def test_grid_exact_on_massive_ties():
     assert int(nf_g) == int(nf_p)
 
 
+@pytest.mark.slow  # ~20s; test_grid_ranks_match_peel and the sweep2d
+                   # variant keep grid-vs-peel equivalence pinned in tier-1
 def test_densegrid_ranks_match_peel():
     """The dense value-rank grid (the discrete-objective exact path) must
     reproduce the count-peel partition on integer objectives of every
