@@ -30,6 +30,7 @@ selects — the count preserves the reference's bookkeeping.)
 
 from __future__ import annotations
 
+import contextlib
 from functools import partial
 from typing import Any, Callable
 
@@ -41,6 +42,8 @@ from jax import lax
 from .base import Population, Fitness
 from .utils.support import (Logbook, HallOfFame, ParetoFront,
                             hof_update, pareto_update)
+from .observability import events as _events
+from .observability.sinks import emit_text as _emit_text
 
 __all__ = ["var_and", "vary_genome", "var_or", "ea_simple",
            "ea_mu_plus_lambda", "ea_mu_comma_lambda", "ea_generate_update",
@@ -195,6 +198,8 @@ def vary_genome(key, g, toolbox, cxpb: float, mutpb: float,
     else:
         raise ValueError(f"unknown pairing {pairing!r}")
     do_cx = jax.random.bernoulli(k_cx, cxpb, (n2,))
+    if _events.active():      # telemetry event tap; inert when no collector
+        _events.emit("mate_pairs", jnp.sum(do_cx, dtype=jnp.int32))
     ca, cb = _apply_op(toolbox.mate, k_cxkeys, n2, ga, gb)
     ga = _where_rows(do_cx, ca, ga)
     gb = _where_rows(do_cx, cb, gb)
@@ -216,6 +221,8 @@ def vary_genome(key, g, toolbox, cxpb: float, mutpb: float,
 
     # --- mutation (reference algorithms.py:78-82) ---
     do_mut = jax.random.bernoulli(k_mut, mutpb, (n,))
+    if _events.active():
+        _events.emit("mutate_calls", jnp.sum(do_mut, dtype=jnp.int32))
     mutated = _apply_op(toolbox.mutate, k_mutkeys, n, g)
     g = _where_rows(do_mut, mutated, g)
     touched = touched | do_mut
@@ -239,6 +246,9 @@ def var_or(key, population: Population, toolbox, lambda_: int,
     u = jax.random.uniform(k_choice, (lambda_,))
     use_cx = u < cxpb
     use_mut = (u >= cxpb) & (u < cxpb + mutpb)
+    if _events.active():
+        _events.emit("mate_pairs", jnp.sum(use_cx, dtype=jnp.int32))
+        _events.emit("mutate_calls", jnp.sum(use_mut, dtype=jnp.int32))
 
     i1 = jax.random.randint(k_p1, (lambda_,), 0, n)
     off = jax.random.randint(k_p2, (lambda_,), 1, n)
@@ -314,9 +324,11 @@ def _record(stats, population, nevals):
     return rec
 
 
-def _emit_stream(gen, rec) -> None:
-    """Host-side one-line record print (the streaming analogue of the
-    reference's ``print(logbook.stream)``, algorithms.py:159-160)."""
+def _emit_stream(gen, rec, sinks=None) -> None:
+    """Host-side one-line record emit (the streaming analogue of the
+    reference's ``print(logbook.stream)``, algorithms.py:159-160) — routed
+    through the observability sink layer (default: stdout on process 0
+    only), so streaming output is capturable and multihost-disciplined."""
     def flat(prefix, d, out):
         for k in sorted(d):
             v = d[k]
@@ -328,7 +340,7 @@ def _emit_stream(gen, rec) -> None:
                            else f"{prefix}{k}={a}")
     parts = [f"gen={int(gen)}"]
     flat("", rec, parts)
-    print("\t".join(parts), flush=True)
+    _emit_text("\t".join(parts), sinks)
 
 
 def _resolve_stream_mode(stream_every: int, stream_mode: str) -> str:
@@ -347,34 +359,74 @@ def _resolve_stream_mode(stream_every: int, stream_mode: str) -> str:
     return stream_mode
 
 
-def _stream_record(stream_mode: str, stream_every: int, gen, rec) -> None:
+def _stream_record(stream_mode: str, stream_every: int, gen, rec,
+                   sinks=None) -> None:
     """In-scan streaming emit (callback mode only; other modes are handled
-    outside the trace by :func:`_scan_generations`)."""
+    outside the trace by :func:`_scan_generations`).  Uses an **ordered**
+    ``io_callback`` so records reach the sinks in generation order —
+    ``jax.debug.callback`` is unordered and may interleave under
+    concurrent dispatch."""
     if stream_mode != "callback":
         return
+    from jax.experimental import io_callback
+    emit = partial(_emit_stream, sinks=sinks)
     lax.cond(gen % stream_every == 0,
-             lambda: jax.debug.callback(_emit_stream, gen, rec),
+             lambda: io_callback(emit, None, gen, rec, ordered=True),
              lambda: None)
 
 
+@contextlib.contextmanager
+def _tel_collect(telemetry):
+    """Open the event tap iff telemetry is enabled; yields the collector
+    (or None).  Keeping the tap closed when telemetry is off is what makes
+    instrumented operators contribute nothing to the compiled program."""
+    if telemetry is None:
+        yield None
+    else:
+        with _events.collect() as c:
+            yield c
+
+
 def _scan_generations(gen_step, carry, ngen: int, stream_every: int,
-                      stream_mode: str):
+                      stream_mode: str, telemetry=None, sinks=None):
     """``lax.scan`` over generations 1..ngen — as ONE dispatch normally, or
-    segmented into ``stream_every``-generation chunks with a host print of
-    the chunk's last record in between (``segmented`` mode; trajectory is
+    segmented into chunks with host work between them (``segmented``
+    streaming and/or segmented telemetry drains; trajectory is
     bit-identical to the single scan, the generations are simply dispatched
     in groups).  At most two program shapes compile (the chunk size and one
-    remainder)."""
-    if stream_mode != "segmented":
+    remainder).
+
+    Segmented telemetry (the fallback for backends without host
+    callbacks): when telemetry resolves to ``"segmented"`` mode, the loop
+    convention is that the **last element of the carry tuple is the
+    MetricBuffer**; it is drained host-side at every ``flush_every``
+    boundary (and at the final chunk).  With both segmented streaming and
+    segmented telemetry active, the scan is cut at the UNION of the two
+    boundary sets — never more dispatches than one per boundary, and each
+    emit honors its own cadence (a gcd-sized chunk would degenerate to
+    one-generation dispatches for coprime cadences).  The number of
+    distinct chunk lengths — hence compiled program shapes — stays
+    bounded by the smaller cadence."""
+    tel_mode = telemetry.resolved_mode() if telemetry is not None else "off"
+    seg_stream = stream_mode == "segmented"
+    seg_tel = tel_mode == "segmented"
+    if not seg_stream and not seg_tel:
         return lax.scan(gen_step, carry, jnp.arange(1, ngen + 1))
     if any(isinstance(leaf, jax.core.Tracer)
            for leaf in jax.tree_util.tree_leaves(carry)):
         import warnings
-        warnings.warn("stream_every ignored: segmented streaming needs to "
-                      "drive the generations from the host, but the loop is "
-                      "being traced (e.g. under jit); records are still in "
-                      "the returned logbook")
+        warnings.warn("stream_every/telemetry flushes ignored: segmented "
+                      "dispatch needs to drive the generations from the "
+                      "host, but the loop is being traced (e.g. under jit); "
+                      "records are still in the returned logbook")
         return lax.scan(gen_step, carry, jnp.arange(1, ngen + 1))
+
+    boundaries = {ngen}
+    if seg_stream:
+        boundaries.update(range(stream_every, ngen + 1, stream_every))
+    if seg_tel:
+        boundaries.update(range(telemetry.flush_every, ngen + 1,
+                                telemetry.flush_every))
 
     jitted = {}
 
@@ -386,20 +438,22 @@ def _scan_generations(gen_step, carry, ngen: int, stream_every: int,
 
     chunks = []
     pos = 1
-    while pos <= ngen:
-        k = min(stream_every, ngen - pos + 1)
-        carry, stacked = seg(carry, pos, k)
-        last = jax.tree_util.tree_map(lambda x: np.asarray(x[-1]), stacked)
-        _emit_stream(pos + k - 1, last)
+    for end in sorted(boundaries):
+        carry, stacked = seg(carry, pos, end - pos + 1)
+        if seg_stream and (end % stream_every == 0 or end == ngen):
+            last = jax.tree_util.tree_map(lambda x: np.asarray(x[-1]), stacked)
+            _emit_stream(end, last, sinks)
+        if seg_tel and (end % telemetry.flush_every == 0 or end == ngen):
+            telemetry.host_drain(carry[-1], end)
         chunks.append(stacked)
-        pos += k
+        pos = end + 1
     stacked = jax.tree_util.tree_map(
         lambda *xs: jnp.concatenate([jnp.atleast_1d(x) for x in xs]), *chunks)
     return carry, stacked
 
 
 def _finish(key, population, hof_state, halloffame, stats, rec0, stacked,
-            ngen, verbose):
+            ngen, verbose, sinks=None):
     logbook = Logbook()
     logbook.header = ["gen", "nevals"] + (stats.fields if stats else [])
     logbook.record(gen=0, **{k: (v.item() if hasattr(v, "item") and jnp.ndim(v) == 0
@@ -410,14 +464,14 @@ def _finish(key, population, hof_state, halloffame, stats, rec0, stacked,
     if halloffame is not None:
         halloffame.state = hof_state
     if verbose:
-        print(logbook.stream)
+        _emit_text(logbook.stream, sinks)
     return logbook
 
 
 def ea_simple(key, population: Population, toolbox, cxpb: float, mutpb: float,
               ngen: int, stats=None, halloffame=None, verbose=False,
               reevaluate_all: bool = False, stream_every: int = 0,
-              stream_mode: str = "auto"):
+              stream_mode: str = "auto", telemetry=None):
     """The simplest GA (reference eaSimple, algorithms.py:85-189): per
     generation select ``n`` parents, apply :func:`var_and`, evaluate, update
     the hall of fame.  Runs as one ``lax.scan``; returns
@@ -437,100 +491,146 @@ def ea_simple(key, population: Population, toolbox, cxpb: float, mutpb: float,
     via an in-scan host callback where the backend supports one, else by
     segmenting the scan into ``k``-generation dispatches with a host print
     between chunks (bit-identical trajectory; ``stream_mode`` forces
-    ``"callback"``/``"segmented"`` explicitly)."""
+    ``"callback"``/``"segmented"`` explicitly).
+
+    ``telemetry`` (a :class:`deap_tpu.observability.Telemetry`) carries a
+    :class:`~deap_tpu.observability.metrics.MetricBuffer` through the scan:
+    counters (nevals, operator invocations, quarantine hits) and fitness
+    gauges accumulate as array ops and flush to the telemetry's sinks every
+    ``flush_every`` generations.  ``None`` (default) compiles the identical
+    program as before the buffer existed."""
     smode = _resolve_stream_mode(stream_every, stream_mode)
+    sinks = telemetry.sinks if telemetry is not None else None
     key, k0 = jax.random.split(key)
-    population, nevals0 = evaluate_population(toolbox, population)
+    with _tel_collect(telemetry) as ev0:
+        population, nevals0 = evaluate_population(toolbox, population)
     hof_state, hof_upd = _hof_setup(halloffame, population)
     if hof_state is not None:
         hof_state = hof_upd(hof_state, population)
     rec0 = _record(stats, population, nevals0)
+    buf0 = None
+    if telemetry is not None:
+        buf0 = telemetry.on_loop_start(population)
+        buf0 = telemetry.accumulate(buf0, population=population,
+                                    nevals=nevals0, events=ev0.drain(),
+                                    generation=False)
 
     def gen_step(carry, gen):
-        key, pop, hof = carry
+        key, pop, hof, buf = carry
         key, k_sel, k_var = jax.random.split(key, 3)
-        idx = toolbox.select(k_sel, pop.fitness, pop.size)
-        if reevaluate_all:
-            genome = jax.tree_util.tree_map(lambda x: x[idx], pop.genome)
-            genome, touched = vary_genome(k_var, genome, toolbox, cxpb, mutpb)
-            off = Population(genome, Fitness.empty(
-                pop.size, pop.fitness.weights, pop.fitness.values.dtype))
-            off, _ = evaluate_population(toolbox, off)
-            nevals = jnp.sum(touched)
-        else:
-            off = pop.take(idx)
-            off = var_and(k_var, off, toolbox, cxpb, mutpb)
-            off, nevals = evaluate_population(toolbox, off)
+        with _tel_collect(telemetry if buf is not None else None) as ev:
+            idx = toolbox.select(k_sel, pop.fitness, pop.size)
+            if reevaluate_all:
+                genome = jax.tree_util.tree_map(lambda x: x[idx], pop.genome)
+                genome, touched = vary_genome(k_var, genome, toolbox, cxpb,
+                                              mutpb)
+                off = Population(genome, Fitness.empty(
+                    pop.size, pop.fitness.weights, pop.fitness.values.dtype))
+                off, _ = evaluate_population(toolbox, off)
+                nevals = jnp.sum(touched)
+            else:
+                off = pop.take(idx)
+                off = var_and(k_var, off, toolbox, cxpb, mutpb)
+                off, nevals = evaluate_population(toolbox, off)
         if hof is not None:
             hof = hof_upd(hof, off)
+        if buf is not None:
+            buf = telemetry.accumulate(buf, population=off, nevals=nevals,
+                                       events=ev.drain())
+            telemetry.inscan_flush(buf, gen)
         rec = _record(stats, off, nevals)
-        _stream_record(smode, stream_every, gen, rec)
-        return (key, off, hof), rec
+        _stream_record(smode, stream_every, gen, rec, sinks)
+        return (key, off, hof, buf), rec
 
-    (key, population, hof_state), stacked = _scan_generations(
-        gen_step, (key, population, hof_state), ngen, stream_every, smode)
+    (key, population, hof_state, buf), stacked = _scan_generations(
+        gen_step, (key, population, hof_state, buf0), ngen, stream_every,
+        smode, telemetry=telemetry, sinks=sinks)
+    if telemetry is not None:
+        telemetry.on_loop_end(buf, final_gen=ngen)
     logbook = _finish(key, population, hof_state, halloffame, stats, rec0,
-                      stacked, ngen, verbose)
+                      stacked, ngen, verbose, sinks)
     return population, logbook
 
 
 def _ea_mu_lambda(key, population, toolbox, mu, lambda_, cxpb, mutpb, ngen,
                   stats, halloffame, verbose, plus: bool,
-                  stream_every: int = 0, stream_mode: str = "auto"):
+                  stream_every: int = 0, stream_mode: str = "auto",
+                  telemetry=None):
     smode = _resolve_stream_mode(stream_every, stream_mode)
+    sinks = telemetry.sinks if telemetry is not None else None
     key, k0 = jax.random.split(key)
-    population, nevals0 = evaluate_population(toolbox, population)
+    with _tel_collect(telemetry) as ev0:
+        population, nevals0 = evaluate_population(toolbox, population)
     hof_state, hof_upd = _hof_setup(halloffame, population)
     if hof_state is not None:
         hof_state = hof_upd(hof_state, population)
     rec0 = _record(stats, population, nevals0)
+    buf0 = None
+    if telemetry is not None:
+        buf0 = telemetry.on_loop_start(population)
+        buf0 = telemetry.accumulate(buf0, population=population,
+                                    nevals=nevals0, events=ev0.drain(),
+                                    generation=False)
 
     def gen_step(carry, gen):
-        key, pop, hof = carry
+        key, pop, hof, buf = carry
         key, k_var, k_sel = jax.random.split(key, 3)
-        off = var_or(k_var, pop, toolbox, lambda_, cxpb, mutpb)
-        off, nevals = evaluate_population(toolbox, off)
+        with _tel_collect(telemetry if buf is not None else None) as ev:
+            off = var_or(k_var, pop, toolbox, lambda_, cxpb, mutpb)
+            off, nevals = evaluate_population(toolbox, off)
         if hof is not None:
             hof = hof_upd(hof, off)
         pool = pop.concat(off) if plus else off
         idx = toolbox.select(k_sel, pool.fitness, mu)
         new_pop = pool.take(idx)
+        if buf is not None:
+            buf = telemetry.accumulate(buf, population=new_pop, nevals=nevals,
+                                       events=ev.drain())
+            telemetry.inscan_flush(buf, gen)
         rec = _record(stats, new_pop, nevals)
-        _stream_record(smode, stream_every, gen, rec)
-        return (key, new_pop, hof), rec
+        _stream_record(smode, stream_every, gen, rec, sinks)
+        return (key, new_pop, hof, buf), rec
 
-    (key, population, hof_state), stacked = _scan_generations(
-        gen_step, (key, population, hof_state), ngen, stream_every, smode)
+    (key, population, hof_state, buf), stacked = _scan_generations(
+        gen_step, (key, population, hof_state, buf0), ngen, stream_every,
+        smode, telemetry=telemetry, sinks=sinks)
+    if telemetry is not None:
+        telemetry.on_loop_end(buf, final_gen=ngen)
     logbook = _finish(key, population, hof_state, halloffame, stats, rec0,
-                      stacked, ngen, verbose)
+                      stacked, ngen, verbose, sinks)
     return population, logbook
 
 
 def ea_mu_plus_lambda(key, population, toolbox, mu, lambda_, cxpb, mutpb,
                       ngen, stats=None, halloffame=None, verbose=False,
-                      stream_every: int = 0, stream_mode: str = "auto"):
+                      stream_every: int = 0, stream_mode: str = "auto",
+                      telemetry=None):
     """(μ + λ) strategy (reference eaMuPlusLambda, algorithms.py:248-337):
     offspring by :func:`var_or`, next generation selected from parents ∪
     offspring."""
     return _ea_mu_lambda(key, population, toolbox, mu, lambda_, cxpb, mutpb,
                          ngen, stats, halloffame, verbose, plus=True,
-                         stream_every=stream_every, stream_mode=stream_mode)
+                         stream_every=stream_every, stream_mode=stream_mode,
+                         telemetry=telemetry)
 
 
 def ea_mu_comma_lambda(key, population, toolbox, mu, lambda_, cxpb, mutpb,
                        ngen, stats=None, halloffame=None, verbose=False,
-                       stream_every: int = 0, stream_mode: str = "auto"):
+                       stream_every: int = 0, stream_mode: str = "auto",
+                       telemetry=None):
     """(μ , λ) strategy (reference eaMuCommaLambda, algorithms.py:340-437):
     next generation selected from offspring only (λ ≥ μ required)."""
     assert lambda_ >= mu, ("lambda must be greater or equal to mu.")
     return _ea_mu_lambda(key, population, toolbox, mu, lambda_, cxpb, mutpb,
                          ngen, stats, halloffame, verbose, plus=False,
-                         stream_every=stream_every, stream_mode=stream_mode)
+                         stream_every=stream_every, stream_mode=stream_mode,
+                         telemetry=telemetry)
 
 
 def ea_generate_update(key, toolbox, state, ngen: int, weights=(-1.0,),
                        stats=None, halloffame=None, verbose=False,
-                       stream_every: int = 0, stream_mode: str = "auto"):
+                       stream_every: int = 0, stream_mode: str = "auto",
+                       telemetry=None):
     """Ask-tell loop (reference eaGenerateUpdate, algorithms.py:440-503):
     ``toolbox.generate(state, key) -> genome batch`` then
     ``toolbox.update(state, population) -> state`` — the functional form of
@@ -538,29 +638,39 @@ def ea_generate_update(key, toolbox, state, ngen: int, weights=(-1.0,),
 
     Returns ``(population, state, logbook)``."""
     smode = _resolve_stream_mode(stream_every, stream_mode)
+    sinks = telemetry.sinks if telemetry is not None else None
     weights = tuple(weights)
 
     sample = toolbox.generate(state, jax.random.fold_in(key, 0))
     n = jax.tree_util.tree_leaves(sample)[0].shape[0]
     sample_pop = Population(sample, Fitness.empty(n, weights))
     hof_state, hof_upd = _hof_setup(halloffame, sample_pop)
+    buf0 = telemetry.on_loop_start(sample_pop) if telemetry is not None \
+        else None
 
     def gen_step(carry, gen):
-        key, state, hof, _ = carry
+        key, state, hof, _, buf = carry
         key, k_gen = jax.random.split(key)
-        genome = toolbox.generate(state, k_gen)
-        pop = Population(genome, Fitness.empty(n, weights))
-        pop, nevals = evaluate_population(toolbox, pop)
-        state = toolbox.update(state, pop)
+        with _tel_collect(telemetry if buf is not None else None) as ev:
+            genome = toolbox.generate(state, k_gen)
+            pop = Population(genome, Fitness.empty(n, weights))
+            pop, nevals = evaluate_population(toolbox, pop)
+            state = toolbox.update(state, pop)
         if hof is not None:
             hof = hof_upd(hof, pop)
+        if buf is not None:
+            buf = telemetry.accumulate(buf, population=pop, nevals=nevals,
+                                       events=ev.drain())
+            telemetry.inscan_flush(buf, gen)
         rec = _record(stats, pop, nevals)
-        _stream_record(smode, stream_every, gen, rec)
-        return (key, state, hof, pop), rec
+        _stream_record(smode, stream_every, gen, rec, sinks)
+        return (key, state, hof, pop, buf), rec
 
-    (key, state, hof_state, last_pop), stacked = _scan_generations(
-        gen_step, (key, state, hof_state, sample_pop), ngen, stream_every,
-        smode)
+    (key, state, hof_state, last_pop, buf), stacked = _scan_generations(
+        gen_step, (key, state, hof_state, sample_pop, buf0), ngen,
+        stream_every, smode, telemetry=telemetry, sinks=sinks)
+    if telemetry is not None:
+        telemetry.on_loop_end(buf, final_gen=ngen)
 
     logbook = Logbook()
     logbook.header = ["gen", "nevals"] + (stats.fields if stats else [])
@@ -568,7 +678,7 @@ def ea_generate_update(key, toolbox, state, ngen: int, weights=(-1.0,),
     if halloffame is not None:
         halloffame.state = hof_state
     if verbose:
-        print(logbook.stream)
+        _emit_text(logbook.stream, sinks)
     return last_pop, state, logbook
 
 

@@ -28,6 +28,7 @@ from jax import lax
 from .algorithms import var_and, _record
 from .base import Fitness, Population
 from .utils.support import Logbook
+from .observability.sinks import emit_text
 
 __all__ = ["ea_cooperative", "ea_host_parasite"]
 
@@ -106,7 +107,7 @@ def ea_cooperative(key, species: Population, toolbox, cxpb: float,
     logbook.header = ["gen"] + (stats.fields if stats else [])
     logbook.record_stacked(gen=jnp.arange(1, ngen + 1), **stacked)
     if verbose:
-        print(logbook.stream)
+        emit_text(logbook.stream)
     return species, reps, logbook
 
 
@@ -150,5 +151,5 @@ def ea_host_parasite(key, hosts: Population, parasites: Population,
     logbook.header = ["gen", "nevals"] + (stats.fields if stats else [])
     logbook.record_stacked(gen=jnp.arange(1, ngen + 1), **stacked)
     if verbose:
-        print(logbook.stream)
+        emit_text(logbook.stream)
     return hosts, parasites, logbook

@@ -27,6 +27,7 @@ from jax import lax
 from .algorithms import _hof_setup, _norm_eval, _record
 from .base import Fitness, Population
 from .utils.support import Logbook
+from .observability.sinks import emit_text
 
 __all__ = ["de_step", "de"]
 
@@ -127,5 +128,5 @@ def de(key, population: Population, evaluate: Callable, ngen: int,
     if halloffame is not None:
         halloffame.state = hof_state
     if verbose:
-        print(logbook.stream)
+        emit_text(logbook.stream)
     return population, logbook

@@ -1,0 +1,42 @@
+"""Observability: on-device telemetry, trace spans, and metric sinks.
+
+The reference's whole observability story is ``print(logbook.stream)``
+(deap/algorithms.py:159-160); this package is its equivalent for a
+runtime where an entire evolution run is one ``lax.scan`` dispatch:
+
+* :mod:`~deap_tpu.observability.metrics` — :class:`MetricBuffer`, the
+  counters/gauges pytree carried through the compiled generation scan,
+  plus multihost reduction helpers;
+* :mod:`~deap_tpu.observability.events` — the in-trace event tap deep
+  library code (variation ops, quarantine, migration) reports through;
+* :mod:`~deap_tpu.observability.telemetry` — :class:`Telemetry`, the
+  host object that loops accept as ``telemetry=``: periodic ordered
+  ``io_callback`` flushes, segmented-drain fallback, resumable state;
+* :mod:`~deap_tpu.observability.sinks` — where flushes and streaming
+  text go (:class:`InMemorySink`, :class:`JsonlSink`,
+  :class:`LogbookSink`, :class:`StdoutSink`, optional
+  :class:`TensorBoardSink`), process-0-only on multihost;
+* :mod:`~deap_tpu.observability.tracing` — wall-clock + profiler spans,
+  AOT compile-vs-execute phase timers, ``capture_trace``, device-memory
+  reports; surfaced by the ``deap-tpu-trace`` console entry.
+"""
+
+from . import events, metrics, sinks, telemetry, tracing   # noqa: F401
+from .metrics import (MetricBuffer, buffer_init, cross_host_sum,  # noqa: F401
+                      psum_counters)
+from .sinks import (MetricRecord, Sink, InMemorySink, JsonlSink,  # noqa: F401
+                    LogbookSink, StdoutSink, TensorBoardSink,
+                    emit_record, emit_text, format_record)
+from .telemetry import Telemetry, STANDARD_COUNTERS, STANDARD_GAUGES  # noqa: F401
+from .tracing import (Span, span, PhaseTimes, aot_phase_times,  # noqa: F401
+                      capture_trace, device_memory_report)
+
+__all__ = [
+    "MetricBuffer", "buffer_init", "cross_host_sum", "psum_counters",
+    "MetricRecord", "Sink", "InMemorySink", "JsonlSink", "LogbookSink",
+    "StdoutSink", "TensorBoardSink", "emit_record", "emit_text",
+    "format_record",
+    "Telemetry", "STANDARD_COUNTERS", "STANDARD_GAUGES",
+    "Span", "span", "PhaseTimes", "aot_phase_times", "capture_trace",
+    "device_memory_report",
+]

@@ -1,0 +1,118 @@
+"""``deap-tpu-trace`` — deployment-time tracing probe.
+
+The observability sibling of ``deap-tpu-selftest`` / ``deap-tpu-faultdrill``:
+compile and run a representative GA generation scan ON THE TARGET BACKEND
+and report where the time goes — trace+lower vs XLA compile vs device
+execute (the split ``bench.py`` hand-timing can't see), per-generation
+marginal cost, and the device-memory watermarks.  Optionally capture a
+full profiler trace for TensorBoard/Perfetto.
+
+    deap-tpu-trace                                  # defaults, JSON report
+    deap-tpu-trace --pop 131072 --dim 100 --ngen 30
+    deap-tpu-trace --capture /tmp/trace_out         # + profiler trace
+    JAX_PLATFORMS=cpu deap-tpu-trace                # pin a backend
+
+Exit status is non-zero when the probe itself fails (compile error,
+non-finite result) — a smoke gate, not a benchmark.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _build_run(pop: int, dim: int, ngen: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from .. import base, benchmarks
+    from ..algorithms import vary_genome, evaluate_population
+    from ..ops import crossover, mutation, selection
+
+    tb = base.Toolbox()
+    tb.register("evaluate", benchmarks.rastrigin)
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=0.3,
+                indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3,
+                tie_break="rank")
+
+    def generation(carry, _):
+        key, p = carry
+        key, k_sel, k_var = jax.random.split(key, 3)
+        idx = tb.select(k_sel, p.fitness, pop)
+        genome = jax.tree_util.tree_map(lambda x: x[idx], p.genome)
+        genome, _ = vary_genome(k_var, genome, tb, 0.9, 0.5,
+                                pairing="halves")
+        off = base.Population(genome, base.Fitness.empty(pop, (-1.0,)))
+        off, _ = evaluate_population(tb, off)
+        return (key, off), jnp.min(off.fitness.values[:, 0])
+
+    def run(key, p):
+        return lax.scan(generation, (key, p), None, length=ngen)
+
+    key = jax.random.PRNGKey(0)
+    genome = jax.random.uniform(key, (pop, dim), jnp.float32, -5.12, 5.12)
+    p = base.Population(genome=genome,
+                        fitness=base.Fitness.empty(pop, (-1.0,)))
+    p, _ = evaluate_population(tb, p)
+    return run, key, p
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="deap-tpu-trace",
+        description="phase-split trace of a GA generation scan on the "
+                    "target backend")
+    ap.add_argument("--pop", type=int, default=16384)
+    ap.add_argument("--dim", type=int, default=100)
+    ap.add_argument("--ngen", type=int, default=20)
+    ap.add_argument("--capture", metavar="DIR", default=None,
+                    help="also capture a jax.profiler trace into DIR")
+    args = ap.parse_args(argv)
+
+    import jax
+    import numpy as np
+    from .tracing import (aot_phase_times, capture_trace,
+                          device_memory_report)
+
+    run, key, p = _build_run(args.pop, args.dim, args.ngen)
+    # keep the compiled executable so the marginal per-generation
+    # measurement below re-dispatches without recompiling
+    (_, best), phases, compiled = aot_phase_times(run, key, p,
+                                                  return_compiled=True)
+    best_end = float(np.asarray(best)[-1])
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(compiled(key, p))
+    exec2 = time.perf_counter() - t0
+
+    trace_dir = None
+    if args.capture:
+        with capture_trace(args.capture) as out:
+            jax.block_until_ready(compiled(key, p))
+        trace_dir = str(out)
+
+    report = {
+        "backend": jax.default_backend(),
+        "devices": len(jax.devices()),
+        "pop": args.pop, "dim": args.dim, "ngen": args.ngen,
+        "phases": phases.to_dict(),
+        "per_gen_s": exec2 / args.ngen,
+        "gens_per_sec": args.ngen / exec2 if exec2 > 0 else -1.0,
+        "best_fitness_end": best_end,
+        "device_memory": device_memory_report(),
+        "profiler_trace": trace_dir,
+    }
+    print(json.dumps(report))
+    if not np.isfinite(best_end):
+        print("FAILED: non-finite best fitness", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,84 @@
+"""In-trace event tap — how deep library code reports countable events to
+an enclosing telemetry-enabled loop without threading a carry argument
+through every call signature.
+
+The generation body of every loop is traced exactly once per compile;
+while that trace runs, operators and policies (variation, quarantine,
+migration) call :func:`emit` with traced scalar values.  A loop that
+carries a :class:`~deap_tpu.observability.metrics.MetricBuffer` wraps its
+body in :func:`collect`, drains the emitted values, and folds them into
+the buffer *inside the same trace* — the values stay device-side array
+ops, and the scan carry is the only state.
+
+When no collector is active (telemetry off — the default), :func:`emit`
+is a two-instruction no-op on the host at trace time and contributes
+nothing to the compiled program, so instrumented operators cost nothing
+in the telemetry-off configuration.
+
+The tap is thread-local: concurrent traces (e.g. persistent compilation
+workers) cannot observe each other's events.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, Iterator, List, Tuple
+
+__all__ = ["emit", "collect", "active"]
+
+_tls = threading.local()
+
+
+def _stack() -> List["_Collector"]:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def active() -> bool:
+    """True iff a :func:`collect` context is open on this thread."""
+    return bool(getattr(_tls, "stack", None))
+
+
+def emit(name: str, value: Any) -> None:
+    """Report ``value`` (a scalar, possibly traced) under counter ``name``
+    to the innermost open collector; no-op when none is active."""
+    stack = getattr(_tls, "stack", None)
+    if not stack:
+        return
+    stack[-1].items.append((name, value))
+
+
+class _Collector:
+    """Accumulates ``(name, value)`` pairs emitted while its context is
+    open; :meth:`drain` sums same-named values (as array ops, so traced
+    values compose into the enclosing trace)."""
+
+    def __init__(self):
+        self.items: List[Tuple[str, Any]] = []
+
+    def drain(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        out: Dict[str, Any] = {}
+        for name, value in self.items:
+            v = jnp.asarray(value)
+            out[name] = v if name not in out else out[name] + v
+        self.items = []
+        return out
+
+
+@contextlib.contextmanager
+def collect() -> Iterator[_Collector]:
+    """Open an event collector for the current thread.  Nested contexts
+    shadow outer ones (events go to the innermost only) — a telemetry-
+    enabled loop used as a building block inside another loop's trace
+    keeps its events to itself."""
+    stack = _stack()
+    c = _Collector()
+    stack.append(c)
+    try:
+        yield c
+    finally:
+        stack.pop()

@@ -1,0 +1,119 @@
+"""On-device metric accumulation — the :class:`MetricBuffer` pytree that
+rides the generation-scan carry.
+
+The reference's observability is host-side and per-generation
+(``print(logbook.stream)``, deap/algorithms.py:159-160); here the whole
+run is one ``lax.scan`` dispatch, so live metrics must accumulate *as
+array ops inside the compiled program* and surface periodically through a
+host callback (EvoJAX/evosax idiom: in-scan accumulation, periodic host
+flush).  A :class:`MetricBuffer` is a frozen dataclass pytree of
+
+* ``counters`` — cumulative ``int32`` scalars (``nevals``, quarantine
+  hits, operator invocations, migration events, ...), monotone over the
+  run and therefore comparable across flushes and across
+  preemption-resume boundaries;
+* ``gauges`` — last-value ``float32`` scalars (fitness summary,
+  population diversity, ...).
+
+All update methods are functional (they return a new buffer) and shape-
+static: the key sets are fixed when the buffer is created, because the
+buffer lives in a ``lax.scan`` carry whose pytree structure cannot change
+between iterations.  Events emitted under names the buffer does not carry
+are dropped by :meth:`MetricBuffer.merge_events`.
+
+Multihost semantics: counters computed from *globally sharded* arrays
+under jit are already global (every process sees the same replicated
+scalar).  For host-local values, :func:`cross_host_sum` reduces a counter
+dict across processes; inside ``shard_map`` kernels use
+:func:`psum_counters`.  Writing is the sink layer's job and is
+process-0-only by default (:mod:`deap_tpu.observability.sinks`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, Mapping
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["MetricBuffer", "buffer_init", "cross_host_sum", "psum_counters",
+           "COUNTER_DTYPE", "GAUGE_DTYPE"]
+
+# int32: exact integer accumulation to 2**31-1 (float32 loses integer
+# exactness past 2**24, which a pop=10^6 run crosses in ~17 generations
+# of nevals); runs long enough to overflow int32 should flush and reset.
+COUNTER_DTYPE = jnp.int32
+GAUGE_DTYPE = jnp.float32
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class MetricBuffer:
+    """Device-side telemetry state carried through the generation scan."""
+
+    counters: Dict[str, jax.Array]
+    gauges: Dict[str, jax.Array]
+
+    def inc(self, name: str, value) -> "MetricBuffer":
+        """Add ``value`` to counter ``name`` (which must exist)."""
+        c = dict(self.counters)
+        c[name] = c[name] + jnp.asarray(value).astype(COUNTER_DTYPE)
+        return dataclasses.replace(self, counters=c)
+
+    def put(self, name: str, value) -> "MetricBuffer":
+        """Set gauge ``name`` (which must exist) to ``value``."""
+        g = dict(self.gauges)
+        g[name] = jnp.asarray(value).astype(GAUGE_DTYPE)
+        return dataclasses.replace(self, gauges=g)
+
+    def merge_events(self, events: Mapping[str, jax.Array]) -> "MetricBuffer":
+        """Fold a drained event dict (see
+        :mod:`deap_tpu.observability.events`) into the counters; names the
+        buffer does not carry are dropped (the carry structure is static
+        under ``lax.scan``)."""
+        if not events:
+            return self
+        c = dict(self.counters)
+        for name, v in events.items():
+            if name in c:
+                c[name] = c[name] + jnp.asarray(v).astype(COUNTER_DTYPE)
+        return dataclasses.replace(self, counters=c)
+
+    def host_values(self) -> tuple[Dict[str, int], Dict[str, float]]:
+        """Pull both dicts to host python scalars (blocks on the device)."""
+        counters = {k: int(np.asarray(v)) for k, v in self.counters.items()}
+        gauges = {k: float(np.asarray(v)) for k, v in self.gauges.items()}
+        return counters, gauges
+
+
+def buffer_init(counters: Iterable[str], gauges: Iterable[str] = ()
+                ) -> MetricBuffer:
+    """A zeroed buffer with the given (static) key sets."""
+    return MetricBuffer(
+        counters={k: jnp.zeros((), COUNTER_DTYPE) for k in counters},
+        gauges={k: jnp.zeros((), GAUGE_DTYPE) for k in gauges})
+
+
+def cross_host_sum(counters: Mapping[str, int]) -> Dict[str, int]:
+    """Sum a *host-local* counter dict across every process (all processes
+    see the identical totals).  Counters that came out of a jitted program
+    over globally sharded arrays are already global — do not reduce them
+    again.  Single-process: returns the dict unchanged."""
+    if jax.process_count() == 1:
+        return dict(counters)
+    from jax.experimental import multihost_utils
+    names = sorted(counters)
+    local = np.asarray([int(counters[k]) for k in names], np.int64)
+    total = np.asarray(multihost_utils.process_allgather(local)).sum(axis=0)
+    return {k: int(v) for k, v in zip(names, total)}
+
+
+def psum_counters(counters: Mapping[str, jax.Array], axis_name: str
+                  ) -> Dict[str, jax.Array]:
+    """``lax.psum`` every counter over ``axis_name`` — for accumulators
+    built inside a ``shard_map``/``pmap`` kernel, where each device holds
+    only its shard's contribution."""
+    from jax import lax
+    return {k: lax.psum(v, axis_name) for k, v in counters.items()}

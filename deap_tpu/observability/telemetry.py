@@ -1,0 +1,241 @@
+"""The :class:`Telemetry` host object — wiring between the compiled
+generation loop and the sink layer.
+
+Usage::
+
+    from deap_tpu.observability import Telemetry, JsonlSink
+
+    tel = Telemetry(sinks=[JsonlSink("run.jsonl")], flush_every=10)
+    pop, logbook = ea_simple(key, pop, toolbox, 0.5, 0.2, ngen=200,
+                             telemetry=tel)
+    tel.state            # final MetricBuffer (device)
+    tel.records          # flushed MetricRecords (if an InMemorySink is attached)
+
+The loop threads a :class:`~deap_tpu.observability.metrics.MetricBuffer`
+through its scan carry and calls, per generation *inside the trace*:
+``accumulate`` (fold nevals / drained events / fitness gauges into the
+buffer) and ``inscan_flush`` (every ``flush_every`` generations, push the
+buffer's host values through an **ordered** ``io_callback`` — ordered so
+flushes arrive at the sinks in generation order).  Backends without host
+callbacks (``flush_mode="segmented"``, or ``"auto"`` on the axon plugin)
+instead get the loop's segmented-dispatch fallback: the scan is chunked at
+``flush_every`` boundaries and the buffer is drained host-side between
+chunks — same counters, no callback inside the compiled program.
+
+Like :class:`~deap_tpu.utils.support.HallOfFame`, a Telemetry carries its
+device state across successive loop calls (``state``): counters are
+cumulative over segments, which is what lets
+:func:`deap_tpu.resilience.run_resumable` checkpoint and restore telemetry
+bit-exactly across preemptions.  Call :meth:`clear` for a fresh run.
+
+With ``telemetry=None`` (every loop's default) none of this exists in the
+compiled program: the carry slot is ``None`` (zero pytree leaves), event
+emission is inert, and the scan compiles to the identical dispatch
+sequence as before the subsystem existed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .metrics import MetricBuffer, buffer_init
+from .sinks import (Sink, InMemorySink, MetricRecord, emit_record)
+
+__all__ = ["Telemetry", "STANDARD_COUNTERS", "STANDARD_GAUGES"]
+
+#: Counters every loop feeds (via nevals + the event tap).  Extra names
+#: can be added per-Telemetry; events under unknown names are dropped.
+STANDARD_COUNTERS = ("generations", "nevals", "quarantined",
+                     "mate_pairs", "mutate_calls", "migrations")
+
+#: Gauges computed by ``accumulate`` (fitness summary always; diversity
+#: only when enabled — it costs a pass over the genome).
+STANDARD_GAUGES = ("fitness_best", "fitness_mean", "fitness_std")
+
+
+def _resolve_flush_mode(flush_every: int, mode: str) -> str:
+    if not flush_every:
+        return "accumulate"
+    if mode == "auto":
+        return ("segmented" if jax.default_backend() in ("axon",)
+                else "callback")
+    if mode not in ("callback", "segmented", "accumulate"):
+        raise ValueError(f"flush_mode {mode!r}: expected 'auto', 'callback', "
+                         "'segmented' or 'accumulate'")
+    return mode
+
+
+class Telemetry:
+    """Host-side telemetry coordinator (see module docstring).
+
+    Parameters
+    ----------
+    sinks:
+        Where flushes go; defaults to one :class:`InMemorySink`.
+    flush_every:
+        Flush cadence in generations; ``0`` disables periodic flushing
+        (the buffer still accumulates and lands in ``state``).
+    flush_mode:
+        ``"auto"`` | ``"callback"`` (ordered ``io_callback`` from inside
+        the scan) | ``"segmented"`` (chunked dispatch, host drain between
+        chunks) | ``"accumulate"`` (never flush mid-run).
+    counters / gauges:
+        Counter/gauge key sets of the buffer (static — the buffer lives
+        in a scan carry).
+    diversity:
+        Also track mean per-dimension genome std as gauge ``diversity``.
+    """
+
+    def __init__(self, sinks: Sequence[Sink] = (), flush_every: int = 10,
+                 flush_mode: str = "auto",
+                 counters: Iterable[str] = STANDARD_COUNTERS,
+                 gauges: Iterable[str] = STANDARD_GAUGES,
+                 diversity: bool = False):
+        self.sinks = list(sinks) if sinks else [InMemorySink()]
+        self.flush_every = int(flush_every)
+        self.flush_mode = flush_mode
+        self.counter_names = tuple(counters)
+        gauges = tuple(gauges)
+        if diversity and "diversity" not in gauges:
+            gauges = gauges + ("diversity",)
+        self.gauge_names = gauges
+        self.diversity = bool(diversity)
+        self.state: Optional[MetricBuffer] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def resolved_mode(self) -> str:
+        return _resolve_flush_mode(self.flush_every, self.flush_mode)
+
+    def clear(self) -> None:
+        self.state = None
+
+    @property
+    def records(self):
+        """Flushed records of the first attached :class:`InMemorySink`
+        (convenience for the default configuration)."""
+        for s in self.sinks:
+            if isinstance(s, InMemorySink):
+                return s.records
+        return []
+
+    def _compatible(self, buf: MetricBuffer) -> bool:
+        return (tuple(sorted(buf.counters)) == tuple(sorted(self.counter_names))
+                and tuple(sorted(buf.gauges)) == tuple(sorted(self.gauge_names)))
+
+    def on_loop_start(self, population) -> MetricBuffer:
+        """Buffer for a starting loop: continues carried ``state`` when
+        its key sets match (cumulative counters across resumable
+        segments), else a fresh zeroed buffer."""
+        del population  # shape-independent; kept for hook symmetry
+        if self.state is not None and self._compatible(self.state):
+            return self.state
+        return buffer_init(self.counter_names, self.gauge_names)
+
+    def on_loop_end(self, buf: MetricBuffer,
+                    final_gen: Optional[int] = None) -> None:
+        """Store the final buffer; in callback mode, also drain a final
+        PARTIAL flush window (``final_gen`` not a ``flush_every``
+        multiple) so callback and segmented modes deliver the same record
+        set to the sinks — segmented mode always drains its last chunk.
+
+        Under an enclosing trace (a loop called inside ``jax.jit``) the
+        buffer leaves are tracers: storing one would leak it out of its
+        trace and draining would crash on the host conversion.  Both are
+        skipped with a warning — in-scan callback flushes still reach the
+        sinks, only the host-side ``state`` capture is unavailable."""
+        if any(isinstance(l, jax.core.Tracer)
+               for l in jax.tree_util.tree_leaves(buf)):
+            import warnings
+            warnings.warn(
+                "telemetry buffer is traced (loop running under jit): "
+                "final state capture and end-of-run drain are skipped; "
+                "in-scan callback flushes still reach the sinks")
+            return
+        self.state = buf
+        if (final_gen is not None and final_gen > 0
+                and self.resolved_mode() == "callback"
+                and final_gen % self.flush_every != 0):
+            jax.effects_barrier()       # in-scan flushes land first
+            self.host_drain(buf, final_gen)
+
+    # -- in-trace hooks ------------------------------------------------------
+
+    def accumulate(self, buf: MetricBuffer, population=None, nevals=None,
+                   events: Optional[Dict[str, jax.Array]] = None,
+                   generation: bool = True) -> MetricBuffer:
+        """Fold one generation into the buffer (pure array ops; called
+        inside the loop's trace).  ``generation=False`` folds work that is
+        not a generation of its own (the loop-start evaluation)."""
+        ev = dict(events or {})
+        if generation:
+            ev["generations"] = ev.get("generations", 0) + 1
+        if nevals is not None:
+            ev["nevals"] = ev.get("nevals", 0) + jnp.asarray(nevals)
+        buf = buf.merge_events(ev)      # drop-unknown semantics live there
+        if population is not None:
+            for name, v in self._gauge_values(population).items():
+                if name in buf.gauges:
+                    buf = buf.put(name, v)
+        return buf
+
+    def _gauge_values(self, population) -> Dict[str, jax.Array]:
+        fit = population.fitness
+        vals = fit.values[:, 0].astype(jnp.float32)
+        valid = fit.valid
+        n = jnp.maximum(jnp.sum(valid), 1).astype(jnp.float32)
+        mean = jnp.sum(jnp.where(valid, vals, 0.0)) / n
+        var = jnp.sum(jnp.where(valid, (vals - mean) ** 2, 0.0)) / n
+        # "best" follows the weight direction but is reported RAW (the
+        # value a user would recognize from the logbook)
+        w0 = fit.masked_wvalues()[:, 0]
+        out = {"fitness_best": vals[jnp.argmax(w0)],
+               "fitness_mean": mean,
+               "fitness_std": jnp.sqrt(var)}
+        if self.diversity:
+            leaves = jax.tree_util.tree_leaves(population.genome)
+            stds = [jnp.mean(jnp.std(
+                l.reshape(l.shape[0], -1).astype(jnp.float32), axis=0))
+                for l in leaves]
+            out["diversity"] = jnp.mean(jnp.stack(stds))
+        return out
+
+    def inscan_flush(self, buf: MetricBuffer, gen) -> None:
+        """Every ``flush_every`` generations, push the buffer to the host
+        through an ordered ``io_callback`` (callback mode only — the
+        other modes flush outside the trace).  Ordered: flushes reach the
+        sinks in generation order, and never reorder against the
+        quarantine 'raise' callback of the same program."""
+        if self.resolved_mode() != "callback":
+            return
+        from jax.experimental import io_callback
+        every = self.flush_every
+
+        def do_flush():
+            io_callback(self._host_emit, None, gen, buf.counters, buf.gauges,
+                        ordered=True)
+
+        lax.cond(gen % every == 0, do_flush, lambda: None)
+
+    # -- host side -----------------------------------------------------------
+
+    def _host_emit(self, gen, counters, gauges) -> None:
+        record = MetricRecord(
+            gen=int(np.asarray(gen)),
+            counters={k: int(np.asarray(v)) for k, v in counters.items()},
+            gauges={k: float(np.asarray(v)) for k, v in gauges.items()})
+        emit_record(self.sinks, record)
+
+    def host_drain(self, buf: MetricBuffer, gen: int) -> None:
+        """Pull the buffer to host and emit a record now (segment
+        boundaries in segmented mode; end-of-run drains)."""
+        self._host_emit(gen, buf.counters, buf.gauges)
+
+    def close(self) -> None:
+        for s in self.sinks:
+            s.close()

@@ -1,0 +1,142 @@
+"""Tracing & profiling: wall-clock spans, compile-vs-execute phase
+timers, profiler capture, device-memory watermarks.
+
+``bench.py``'s hand-rolled ``time.perf_counter()`` around a jitted call
+conflates four phases with very different remedies: *trace* (python
+overhead — fix the program), *lower* + *compile* (XLA — fix shapes /
+cache), *execute* (the hardware — fix the kernel).  The AOT path
+(``jit(f).lower(...).compile()``) exposes the seams; :func:`aot_phase_times`
+times each leg explicitly and is what ``bench.py`` and the
+``deap-tpu-trace`` CLI report.
+
+Everything here is host-side and backend-agnostic: on backends without
+``memory_stats`` the report is empty rather than an error, and
+:func:`capture_trace` wraps ``jax.profiler`` so a failed profiler build
+degrades to a clear exception at the call site, not at import.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from pathlib import Path
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+
+from .sinks import Sink, emit_text
+
+__all__ = ["Span", "span", "PhaseTimes", "aot_phase_times",
+           "capture_trace", "device_memory_report"]
+
+
+@dataclasses.dataclass
+class Span:
+    """A named wall-clock interval; ``seconds`` is filled when the
+    context exits."""
+
+    name: str
+    seconds: float = float("nan")
+
+
+@contextlib.contextmanager
+def span(name: str, sinks: Optional[list] = None,
+         annotate: bool = True) -> Iterator[Span]:
+    """Time a host-side block and (with ``annotate``) mark it as a
+    ``jax.profiler.TraceAnnotation`` so it shows up as a named range in a
+    captured device trace.  With ``sinks`` given, the duration is emitted
+    as a text line through the sink layer on exit.
+
+    Wall-clock caveat: jax dispatch is asynchronous — a span around a
+    jitted call measures dispatch unless the block itself blocks on the
+    result (``jax.block_until_ready``)."""
+    s = Span(name)
+    ctx = (jax.profiler.TraceAnnotation(name) if annotate
+           else contextlib.nullcontext())
+    t0 = time.perf_counter()
+    try:
+        with ctx:
+            yield s
+    finally:
+        s.seconds = time.perf_counter() - t0
+        if sinks is not None:
+            emit_text(f"[span] {name}: {s.seconds:.6f}s", sinks)
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseTimes:
+    """Seconds per AOT phase of one compiled call."""
+
+    trace_lower_s: float      # python trace + StableHLO lowering
+    compile_s: float          # XLA compilation
+    execute_s: float          # device execution (blocked on completion)
+
+    @property
+    def total_s(self) -> float:
+        return self.trace_lower_s + self.compile_s + self.execute_s
+
+    def to_dict(self) -> Dict[str, float]:
+        return {"trace_lower_s": self.trace_lower_s,
+                "compile_s": self.compile_s,
+                "execute_s": self.execute_s,
+                "total_s": self.total_s}
+
+
+def aot_phase_times(fn, *args, return_compiled: bool = False, **kwargs):
+    """Run ``fn(*args, **kwargs)`` through the explicit AOT pipeline
+    (``jax.jit(fn).lower(...).compile()``) timing each phase, and return
+    ``(result, PhaseTimes)``.  ``execute_s`` includes the transfer wait
+    (``block_until_ready``), so it is honest end-to-end device time for
+    one dispatch of the compiled program.
+
+    ``return_compiled=True`` appends the compiled executable —
+    ``(result, PhaseTimes, compiled)`` — for callers that go on to
+    re-dispatch the same program (marginal-cost timing in ``bench.py``
+    and the ``deap-tpu-trace`` CLI) without paying a second compile."""
+    jitted = fn if isinstance(fn, jax.stages.Wrapped) else jax.jit(fn)
+    t0 = time.perf_counter()
+    lowered = jitted.lower(*args, **kwargs)
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t2 = time.perf_counter()
+    out = compiled(*args, **kwargs)
+    out = jax.block_until_ready(out)
+    t3 = time.perf_counter()
+    phases = PhaseTimes(trace_lower_s=t1 - t0, compile_s=t2 - t1,
+                        execute_s=t3 - t2)
+    if return_compiled:
+        return out, phases, compiled
+    return out, phases
+
+
+@contextlib.contextmanager
+def capture_trace(out_dir) -> Iterator[Path]:
+    """Capture a profiler trace of the enclosed block into ``out_dir``
+    (viewable with TensorBoard's profile plugin / Perfetto).  Wraps
+    ``jax.profiler.start_trace``/``stop_trace`` so the trace is closed
+    even when the block raises."""
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    jax.profiler.start_trace(str(out))
+    try:
+        yield out
+    finally:
+        jax.profiler.stop_trace()
+
+
+def device_memory_report(devices=None) -> Dict[str, Dict[str, int]]:
+    """Per-device memory watermarks from ``Device.memory_stats()``
+    (``bytes_in_use``, ``peak_bytes_in_use``, ... — exact keys are
+    backend-defined).  Devices whose backend implements no stats (e.g.
+    CPU) are simply absent; the report is ``{}`` rather than an error on
+    such backends, so callers can log it unconditionally."""
+    report: Dict[str, Dict[str, int]] = {}
+    for d in (devices if devices is not None else jax.devices()):
+        try:
+            stats = d.memory_stats()
+        except (NotImplementedError, AttributeError, jax.errors.JaxRuntimeError):
+            continue
+        if stats:
+            report[f"{d.platform}:{d.id}"] = dict(stats)
+    return report

@@ -106,17 +106,21 @@ def nondominated_ranks_sharded(w: jax.Array, mesh: Mesh, axis: str = "pop",
         else:
             vary = lambda x: x                                    # noqa: E731
         # one population gather: every device needs all rows to count its
-        # columns' dominators
-        w_full = lax.all_gather(w_local, axis, axis=0, tiled=True)
-        rows_chunks = _pad_rows(w_full, n_rows_pad, -jnp.inf
-                                ).reshape(-1, rc, m)
+        # columns' dominators.  named_scope: the two O(N²/D) phases show
+        # up as named ranges in a profiler capture
+        # (deap_tpu.observability.tracing.capture_trace)
+        with jax.named_scope("obs:dominance_count"):
+            w_full = lax.all_gather(w_local, axis, axis=0, tiled=True)
+            rows_chunks = _pad_rows(w_full, n_rows_pad, -jnp.inf
+                                    ).reshape(-1, rc, m)
 
-        def count_body(acc, rows):
-            d = dominates(rows[:, None, :], w_local[None, :, :])  # (rc, n_loc)
-            return acc + jnp.sum(d, axis=0, dtype=jnp.int32), None
+            def count_body(acc, rows):
+                d = dominates(rows[:, None, :], w_local[None, :, :])
+                return acc + jnp.sum(d, axis=0, dtype=jnp.int32), None
 
-        counts, _ = lax.scan(count_body, vary(jnp.zeros((n_loc,), jnp.int32)),
-                             rows_chunks)
+            counts, _ = lax.scan(count_body,
+                                 vary(jnp.zeros((n_loc,), jnp.int32)),
+                                 rows_chunks)
 
         # -inf sentinel row for out-of-range compaction fills
         wp_local = jnp.concatenate(
@@ -152,12 +156,13 @@ def nondominated_ranks_sharded(w: jax.Array, mesh: Mesh, axis: str = "pop",
             return (ranks, counts, active, r + 1,
                     lax.psum(jnp.sum(active, dtype=jnp.int32), axis))
 
-        ranks0 = vary(jnp.full((n_loc,), n, jnp.int32))  # sentinel = real n
-        active0 = vary(jnp.ones((n_loc,), bool))
-        n_active0 = lax.psum(jnp.sum(active0, dtype=jnp.int32), axis)
-        ranks, _, _, nf, _ = lax.while_loop(
-            cond, body,
-            (ranks0, counts, active0, jnp.int32(0), n_active0))
+        with jax.named_scope("obs:front_peel"):
+            ranks0 = vary(jnp.full((n_loc,), n, jnp.int32))  # sentinel = n
+            active0 = vary(jnp.ones((n_loc,), bool))
+            n_active0 = lax.psum(jnp.sum(active0, dtype=jnp.int32), axis)
+            ranks, _, _, nf, _ = lax.while_loop(
+                cond, body,
+                (ranks0, counts, active0, jnp.int32(0), n_active0))
         return ranks, nf[None]                        # nf: per-shard copy
 
     spec = P(axis)
@@ -181,6 +186,7 @@ def sel_nsga2_sharded(key, fitness, k, mesh: Mesh, axis: str = "pop",
     ranks, _ = nondominated_ranks_sharded(
         w, mesh, axis=axis, front_chunk=front_chunk, row_chunk=row_chunk,
         stop_at_k=int(k))
-    dist = assign_crowding_dist(values, ranks)
-    order = jnp.lexsort((-dist, ranks))
+    with jax.named_scope("obs:crowding_tail"):
+        dist = assign_crowding_dist(values, ranks)
+        order = jnp.lexsort((-dist, ranks))
     return order[:k]

@@ -21,9 +21,10 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import Population, Fitness
-from ..algorithms import var_and, evaluate_population
+from ..algorithms import var_and, evaluate_population, _tel_collect
 from ..ops.migration import mig_ring_stacked
 from ..ops.selection import sel_best
+from ..observability import events as _events
 
 __all__ = ["ea_simple_islands", "stack_populations", "unstack_populations"]
 
@@ -43,7 +44,8 @@ def ea_simple_islands(key, populations: Population, toolbox, cxpb: float,
                       mutpb: float, ngen: int, mig_freq: int, mig_k: int = 5,
                       mig_selection: Callable = sel_best,
                       migarray=None, stats=None, mesh: Mesh | None = None,
-                      island_axis: str = "island", verbose: bool = False):
+                      island_axis: str = "island", verbose: bool = False,
+                      telemetry=None):
     """eaSimple per island with periodic ring migration (reference
     examples/ga/onemax_island.py:112-150).
 
@@ -58,6 +60,18 @@ def ea_simple_islands(key, populations: Population, toolbox, cxpb: float,
 
     Returns ``(populations, per_gen_stats)`` where the stats dict holds
     stacked ``(ngen, n_islands, ...)`` arrays.
+
+    ``telemetry`` (a :class:`deap_tpu.observability.Telemetry`) accumulates
+    counters in-scan — ``nevals`` (summed over islands), operator
+    invocations, quarantine hits, and ``migrations`` (emigrant rows moved
+    per ring migration).  Fitness gauges are island-shaped here and are
+    not reduced; counters only.  Without a mesh, callback-mode flushing
+    works as in :func:`~deap_tpu.algorithms.ea_simple`.  **With a mesh**,
+    in-scan host callbacks are disabled — XLA's sharding propagation
+    rejects host callbacks inside this program class (sharded carry +
+    collective-permute migration) on current builds with a hard CHECK
+    failure — so the buffer accumulates on device and drains once at the
+    end of the run, as in segmented mode (this loop is one scan).
     """
     n_isl = populations.size  # leading axis = islands
 
@@ -67,12 +81,16 @@ def ea_simple_islands(key, populations: Population, toolbox, cxpb: float,
             lambda x: jax.device_put(x, sh) if x.ndim else x, populations)
 
     def island_gen(key, pop: Population) -> tuple:
-        k_sel, k_var = jax.random.split(key)
-        idx = toolbox.select(k_sel, pop.fitness, pop.size)
-        off = pop.take(idx)
-        off = var_and(k_var, off, toolbox, cxpb, mutpb)
-        off, nevals = evaluate_population(toolbox, off)
-        return off, nevals
+        # the event tap opens INSIDE the vmapped function: emitted values
+        # are per-island batch tracers and must be drained at the same
+        # trace level, coming out as an extra (n_islands,)-shaped output
+        with _tel_collect(telemetry) as ev:
+            k_sel, k_var = jax.random.split(key)
+            idx = toolbox.select(k_sel, pop.fitness, pop.size)
+            off = pop.take(idx)
+            off = var_and(k_var, off, toolbox, cxpb, mutpb)
+            off, nevals = evaluate_population(toolbox, off)
+        return off, nevals, (ev.drain() if telemetry is not None else {})
 
     def migrate(key, pops: Population) -> Population:
         bundle = dict(genome=pops.genome,
@@ -98,23 +116,54 @@ def ea_simple_islands(key, populations: Population, toolbox, cxpb: float,
         keep_replicated = lambda x: x                                     # noqa: E731
 
     def gen_step(carry, gen):
-        key, pops = carry
+        key, pops, buf = carry
         key, k_gen, k_mig = jax.random.split(key, 3)
         keys = keep_replicated(jax.random.split(k_gen, n_isl))
-        pops, nevals = jax.vmap(island_gen)(keys, pops)
+        pops, nevals, ev = jax.vmap(island_gen)(keys, pops)
         do_mig = (mig_freq > 0) & ((gen % mig_freq) == 0)
-        pops = lax.cond(do_mig, lambda p: migrate(k_mig, p), lambda p: p, pops)
+        pops = lax.cond(do_mig, lambda p: migrate(k_mig, p),
+                        lambda p: p, pops)
+        if buf is not None:
+            events = {k: jnp.sum(v) for k, v in ev.items()}
+            # emigrant rows moved this generation (mig_k per island over
+            # the whole ring when migration fires)
+            events["migrations"] = (events.get("migrations", 0)
+                                    + jnp.where(do_mig, mig_k * n_isl, 0))
+            buf = telemetry.accumulate(buf, nevals=jnp.sum(nevals),
+                                       events=events)
+            if mesh is None:      # see docstring: no host callbacks on a
+                telemetry.inscan_flush(buf, gen)    # sharded islands scan
         rec = stats.compile(pops) if stats is not None else {}
         rec = dict(rec)
         rec["nevals"] = nevals
-        return (key, pops), rec
+        return (key, pops, buf), rec
 
     # initial evaluation per island
     keys0 = jax.random.split(key, n_isl + 1)
     key = keys0[0]
-    populations, _ = jax.vmap(
-        lambda p: evaluate_population(toolbox, p))(populations)
 
-    (key, populations), stacked = lax.scan(
-        gen_step, (key, populations), jnp.arange(1, ngen + 1))
+    def init_eval(p):
+        with _tel_collect(telemetry) as ev:
+            p, nev = evaluate_population(toolbox, p)
+        return p, nev, (ev.drain() if telemetry is not None else {})
+
+    populations, nevals0, ev0 = jax.vmap(init_eval)(populations)
+    buf0 = None
+    if telemetry is not None:
+        buf0 = telemetry.on_loop_start(populations)
+        buf0 = telemetry.accumulate(
+            buf0, nevals=jnp.sum(nevals0),
+            events={k: jnp.sum(v) for k, v in ev0.items()},
+            generation=False)
+
+    (key, populations, buf), stacked = lax.scan(
+        gen_step, (key, populations, buf0), jnp.arange(1, ngen + 1))
+    if telemetry is not None:
+        mode = telemetry.resolved_mode()
+        if mode == "segmented" or (mode == "callback" and mesh is not None):
+            # one end-of-run drain (in-scan flushing unavailable here)
+            telemetry.on_loop_end(buf)
+            telemetry.host_drain(buf, ngen)
+        else:
+            telemetry.on_loop_end(buf, final_gen=ngen)
     return populations, stacked

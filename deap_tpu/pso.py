@@ -31,6 +31,7 @@ from jax import lax
 
 from .base import Fitness, Population
 from .utils.support import Logbook
+from .observability.sinks import emit_text
 
 __all__ = ["PSOState", "pso_init", "pso_step", "pso",
            "MultiswarmState", "multiswarm_init", "multiswarm_step"]
@@ -154,7 +155,7 @@ def pso(key, state: PSOState, evaluate: Callable, ngen: int,
     logbook.header = ["gen"] + (stats.fields if stats else [])
     logbook.record_stacked(gen=jnp.arange(1, ngen + 1), **stacked)
     if verbose:
-        print(logbook.stream)
+        emit_text(logbook.stream)
     return state, logbook
 
 

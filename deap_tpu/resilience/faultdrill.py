@@ -56,26 +56,33 @@ def _check(name, fn, failures):
 
 def _drill_preempt_resume(root: Path):
     """Kill mid-run (injected preemption), resume, compare against an
-    uninterrupted run — must be bitwise identical."""
+    uninterrupted run — population, logbook AND telemetry MetricBuffer
+    must be bitwise identical."""
     from deap_tpu.resilience import (run_resumable, Preempted, FaultPlan,
                                      FaultInjector)
+    from deap_tpu.observability import Telemetry
     kw = dict(loop_kwargs=dict(cxpb=0.6, mutpb=0.3), checkpoint_every=4)
 
     tb, pop, key = _setup()
+    tel_ref = Telemetry(flush_every=4)
     ref_pop, ref_lb = run_resumable(key, pop, tb, NGEN,
-                                    ckpt_path=root / "ref.ckpt", **kw)
+                                    ckpt_path=root / "ref.ckpt",
+                                    telemetry=tel_ref, **kw)
 
     tb, pop, key = _setup()
     inj = FaultInjector(FaultPlan(preempt_at_gen=NGEN // 2))
+    tel_cut = Telemetry(flush_every=4)
     try:
         run_resumable(key, pop, tb, NGEN, ckpt_path=root / "cut.ckpt",
-                      faults=inj, **kw)
+                      telemetry=tel_cut, faults=inj, **kw)
         raise AssertionError("injected preemption never fired")
     except Preempted:
         pass
     tb2, pop2, key2 = _setup()
+    tel_res = Telemetry(flush_every=4)
     res_pop, res_lb = run_resumable(key2, pop2, tb2, NGEN,
-                                    ckpt_path=root / "cut.ckpt", **kw)
+                                    ckpt_path=root / "cut.ckpt",
+                                    telemetry=tel_res, **kw)
 
     np.testing.assert_array_equal(np.asarray(ref_pop.genome),
                                   np.asarray(res_pop.genome))
@@ -83,6 +90,12 @@ def _drill_preempt_resume(root: Path):
                                   np.asarray(res_pop.fitness.values))
     assert ref_lb.select("nevals") == res_lb.select("nevals"), \
         "resumed logbook diverged"
+    for d_ref, d_res in ((tel_ref.state.counters, tel_res.state.counters),
+                         (tel_ref.state.gauges, tel_res.state.gauges)):
+        for k in d_ref:
+            assert (np.asarray(d_ref[k]).tobytes()
+                    == np.asarray(d_res[k]).tobytes()), \
+                f"telemetry {k!r} diverged across resume"
 
 
 def _drill_retry_flaky_writes(root: Path):

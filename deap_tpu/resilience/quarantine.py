@@ -110,6 +110,10 @@ class Quarantine:
         if newly is not None:
             bad = bad & jnp.asarray(newly, bool)
 
+        from ..observability import events as _events
+        if _events.active():          # telemetry tap; inert when closed
+            _events.emit("quarantined", jnp.sum(bad, dtype=jnp.int32))
+
         if self.policy == "raise":
             if isinstance(bad, jax.core.Tracer):
                 jax.debug.callback(_raise_rows, bad)

@@ -185,7 +185,7 @@ def _device_like(template, value):
 def run_resumable(key, population, toolbox, ngen: int, *, ckpt_path,
                   checkpoint_every: int = 10, loop=ea_simple,
                   loop_kwargs: dict | None = None, stats=None,
-                  halloffame=None, sharded: bool = False,
+                  halloffame=None, telemetry=None, sharded: bool = False,
                   io_retries: int = 3, io_backoff: float = 0.5,
                   io_sleep=time.sleep, io_clock=time.monotonic,
                   signals=(_signal.SIGTERM,), faults=None,
@@ -213,6 +213,14 @@ def run_resumable(key, population, toolbox, ngen: int, *, ckpt_path,
     schedulers observe a non-zero exit.  Returns
     ``(population, logbook)`` with the logbook covering generation 0
     through ``ngen`` regardless of how many restarts happened.
+
+    ``telemetry`` (a :class:`deap_tpu.observability.Telemetry`) survives
+    preemption: its :class:`~deap_tpu.observability.metrics.MetricBuffer`
+    is part of every checkpoint and restored bit-exactly on resume, so
+    cumulative counters span restarts.  In-scan flushing is suppressed
+    under this driver (the loop numbers generations per segment, which
+    would mislabel flush records); instead the buffer is drained to the
+    sinks at every checkpoint boundary with the GLOBAL generation number.
     """
     if checkpoint_every < 1:
         raise ValueError("checkpoint_every must be >= 1")
@@ -253,6 +261,14 @@ def run_resumable(key, population, toolbox, ngen: int, *, ckpt_path,
         return (halloffame.state if halloffame.state is not None
                 else halloffame.init_state(population))
 
+    def _tel_template():
+        if telemetry is None:
+            return None
+        if telemetry.state is not None:
+            return telemetry.state
+        from ..observability.metrics import buffer_init
+        return buffer_init(telemetry.counter_names, telemetry.gauge_names)
+
     # -- resume --------------------------------------------------------------
     gen = 0
     records: list[dict] = []
@@ -263,23 +279,35 @@ def run_resumable(key, population, toolbox, ngen: int, *, ckpt_path,
     if resume != "never" and found:
         if sharded:
             like = {"population": population, "key": key,
-                    "hof": _hof_template(), "gen": 0, "records": b"",
+                    "hof": _hof_template(), "telemetry": _tel_template(),
+                    "gen": 0, "records": b"",
                     "meta": {"checkpoint_every": 0, "ngen": 0}}
             state = loader(ckpt_path, like)
             population = state["population"]
             key = _uncommit(state["key"])
             hof_state = (None if state["hof"] is None
                          else _uncommit(state["hof"]))
+            tel_state = (None if state.get("telemetry") is None
+                         else _uncommit(state["telemetry"]))
         else:
             state = loader(ckpt_path)
             population = _device_like(population, state["population"])
             key = _unpack_key(state["key"])
             hof_state = (None if state["hof"] is None else
                          jax.tree_util.tree_map(jnp.asarray, state["hof"]))
+            tel_state = (None if state.get("telemetry") is None else
+                         jax.tree_util.tree_map(jnp.asarray,
+                                                state["telemetry"]))
         gen = int(state["gen"])
         records = pickle.loads(state["records"])
         if halloffame is not None and hof_state is not None:
             halloffame.state = hof_state
+        if telemetry is not None:
+            # the checkpoint's buffer — INCLUDING None (a checkpoint
+            # written without telemetry) — replaces any leftover host
+            # state: continuation comes from the checkpoint, never from a
+            # previously-used Telemetry object
+            telemetry.state = tel_state
         saved_every = int(state["meta"]["checkpoint_every"])
         if saved_every != checkpoint_every:
             warnings.warn(
@@ -288,12 +316,16 @@ def run_resumable(key, population, toolbox, ngen: int, *, ckpt_path,
                 "trajectory will not match an uninterrupted run (segment "
                 "key-split schedule differs)")
         if verbose:
-            print(f"[run_resumable] resumed at generation {gen} "
-                  f"from {ckpt_path}", flush=True)
-    elif halloffame is not None:
-        # a fresh run starts a fresh archive; continuation comes from the
-        # checkpoint, never from leftover host state on the hof object
-        halloffame.clear()
+            from ..observability.sinks import emit_text
+            emit_text(f"[run_resumable] resumed at generation {gen} "
+                      f"from {ckpt_path}")
+    else:
+        # a fresh run starts fresh accumulators; continuation comes from
+        # the checkpoint, never from leftover host state on the objects
+        if halloffame is not None:
+            halloffame.clear()
+        if telemetry is not None:
+            telemetry.clear()
 
     flag = _PreemptFlag()
 
@@ -301,47 +333,70 @@ def run_resumable(key, population, toolbox, ngen: int, *, ckpt_path,
         state = {"population": population,
                  "key": key if sharded else _pack_key(key),
                  "hof": halloffame.state if halloffame is not None else None,
+                 "telemetry": (telemetry.state if telemetry is not None
+                               else None),
                  "gen": int(at_gen), "records": pickle.dumps(records),
                  "meta": {"checkpoint_every": int(checkpoint_every),
                           "ngen": int(ngen)}}
         saver(state)
 
+    loop_tel = {"telemetry": telemetry} if telemetry is not None else {}
+
     # -- drive ---------------------------------------------------------------
-    with _trap_signals(signals, flag):
-        while gen < ngen:
-            boundary = min(ngen, (gen // checkpoint_every + 1)
-                           * checkpoint_every)
-            seg_toolbox = toolbox
-            seg_end = boundary
-            if faults is not None and plan.nan_at_gen is not None \
-                    and gen < plan.nan_at_gen <= boundary:
-                if plan.nan_at_gen - 1 > gen:
-                    seg_end = plan.nan_at_gen - 1     # stop short of it
-                else:
-                    seg_end = gen + 1                 # the poisoned gen
-                    seg_toolbox = faults.poison_toolbox(toolbox, seg_end)
+    # in-scan flushes would carry SEGMENT-local generation numbers; the
+    # driver drains at checkpoint boundaries with global numbers instead.
+    # The mutation sits INSIDE the restoring try/finally so an exception
+    # anywhere past this point cannot leak "accumulate" onto the caller's
+    # Telemetry (resume errors above this line never touch it).
+    tel_saved_mode = None
+    if telemetry is not None:
+        tel_saved_mode = telemetry.flush_mode
+    try:
+        if telemetry is not None:
+            telemetry.flush_mode = "accumulate"
+        with _trap_signals(signals, flag):
+            while gen < ngen:
+                boundary = min(ngen, (gen // checkpoint_every + 1)
+                               * checkpoint_every)
+                seg_toolbox = toolbox
+                seg_end = boundary
+                if faults is not None and plan.nan_at_gen is not None \
+                        and gen < plan.nan_at_gen <= boundary:
+                    if plan.nan_at_gen - 1 > gen:
+                        seg_end = plan.nan_at_gen - 1  # stop short of it
+                    else:
+                        seg_end = gen + 1              # the poisoned gen
+                        seg_toolbox = faults.poison_toolbox(toolbox, seg_end)
 
-            key, k_seg = jax.random.split(key)
-            population, seg_lb = loop(
-                k_seg, population, seg_toolbox, ngen=seg_end - gen,
-                stats=stats, halloffame=halloffame, **loop_kwargs)
-            for i in range(len(seg_lb)):
-                rec = _nested_record(seg_lb, i)
-                local = rec.get("gen", i)
-                if local == 0 and (gen > 0 or records):
-                    continue          # segment-start record duplicates the
+                key, k_seg = jax.random.split(key)
+                population, seg_lb = loop(
+                    k_seg, population, seg_toolbox, ngen=seg_end - gen,
+                    stats=stats, halloffame=halloffame, **loop_tel,
+                    **loop_kwargs)
+                for i in range(len(seg_lb)):
+                    rec = _nested_record(seg_lb, i)
+                    local = rec.get("gen", i)
+                    if local == 0 and (gen > 0 or records):
+                        continue      # segment-start record duplicates the
                                       # previous segment's final state
-                rec["gen"] = gen + local
-                records.append(rec)
-            gen = seg_end
+                    rec["gen"] = gen + local
+                    records.append(rec)
+                gen = seg_end
 
-            if faults is not None:
-                faults.maybe_preempt(gen, flag.trip)
-            preempt = _global_any(flag.tripped)
-            if preempt or gen >= ngen or gen % checkpoint_every == 0:
-                _checkpoint(gen)
-            if preempt:
-                raise Preempted(gen, ckpt_path)
+                if faults is not None:
+                    faults.maybe_preempt(gen, flag.trip)
+                preempt = _global_any(flag.tripped)
+                if preempt or gen >= ngen or gen % checkpoint_every == 0:
+                    _checkpoint(gen)
+                    if telemetry is not None and telemetry.state is not None:
+                        # drain with the GLOBAL generation number (see
+                        # docstring: in-scan flushing is suppressed here)
+                        telemetry.host_drain(telemetry.state, gen)
+                if preempt:
+                    raise Preempted(gen, ckpt_path)
+    finally:
+        if telemetry is not None:
+            telemetry.flush_mode = tel_saved_mode
 
     logbook = Logbook()
     logbook.header = ["gen", "nevals"] + (stats.fields if stats else [])

@@ -98,7 +98,17 @@ class Logbook(list):
 
     def record_stacked(self, **stacked):
         """Unpack per-generation stacked arrays (as produced by a scanned
-        loop) into one ``record`` call per generation."""
+        loop) into one ``record`` call per generation.
+
+        Each leaf is converted to host numpy ONCE up front: ``np.asarray``
+        on a device array is a device->host transfer, and doing it inside
+        the per-generation loop repeated the full-column transfer O(ngen)
+        times per leaf."""
+        def to_host(v):
+            if isinstance(v, dict):
+                return {k: to_host(x) for k, x in v.items()}
+            return np.asarray(v)
+
         def length(v):
             if isinstance(v, dict):
                 return length(next(iter(v.values())))
@@ -107,9 +117,10 @@ class Logbook(list):
         def slice_i(v, i):
             if isinstance(v, dict):
                 return {k: slice_i(x, i) for k, x in v.items()}
-            x = np.asarray(v)[i]
+            x = v[i]
             return x.item() if np.ndim(x) == 0 else x
 
+        stacked = {k: to_host(v) for k, v in stacked.items()}
         ngen = length(next(iter(stacked.values())))
         for i in range(ngen):
             self.record(**{k: slice_i(v, i) for k, v in stacked.items()})

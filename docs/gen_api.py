@@ -63,6 +63,12 @@ PAGES = {
                     "deap_tpu.resilience.quarantine",
                     "deap_tpu.resilience.retry",
                     "deap_tpu.resilience.faultinject"]),
+    "observability": ("Observability (deap_tpu.observability)",
+                      ["deap_tpu.observability.metrics",
+                       "deap_tpu.observability.events",
+                       "deap_tpu.observability.telemetry",
+                       "deap_tpu.observability.sinks",
+                       "deap_tpu.observability.tracing"]),
     "support": ("Observability & persistence (deap_tpu.utils)",
                 ["deap_tpu.utils.support", "deap_tpu.utils.checkpoint"]),
     "benchmarks": ("Problem library (deap_tpu.benchmarks)",

@@ -350,3 +350,71 @@ def test_mo_cma_device_selection_matches_host():
         ch_d, nc_d = s._select(genomes, values, tags)
         assert list(ch_h) == list(ch_d), (mu, ch_h, ch_d)
         assert sorted(nc_h) == sorted(nc_d)
+
+
+def test_segmented_streaming_nondivisible_remainder(capsys):
+    """ngen=7 with stream_every=3 leaves a remainder chunk (3+3+1): the
+    stacked logbook must be bit-identical to the single-scan run, and the
+    remainder boundary still emits."""
+    from deap_tpu.ops import selection
+    from deap_tpu.utils.support import Statistics
+
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: jnp.sum(g).astype(jnp.float32))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    key = jax.random.PRNGKey(11)
+    genome = jax.random.bernoulli(key, 0.5, (48, 32)).astype(jnp.int32)
+    stats = Statistics(lambda p: p.fitness.values[:, 0])
+    stats.register("max", jnp.max)
+    stats.register("mean", jnp.mean)
+
+    def run(**kw):
+        pop = base.Population(genome, base.Fitness.empty(48, (1.0,)))
+        return algorithms.ea_simple(key, pop, tb, 0.5, 0.2, ngen=7,
+                                    stats=stats, **kw)
+
+    pop_a, log_a = run()
+    capsys.readouterr()
+    pop_b, log_b = run(stream_every=3, stream_mode="segmented")
+    out = capsys.readouterr().out
+
+    np.testing.assert_array_equal(np.asarray(pop_a.genome),
+                                  np.asarray(pop_b.genome))
+    np.testing.assert_array_equal(np.asarray(pop_a.fitness.values),
+                                  np.asarray(pop_b.fitness.values))
+    # bit-identical logbook, record by record (incl. the remainder chunk)
+    assert len(log_a) == len(log_b) == 8
+    for ra, rb in zip(log_a, log_b):
+        assert ra == rb, (ra, rb)
+    assert log_a.select("max") == log_b.select("max")
+    assert log_a.select("mean") == log_b.select("mean")
+    lines = [l for l in out.splitlines() if l.startswith("gen=")]
+    assert [l.split("\t")[0] for l in lines] == ["gen=3", "gen=6", "gen=7"]
+
+
+def test_callback_stream_emission_is_ordered(capfd):
+    """stream_every in callback mode goes through io_callback(ordered=True):
+    every emitted record must appear in strictly increasing generation
+    order on a many-generation run."""
+    from deap_tpu.ops import selection
+    from deap_tpu.utils.support import Statistics
+
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: jnp.sum(g).astype(jnp.float32))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    key = jax.random.PRNGKey(5)
+    genome = jax.random.bernoulli(key, 0.5, (32, 16)).astype(jnp.int32)
+    stats = Statistics(lambda p: p.fitness.values[:, 0])
+    stats.register("max", jnp.max)
+    pop = base.Population(genome, base.Fitness.empty(32, (1.0,)))
+    algorithms.ea_simple(key, pop, tb, 0.5, 0.2, ngen=12, stats=stats,
+                         stream_every=1, stream_mode="callback")
+    jax.effects_barrier()
+    gens = [int(l.split("\t")[0].split("=")[1])
+            for l in capfd.readouterr().out.splitlines()
+            if l.startswith("gen=")]
+    assert gens == list(range(1, 13))

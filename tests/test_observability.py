@@ -1,0 +1,504 @@
+"""Observability subsystem tests: in-scan MetricBuffer accumulation,
+sinks, multihost counter reduction, tracing phase timers, and the
+telemetry-off no-overhead guarantee."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base, algorithms
+from deap_tpu.ops import crossover, mutation, selection
+from deap_tpu.observability import (
+    Telemetry, InMemorySink, JsonlSink, LogbookSink, StdoutSink,
+    MetricRecord, MetricBuffer, buffer_init, emit_record, emit_text,
+    format_record, aot_phase_times, capture_trace, device_memory_report,
+    events)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _toolbox():
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: jnp.sum(g).astype(jnp.float32))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    return tb
+
+
+def _population(n=32, d=24, seed=7):
+    key = jax.random.PRNGKey(seed)
+    genome = jax.random.bernoulli(key, 0.5, (n, d)).astype(jnp.int32)
+    return base.Population(genome, base.Fitness.empty(n, (1.0,))), key
+
+
+def _run_simple(telemetry=None, ngen=6, **kw):
+    tb = _toolbox()
+    pop, key = _population()
+    return algorithms.ea_simple(key, pop, tb, 0.5, 0.2, ngen=ngen,
+                                telemetry=telemetry, **kw)
+
+
+# ---------------------------------------------------------------------------
+# MetricBuffer + event tap (no compiles)
+# ---------------------------------------------------------------------------
+
+
+def test_metric_buffer_functional_ops():
+    buf = buffer_init(["a", "b"], ["g"])
+    buf2 = buf.inc("a", 3).inc("a", 2).put("g", 1.5)
+    # frozen/functional: the original is untouched
+    assert int(buf.counters["a"]) == 0
+    assert int(buf2.counters["a"]) == 5
+    assert float(buf2.gauges["g"]) == 1.5
+    # merge_events drops names outside the (static) key set
+    buf3 = buf2.merge_events({"a": jnp.int32(4), "unknown": jnp.int32(9)})
+    counters, gauges = buf3.host_values()
+    assert counters == {"a": 9, "b": 0}
+    assert gauges == {"g": 1.5}
+
+
+def test_event_tap_inert_without_collector():
+    # must not raise, must not retain anything
+    events.emit("anything", 42)
+    assert not events.active()
+    with events.collect() as outer:
+        events.emit("x", jnp.int32(1))
+        with events.collect() as inner:      # innermost shadows
+            events.emit("x", jnp.int32(10))
+        assert int(inner.drain()["x"]) == 10
+        events.emit("x", jnp.int32(2))
+        assert int(outer.drain()["x"]) == 3
+    assert not events.active()
+
+
+# ---------------------------------------------------------------------------
+# in-scan accumulation + flushing
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def std_runs():
+    """One plain run + one callback-telemetry run of the same seeded
+    program, shared across tests (each scan compile costs seconds —
+    tier-1 budget)."""
+    plain = _run_simple()
+    tel = Telemetry(flush_every=2, flush_mode="callback")
+    with_tel = _run_simple(telemetry=tel)
+    jax.effects_barrier()
+    return plain, with_tel, tel
+
+
+def test_in_scan_accumulation_and_ordered_flush(std_runs):
+    """Callback mode: flushes arrive in generation order (ordered
+    io_callback), counters are cumulative, and the nevals counter agrees
+    with the logbook's per-generation bookkeeping."""
+    _, (pop, logbook), tel = std_runs
+    gens = [r.gen for r in tel.records]
+    assert gens == [2, 4, 6]
+    nevals = [r.counters["nevals"] for r in tel.records]
+    assert nevals == sorted(nevals)          # cumulative, in order
+    counters, gauges = tel.state.host_values()
+    assert counters["generations"] == 6
+    assert counters["nevals"] == sum(logbook.select("nevals"))
+    assert counters["mate_pairs"] > 0 and counters["mutate_calls"] > 0
+    # fitness gauges reflect the final population
+    vals = np.asarray(pop.fitness.values)[:, 0]
+    assert gauges["fitness_best"] == pytest.approx(vals.max())
+    assert gauges["fitness_mean"] == pytest.approx(vals.mean(), rel=1e-5)
+
+
+def test_trajectory_identical_with_and_without_telemetry(std_runs):
+    (pop_off, log_off), (pop_on, log_on), _ = std_runs
+    np.testing.assert_array_equal(np.asarray(pop_off.genome),
+                                  np.asarray(pop_on.genome))
+    np.testing.assert_array_equal(np.asarray(pop_off.fitness.values),
+                                  np.asarray(pop_on.fitness.values))
+    assert log_off.select("nevals") == log_on.select("nevals")
+
+
+def test_telemetry_off_adds_no_carry_leaves_and_no_callbacks(monkeypatch):
+    """The acceptance guarantee behind 'same number of dispatches when
+    disabled': with telemetry=None the scan carry gains a zero-leaf
+    ``None`` slot and the traced generation body contains no host
+    callbacks; enabled callback-mode telemetry shows the io_callback."""
+    captured = {}
+    orig = algorithms._scan_generations
+
+    def spy(gen_step, carry, ngen, stream_every, stream_mode,
+            telemetry=None, sinks=None):
+        captured["carry"] = carry
+        captured["jaxpr"] = str(jax.make_jaxpr(gen_step)(carry, jnp.int32(1)))
+        return orig(gen_step, carry, ngen, stream_every, stream_mode,
+                    telemetry=telemetry, sinks=sinks)
+
+    monkeypatch.setattr(algorithms, "_scan_generations", spy)
+
+    _run_simple(ngen=2)
+    assert captured["carry"][-1] is None
+    off_leaves = len(jax.tree_util.tree_leaves(captured["carry"]))
+    assert "io_callback" not in captured["jaxpr"]
+
+    tel = Telemetry(flush_every=1, flush_mode="callback")
+    _run_simple(ngen=2, telemetry=tel)
+    jax.effects_barrier()
+    assert isinstance(captured["carry"][-1], MetricBuffer)
+    assert "io_callback" in captured["jaxpr"]
+    on_leaves = len(jax.tree_util.tree_leaves(captured["carry"]))
+    n_buf = len(jax.tree_util.tree_leaves(captured["carry"][-1]))
+    assert on_leaves == off_leaves + n_buf
+
+
+def test_segmented_drain_matches_callback_records_and_counters():
+    """Segmented mode (callback-less backends) and callback mode must
+    deliver the SAME record stream to the sinks — including the final
+    partial window (gen 7 with flush_every=3) — and bit-identical final
+    buffers."""
+    tel_cb = Telemetry(flush_every=3, flush_mode="callback")
+    _run_simple(telemetry=tel_cb, ngen=7)
+    jax.effects_barrier()
+    tel_seg = Telemetry(flush_every=3, flush_mode="segmented")
+    _run_simple(telemetry=tel_seg, ngen=7)
+    assert [r.gen for r in tel_seg.records] == [3, 6, 7]
+    assert [r.gen for r in tel_cb.records] == [3, 6, 7]
+    for rc, rs in zip(tel_cb.records, tel_seg.records):
+        assert rc.counters == rs.counters
+        assert rc.gauges == rs.gauges
+    for (ka, va), (kb, vb) in zip(sorted(tel_cb.state.counters.items()),
+                                  sorted(tel_seg.state.counters.items())):
+        assert ka == kb
+        assert np.asarray(va).tobytes() == np.asarray(vb).tobytes()
+
+
+@pytest.mark.slow
+def test_state_continues_across_loop_calls_and_clear():
+    tel = Telemetry(flush_every=0)          # accumulate only
+    _run_simple(telemetry=tel, ngen=3)
+    c1, _ = tel.state.host_values()
+    _run_simple(telemetry=tel, ngen=3)
+    c2, _ = tel.state.host_values()
+    assert c2["generations"] == 6
+    assert c2["nevals"] > c1["nevals"]
+    tel.clear()
+    assert tel.state is None
+
+
+def test_quarantine_hits_counted():
+    tb = _toolbox()
+    # rows whose first gene is set overflow to inf
+    tb.register("evaluate",
+                lambda g: (jnp.sum(g) / jnp.where(g[0] > 0, 0.0, 1.0),))
+    from deap_tpu.resilience import Quarantine
+    tb.quarantine = Quarantine("penalize")
+    pop, key = _population(n=48, d=16)
+    tel = Telemetry(flush_every=0)
+    out, _ = algorithms.ea_simple(key, pop, tb, 0.5, 0.2, ngen=3,
+                                  telemetry=tel)
+    counters, _ = tel.state.host_values()
+    assert counters["quarantined"] > 0
+    assert np.isfinite(np.asarray(out.fitness.values)).all()
+
+
+@pytest.mark.slow
+def test_mu_lambda_and_ask_tell_loops_accumulate():
+    tb = _toolbox()
+    pop, key = _population(n=24, d=16)
+    tel = Telemetry(flush_every=0)
+    _, lb = algorithms.ea_mu_plus_lambda(key, pop, tb, mu=24, lambda_=24,
+                                         cxpb=0.4, mutpb=0.3, ngen=3,
+                                         telemetry=tel)
+    counters, _ = tel.state.host_values()
+    assert counters["generations"] == 3
+    assert counters["nevals"] == sum(lb.select("nevals"))
+
+    # ask-tell tier (eaGenerateUpdate protocol)
+    atb = base.Toolbox()
+    atb.register("evaluate", lambda g: jnp.sum(g * g).astype(jnp.float32))
+    atb.register("generate",
+                 lambda state, k: state + 0.1 * jax.random.normal(k, (8, 4)))
+    atb.register("update", lambda state, p: state)
+    tel2 = Telemetry(flush_every=0)
+    _, _, lb2 = algorithms.ea_generate_update(
+        jax.random.PRNGKey(0), atb, jnp.zeros((8, 4)), ngen=3,
+        telemetry=tel2)
+    c2, _ = tel2.state.host_values()
+    assert c2["generations"] == 3
+    assert c2["nevals"] == sum(lb2.select("nevals"))
+
+
+def test_islands_migration_counter():
+    from deap_tpu.parallel.islands import (ea_simple_islands,
+                                           stack_populations)
+    tb = _toolbox()
+    tb.register("evaluate", lambda g: (jnp.sum(g),))
+    key = jax.random.PRNGKey(3)
+    pops = []
+    for i in range(4):
+        g = jax.random.bernoulli(jax.random.fold_in(key, i), 0.5,
+                                 (16, 12)).astype(jnp.float32)
+        pops.append(base.Population(g, base.Fitness.empty(16, (1.0,))))
+    tel = Telemetry(flush_every=0)
+    ea_simple_islands(key, stack_populations(pops), tb, 0.5, 0.2, ngen=6,
+                      mig_freq=2, mig_k=3, telemetry=tel)
+    counters, _ = tel.state.host_values()
+    # migration fires at gens 2, 4, 6: 3 emigrants x 4 islands each time
+    assert counters["migrations"] == 3 * 3 * 4
+    assert counters["generations"] == 6 and counters["nevals"] > 0
+
+
+# ---------------------------------------------------------------------------
+# sinks
+# ---------------------------------------------------------------------------
+
+
+def _record():
+    return MetricRecord(gen=4, counters={"nevals": 100, "generations": 4},
+                        gauges={"fitness_best": 31.5})
+
+
+def test_in_memory_and_logbook_and_stdout_sinks(capfd):
+    rec = _record()
+    mem, lbs, out = InMemorySink(), LogbookSink(), StdoutSink()
+    emit_record([mem, lbs, out], rec)
+    assert mem.records == [rec]
+    assert lbs.logbook.chapters["counters"][0]["nevals"] == 100
+    assert lbs.logbook.chapters["gauges"][0]["fitness_best"] == 31.5
+    line = capfd.readouterr().out.strip()
+    assert line == format_record(rec)
+    assert "gen=4" in line and "nevals=100" in line
+
+    emit_text("hello", [mem])
+    assert mem.texts == ["hello"]
+
+
+def test_jsonl_sink_roundtrip(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    sink = JsonlSink(path)
+    emit_record([sink], _record())
+    emit_text("a text line", [sink])
+    sink.close()
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert lines[0]["gen"] == 4 and lines[0]["counters"]["nevals"] == 100
+    assert lines[1] == {"text": "a text line"}
+
+
+@pytest.mark.slow
+def test_tensorboard_sink_gated_behind_obs_extra(tmp_path):
+    from deap_tpu.observability import TensorBoardSink
+    try:
+        import tensorboardX  # noqa: F401
+        have = True
+    except ImportError:
+        try:
+            from torch.utils.tensorboard import SummaryWriter  # noqa: F401
+            have = True
+        except ImportError:
+            have = False
+    if have:
+        sink = TensorBoardSink(tmp_path)
+        sink.emit(_record())
+        sink.close()
+        assert any(tmp_path.iterdir())
+    else:
+        with pytest.raises(ImportError, match="obs"):
+            TensorBoardSink(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# tracing
+# ---------------------------------------------------------------------------
+
+
+def test_aot_phase_timer():
+    def f(x):
+        return jnp.sum(x * x)
+
+    x = jnp.arange(64, dtype=jnp.float32)
+    out, phases = aot_phase_times(f, x)
+    assert float(out) == pytest.approx(float(np.sum(np.arange(64.0) ** 2)))
+    assert phases.trace_lower_s > 0
+    assert phases.compile_s > 0
+    assert phases.execute_s > 0
+    assert phases.total_s == pytest.approx(
+        phases.trace_lower_s + phases.compile_s + phases.execute_s)
+    d = phases.to_dict()
+    assert set(d) == {"trace_lower_s", "compile_s", "execute_s", "total_s"}
+
+
+def test_span_and_memory_report():
+    from deap_tpu.observability import span
+    mem = InMemorySink()
+    with span("unit-span", sinks=[mem]) as s:
+        jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    assert s.seconds > 0
+    assert mem.texts and "unit-span" in mem.texts[0]
+
+    report = device_memory_report()
+    assert isinstance(report, dict)         # may be {} on CPU backends
+
+
+@pytest.mark.slow
+def test_capture_trace_writes_profile(tmp_path):
+    with capture_trace(tmp_path / "trace") as out:
+        jax.block_until_ready(jnp.ones((16, 16)) @ jnp.ones((16, 16)))
+    assert any(out.rglob("*"))              # profiler wrote something
+
+
+# ---------------------------------------------------------------------------
+# multihost counter reduction (2-process CPU cluster)
+# ---------------------------------------------------------------------------
+
+_MH_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    sys.path.insert(0, %(repo)r)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from deap_tpu.parallel import initialize_cluster
+    initialize_cluster()
+    import numpy as np
+    from deap_tpu.observability import (cross_host_sum, MetricRecord,
+                                        JsonlSink, InMemorySink, emit_record)
+    pid = jax.process_index()
+    # each process contributes a HOST-LOCAL counter dict; the reduction
+    # must produce identical global totals on every process
+    local = {"nevals": 10 * (pid + 1), "migrations": pid}
+    total = cross_host_sum(local)
+    assert total == {"nevals": 30, "migrations": 1}, total
+    rec = MetricRecord(gen=1, counters=total, gauges={})
+    mem = InMemorySink()
+    sink = JsonlSink(%(out)r + f".p{pid}")
+    emit_record([mem, sink], rec)          # Jsonl: process-0-only write
+    assert len(mem.records) == 1           # all_processes sink: everywhere
+    print("WROTE", pid, int(os.path.exists(%(out)r + f".p{pid}")))
+""")
+
+
+@pytest.mark.multihost
+@pytest.mark.slow
+def test_multihost_counter_reduction_two_process_cluster(tmp_path):
+    """cross_host_sum produces identical global totals on both processes
+    of a real 2-process jax.distributed cluster, and only process 0's
+    JsonlSink writes."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    out = str(tmp_path / "metrics.jsonl")
+    env_base = {k: v for k, v in os.environ.items()
+                if not k.startswith(("XLA_", "JAX_", "DEAP_TPU_"))}
+    procs = []
+    for pid in range(2):
+        env = dict(env_base, DEAP_TPU_COORDINATOR=f"127.0.0.1:{port}",
+                   DEAP_TPU_NPROC="2", DEAP_TPU_PROC_ID=str(pid))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c",
+             _MH_WORKER % {"repo": REPO, "out": out}],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multihost telemetry run timed out")
+        outs.append(stdout)
+    for stdout, p in zip(outs, procs):
+        assert p.returncode == 0, f"worker failed:\n{stdout}"
+    wrote = {}
+    for stdout in outs:
+        for line in stdout.splitlines():
+            if line.startswith("WROTE"):
+                _, pid, exists = line.split()
+                wrote[int(pid)] = bool(int(exists))
+    assert wrote == {0: True, 1: False}, wrote
+
+
+@pytest.mark.slow
+def test_combined_segmented_stream_and_flush_coprime_cadences():
+    """Segmented streaming (every 3) + segmented telemetry (every 2) on
+    ngen=7: the scan is cut at the UNION of the boundary sets (2,3,4,6,7
+    — not gcd=1 single-generation dispatches), each emit keeps its own
+    cadence, and the trajectory stays bit-identical.  With telemetry
+    attached, stream lines route to ITS sinks (captured by the
+    InMemorySink, not stdout)."""
+    pop_plain, _ = _run_simple(ngen=7)
+    tel = Telemetry(flush_every=2, flush_mode="segmented")
+    pop_seg, _ = _run_simple(ngen=7, telemetry=tel, stream_every=3,
+                             stream_mode="segmented")
+    np.testing.assert_array_equal(np.asarray(pop_plain.genome),
+                                  np.asarray(pop_seg.genome))
+    mem = tel.sinks[0]
+    stream_gens = [l.split("\t")[0] for l in mem.texts
+                   if l.startswith("gen=")]
+    assert stream_gens == ["gen=3", "gen=6", "gen=7"]
+    assert [r.gen for r in tel.records] == [2, 4, 6, 7]
+
+
+def test_islands_telemetry_on_sharded_mesh_end_drains():
+    """Telemetry on a MESH-sharded islands run must not inject host
+    callbacks into the compiled scan (XLA sharding propagation aborts the
+    process on this program class) — the buffer accumulates on device and
+    drains once at end of run."""
+    from jax.sharding import Mesh
+    from deap_tpu.parallel.islands import (ea_simple_islands,
+                                           stack_populations)
+    tb = _toolbox()
+    tb.register("evaluate", lambda g: (jnp.sum(g),))
+    key = jax.random.PRNGKey(9)
+    pops = stack_populations([
+        base.Population(
+            jax.random.bernoulli(jax.random.fold_in(key, i), 0.5,
+                                 (16, 12)).astype(jnp.float32),
+            base.Fitness.empty(16, (1.0,))) for i in range(8)])
+    mesh = Mesh(np.array(jax.devices()), ("island",))
+    tel = Telemetry(flush_every=2, flush_mode="callback")
+    final, _ = ea_simple_islands(key, pops, tb, 0.5, 0.2, ngen=6,
+                                 mig_freq=2, mig_k=3, mesh=mesh,
+                                 telemetry=tel)
+    jax.effects_barrier()
+    assert "island" in str(final.genome.sharding.spec)
+    assert [r.gen for r in tel.records] == [6]       # end drain only
+    counters, _ = tel.state.host_values()
+    assert counters["migrations"] == 3 * 3 * 8       # gens 2,4,6 x 3 x 8
+
+
+def test_enclosing_jit_does_not_crash_or_leak_tracers():
+    """A telemetry-enabled loop called under jax.jit must not crash in
+    on_loop_end nor store a tracer into tel.state: state capture is
+    skipped with a warning, while in-scan callback flushes still reach
+    the sinks.  (ea_simple itself is not fully jittable — its Logbook is
+    host-side — so drive the hooks the way an embedded loop would.)"""
+    import warnings
+    from jax import lax
+    tel = Telemetry(flush_every=2, flush_mode="callback")
+
+    def run(key):
+        buf = tel.on_loop_start(None)
+
+        def step(b, gen):
+            b = tel.accumulate(b, nevals=jnp.int32(5))
+            tel.inscan_flush(b, gen)
+            return b, b.counters["nevals"]
+
+        buf, traj = lax.scan(step, buf, jnp.arange(1, 8))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            tel.on_loop_end(buf, final_gen=7)    # traced: must not raise
+        assert any("traced" in str(x.message) for x in w)
+        return traj
+
+    traj = jax.jit(run)(jax.random.PRNGKey(0))
+    jax.effects_barrier()
+    assert tel.state is None                     # no tracer leaked
+    assert [r.gen for r in tel.records] == [2, 4, 6]
+    assert [int(t) for t in traj] == [5 * g for g in range(1, 8)]

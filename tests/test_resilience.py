@@ -769,3 +769,95 @@ def test_preempted_checkpoint_is_loadable_state(tmp_path):
     recs = pickle.loads(state["records"])
     assert [r["gen"] for r in recs] == list(range(5))
     assert state["population"].size == 32
+
+
+def test_preempt_resume_restores_metric_buffer_bit_exactly(tmp_path):
+    """Telemetry survives preemption: the resumed run's MetricBuffer and
+    cumulative counters are BITWISE identical to an uninterrupted run's,
+    and the buffer rides every checkpoint."""
+    from deap_tpu.observability import Telemetry
+
+    def buffer_bytes(buf):
+        return [(k, np.asarray(v).tobytes())
+                for k, v in sorted(buf.counters.items())] + \
+               [(k, np.asarray(v).tobytes())
+                for k, v in sorted(buf.gauges.items())]
+
+    tb = _onemax_toolbox()
+    pop, key = _fresh_pop()
+    tel_ref = Telemetry(flush_every=2)
+    run_resumable(key, pop, tb, 8, ckpt_path=tmp_path / "ref.ckpt",
+                  telemetry=tel_ref, **_RUN_KW)
+
+    tb = _onemax_toolbox()
+    pop, key = _fresh_pop()
+    tel_cut = Telemetry(flush_every=2)
+    inj = FaultInjector(FaultPlan(preempt_at_gen=4))
+    with pytest.raises(Preempted):
+        run_resumable(key, pop, tb, 8, ckpt_path=tmp_path / "cut.ckpt",
+                      telemetry=tel_cut, faults=inj, **_RUN_KW)
+    # the buffer is in the on-disk state, restorable by a fresh process
+    state = load_checkpoint(tmp_path / "cut.ckpt")
+    assert int(np.asarray(state["telemetry"].counters["generations"])) == 4
+
+    tb2 = _onemax_toolbox()
+    pop2, key2 = _fresh_pop()
+    tel_res = Telemetry(flush_every=2)    # fresh object, as after restart
+    run_resumable(key2, pop2, tb2, 8, ckpt_path=tmp_path / "cut.ckpt",
+                  telemetry=tel_res, **_RUN_KW)
+
+    assert buffer_bytes(tel_res.state) == buffer_bytes(tel_ref.state)
+    c_ref, _ = tel_ref.state.host_values()
+    c_res, _ = tel_res.state.host_values()
+    assert c_res == c_ref and c_res["generations"] == 8
+    # the driver drained at the checkpoint boundaries with GLOBAL gens
+    assert [r.gen for r in tel_ref.records] == [4, 8]
+    # in-scan flush suppression was rolled back after the run
+    assert tel_res.flush_mode == "auto"
+
+
+def test_flush_mode_not_leaked_on_resume_error(tmp_path):
+    """run_resumable suppresses in-scan flushing by temporarily setting
+    flush_mode='accumulate'; an error ANYWHERE (including the resume
+    section, before the drive loop) must not leak that onto the caller's
+    Telemetry object."""
+    from deap_tpu.observability import Telemetry
+
+    tb = _onemax_toolbox()
+    pop, key = _fresh_pop()
+    tel = Telemetry(flush_every=2)
+    with pytest.raises(FileNotFoundError):
+        run_resumable(key, pop, tb, 8, ckpt_path=tmp_path / "none.ckpt",
+                      telemetry=tel, resume="require", **_RUN_KW)
+    assert tel.flush_mode == "auto"
+
+
+def test_resume_clears_stale_telemetry_when_checkpoint_has_none(tmp_path):
+    """Resuming from a checkpoint written WITHOUT telemetry must clear
+    leftover buffer state on a previously-used Telemetry object —
+    continuation comes from the checkpoint, never from host leftovers."""
+    from deap_tpu.observability import Telemetry
+
+    tb = _onemax_toolbox()
+    pop, key = _fresh_pop()
+    inj = FaultInjector(FaultPlan(preempt_at_gen=4))
+    with pytest.raises(Preempted):
+        run_resumable(key, pop, tb, 8, ckpt_path=tmp_path / "c.ckpt",
+                      faults=inj, **_RUN_KW)     # no telemetry in ckpt
+
+    tel = Telemetry(flush_every=2)
+    tb2, pop2, key2 = _fresh_pop()[0], None, None
+    tb2 = _onemax_toolbox()
+    pop2, key2 = _fresh_pop(seed=99)             # unrelated prior run
+    run_resumable(key2, pop2, tb2, 4,
+                  ckpt_path=tmp_path / "other.ckpt", telemetry=tel,
+                  **_RUN_KW)
+    assert tel.state is not None                 # now carries leftovers
+
+    tb3 = _onemax_toolbox()
+    pop3, key3 = _fresh_pop()
+    _, lb = run_resumable(key3, pop3, tb3, 8, ckpt_path=tmp_path / "c.ckpt",
+                          telemetry=tel, **_RUN_KW)
+    c, _ = tel.state.host_values()
+    # only the resumed generations (5..8) were counted, not 4 + 4 + 4
+    assert c["generations"] == 4, c

@@ -839,3 +839,33 @@ def test_nsga3_waterfill_counts_law():
         assert extra_seq.min() >= 0 and extra_seq.max() <= 1
         assert extra_seq.sum() == r
         assert np.all(extra_seq[~elig] == 0)
+
+
+def test_record_stacked_converts_each_leaf_once(monkeypatch):
+    """record_stacked must pull each stacked leaf to host numpy ONCE, not
+    once per generation (device->host transfers scale O(ngen) otherwise)."""
+    from deap_tpu.utils import support as support_mod
+
+    calls = {"n": 0}
+    real_asarray = np.asarray
+
+    class CountingNp:
+        def __getattr__(self, name):
+            return getattr(np, name)
+
+        @staticmethod
+        def asarray(x, *a, **kw):
+            calls["n"] += 1
+            return real_asarray(x, *a, **kw)
+
+    monkeypatch.setattr(support_mod, "np", CountingNp())
+    lb = Logbook()
+    ngen = 25
+    lb.record_stacked(gen=jnp.arange(1, ngen + 1),
+                      nevals=jnp.arange(ngen),
+                      stats={"max": jnp.arange(ngen, dtype=jnp.float32)})
+    # 3 leaves -> 3 conversions (np.ndim on host slices is not np.asarray)
+    assert calls["n"] == 3
+    assert len(lb) == ngen
+    assert lb[0] == {"gen": 1, "nevals": 0}
+    assert lb.chapters["stats"][24]["max"] == 24.0

@@ -1,0 +1,34 @@
+"""Tier-1 wiring for the static tooling passes under ``tools/``."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_no_bare_print_in_library_code():
+    """Runtime output must route through the observability sink layer;
+    ``tools/check_no_bare_print.py`` walks deap_tpu/ with ast and fails on
+    ``print(`` outside the sanctioned emitter modules."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "check_no_bare_print.py")],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr or out.stdout
+
+
+def test_checker_catches_a_planted_print(tmp_path):
+    """The pass must actually detect violations (a checker that can't
+    fail is not a gate): run its finder on a file with a bare print."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import check_no_bare_print as chk
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad.py"
+    bad.write_text('x = 1\nprint("hi")\n# print("in a comment")\n'
+                   's = "print(not a call)"\n')
+    assert chk.find_bare_prints(bad) == [2]

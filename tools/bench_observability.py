@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Measure telemetry overhead on the generation scan.
+
+Times ``ea_simple`` (the real instrumented path, not a synthetic loop)
+with telemetry off vs. on (callback mode, ``flush_every`` generations)
+and reports the marginal per-generation cost of each — the
+``(t(2N) - t(N)) / N`` construction from ``bench.py``, which cancels
+trace/compile/dispatch fixed costs out of the comparison.
+
+Noise control: the off/on runs are INTERLEAVED and repeated
+``OBS_BENCH_REPS`` times, and the marginal is computed from the per-shape
+minima — on a shared host, single-shot wall times swing far more than the
+effect being measured (observed ±17% rep-to-rep on the CI box; the
+min-of-reps estimator approximates the unloaded machine).
+
+The committed acceptance configuration (docs/observability.md):
+
+    JAX_PLATFORMS=cpu python tools/bench_observability.py
+    # pop=131072 dim=100 flush_every=10 -> overhead must stay < 5%
+
+Env overrides: OBS_BENCH_POP, OBS_BENCH_DIM, OBS_BENCH_NGEN,
+OBS_BENCH_FLUSH, OBS_BENCH_REPS.  Prints one JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+POP = int(os.environ.get("OBS_BENCH_POP", 131072))
+DIM = int(os.environ.get("OBS_BENCH_DIM", 100))
+NGEN = int(os.environ.get("OBS_BENCH_NGEN", 10))
+FLUSH = int(os.environ.get("OBS_BENCH_FLUSH", 10))
+REPS = int(os.environ.get("OBS_BENCH_REPS", 5))
+
+
+def main():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from deap_tpu import base, benchmarks, algorithms
+    from deap_tpu.ops import crossover, mutation, selection
+    from deap_tpu.observability import Telemetry, InMemorySink
+
+    tb = base.Toolbox()
+    tb.register("evaluate", benchmarks.rastrigin)
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=0.3,
+                indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3,
+                tie_break="rank")
+
+    key = jax.random.PRNGKey(0)
+    genome0 = jax.random.uniform(key, (POP, DIM), jnp.float32, -5.12, 5.12)
+
+    # ONE telemetry object for every on-run: identical trace closures, so
+    # the scan executable cache is hit like a long-lived service would
+    tel = Telemetry(sinks=[InMemorySink()], flush_every=FLUSH,
+                    flush_mode="callback")
+
+    def run_once(ngen, telemetry):
+        pop = base.Population(genome=genome0,
+                              fitness=base.Fitness.empty(POP, (-1.0,)))
+        t0 = time.perf_counter()
+        out, _ = algorithms.ea_simple(key, pop, tb, 0.9, 0.5, ngen=ngen,
+                                      reevaluate_all=True,
+                                      telemetry=telemetry)
+        np.asarray(out.fitness.values[:1])     # force completion
+        jax.effects_barrier()                  # incl. telemetry flushes
+        return time.perf_counter() - t0
+
+    for tl in (None, tel):                     # compile all four shapes
+        run_once(NGEN, tl)
+        run_once(2 * NGEN, tl)
+
+    times = {k: [] for k in ("n_off", "n_on", "2n_off", "2n_on")}
+    for _ in range(REPS):                      # interleaved off/on reps
+        times["n_off"].append(run_once(NGEN, None))
+        times["n_on"].append(run_once(NGEN, tel))
+        times["2n_off"].append(run_once(2 * NGEN, None))
+        times["2n_on"].append(run_once(2 * NGEN, tel))
+
+    per_gen_off = (min(times["2n_off"]) - min(times["n_off"])) / NGEN
+    per_gen_on = (min(times["2n_on"]) - min(times["n_on"])) / NGEN
+    overhead = (per_gen_on - per_gen_off) / per_gen_off * 100.0
+
+    print(json.dumps({
+        "metric": "telemetry_overhead_pct",
+        "pop": POP, "dim": DIM, "ngen_marginal": NGEN,
+        "flush_every": FLUSH, "reps": REPS,
+        "backend": jax.default_backend(),
+        "per_gen_off_s": round(per_gen_off, 6),
+        "per_gen_on_s": round(per_gen_on, 6),
+        "overhead_pct": round(overhead, 2),
+        "pass_lt_5pct": overhead < 5.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Static pass: no bare ``print(`` in library code.
+
+Runtime output must flow through the observability sink layer
+(``deap_tpu.observability.sinks.emit_text`` / the ``Sink`` classes) so it
+is capturable and process-0-only on multihost — a bare ``print`` in
+library code bypasses both.  This checker walks every module under
+``deap_tpu/`` with ``ast`` (no false positives from strings or comments)
+and fails on any ``print(...)`` call outside the sanctioned emitter
+modules:
+
+* ``observability/sinks.py`` — the sink layer itself (the one sanctioned
+  home of ``print`` for runtime output);
+* ``observability/cli.py``, ``selftest.py``, ``resilience/faultdrill.py``,
+  ``native/build.py`` — console entry points whose stdout IS their
+  interface.
+
+Run directly (``python tools/check_no_bare_print.py``) or through the
+tier-1 gate (``tests/test_tooling.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "deap_tpu"
+
+#: posix-relative paths (under deap_tpu/) allowed to call print()
+SANCTIONED = {
+    "observability/sinks.py",
+    "observability/cli.py",
+    "selftest.py",
+    "resilience/faultdrill.py",
+    "native/build.py",
+}
+
+
+def find_bare_prints(path: Path) -> list[int]:
+    """Line numbers of ``print(...)`` calls in ``path``."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    lines = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            lines.append(node.lineno)
+    return lines
+
+
+def main() -> int:
+    violations = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        rel = path.relative_to(PACKAGE).as_posix()
+        if rel in SANCTIONED:
+            continue
+        for lineno in find_bare_prints(path):
+            violations.append(f"deap_tpu/{rel}:{lineno}")
+    if violations:
+        sys.stderr.write(
+            "bare print() in library code (route through "
+            "deap_tpu.observability.sinks.emit_text, or add the module to "
+            "SANCTIONED in tools/check_no_bare_print.py if its stdout is "
+            "its interface):\n"
+            + "\n".join(f"  {v}" for v in violations) + "\n")
+        return 1
+    print(f"no bare print() outside sanctioned emitters "
+          f"({len(SANCTIONED)} sanctioned modules)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
