@@ -234,6 +234,15 @@ def measured_baseline():
 
 
 def main():
+    # opt-in persistent XLA compile cache (DEAP_TPU_COMPILE_CACHE=<dir>):
+    # the warmup compile of the flagship program is the dominant cold-start
+    # cost, and reusing it across bench invocations removes it entirely
+    # (docs/performance.md "Persistent compilation cache")
+    from deap_tpu.utils.compilecache import (cache_dir_from_env,
+                                             enable_compile_cache)
+    cache_dir = cache_dir_from_env()
+    if cache_dir:
+        enable_compile_cache(cache_dir)
     gens_per_sec, ratio, best, platform, phases = run_tpu()
     linear_ok = 1.5 <= ratio <= 2.7
     baseline = measured_baseline()

@@ -18,5 +18,5 @@ __version__ = "0.1.0"
 __revision__ = "0.1.0"
 
 from . import base, creator, tools, algorithms, cma, benchmarks, ops, utils, parallel  # noqa: F401
-from . import pso, de, eda, coev, resilience, observability  # noqa: F401
+from . import pso, de, eda, coev, resilience, observability, serve  # noqa: F401
 from .base import Toolbox, Fitness, Population  # noqa: F401

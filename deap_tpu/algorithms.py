@@ -31,6 +31,7 @@ selects — the count preserves the reference's bookkeeping.)
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 from functools import partial
 from typing import Any, Callable
 
@@ -47,7 +48,7 @@ from .observability.sinks import emit_text as _emit_text
 
 __all__ = ["var_and", "vary_genome", "var_or", "ea_simple",
            "ea_mu_plus_lambda", "ea_mu_comma_lambda", "ea_generate_update",
-           "evaluate_population",
+           "evaluate_population", "ea_ask", "ea_tell", "ea_step",
            # reference camelCase aliases (bound at end of module)
            "varAnd", "varOr", "eaSimple", "eaMuPlusLambda",
            "eaMuCommaLambda", "eaGenerateUpdate"]
@@ -269,6 +270,113 @@ def var_or(key, population: Population, toolbox, lambda_: int,
     fit = Fitness.empty(lambda_, population.fitness.weights,
                         population.fitness.values.dtype)
     return Population(genome=child, fitness=fit)
+
+
+# ---------------------------------------------------------------------------
+# the factored generation step (ask / tell halves)
+# ---------------------------------------------------------------------------
+#
+# ``ea_simple``'s generation body is also the unit of work the serving layer
+# (:mod:`deap_tpu.serve`) dispatches: many concurrent sessions are padded to a
+# common bucket shape and stepped under one vmap.  The split into *ask*
+# (select + vary, no evaluation) and *tell* (evaluate — or assign externally
+# computed values) is the reference's generate/update protocol applied to the
+# plain GA, and what an ask/tell service session speaks over the wire.
+#
+# ``live`` is the padding contract: a boolean ``(pop,)`` PREFIX mask (all live
+# rows first, pad rows after — the layout ``deap_tpu.serve.buckets.pad_rows``
+# produces).  Pad rows are frozen: they never win selection (their fitness is
+# invalid, so masked comparisons see -inf; selected indices that land in the
+# pad are remapped into the live prefix), are never varied, never evaluated,
+# and never counted in ``nevals`` — a padded step is the *defined* trajectory
+# of the session at its bucket, independent of what any other row (or vmapped
+# sibling slot) contains.
+
+
+def ea_ask(key, population: Population, toolbox, cxpb: float, mutpb: float,
+           *, live=None):
+    """Selection + variation half of one :func:`ea_simple` generation:
+    select ``pop.size`` parents, apply :func:`var_and`; returns ``(key,
+    offspring)`` with touched rows' fitness invalidated and NOTHING
+    evaluated — feed the offspring to :func:`ea_tell` (internal evaluation)
+    or evaluate the invalid rows externally and ``ea_tell(values=...)``.
+
+    With ``live`` (bool prefix mask, see module comment above) pad rows
+    pass through untouched and any selected pad index is remapped into the
+    live prefix (``idx % live_n``), so the trajectory of the live rows is a
+    pure function of the live rows."""
+    key, k_sel, k_var = jax.random.split(key, 3)
+    idx = toolbox.select(k_sel, population.fitness, population.size)
+    if live is None:
+        off = population.take(idx)
+        off = var_and(k_var, off, toolbox, cxpb, mutpb)
+        return key, off
+    live = jnp.asarray(live, bool)
+    live_n = jnp.maximum(jnp.sum(live.astype(jnp.int32)), 1)
+    idx = jnp.where(idx < live_n, idx, idx % live_n)
+    off = population.take(idx)
+    g, touched = vary_genome(k_var, off.genome, toolbox, cxpb, mutpb)
+    touched = touched & live
+    g = _where_rows(live, g, population.genome)
+    fit = off.fitness
+    values = jnp.where(live[:, None], fit.values, population.fitness.values)
+    valid = jnp.where(live, fit.valid & ~touched, False)
+    return key, Population(g, dataclasses.replace(fit, values=values,
+                                                  valid=valid))
+
+
+def ea_tell(toolbox, population: Population, values=None, *, live=None):
+    """Evaluation half of one generation: evaluate the invalid rows via the
+    toolbox (``values=None``) or assign externally computed ``values`` to
+    them — either way ``toolbox.quarantine`` is applied to the freshly
+    assigned rows.  Returns ``(population, nevals)``.
+
+    With ``live``, pad rows are excluded from evaluation, assignment,
+    quarantine and the ``nevals`` count, and come back invalid (so they
+    keep losing masked comparisons next generation)."""
+    if live is None:
+        if values is None:
+            return evaluate_population(toolbox, population)
+        invalid = ~population.fitness.valid
+        population = population.evaluated(values, where=invalid)
+        quarantine = getattr(toolbox, "quarantine", None)
+        if quarantine is not None:
+            population = quarantine.apply(population, newly=invalid)
+        return population, jnp.sum(invalid)
+    live = jnp.asarray(live, bool)
+    fit = population.fitness
+    # pad rows masquerade as valid for the evaluation so the masked
+    # assignment (and quarantine's ``newly``) skips them entirely
+    guarded = Population(population.genome,
+                         dataclasses.replace(fit, valid=fit.valid | ~live))
+    out, nevals = ea_tell(toolbox, guarded, values)
+    return Population(out.genome, dataclasses.replace(
+        out.fitness, valid=out.fitness.valid & live)), nevals
+
+
+def ea_step(key, population: Population, toolbox, cxpb: float, mutpb: float,
+            *, reevaluate_all: bool = False, live=None):
+    """One full :func:`ea_simple` generation — exactly the op sequence of
+    the loop body, reusable outside the scan (the compiled unit the
+    :mod:`deap_tpu.serve` dispatcher invokes).  Returns ``(key, population,
+    nevals)``; bitwise identical to a generation of :func:`ea_simple` under
+    the same key."""
+    if reevaluate_all:
+        if live is not None:
+            raise ValueError("reevaluate_all is incompatible with a live "
+                             "mask: it recomputes every row, including pads")
+        key, k_sel, k_var = jax.random.split(key, 3)
+        idx = toolbox.select(k_sel, population.fitness, population.size)
+        genome = jax.tree_util.tree_map(lambda x: x[idx], population.genome)
+        genome, touched = vary_genome(k_var, genome, toolbox, cxpb, mutpb)
+        off = Population(genome, Fitness.empty(
+            population.size, population.fitness.weights,
+            population.fitness.values.dtype))
+        off, _ = evaluate_population(toolbox, off)
+        return key, off, jnp.sum(touched)
+    key, off = ea_ask(key, population, toolbox, cxpb, mutpb, live=live)
+    off, nevals = ea_tell(toolbox, off, live=live)
+    return key, off, nevals
 
 
 # ---------------------------------------------------------------------------
@@ -517,21 +625,9 @@ def ea_simple(key, population: Population, toolbox, cxpb: float, mutpb: float,
 
     def gen_step(carry, gen):
         key, pop, hof, buf = carry
-        key, k_sel, k_var = jax.random.split(key, 3)
         with _tel_collect(telemetry if buf is not None else None) as ev:
-            idx = toolbox.select(k_sel, pop.fitness, pop.size)
-            if reevaluate_all:
-                genome = jax.tree_util.tree_map(lambda x: x[idx], pop.genome)
-                genome, touched = vary_genome(k_var, genome, toolbox, cxpb,
-                                              mutpb)
-                off = Population(genome, Fitness.empty(
-                    pop.size, pop.fitness.weights, pop.fitness.values.dtype))
-                off, _ = evaluate_population(toolbox, off)
-                nevals = jnp.sum(touched)
-            else:
-                off = pop.take(idx)
-                off = var_and(k_var, off, toolbox, cxpb, mutpb)
-                off, nevals = evaluate_population(toolbox, off)
+            key, off, nevals = ea_step(key, pop, toolbox, cxpb, mutpb,
+                                       reevaluate_all=reevaluate_all)
         if hof is not None:
             hof = hof_upd(hof, off)
         if buf is not None:

@@ -10,7 +10,7 @@ propagation without further user action.
 """
 
 from .mapper import (tpu_map, default_mesh, shard_population,
-                     population_sharding)  # noqa: F401
+                     population_sharding, pad_to_multiple)  # noqa: F401
 from .islands import (ea_simple_islands, stack_populations,
                       unstack_populations)  # noqa: F401
 from .multihost import (initialize_cluster, cluster_mesh,

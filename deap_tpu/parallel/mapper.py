@@ -22,7 +22,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import Population, Fitness
 
-__all__ = ["default_mesh", "population_sharding", "shard_population", "tpu_map"]
+__all__ = ["default_mesh", "population_sharding", "shard_population",
+           "tpu_map", "pad_to_multiple"]
 
 
 def default_mesh(axis_name: str = "pop", devices=None) -> Mesh:
@@ -52,8 +53,34 @@ def shard_population(population: Population, mesh: Mesh,
     return jax.tree_util.tree_map(put, population)
 
 
+def pad_to_multiple(batch, multiple: int, fill=0):
+    """Pad the leading axis of every leaf up to the next multiple of
+    ``multiple`` (zero rows appended) and return ``(padded, n)`` with ``n``
+    the original row count.  The appended rows are *mask semantics*: they
+    exist only to make the leading axis divisible for sharding, carry
+    ``fill``, and the caller discards whatever a mapped function computes
+    for them (slice back with ``[:n]``)."""
+    if multiple < 1:
+        raise ValueError("multiple must be >= 1")
+    leaves = jax.tree_util.tree_leaves(batch)
+    if not leaves:
+        raise TypeError("pad_to_multiple needs at least one array leaf")
+    n = leaves[0].shape[0]
+    pad = (-n) % multiple
+
+    def one(x):
+        if x.shape[0] != n:
+            raise ValueError(
+                f"inconsistent leading axis: {x.shape[0]} vs {n}")
+        if pad == 0:
+            return jnp.asarray(x)
+        width = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(jnp.asarray(x), width, constant_values=fill)
+    return jax.tree_util.tree_map(one, batch), n
+
+
 def tpu_map(fn: Callable, *batches, mesh: Mesh | None = None,
-            axis_name: str = "pop"):
+            axis_name: str = "pop", pad: bool | int = True):
     """``toolbox.map`` replacement: apply a per-individual ``fn`` to stacked
     argument arrays, vmapped + jitted, with outputs sharded like inputs.
 
@@ -64,13 +91,42 @@ def tpu_map(fn: Callable, *batches, mesh: Mesh | None = None,
 
         toolbox.register("map", tpu_map, mesh=mesh)
         values = toolbox.map(evaluate, genomes)
-    """
+
+    A population whose size is not divisible by the mesh size cannot be
+    placed with a pop-axis NamedSharding at all (``jax.device_put``
+    rejects it) — relying on any implicit XLA padding is not an option.
+    ``pad`` makes the semantics explicit: ``True`` (default) pads every
+    batch to the next multiple of the mesh size with zero rows
+    (:func:`pad_to_multiple`), maps, and slices the result back to the
+    true row count — mapped outputs for pad rows are computed on the
+    zero filler and DISCARDED, never returned.  An int pads to that
+    multiple instead (e.g. a serving bucket size); ``False`` restores
+    the strict divisibility error.  Unsharded calls (``mesh=None``) pad
+    only when an explicit int is given."""
     if not batches:
         raise TypeError(
             "tpu_map needs at least one batched argument; to register a "
             'mapper use toolbox.register("map", tpu_map, mesh=mesh)')
+    multiple = 0
+    if isinstance(pad, bool):
+        if pad and mesh is not None:
+            multiple = mesh.devices.size
+    else:
+        multiple = int(pad)
+    n = None
+    if multiple > 1:
+        padded = []
+        for b in batches:
+            p, rows = pad_to_multiple(b, multiple)
+            if rows % multiple:       # only slice back when rows were added
+                n = rows
+            padded.append(p)
+        batches = tuple(padded)
     mapped = jax.jit(jax.vmap(fn))
     if mesh is not None:
         sh = population_sharding(mesh, axis_name)
         batches = tuple(jax.device_put(b, sh) for b in batches)
-    return mapped(*batches)
+    out = mapped(*batches)
+    if n is not None:
+        out = jax.tree_util.tree_map(lambda x: x[:n], out)
+    return out

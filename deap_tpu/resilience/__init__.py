@@ -19,16 +19,21 @@ deterministic fault-injection harness in :mod:`.faultinject`
   checkpoint I/O and the cluster coordinator connection (:mod:`.retry`).
 * :class:`FaultPlan` / :class:`FaultInjector` — declarative fault
   schedules for tests and drills (:mod:`.faultinject`).
+* :func:`save_session_states` / :func:`load_session_states` — the
+  retried checkpoint tier for every live session of a
+  :class:`deap_tpu.serve.EvolutionService` (:mod:`.runner`).
 """
 
 from .retry import with_retries, RetriesExhausted  # noqa: F401
 from .quarantine import (Quarantine, NonFiniteFitnessError,  # noqa: F401
                          nonfinite_rows)
 from .faultinject import FaultPlan, FaultInjector, VirtualClock  # noqa: F401
-from .runner import run_resumable, Preempted  # noqa: F401
+from .runner import (run_resumable, Preempted,  # noqa: F401
+                     save_session_states, load_session_states)
 
 __all__ = [
     "run_resumable", "Preempted",
+    "save_session_states", "load_session_states",
     "Quarantine", "NonFiniteFitnessError", "nonfinite_rows",
     "with_retries", "RetriesExhausted",
     "FaultPlan", "FaultInjector", "VirtualClock",

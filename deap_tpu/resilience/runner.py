@@ -56,7 +56,8 @@ from ..utils.checkpoint import (save_checkpoint, load_checkpoint,
 from ..utils.support import Logbook
 from .retry import with_retries
 
-__all__ = ["run_resumable", "Preempted"]
+__all__ = ["run_resumable", "Preempted", "save_session_states",
+           "load_session_states"]
 
 
 class Preempted(RuntimeError):
@@ -180,6 +181,50 @@ def _device_like(template, value):
             return jax.device_put(jnp.asarray(v), t.sharding)
         return v
     return jax.tree_util.tree_map(put, template, value)
+
+
+_SESSION_FORMAT = 1
+
+
+def save_session_states(ckpt_path, sessions: dict, *, io_retries: int = 3,
+                        io_backoff: float = 0.5, io_sleep=time.sleep,
+                        io_clock=time.monotonic) -> None:
+    """Checkpoint the live-session snapshot of a
+    :class:`deap_tpu.serve.EvolutionService` (the dict its
+    ``snapshot_sessions()`` returns: per-session host state + run
+    metadata) through the same retried single-pickle tier
+    :func:`run_resumable` uses — a flaky filesystem costs retries, not the
+    service.  Process-0-only on multihost, like the driver's checkpoints.
+
+    The on-disk payload wraps the snapshot in a versioned envelope so a
+    future layout change can migrate instead of corrupting restores."""
+    state = {"format": _SESSION_FORMAT,
+             "sessions": {name: dict(snap, key=_pack_key(snap["key"]))
+                          for name, snap in sessions.items()}}
+
+    def _save():
+        if jax.process_count() == 1 or jax.process_index() == 0:
+            save_checkpoint(ckpt_path, state)
+    with_retries(_save, retries=io_retries, backoff=io_backoff,
+                 sleep=io_sleep, clock=io_clock,
+                 retry_on=(OSError, TimeoutError))()
+
+
+def load_session_states(ckpt_path, *, io_retries: int = 3,
+                        io_backoff: float = 0.5, io_sleep=time.sleep,
+                        io_clock=time.monotonic) -> dict:
+    """Load a :func:`save_session_states` checkpoint back into the
+    snapshot dict ``EvolutionService.restore_sessions`` consumes."""
+    loader = with_retries(load_checkpoint, retries=io_retries,
+                          backoff=io_backoff, sleep=io_sleep, clock=io_clock,
+                          retry_on=(OSError, TimeoutError))
+    state = loader(ckpt_path)
+    fmt = state.get("format")
+    if fmt != _SESSION_FORMAT:
+        raise ValueError(f"unsupported session checkpoint format {fmt!r} "
+                         f"(this build reads format {_SESSION_FORMAT})")
+    return {name: dict(snap, key=_unpack_key(snap["key"]))
+            for name, snap in state["sessions"].items()}
 
 
 def run_resumable(key, population, toolbox, ngen: int, *, ckpt_path,

@@ -1,0 +1,45 @@
+"""Serving layer: many concurrent evolution runs multiplexed onto one
+device mesh as an async ask/tell service.
+
+The reference frames ``toolbox.map`` as the entire distribution boundary
+(doc/tutorials/basic/part4.rst); this package is the *other* half a
+production deployment needs — the multi-tenant control plane in front of
+the compiled evolution step:
+
+* :mod:`~deap_tpu.serve.service` — :class:`EvolutionService` /
+  :class:`Session`: the concurrent ask/tell/step/evaluate API;
+* :mod:`~deap_tpu.serve.dispatcher` — bounded request queue with
+  backpressure, per-request deadlines, cancellation, retry-wrapped
+  microbatch dispatch;
+* :mod:`~deap_tpu.serve.buckets` — pad-and-bucket shape selection, so
+  XLA compiles one program per bucket and never recompiles in steady
+  state;
+* :mod:`~deap_tpu.serve.cache` — two-tier content-addressed fitness
+  cache (device sort/unique dedup within a batch + host LRU across
+  sessions);
+* :mod:`~deap_tpu.serve.metrics` — host counters/gauges/latency
+  quantiles, snapshotting into the observability sink layer;
+* :mod:`~deap_tpu.serve.cli` — the ``deap-tpu-serve`` console entry
+  (multi-session demo load with a live stats view).
+"""
+
+from .buckets import (BucketPolicy, BucketKey, BucketOverflow,  # noqa: F401
+                      genome_signature, pad_rows, unpad_rows,
+                      pad_population)
+from .cache import FitnessCache, row_digests, rep_indices  # noqa: F401
+from .dispatcher import (BatchDispatcher, Request, ServeFuture,  # noqa: F401
+                         ServeError, ServiceClosed, ServiceOverloaded,
+                         DeadlineExceeded, RequestCancelled)
+from .metrics import ServeMetrics, SERVE_COUNTERS, SERVE_GAUGES  # noqa: F401
+from .service import EvolutionService, Session  # noqa: F401
+
+__all__ = [
+    "EvolutionService", "Session",
+    "BucketPolicy", "BucketKey", "BucketOverflow", "genome_signature",
+    "pad_rows", "unpad_rows", "pad_population",
+    "FitnessCache", "row_digests", "rep_indices",
+    "BatchDispatcher", "Request", "ServeFuture",
+    "ServeError", "ServiceClosed", "ServiceOverloaded", "DeadlineExceeded",
+    "RequestCancelled",
+    "ServeMetrics", "SERVE_COUNTERS", "SERVE_GAUGES",
+]

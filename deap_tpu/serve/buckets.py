@@ -1,0 +1,142 @@
+"""Pad-and-bucket shape selection — how the service keeps XLA from
+recompiling in steady state.
+
+Every request the :class:`~deap_tpu.serve.dispatcher.BatchDispatcher`
+executes runs a compiled program whose shapes come from a SMALL, FIXED set
+of buckets, not from whatever population size a client happened to open.  A
+session with ``pop=100`` rows is padded (zero rows appended, a ``live``
+prefix mask carried as data) up to the enclosing bucket — by default the
+next power of two — so every session whose genome structure matches shares
+one compiled program per request kind.  Steady-state compile count ==
+number of distinct buckets in use; ``tests/test_serve.py`` pins it via the
+service's ``compiles`` counter.
+
+The bucketing policy is deliberately asymmetric:
+
+* the **population (row) axis pads** — a pad row is masked out of
+  selection, variation, evaluation and counters by the ``live``-mask
+  contract of :func:`deap_tpu.algorithms.ea_step`, so padding is
+  semantics-free;
+* the **genome (dim) axis does not pad** — a zero-padded genome column
+  would flow into the user's evaluate function and change the objective.
+  Distinct trailing genome shapes therefore land in distinct buckets: the
+  bucket key is effectively a ``(pop_bucket, dim)`` pair (generalized to a
+  full genome signature for pytree genomes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..base import Population, Fitness
+
+__all__ = ["BucketPolicy", "BucketKey", "BucketOverflow", "genome_signature",
+           "pad_rows", "unpad_rows", "pad_population"]
+
+
+class BucketOverflow(ValueError):
+    """The requested row count exceeds the policy's largest bucket."""
+
+
+def genome_signature(genome: Any) -> tuple:
+    """Hashable structural identity of a genome pytree: treedef plus each
+    leaf's ``(dtype, trailing shape)``.  Two populations with equal
+    signatures (and any row counts) can share bucket programs."""
+    leaves, treedef = jax.tree_util.tree_flatten(genome)
+    return (treedef,
+            tuple((str(l.dtype), tuple(l.shape[1:])) for l in leaves))
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketKey:
+    """One compiled-program shape class: padded row count + genome
+    signature + objective structure."""
+
+    rows: int
+    genome_sig: tuple
+    nobj: int
+    weights: tuple
+
+    def describe(self) -> str:
+        dims = "/".join("x".join(map(str, s)) or "scalar"
+                        for _, s in self.genome_sig[1])
+        return f"rows={self.rows} dim={dims} nobj={self.nobj}"
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPolicy:
+    """Row-bucket selection.
+
+    ``sizes`` — explicit ascending bucket grid; a request lands in the
+    smallest listed size that fits (:class:`BucketOverflow` beyond the
+    largest).  Empty (default): next power of two, floored at
+    ``min_rows``, capped at ``max_rows`` when set.
+    """
+
+    sizes: Tuple[int, ...] = ()
+    min_rows: int = 8
+    max_rows: Optional[int] = None
+
+    def __post_init__(self):
+        if self.sizes and tuple(sorted(self.sizes)) != tuple(self.sizes):
+            raise ValueError("BucketPolicy.sizes must be ascending")
+
+    def rows_for(self, n: int) -> int:
+        """Bucketed row count for ``n`` live rows."""
+        if n < 1:
+            raise ValueError("row count must be >= 1")
+        if self.sizes:
+            for s in self.sizes:
+                if n <= s:
+                    return int(s)
+            raise BucketOverflow(
+                f"{n} rows exceeds the largest bucket {self.sizes[-1]}")
+        rows = max(int(self.min_rows), 1)
+        while rows < n:
+            rows *= 2
+        if self.max_rows is not None and rows > self.max_rows:
+            raise BucketOverflow(
+                f"{n} rows needs bucket {rows} > max_rows={self.max_rows}")
+        return rows
+
+    def bucket_for(self, population: Population) -> BucketKey:
+        """Bucket of a (live, unpadded) population."""
+        return BucketKey(rows=self.rows_for(population.size),
+                         genome_sig=genome_signature(population.genome),
+                         nobj=population.fitness.nobj,
+                         weights=population.fitness.weights)
+
+
+def pad_rows(tree: Any, rows: int):
+    """Pad every leaf's leading axis to ``rows`` with zeros (appended, so
+    the live rows form a PREFIX — the layout the ``live``-mask contract of
+    :func:`deap_tpu.algorithms.ea_step` requires)."""
+    def pad(x):
+        n = x.shape[0]
+        if n == rows:
+            return jnp.asarray(x)
+        if n > rows:
+            raise ValueError(f"cannot pad {n} rows down to {rows}")
+        width = [(0, rows - n)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(jnp.asarray(x), width)
+    return jax.tree_util.tree_map(pad, tree)
+
+
+def unpad_rows(tree: Any, n: int):
+    """Strip pad rows: slice every leaf back to its first ``n`` rows."""
+    return jax.tree_util.tree_map(lambda x: x[:n], tree)
+
+
+def pad_population(population: Population, rows: int) -> Population:
+    """Pad a population to ``rows``: genome and fitness values get zero
+    rows, validity gets ``False`` (pad rows lose every masked comparison
+    and are skipped by live-masked evaluation)."""
+    return Population(
+        genome=pad_rows(population.genome, rows),
+        fitness=Fitness(values=pad_rows(population.fitness.values, rows),
+                        valid=pad_rows(population.fitness.valid, rows),
+                        weights=population.fitness.weights))

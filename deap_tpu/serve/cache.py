@@ -1,0 +1,171 @@
+"""Two-tier content-addressed fitness cache.
+
+Tier 1 (device, within a batch): :func:`rep_indices` — lexicographic
+sort + adjacent-unique over the raw genome bits maps every row of an
+evaluation microbatch to the batch index of its group leader; gathering
+evaluated values through that map makes identical genomes return
+**bitwise-identical** fitness inside one dispatch even for a
+non-deterministic evaluator, and the unique count feeds the ``dedup_rows``
+counter.
+
+Tier 2 (host, across batches/sessions): :class:`FitnessCache` — an LRU of
+``blake2b(genome row bytes)`` → fitness values, namespaced by evaluator
+identity (two sessions sharing an evaluator share entries; different
+objectives never collide).  Hits are spliced over the device results, so a
+genome evaluated once returns the same bits forever after, from any
+session.  **Non-finite values are never inserted** — a quarantined (NaN)
+evaluation must be re-attempted, not immortalized (pinned by
+``tests/test_serve.py``).
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["FitnessCache", "row_digests", "rep_indices", "flatten_rows"]
+
+
+def flatten_rows(genome) -> jax.Array:
+    """Concatenate a genome pytree into one ``(rows, flat_dim)`` array
+    (the content view both cache tiers hash/compare)."""
+    leaves = jax.tree_util.tree_leaves(genome)
+    return jnp.concatenate(
+        [jnp.asarray(l).reshape(l.shape[0], -1) for l in leaves], axis=1)
+
+
+def row_digests(rows: np.ndarray) -> List[bytes]:
+    """Content digest per row: blake2b over the raw row bytes, salted with
+    dtype + row shape so equal bytes of different types never collide."""
+    rows = np.ascontiguousarray(rows)
+    salt = f"{rows.dtype.str}:{rows.shape[1:]}".encode()
+    return [hashlib.blake2b(salt + r.tobytes(), digest_size=16).digest()
+            for r in rows]
+
+
+def _bit_view(flat: jax.Array) -> jax.Array:
+    """Exact-equality integer view of the rows (floats compared by bit
+    pattern, so sort/unique grouping never hits NaN != NaN semantics)."""
+    if flat.dtype == jnp.float32:
+        return jax.lax.bitcast_convert_type(flat, jnp.uint32)
+    if flat.dtype == jnp.float16 or flat.dtype == jnp.bfloat16:
+        return jax.lax.bitcast_convert_type(flat, jnp.uint16)
+    if jnp.issubdtype(flat.dtype, jnp.integer) or flat.dtype == jnp.bool_:
+        return flat
+    raise TypeError(f"no exact bit view for dtype {flat.dtype}")
+
+
+def rep_indices(flat: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Device-side within-batch dedup: for ``(rows, flat_dim)`` genome
+    content, return ``(rep, n_unique)`` where ``rep[i]`` is the batch index
+    of the first row whose content equals row ``i`` (its group *leader*),
+    and ``n_unique`` counts distinct rows.  Pure array ops (one variadic
+    lexsort + a cumulative max), safe under jit.
+
+    ``values[rep]`` then assigns every duplicate its leader's evaluated
+    value — bitwise equality of identical genomes by construction."""
+    b = _bit_view(flat)
+    rows, d = b.shape
+    order = jnp.lexsort([b[:, j] for j in range(d - 1, -1, -1)])
+    sg = b[order]
+    first = jnp.concatenate([jnp.ones((1,), bool),
+                             jnp.any(sg[1:] != sg[:-1], axis=1)])
+    # index (in sorted space) of each row's group leader, then back to
+    # batch space on both sides of the mapping
+    leader_sorted = jax.lax.cummax(jnp.where(first, jnp.arange(rows), 0))
+    rep = jnp.zeros((rows,), jnp.int32).at[order].set(
+        order[leader_sorted].astype(jnp.int32))
+    return rep, jnp.sum(first.astype(jnp.int32))
+
+
+class FitnessCache:
+    """Host LRU of genome-content digests → fitness values.
+
+    ``capacity`` bounds the entry count (least-recently-used eviction,
+    counted in ``cache_evictions``).  Keys are ``(namespace, digest)`` —
+    the service namespaces by evaluator identity + genome signature +
+    objective count, so only sessions that share an evaluator share
+    entries.  Values are defensive copies of ``(nobj,)`` float arrays.
+    Thread-safe (the dispatcher thread writes; stats readers poll)."""
+
+    def __init__(self, capacity: int = 4096, metrics=None):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = int(capacity)
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[tuple, np.ndarray]" = \
+            collections.OrderedDict()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def _inc(self, name: str, v: int = 1) -> None:
+        if self._metrics is not None and v:
+            self._metrics.inc(name, v)
+
+    def lookup(self, namespace, digests: List[bytes]
+               ) -> List[Optional[np.ndarray]]:
+        """Per-digest hit values (``None`` on miss); hits are refreshed to
+        most-recently-used and counted."""
+        out: List[Optional[np.ndarray]] = []
+        hits = misses = 0
+        with self._lock:
+            for d in digests:
+                k = (namespace, d)
+                v = self._entries.get(k)
+                if v is None:
+                    misses += 1
+                    out.append(None)
+                else:
+                    hits += 1
+                    self._entries.move_to_end(k)
+                    out.append(v)
+        self._inc("cache_hits", hits)
+        self._inc("cache_misses", misses)
+        return out
+
+    def insert(self, namespace, digests: List[bytes],
+               values: np.ndarray) -> int:
+        """Insert ``digest[i] -> values[i]`` for every FINITE row; NaN/Inf
+        rows are skipped (and counted as ``cache_nan_skipped``) — a
+        quarantined evaluation is never content-addressable.  Returns the
+        number of rows inserted."""
+        values = np.asarray(values)
+        inserted = skipped = evicted = 0
+        with self._lock:
+            for d, v in zip(digests, values):
+                if not np.all(np.isfinite(v)):
+                    skipped += 1
+                    continue
+                k = (namespace, d)
+                if k in self._entries:
+                    self._entries.move_to_end(k)
+                    continue
+                self._entries[k] = np.array(v, copy=True)
+                inserted += 1
+                while len(self._entries) > self.capacity:
+                    self._entries.popitem(last=False)
+                    evicted += 1
+        self._inc("cache_nan_skipped", skipped)
+        self._inc("cache_evictions", evicted)
+        return inserted
+
+    def contains(self, namespace, digest: bytes) -> bool:
+        with self._lock:
+            return (namespace, digest) in self._entries
+
+    def hit_rate(self) -> float:
+        """Lifetime hit fraction (0.0 when nothing was looked up)."""
+        if self._metrics is None:
+            return 0.0
+        h = self._metrics.counter("cache_hits")
+        m = self._metrics.counter("cache_misses")
+        return h / (h + m) if h + m else 0.0

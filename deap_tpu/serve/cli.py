@@ -1,0 +1,157 @@
+"""``deap-tpu-serve`` — multi-session service demo with a live stats view.
+
+The serving sibling of ``deap-tpu-selftest`` / ``deap-tpu-trace``: stand up
+an :class:`~deap_tpu.serve.service.EvolutionService` ON THE TARGET BACKEND,
+drive a mixed-shape fleet of synthetic GA sessions through it, and stream
+the service's own metrics (queue depth, batch occupancy, compile count,
+cache hit rate, latency p50/p99) while it runs — then print one JSON
+summary line.
+
+    deap-tpu-serve                                   # defaults
+    deap-tpu-serve --sessions 8 --pops 100,256 --dims 16,32 --ngen 50
+    deap-tpu-serve --compile-cache /tmp/xla_cache    # persistent compiles
+    deap-tpu-serve --smoke                           # tiny CI smoke run
+
+Exit status is non-zero when any session fails or goes non-finite — a
+smoke gate, not a benchmark (throughput numbers live in
+``tools/bench_serve.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _build_toolbox():
+    from .. import base
+    from ..benchmarks import rastrigin
+    from ..ops import crossover, mutation, selection
+    from ..resilience import Quarantine
+
+    tb = base.Toolbox()
+    tb.register("evaluate", rastrigin)
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=0.3,
+                indpb=0.1)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    tb.quarantine = Quarantine("penalize")
+    return tb
+
+
+def _open_fleet(svc, tb, sessions, pops, dims, seed):
+    import jax
+    import jax.numpy as jnp
+    from .. import base
+
+    fleet = []
+    for i in range(sessions):
+        n, d = pops[i % len(pops)], dims[i % len(dims)]
+        key = jax.random.PRNGKey(seed + i)
+        genome = jax.random.uniform(key, (n, d), jnp.float32, -5.12, 5.12)
+        pop = base.Population(genome=genome,
+                              fitness=base.Fitness.empty(n, (-1.0,)))
+        fleet.append(svc.open_session(key, pop, tb, cxpb=0.7, mutpb=0.3,
+                                      name=f"demo-{i}"))
+    return fleet
+
+
+def _stat_line(rec) -> str:
+    c, g = rec.counters, rec.gauges
+    return ("[serve] "
+            f"batches={rec.gen} queue={g['queue_depth']:.0f} "
+            f"slot_occ={g['slot_occupancy']:.2f} "
+            f"compiles={c['compiles']} steps={c['steps']} "
+            f"cache_hit={c['cache_hits']}/{c['cache_hits'] + c['cache_misses']} "
+            f"p50={g.get('latency_p50_ms', 0.0):.1f}ms "
+            f"p99={g.get('latency_p99_ms', 0.0):.1f}ms")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="deap-tpu-serve",
+        description="drive a mixed-shape session fleet through one "
+                    "EvolutionService with a live stats view")
+    ap.add_argument("--sessions", type=int, default=6)
+    ap.add_argument("--pops", default="100,180",
+                    help="comma-separated session population sizes")
+    ap.add_argument("--dims", default="16,32",
+                    help="comma-separated genome dims")
+    ap.add_argument("--ngen", type=int, default=30)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--stats-every", type=int, default=10,
+                    help="emit a live stats line every N dispatched batches")
+    ap.add_argument("--compile-cache", metavar="DIR", default=None,
+                    help="persist XLA compilations under DIR "
+                         "(deap_tpu.utils.compilecache)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny fixed configuration for CI smoke tests")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.sessions, args.pops, args.dims = 2, "12", "6"
+        args.ngen, args.stats_every = 3, 2
+
+    if args.compile_cache:
+        from ..utils.compilecache import enable_compile_cache
+        enable_compile_cache(args.compile_cache)
+
+    import numpy as np
+    from ..observability.sinks import StdoutSink
+    from .service import EvolutionService
+
+    pops = [int(p) for p in args.pops.split(",")]
+    dims = [int(d) for d in args.dims.split(",")]
+    tb = _build_toolbox()
+    sink = StdoutSink()
+
+    t0 = time.perf_counter()
+    failures = 0
+    with EvolutionService(max_batch=args.max_batch) as svc:
+        fleet = _open_fleet(svc, tb, args.sessions, pops, dims, args.seed)
+        futures = {s.name: s.step(args.ngen) for s in fleet}
+        last_line = 0
+        outstanding = {n: list(fs) for n, fs in futures.items()}
+        while outstanding:
+            for name in list(outstanding):
+                fs = outstanding[name]
+                while fs and fs[0].done():
+                    exc = fs.pop(0).exception()
+                    if exc is not None:
+                        failures += 1
+                        print(f"[serve] {name} step failed: {exc!r}",
+                              file=sys.stderr)
+                if not fs:
+                    del outstanding[name]
+            rec = svc.stats()
+            if args.stats_every and rec.gen - last_line >= args.stats_every:
+                sink.write_text(_stat_line(rec))
+                last_line = rec.gen
+            if outstanding:
+                next(iter(outstanding.values()))[0].exception(timeout=60)
+        wall = time.perf_counter() - t0
+
+        bests = []
+        for s in fleet:
+            p = s.population()
+            bests.append(float(np.asarray(p.fitness.values[:, 0]).min()))
+        rec = svc.stats()
+        report = {
+            "sessions": args.sessions, "ngen": args.ngen,
+            "pops": pops, "dims": dims, "wall_s": wall,
+            "gens_per_sec": args.sessions * args.ngen / wall,
+            "counters": rec.counters, "gauges": rec.gauges,
+            "best_fitness": bests, "failures": failures,
+        }
+    print(json.dumps(report))
+    if failures or not all(np.isfinite(bests)):
+        print("FAILED: session failures or non-finite results",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,409 @@
+"""Request queue + microbatching worker — the service's control plane.
+
+One daemon thread owns all device dispatch.  Clients (any thread) submit
+:class:`Request` objects into a **bounded** pending deque — a full queue
+rejects (:class:`ServiceOverloaded`) or blocks with a timeout, so overload
+backpressures at the edge instead of growing an unbounded heap.  The worker
+coalesces compatible pending requests into one fixed-shape microbatch per
+dispatch:
+
+* **group identity** — requests batch together iff they share
+  ``(kind, program_key)``: same compiled program, same bucket shapes;
+* **capacity** — a batch packs requests while the sum of their ``weight``
+  stays within the group's ``capacity`` (step/ask/tell weigh 1 against the
+  slot count; evaluate requests weigh their row count against the row
+  bucket);
+* **per-session FIFO** — at most one request per session per batch, and a
+  session's later request never overtakes its earlier one (stateful kinds
+  would otherwise race their own state);
+* **deadlines** — a request whose deadline passed before dispatch fails
+  with :class:`DeadlineExceeded` and never reaches the device: deadline
+  misses fail the *request*, not the service;
+* **cancellation** — :meth:`ServeFuture.cancel` wins any race that
+  resolves before dispatch; cancelled requests are dropped at collection.
+
+Execution runs under :func:`deap_tpu.resilience.with_retries` (transient
+``OSError``/``TimeoutError``-class faults back off and retry; anything
+else fails the batch's requests and the worker moves on).  Waiting uses
+``threading.Condition`` timeouts only — no blocking ``time.sleep`` on any
+service path (``tools/check_no_blocking_sleep.py`` pins it as a tier-1
+static pass).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..resilience.retry import with_retries, RetriesExhausted
+
+__all__ = ["ServeFuture", "Request", "BatchDispatcher", "ServeError",
+           "ServiceClosed", "ServiceOverloaded", "DeadlineExceeded",
+           "RequestCancelled"]
+
+
+class ServeError(RuntimeError):
+    """Base class of service-layer failures."""
+
+
+class ServiceClosed(ServeError):
+    """The service (or the request's session) was closed."""
+
+
+class ServiceOverloaded(ServeError):
+    """The bounded request queue is full — shed load or retry later."""
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline passed before it was dispatched."""
+
+
+class RequestCancelled(ServeError):
+    """The request was cancelled before it was dispatched."""
+
+
+class ServeFuture:
+    """Completion handle for one submitted request (thread-safe).
+
+    ``result(timeout)`` blocks until resolution and returns the request's
+    payload result or raises its failure; ``cancel()`` succeeds iff the
+    request has not started executing."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._result: Any = None
+        self._exc: Optional[BaseException] = None
+        self._cancelled = False
+        self._started = False
+        #: optional hook run exactly once when the future resolves with a
+        #: failure (cancellation included) — sessions use it to roll back
+        #: protocol state (e.g. an ask() that never executed)
+        self._on_failure: Optional[Callable[[], None]] = None
+
+    # -- dispatcher side -----------------------------------------------------
+
+    def _start(self) -> bool:
+        """Claim the future for execution; False if already cancelled."""
+        with self._lock:
+            if self._cancelled:
+                return False
+            self._started = True
+            return True
+
+    def _set_result(self, value: Any) -> None:
+        with self._lock:
+            if self._event.is_set():
+                return
+            self._result = value
+            self._event.set()
+
+    def _set_exception(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._event.is_set():
+                return
+            self._exc = exc
+            self._event.set()
+            hook, self._on_failure = self._on_failure, None
+        if hook is not None:
+            hook()
+
+    # -- client side ---------------------------------------------------------
+
+    def cancel(self) -> bool:
+        """Request cancellation.  True iff the request will never execute
+        (it had not been claimed by a batch); a started request cannot be
+        recalled from the device."""
+        with self._lock:
+            if self._started or self._event.is_set():
+                return False
+            self._cancelled = True
+            self._exc = RequestCancelled("request cancelled")
+            self._event.set()
+            hook, self._on_failure = self._on_failure, None
+        if hook is not None:
+            hook()
+        return True
+
+    def cancelled(self) -> bool:
+        with self._lock:
+            return self._cancelled
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError("request not complete")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def exception(self, timeout: Optional[float] = None
+                  ) -> Optional[BaseException]:
+        if not self._event.wait(timeout):
+            raise TimeoutError("request not complete")
+        return self._exc
+
+
+_req_ids = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One unit of queued work.  ``program_key`` is the batching identity
+    (same compiled program + bucket); ``weight``/``capacity`` implement
+    slot- or row-packing; ``session`` scopes the per-session FIFO rule
+    (``None`` → unconstrained)."""
+
+    kind: str
+    program_key: tuple
+    payload: Dict[str, Any]
+    session: Any = None
+    weight: int = 1
+    capacity: int = 1
+    deadline: Optional[float] = None
+    future: ServeFuture = dataclasses.field(default_factory=ServeFuture)
+    submitted: float = 0.0
+    seq: int = dataclasses.field(default_factory=lambda: next(_req_ids))
+
+
+class BatchDispatcher:
+    """Bounded queue + single worker thread (see module docstring).
+
+    ``execute`` is called on the worker thread as
+    ``execute(kind, program_key, requests) -> list_of_results`` (one result
+    per request, same order) and is wrapped in
+    :func:`~deap_tpu.resilience.with_retries` with ``retries`` /
+    ``backoff`` (transient classes only).  ``clock`` is the monotonic
+    deadline clock, injectable for tests."""
+
+    def __init__(self, execute: Callable[[str, tuple, List[Request]], list],
+                 *, max_pending: int = 256, batch_window: float = 0.0,
+                 metrics=None, retries: int = 2, backoff: float = 0.05,
+                 retry_on: tuple = (OSError, TimeoutError, ConnectionError),
+                 clock: Callable[[], float] = time.monotonic,
+                 on_retry: Optional[Callable] = None):
+        if max_pending < 1:
+            raise ValueError("max_pending must be >= 1")
+        self._execute_once = execute
+        self._metrics = metrics
+
+        def _note_retry(attempt, exc, delay):
+            if metrics is not None:
+                metrics.inc("retries")
+            if on_retry is not None:
+                on_retry(attempt, exc, delay)
+
+        # the backoff sleep inside with_retries runs on the WORKER thread
+        # between attempts of an already-failing batch — queued requests
+        # wait behind it by design (the device path is down).
+        self._execute = with_retries(
+            execute, retries=retries, backoff=backoff, retry_on=retry_on,
+            on_retry=_note_retry)
+        self.max_pending = int(max_pending)
+        self.batch_window = float(batch_window)
+        self._clock = clock
+        self._cv = threading.Condition()
+        self._pending: "collections.deque[Request]" = collections.deque()
+        self._closed = False
+        self._paused = False
+        self._busy = False
+        self._batches = 0
+        self._thread = threading.Thread(
+            target=self._run, name="deap-tpu-serve-dispatch", daemon=True)
+        self._thread.start()
+
+    # -- client side ---------------------------------------------------------
+
+    def submit(self, request: Request, *, block: bool = False,
+               timeout: Optional[float] = None) -> ServeFuture:
+        """Enqueue; on a full queue either raise :class:`ServiceOverloaded`
+        (default) or block up to ``timeout`` for space."""
+        request.submitted = self._clock()
+        with self._cv:
+            if self._closed:
+                raise ServiceClosed("service is closed")
+            if len(self._pending) >= self.max_pending:
+                # cancelled/expired entries still hold queue slots until
+                # the worker reaches them — resolve them here instead of
+                # shedding live work while the queue is full of corpses
+                self._pending = collections.deque(
+                    r for r in self._pending if not self._prune_locked(r))
+            if len(self._pending) >= self.max_pending:
+                if not block or not self._cv.wait_for(
+                        lambda: self._closed
+                        or len(self._pending) < self.max_pending,
+                        timeout=timeout):
+                    if self._metrics is not None:
+                        self._metrics.inc("rejected")
+                    raise ServiceOverloaded(
+                        f"{len(self._pending)} requests pending "
+                        f"(max_pending={self.max_pending})")
+                if self._closed:
+                    raise ServiceClosed("service is closed")
+            self._pending.append(request)
+            if self._metrics is not None:
+                self._metrics.inc("requests")
+                self._metrics.set_gauge("queue_depth", len(self._pending))
+            self._cv.notify_all()
+        return request.future
+
+    def pause(self) -> None:
+        """Stop dispatching new batches (in-flight one completes) —
+        checkpoint quiesce uses this."""
+        with self._cv:
+            self._paused = True
+            self._cv.wait_for(lambda: not self._busy)
+
+    def resume(self) -> None:
+        with self._cv:
+            self._paused = False
+            self._cv.notify_all()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until the queue is empty and no batch is in flight."""
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: not self._pending and not self._busy,
+                timeout=timeout)
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the worker; every still-pending request fails with
+        :class:`ServiceClosed`."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            while self._pending:
+                self._pending.popleft().future._set_exception(
+                    ServiceClosed("service closed with request pending"))
+            self._cv.notify_all()
+        self._thread.join(timeout=timeout)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._pending)
+
+    @property
+    def batches(self) -> int:
+        with self._cv:
+            return self._batches
+
+    # -- worker side ---------------------------------------------------------
+
+    def _prune_locked(self, req: Request) -> bool:
+        """Resolve a request that must not run; True if it was pruned."""
+        if req.future.cancelled():
+            if self._metrics is not None:
+                self._metrics.inc("cancelled")
+            return True
+        if req.session is not None and getattr(req.session, "closed", False):
+            req.future._set_exception(ServiceClosed(
+                f"session {getattr(req.session, 'name', '?')} is closed"))
+            return True
+        if req.deadline is not None and self._clock() > req.deadline:
+            req.future._set_exception(DeadlineExceeded(
+                f"deadline passed {self._clock() - req.deadline:.3f}s "
+                "before dispatch"))
+            if self._metrics is not None:
+                self._metrics.inc("deadline_misses")
+            return True
+        return False
+
+    def _collect_locked(self) -> List[Request]:
+        """Pop the next microbatch (FIFO anchor + compatible followers)."""
+        batch: List[Request] = []
+        anchor_key = None
+        weight = 0
+        capacity = 0
+        sessions_seen = set()
+        keep: "collections.deque[Request]" = collections.deque()
+        while self._pending:
+            req = self._pending.popleft()
+            if self._prune_locked(req):
+                continue
+            sess = id(req.session) if req.session is not None else None
+            if anchor_key is None:
+                anchor_key = (req.kind, req.program_key)
+                capacity = req.capacity
+            if ((req.kind, req.program_key) == anchor_key
+                    and weight + req.weight <= capacity
+                    and (sess is None or sess not in sessions_seen)):
+                batch.append(req)
+                weight += req.weight
+            else:
+                keep.append(req)
+            if sess is not None:
+                # a skipped session's LATER requests must also wait,
+                # preserving per-session order
+                sessions_seen.add(sess)
+        self._pending = keep
+        if self._metrics is not None:
+            self._metrics.set_gauge("queue_depth", len(self._pending))
+        return batch
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                self._cv.wait_for(
+                    lambda: self._closed
+                    or (self._pending and not self._paused))
+                if self._closed:
+                    return
+                batch = self._collect_locked()
+                if (batch and self.batch_window > 0
+                        and sum(r.weight for r in batch) < batch[0].capacity):
+                    # linger once for stragglers, then take what arrived.
+                    # wait() released the lock, so pause()/close() may have
+                    # happened meanwhile — re-check before dispatching: a
+                    # quiesced service must not swap session states under a
+                    # checkpoint, and a closed one must fail, not run
+                    self._cv.wait(self.batch_window)
+                    self._pending.extendleft(reversed(batch))
+                    if self._closed:
+                        while self._pending:
+                            self._pending.popleft().future._set_exception(
+                                ServiceClosed(
+                                    "service closed with request pending"))
+                        return
+                    if self._paused:
+                        continue
+                    batch = self._collect_locked()
+                if not batch:
+                    continue
+                self._busy = True
+            try:
+                self._dispatch(batch)
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._batches += 1
+                    self._cv.notify_all()
+
+    def _dispatch(self, batch: List[Request]) -> None:
+        live = [r for r in batch if r.future._start()]
+        if not live:
+            return
+        kind, program_key = live[0].kind, live[0].program_key
+        try:
+            results = self._execute(kind, program_key, live)
+        except (Exception, RetriesExhausted) as e:  # noqa: BLE001
+            for r in live:
+                r.future._set_exception(e)
+            if self._metrics is not None:
+                self._metrics.inc("failed", len(live))
+            return
+        now = self._clock()
+        for r, res in zip(live, results):
+            r.future._set_result(res)
+            if self._metrics is not None:
+                self._metrics.observe_latency(kind, now - r.submitted)
+        if self._metrics is not None:
+            self._metrics.inc("completed", len(live))
+            self._metrics.inc("batches")

@@ -1,0 +1,115 @@
+"""Host-side service metrics: counters, gauges and latency quantiles.
+
+The generation loops' :class:`~deap_tpu.observability.metrics.MetricBuffer`
+accumulates ON DEVICE because a whole run is one dispatch; the serving
+layer's control plane is host threads, so its metrics are plain (locked)
+python counters that snapshot into the same
+:class:`~deap_tpu.observability.sinks.MetricRecord` shape the sink layer
+already speaks — one stats pipeline, two producers.
+
+Latency is tracked as a bounded reservoir of recent per-request wall times
+per request kind; :meth:`ServeMetrics.latency_quantiles` reports p50/p90/p99
+over the window (steady-state service quantiles, not all-time)."""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, Iterable, Optional
+
+from ..observability.sinks import MetricRecord, emit_record
+
+__all__ = ["ServeMetrics", "SERVE_COUNTERS", "SERVE_GAUGES"]
+
+#: Counters the service maintains (cumulative over the service lifetime).
+SERVE_COUNTERS = (
+    "requests", "completed", "failed", "cancelled", "deadline_misses",
+    "rejected", "batches", "retries", "compiles", "compiles_step",
+    "compiles_init", "compiles_ask", "compiles_tell", "compiles_evaluate",
+    "steps", "evaluations", "cache_hits", "cache_misses", "cache_evictions",
+    "cache_nan_skipped", "dedup_rows", "quarantined",
+)
+
+#: Gauges (last-value).
+SERVE_GAUGES = (
+    "queue_depth", "sessions", "slot_occupancy", "row_occupancy",
+)
+
+
+class ServeMetrics:
+    """Thread-safe counter/gauge/latency store for one
+    :class:`~deap_tpu.serve.service.EvolutionService`."""
+
+    def __init__(self, latency_window: int = 2048):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {k: 0 for k in SERVE_COUNTERS}
+        self._gauges: Dict[str, float] = {k: 0.0 for k in SERVE_GAUGES}
+        self._latency: Dict[str, collections.deque] = {}
+        self._window = int(latency_window)
+
+    # -- writers -------------------------------------------------------------
+
+    def inc(self, name: str, value: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + int(value)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe_latency(self, kind: str, seconds: float) -> None:
+        with self._lock:
+            q = self._latency.get(kind)
+            if q is None:
+                q = self._latency[kind] = collections.deque(
+                    maxlen=self._window)
+            q.append(float(seconds))
+
+    # -- readers -------------------------------------------------------------
+
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return int(self._counters.get(name, 0))
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def gauges(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._gauges)
+
+    @staticmethod
+    def _quantile(sorted_samples, q: float) -> float:
+        if not sorted_samples:
+            return 0.0
+        i = min(len(sorted_samples) - 1,
+                max(0, round(q * (len(sorted_samples) - 1))))
+        return sorted_samples[i]
+
+    def latency_quantiles(self, kinds: Optional[Iterable[str]] = None
+                          ) -> Dict[str, float]:
+        """``{"latency_<kind>_p50_ms": ..., ...}`` over the recent window
+        (all kinds pooled under ``latency_p*`` as well)."""
+        with self._lock:
+            samples = {k: sorted(v) for k, v in self._latency.items()
+                       if (kinds is None or k in kinds) and v}
+        out: Dict[str, float] = {}
+        pooled = sorted(s for v in samples.values() for s in v)
+        for label, data in [("", pooled)] + [
+                (f"{k}_", v) for k, v in sorted(samples.items())]:
+            for q, name in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+                out[f"latency_{label}{name}_ms"] = \
+                    self._quantile(data, q) * 1e3
+        return out
+
+    def snapshot(self, seq: int = 0) -> MetricRecord:
+        """Everything as one :class:`MetricRecord` (``gen`` carries the
+        batch sequence number — the service's notion of time)."""
+        gauges = self.gauges()
+        gauges.update(self.latency_quantiles())
+        return MetricRecord(gen=int(seq), counters=self.counters(),
+                            gauges=gauges, meta={"source": "serve"})
+
+    def emit(self, sinks, seq: int = 0) -> None:
+        emit_record(sinks, self.snapshot(seq))

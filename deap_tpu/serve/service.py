@@ -1,0 +1,718 @@
+""":class:`EvolutionService` — many concurrent EC runs multiplexed onto one
+device (mesh) as an async ask/tell service.
+
+Each :class:`Session` is an independent evolution run: its padded state
+lives on device between requests, and every request kind is executed by a
+compiled program whose shapes come from the service's
+:class:`~deap_tpu.serve.buckets.BucketPolicy`:
+
+* ``step``    — one full :func:`~deap_tpu.algorithms.ea_step` generation
+  (select → vary → evaluate on device); sessions sharing a toolbox and a
+  bucket are **slot-packed**: up to ``max_batch`` sessions advance under
+  one ``vmap`` dispatch, and a slot's result depends only on that slot, so
+  multiplexed results are bitwise identical to the same session served
+  alone (pinned by ``tests/test_serve.py``);
+* ``ask`` / ``tell`` — the generate/update split for clients that evaluate
+  externally: ``ask`` returns the varied offspring genomes, ``tell`` feeds
+  fitness values back (``toolbox.quarantine`` applied to fresh rows);
+* ``evaluate`` — fitness for an ad-hoc genome batch, **row-packed** across
+  sessions into one padded bucket, deduplicated on device
+  (:func:`~deap_tpu.serve.cache.rep_indices`) and served through the host
+  :class:`~deap_tpu.serve.cache.FitnessCache` (content-addressed, never
+  caches non-finite values).
+
+Programs are compiled **ahead-of-time** (``jit().lower().compile()``) once
+per ``(kind, bucket, toolbox)`` and re-dispatched from the cache — a shape
+that would recompile raises instead of silently thrashing, and the
+``compiles*`` counters in :class:`~deap_tpu.serve.metrics.ServeMetrics`
+are therefore exact.  Backpressure, deadlines, cancellation and retry
+semantics live in :class:`~deap_tpu.serve.dispatcher.BatchDispatcher`.
+
+::
+
+    svc = EvolutionService(max_batch=4)
+    s1 = svc.open_session(key1, pop1, toolbox, cxpb=0.6, mutpb=0.3)
+    s2 = svc.open_session(key2, pop2, toolbox)
+    futs = [s.step(10) for s in (s1, s2)]          # pipelined + microbatched
+    for f in futs[0]: f.result()
+    print(svc.stats())
+    svc.close()
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import Population, Fitness
+from ..algorithms import ea_step, ea_ask, ea_tell, _norm_eval
+from ..observability import events as _events
+from ..observability.sinks import emit_text
+from .buckets import (BucketPolicy, BucketKey, pad_rows, unpad_rows,
+                      pad_population, genome_signature)
+from .cache import FitnessCache, flatten_rows, row_digests, rep_indices
+from .dispatcher import (BatchDispatcher, Request, ServeFuture, ServeError,
+                         ServiceClosed)
+from .metrics import ServeMetrics
+
+__all__ = ["EvolutionService", "Session"]
+
+
+def _stack(trees):
+    """Stack a list of identically-shaped pytrees on a new leading axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def _slot(tree, i: int):
+    return jax.tree_util.tree_map(lambda x: x[i], tree)
+
+
+def _host(tree):
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def _as_raw_key(key) -> jax.Array:
+    """Canonical uint32 key form, so session keys and slot templates always
+    stack to one dtype (typed keys are unwrapped; the raw data drives the
+    same threefry stream)."""
+    key = jnp.asarray(key) if not isinstance(key, jax.Array) else key
+    if jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key):
+        return jax.random.key_data(key)
+    return key.astype(jnp.uint32)
+
+
+class Session:
+    """One live evolution run inside an :class:`EvolutionService`.
+
+    All methods are thread-safe and **asynchronous**: they enqueue a
+    request and return a :class:`~deap_tpu.serve.dispatcher.ServeFuture`
+    (``step(n)`` returns a list of ``n`` chained futures).  State advances
+    strictly in submission order; the service packs compatible requests
+    from *different* sessions into shared device batches."""
+
+    def __init__(self, service: "EvolutionService", name: str, toolbox,
+                 bucket: BucketKey, state: Dict[str, jax.Array],
+                 gen: int = 0, phase: str = "idle", pending=None):
+        self._service = service
+        self.name = name
+        self.toolbox = toolbox
+        self.bucket = bucket
+        self._state = state          # swapped atomically by the dispatcher
+        self._pending = pending      # offspring awaiting tell (phase=asked)
+        self.gen = int(gen)
+        self.phase = phase           # idle | asked
+        self.closed = False
+        # guards the phase check-and-transition (concurrent ask()/step()
+        # from two client threads must not both pass the guard); NEVER
+        # held across a submit — the dispatcher takes its own lock first
+        # on some failure paths, and the reverse order would deadlock
+        self._phase_lock = threading.Lock()
+
+    def _rollback_ask(self) -> None:
+        """Failure hook of an ask() that never executed (deadline miss,
+        cancellation, batch fault): the session returns to 'idle' so the
+        client can re-ask or step instead of being wedged."""
+        with self._phase_lock:
+            if self.phase == "asked" and self._pending is None:
+                self.phase = "idle"
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def pop_size(self) -> int:
+        return int(np.asarray(self._state["live_n"]))
+
+    @property
+    def weights(self) -> tuple:
+        return self.bucket.weights
+
+    def population(self) -> Population:
+        """Current (unpadded, host-materialized) population."""
+        st = self._state
+        n = int(np.asarray(st["live_n"]))
+        return Population(
+            genome=unpad_rows(st["genome"], n),
+            fitness=Fitness(values=st["values"][:n], valid=st["valid"][:n],
+                            weights=self.bucket.weights))
+
+    # -- request API ---------------------------------------------------------
+
+    def step(self, n: int = 1, deadline: Optional[float] = None,
+             block: bool = False) -> List[ServeFuture]:
+        """Advance ``n`` generations.  Returns the list of ``n``
+        per-generation futures (each resolves to ``{"gen", "nevals"}``) —
+        always a list, so call sites never branch on ``n``.  ``deadline``
+        is seconds from now; a generation not dispatched by then fails
+        (later ones still run on the state reached so far)."""
+        with self._phase_lock:
+            if self.phase != "idle":
+                raise ServeError(f"session {self.name!r} has an "
+                                 "outstanding ask(); tell() first")
+        return [self._service._submit(self, "step", {}, deadline, block)
+                for _ in range(int(n))]
+
+    def ask(self, deadline: Optional[float] = None) -> ServeFuture:
+        """Produce the next offspring batch (selection + variation, no
+        evaluation).  Resolves to the host genome rows awaiting external
+        evaluation; the session then expects :meth:`tell`.  An ask that
+        fails before executing (deadline, cancellation, fault) rolls the
+        session back to 'idle'."""
+        with self._phase_lock:
+            if self.phase != "idle":
+                raise ServeError(f"session {self.name!r} already asked")
+            self.phase = "asked"
+        try:
+            return self._service._submit(self, "ask", {}, deadline,
+                                         on_failure=self._rollback_ask)
+        except BaseException:
+            self._rollback_ask()
+            raise
+
+    def tell(self, values, deadline: Optional[float] = None) -> ServeFuture:
+        """Complete an :meth:`ask` with externally computed objective
+        ``values`` (``(pop, nobj)`` or ``(pop,)``, one row per live
+        individual); quarantine applies to the freshly assigned rows.
+        Resolves to ``{"gen", "nevals"}``."""
+        with self._phase_lock:
+            if self.phase != "asked":
+                raise ServeError(f"session {self.name!r} has no "
+                                 "outstanding ask()")
+        values = np.asarray(values)
+        if values.shape[0] != self.pop_size:
+            raise ValueError(
+                f"tell() got {values.shape[0]} fitness rows for a "
+                f"population of {self.pop_size}: every live individual "
+                "needs a value (zero-filling the gap would silently "
+                "assign fitness 0.0)")
+        return self._service._submit(self, "tell", {"values": values},
+                                     deadline)
+
+    def evaluate(self, genomes, deadline: Optional[float] = None
+                 ) -> ServeFuture:
+        """Fitness for an ad-hoc genome batch (same structure as the
+        session's genomes, any row count within the bucket policy), served
+        through the content-addressed cache.  Resolves to a host
+        ``(rows, nobj)`` array."""
+        return self._service._submit_evaluate(self, genomes, deadline)
+
+    def close(self) -> None:
+        """Detach from the service; queued requests fail at dispatch."""
+        self.closed = True
+        self._service._forget(self)
+
+
+class EvolutionService:
+    """Multi-tenant ask/tell evaluation service (see module docstring).
+
+    Parameters
+    ----------
+    policy:
+        Row :class:`~deap_tpu.serve.buckets.BucketPolicy` (default: powers
+        of two from 8).
+    max_batch:
+        Slot count of step/ask/tell microbatches — up to this many
+        sessions advance per dispatch.  Part of the compiled shape, so all
+        comparisons across services require equal ``max_batch``.
+    max_pending / batch_window:
+        Queue bound (backpressure) and optional linger seconds to fill a
+        partial batch.
+    cache_capacity / dedup_max_flat_dim:
+        Host fitness-cache entries; flat genome width beyond which the
+        device sort/unique dedup is skipped (a variadic lexsort keys per
+        column).
+    eval_retries / retry_backoff:
+        Transient-fault retry budget around every device dispatch
+        (:func:`deap_tpu.resilience.with_retries`).
+    sinks / stats_every:
+        Observability: emit a stats :class:`MetricRecord` to ``sinks``
+        every N batches (0 = never); compile events also go to the
+        in-trace event tap when one is open.
+    fault_hook:
+        Test seam: called as ``fault_hook(kind, requests)`` before every
+        batch execution (raise to inject an evaluation fault).
+    """
+
+    def __init__(self, *, policy: Optional[BucketPolicy] = None,
+                 max_batch: int = 4, max_pending: int = 256,
+                 batch_window: float = 0.0, cache_capacity: int = 4096,
+                 dedup_max_flat_dim: int = 512, eval_retries: int = 2,
+                 retry_backoff: float = 0.05, sinks: Sequence = (),
+                 stats_every: int = 0, verbose: bool = False,
+                 fault_hook=None, clock=time.monotonic):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.policy = policy if policy is not None else BucketPolicy()
+        self.max_batch = int(max_batch)
+        self.dedup_max_flat_dim = int(dedup_max_flat_dim)
+        self.sinks = list(sinks)
+        self.stats_every = int(stats_every)
+        self.verbose = bool(verbose)
+        self.metrics = ServeMetrics()
+        self.cache = FitnessCache(cache_capacity, metrics=self.metrics)
+        self._fault_hook = fault_hook
+        self._clock = clock
+        self._programs: Dict[tuple, Any] = {}
+        self._templates: Dict[tuple, Dict[str, jax.Array]] = {}
+        # id() pins keep toolboxes/evaluators alive (program keys use
+        # id(), which must not be recycled) — refcounted per session so a
+        # long-lived service releases dead tenants' objects AND their
+        # compiled programs instead of leaking them forever
+        self._refs: Dict[int, Any] = {}
+        self._refcounts: Dict[int, int] = {}
+        self._sessions: Dict[str, Session] = {}
+        self._names = 0
+        self._lock = threading.Lock()
+        self._closed = False
+        self._dispatcher = BatchDispatcher(
+            self._execute, max_pending=max_pending,
+            batch_window=batch_window, metrics=self.metrics,
+            retries=eval_retries, backoff=retry_backoff, clock=clock)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def close(self) -> None:
+        self._closed = True
+        self._dispatcher.close()
+
+    @contextlib.contextmanager
+    def quiesce(self):
+        """Pause dispatch (in-flight batch completes) — session states are
+        stable inside the context.  Queued requests resume after."""
+        self._dispatcher.pause()
+        try:
+            yield
+        finally:
+            self._dispatcher.resume()
+
+    def stats(self):
+        """Current :class:`~deap_tpu.observability.sinks.MetricRecord` —
+        counters (requests/compiles/cache/...) + gauges (queue depth,
+        occupancy, latency p50/p90/p99)."""
+        self.metrics.set_gauge("sessions", len(self._sessions))
+        return self.metrics.snapshot(self._dispatcher.batches)
+
+    # -- sessions ------------------------------------------------------------
+
+    def open_session(self, key, population: Population, toolbox, *,
+                     cxpb: float = 0.5, mutpb: float = 0.2,
+                     name: Optional[str] = None, evaluate_initial: bool = True,
+                     timeout: Optional[float] = 60.0) -> Session:
+        """Register a run and (synchronously, by default) evaluate its
+        initial population through the service.  ``population`` is the
+        UNPADDED initial population; the service pads it to its bucket."""
+        if self._closed:
+            raise ServiceClosed("service is closed")
+        bucket = self.policy.bucket_for(population)
+        with self._lock:
+            if name is None:
+                name = f"session-{self._names}"
+            self._names += 1
+            if name in self._sessions:
+                raise ValueError(f"session name {name!r} already open")
+        state = self._make_state(key, population, bucket, cxpb, mutpb)
+        session = Session(self, name, toolbox, bucket, state)
+        with self._lock:
+            self._sessions[name] = session
+            self._pin_locked(session)
+        if evaluate_initial:
+            self._submit(session, "init", {}).result(timeout=timeout)
+        return session
+
+    def sessions(self) -> Dict[str, Session]:
+        with self._lock:
+            return dict(self._sessions)
+
+    @staticmethod
+    def _session_pins(session: Session) -> list:
+        pins = [session.toolbox]
+        evaluate = getattr(session.toolbox, "evaluate", None)
+        if evaluate is not None:
+            pins.append(evaluate)
+        return pins
+
+    def _pin_locked(self, session: Session) -> None:
+        for obj in self._session_pins(session):
+            oid = id(obj)
+            self._refs[oid] = obj
+            self._refcounts[oid] = self._refcounts.get(oid, 0) + 1
+
+    def _forget(self, session: Session) -> None:
+        """Drop a closed session and, when its toolbox/evaluator pins hit
+        refcount zero, release the pinned objects plus every compiled
+        program and slot template keyed on them (bounded memory in a
+        long-lived multi-tenant service)."""
+        with self._lock:
+            if self._sessions.pop(session.name, None) is None:
+                return          # already forgotten: don't double-release
+            for obj in self._session_pins(session):
+                oid = id(obj)
+                left = self._refcounts.get(oid, 0) - 1
+                if left > 0:
+                    self._refcounts[oid] = left
+                    continue
+                self._refcounts.pop(oid, None)
+                self._refs.pop(oid, None)
+                self._programs = {k: v for k, v in self._programs.items()
+                                  if k[1][0] != oid}
+                self._templates = {k: v for k, v in self._templates.items()
+                                   if k[0] != oid}
+
+    def _make_state(self, key, population: Population, bucket: BucketKey,
+                    cxpb: float, mutpb: float) -> Dict[str, jax.Array]:
+        padded = pad_population(population, bucket.rows)
+        return {"key": _as_raw_key(key),
+                "genome": padded.genome,
+                "values": jnp.asarray(padded.fitness.values, jnp.float32),
+                "valid": padded.fitness.valid,
+                "live_n": jnp.asarray(population.size, jnp.int32),
+                "cxpb": jnp.asarray(cxpb, jnp.float32),
+                "mutpb": jnp.asarray(mutpb, jnp.float32)}
+
+    def _template_state(self, session: Session) -> Dict[str, jax.Array]:
+        """The deterministic empty-slot filler of this session's bucket:
+        zero rows, zero live count — stepped alongside real slots, its
+        results are discarded and (live_n == 0) it assigns nothing."""
+        pkey = (id(session.toolbox), session.bucket)
+        tmpl = self._templates.get(pkey)
+        if tmpl is None:
+            zeros = jax.tree_util.tree_map(jnp.zeros_like,
+                                           session._state["genome"])
+            tmpl = {"key": jnp.zeros((2,), jnp.uint32),
+                    "genome": zeros,
+                    "values": jnp.zeros_like(session._state["values"]),
+                    "valid": jnp.zeros_like(session._state["valid"]),
+                    "live_n": jnp.asarray(0, jnp.int32),
+                    "cxpb": jnp.asarray(0.0, jnp.float32),
+                    "mutpb": jnp.asarray(0.0, jnp.float32)}
+            self._templates[pkey] = tmpl
+        return tmpl
+
+    # -- request submission --------------------------------------------------
+
+    def _deadline_at(self, deadline: Optional[float]) -> Optional[float]:
+        return None if deadline is None else self._clock() + float(deadline)
+
+    def _submit(self, session: Session, kind: str, payload: dict,
+                deadline: Optional[float] = None, block: bool = False,
+                on_failure=None) -> ServeFuture:
+        if session.closed:
+            raise ServiceClosed(f"session {session.name!r} is closed")
+        req = Request(kind=kind,
+                      program_key=(id(session.toolbox), session.bucket),
+                      payload=payload, session=session, weight=1,
+                      capacity=self.max_batch,
+                      deadline=self._deadline_at(deadline))
+        if on_failure is not None:
+            req.future._on_failure = on_failure
+        return self._dispatcher.submit(req, block=block)
+
+    def _submit_evaluate(self, session: Session, genomes,
+                         deadline: Optional[float] = None) -> ServeFuture:
+        genomes = jax.tree_util.tree_map(jnp.asarray, genomes)
+        sig = genome_signature(genomes)
+        n = jax.tree_util.tree_leaves(genomes)[0].shape[0]
+        rows = self.policy.rows_for(n)
+        evaluate = session.toolbox.evaluate
+        # normally pinned at open_session; setdefault covers an evaluator
+        # registered on the toolbox after the session opened
+        self._refs.setdefault(id(evaluate), evaluate)
+        nobj = session.bucket.nobj
+        req = Request(kind="evaluate",
+                      program_key=(id(evaluate), sig, rows, nobj),
+                      payload={"genome": genomes, "n": n},
+                      session=session, weight=n, capacity=rows,
+                      deadline=self._deadline_at(deadline))
+        return self._dispatcher.submit(req)
+
+    # -- compiled-program cache ----------------------------------------------
+
+    def _program(self, kind: str, program_key: tuple, build, args):
+        """AOT-compile on first use; every later dispatch reuses the
+        executable, so the ``compiles`` counters count real XLA
+        compilations exactly (a shape drift raises instead of silently
+        recompiling)."""
+        key = (kind, program_key)
+        compiled = self._programs.get(key)
+        if compiled is None:
+            compiled = jax.jit(build()).lower(*args).compile()
+            self._programs[key] = compiled
+            self.metrics.inc("compiles")
+            self.metrics.inc(f"compiles_{kind}")
+            if _events.active():     # in-trace telemetry tap, if one is open
+                _events.emit("serve_compiles", 1)
+            if self.verbose:
+                emit_text(f"[serve] compiled {kind} program "
+                          f"#{self.metrics.counter('compiles')}", self.sinks)
+        return compiled
+
+    # -- program builders (one per request kind) -----------------------------
+
+    def _build_slot_program(self, kind: str, toolbox, weights: tuple):
+        def as_population(state):
+            return Population(state["genome"],
+                              Fitness(values=state["values"],
+                                      valid=state["valid"], weights=weights))
+
+        def live_of(state):
+            return jnp.arange(state["valid"].shape[0]) < state["live_n"]
+
+        def pack(state, pop):
+            return {**state, "genome": pop.genome,
+                    "values": pop.fitness.values, "valid": pop.fitness.valid}
+
+        if kind == "step":
+            def one(state):
+                key, pop, nevals = ea_step(
+                    state["key"], as_population(state), toolbox,
+                    state["cxpb"], state["mutpb"], live=live_of(state))
+                return {**pack(state, pop), "key": key}, nevals
+            return jax.vmap(one)
+        if kind == "init":
+            def one(state):
+                pop, nevals = ea_tell(toolbox, as_population(state),
+                                      live=live_of(state))
+                return pack(state, pop), nevals
+            return jax.vmap(one)
+        if kind == "ask":
+            def one(state):
+                key, off = ea_ask(state["key"], as_population(state),
+                                  toolbox, state["cxpb"], state["mutpb"],
+                                  live=live_of(state))
+                return ({**state, "key": key}, off.genome,
+                        off.fitness.values, off.fitness.valid)
+            return jax.vmap(one)
+        if kind == "tell":
+            def one(state, pending, values):
+                pg, pv, pvalid = pending
+                pop, nevals = ea_tell(
+                    toolbox, Population(pg, Fitness(pv, pvalid, weights)),
+                    values, live=live_of(state))
+                return pack(state, pop), nevals
+            return jax.vmap(one)
+        raise ValueError(f"unknown slot program kind {kind!r}")
+
+    def _build_evaluate_program(self, evaluate, flat_dim: int):
+        dedup = flat_dim <= self.dedup_max_flat_dim
+
+        def prog(genome):
+            values = jax.vmap(_norm_eval(evaluate))(genome)
+            if dedup:
+                rep, _ = rep_indices(flatten_rows(genome))
+                values = values[rep]
+            return values
+        return prog
+
+    # -- executors (dispatcher worker thread) --------------------------------
+
+    def _execute(self, kind: str, program_key: tuple,
+                 requests: List[Request]) -> list:
+        if self._fault_hook is not None:
+            self._fault_hook(kind, requests)
+        if kind == "evaluate":
+            return self._exec_evaluate(program_key, requests)
+        return self._exec_slots(kind, program_key, requests)
+
+    def _exec_slots(self, kind: str, program_key: tuple,
+                    requests: List[Request]) -> list:
+        sessions = [r.session for r in requests]
+        tmpl = self._template_state(sessions[0])
+        states = [s._state for s in sessions]
+        states += [tmpl] * (self.max_batch - len(states))
+        stacked = _stack(states)
+        toolbox = sessions[0].toolbox
+        weights = sessions[0].bucket.weights
+        build = lambda: self._build_slot_program(kind, toolbox, weights)  # noqa: E731
+
+        if kind == "tell":
+            for s in sessions:
+                if s._pending is None:
+                    raise ServeError(
+                        f"session {s.name!r} has no pending offspring (its "
+                        "ask() may have failed) — re-ask before telling")
+            pend = [s._pending for s in sessions]
+            pend += [self._empty_pending(tmpl)] * \
+                (self.max_batch - len(sessions))
+            rows, nobj = sessions[0].bucket.rows, sessions[0].bucket.nobj
+            vals = [self._pad_values(r.payload["values"], rows, nobj)
+                    for r in requests]
+            vals += [jnp.zeros((rows, nobj), jnp.float32)] * \
+                (self.max_batch - len(requests))
+            args = (stacked, _stack(pend), jnp.stack(vals))
+        else:
+            args = (stacked,)
+
+        compiled = self._program(kind, program_key, build, args)
+        out = compiled(*args)
+
+        self.metrics.set_gauge("slot_occupancy",
+                               len(requests) / self.max_batch)
+        results = []
+        if kind == "ask":
+            new_states, off_g, off_v, off_valid = out
+            for i, (r, s) in enumerate(zip(requests, sessions)):
+                s._state = _slot(new_states, i)
+                s._pending = (_slot(off_g, i), off_v[i], off_valid[i])
+                n = s.pop_size
+                results.append(_host(unpad_rows(_slot(off_g, i), n)))
+        else:
+            new_states, nevals = out
+            nevals = np.asarray(nevals)
+            for i, (r, s) in enumerate(zip(requests, sessions)):
+                s._state = _slot(new_states, i)
+                if kind == "step":
+                    s.gen += 1
+                    self.metrics.inc("steps")
+                elif kind == "tell":
+                    with s._phase_lock:
+                        s._pending = None
+                        s.phase = "idle"
+                    s.gen += 1
+                results.append({"gen": s.gen, "nevals": int(nevals[i])})
+        self._maybe_emit_stats()
+        return results
+
+    @staticmethod
+    def _empty_pending(tmpl):
+        return (tmpl["genome"], tmpl["values"], tmpl["valid"])
+
+    @staticmethod
+    def _pad_values(values, rows: int, nobj: int) -> jax.Array:
+        values = jnp.asarray(values, jnp.float32)
+        if values.ndim == 1:
+            values = values[:, None]
+        return pad_rows(values, rows)
+
+    def _exec_evaluate(self, program_key: tuple,
+                       requests: List[Request]) -> list:
+        evaluate_id, sig, rows, nobj = program_key
+        evaluate = self._refs[evaluate_id]
+        genomes = [r.payload["genome"] for r in requests]
+        counts = [r.payload["n"] for r in requests]
+        total = sum(counts)
+        merged = jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *genomes)
+        padded = pad_rows(merged, rows)
+
+        flat = np.asarray(flatten_rows(merged))
+        digests = row_digests(flat)
+        namespace = (evaluate_id, sig, nobj)
+        hits = self.cache.lookup(namespace, digests)
+        self.metrics.inc("dedup_rows", total - len(set(digests)))
+        self.metrics.set_gauge("row_occupancy", total / rows)
+
+        if all(h is not None for h in hits):
+            values = np.stack(hits).astype(np.float32)
+        else:
+            flat_dim = flat.shape[1]
+            build = lambda: self._build_evaluate_program(  # noqa: E731
+                evaluate, flat_dim)
+            compiled = self._program("evaluate", program_key, build,
+                                     (padded,))
+            # np.array (not asarray): device outputs view as read-only, and
+            # cached rows are spliced over this buffer below
+            values = np.array(compiled(padded))[:total]
+            if values.ndim == 1:
+                values = values[:, None]
+            miss = [i for i, h in enumerate(hits) if h is None]
+            self.cache.insert(namespace, [digests[i] for i in miss],
+                              values[miss])
+            for i, h in enumerate(hits):
+                if h is not None:
+                    values[i] = h
+        self.metrics.inc("evaluations", total)
+
+        results, off = [], 0
+        for n in counts:
+            results.append(np.array(values[off:off + n]))
+            off += n
+        self._maybe_emit_stats()
+        return results
+
+    def _maybe_emit_stats(self) -> None:
+        if (self.stats_every and self.sinks
+                and self._dispatcher.batches % self.stats_every == 0):
+            self.metrics.emit(self.sinks, self._dispatcher.batches)
+
+    # -- checkpoint / restore ------------------------------------------------
+
+    def snapshot_sessions(self) -> Dict[str, dict]:
+        """Host-side snapshot of every live session (unpadded state +
+        run metadata) — the payload
+        :func:`deap_tpu.resilience.save_session_states` persists."""
+        out: Dict[str, dict] = {}
+        with self.quiesce():
+            for name, s in self.sessions().items():
+                st = s._state
+                n = int(np.asarray(st["live_n"]))
+                snap = {"gen": s.gen, "phase": s.phase, "n": n,
+                        "weights": s.bucket.weights,
+                        "key": np.asarray(st["key"]),
+                        "genome": _host(unpad_rows(st["genome"], n)),
+                        "values": np.asarray(st["values"][:n]),
+                        "valid": np.asarray(st["valid"][:n]),
+                        "cxpb": float(np.asarray(st["cxpb"])),
+                        "mutpb": float(np.asarray(st["mutpb"]))}
+                if s._pending is not None:
+                    pg, pv, pvalid = s._pending
+                    snap["pending"] = {"genome": _host(unpad_rows(pg, n)),
+                                       "values": np.asarray(pv[:n]),
+                                       "valid": np.asarray(pvalid[:n])}
+                out[name] = snap
+        return out
+
+    def checkpoint(self, path, **io_kwargs) -> None:
+        """Persist every live session through the resilient checkpoint
+        tier (see :func:`deap_tpu.resilience.save_session_states`)."""
+        from ..resilience.runner import save_session_states
+        save_session_states(path, self.snapshot_sessions(), **io_kwargs)
+
+    def restore_sessions(self, path, toolboxes: Dict[str, Any],
+                         **io_kwargs) -> Dict[str, Session]:
+        """Re-open the sessions checkpointed at ``path``.  ``toolboxes``
+        maps session name → toolbox (functions are not persisted); only
+        named sessions are restored.  Bucketing re-applies the CURRENT
+        policy, so restore works across policy changes."""
+        from ..resilience.runner import load_session_states
+        snaps = load_session_states(path, **io_kwargs)
+        out: Dict[str, Session] = {}
+        for name, toolbox in toolboxes.items():
+            snap = snaps[name]
+            pop = Population(
+                genome=snap["genome"],
+                fitness=Fitness(values=jnp.asarray(snap["values"]),
+                                valid=jnp.asarray(snap["valid"]),
+                                weights=tuple(snap["weights"])))
+            bucket = self.policy.bucket_for(pop)
+            with self._lock:
+                if name in self._sessions:
+                    raise ValueError(f"session name {name!r} already open")
+            state = self._make_state(jnp.asarray(snap["key"]), pop, bucket,
+                                     snap["cxpb"], snap["mutpb"])
+            pending = None
+            if "pending" in snap:
+                p = snap["pending"]
+                pending = (pad_rows(jax.tree_util.tree_map(
+                               jnp.asarray, p["genome"]), bucket.rows),
+                           pad_rows(jnp.asarray(p["values"]), bucket.rows),
+                           pad_rows(jnp.asarray(p["valid"]), bucket.rows))
+            session = Session(self, name, toolbox, bucket, state,
+                              gen=int(snap["gen"]), phase=snap["phase"],
+                              pending=pending)
+            with self._lock:
+                self._sessions[name] = session
+                self._pin_locked(session)
+            out[name] = session
+        return out

@@ -1,0 +1,66 @@
+"""Opt-in persistent XLA compilation cache.
+
+Cold-start compiles dominate short runs and service restarts: the flagship
+``bench.py`` program compiles in seconds-to-minutes depending on backend,
+and a restarted :class:`~deap_tpu.serve.service.EvolutionService` pays one
+compile per bucket before reaching steady state.  JAX can persist compiled
+executables to disk and reload them across *processes* — this module is
+the one switch that turns it on with sane settings:
+
+    from deap_tpu.utils.compilecache import enable_compile_cache
+    enable_compile_cache("~/.cache/deap_tpu_xla")
+
+Entry points wire it to flags/environment: ``bench.py`` honors
+``DEAP_TPU_COMPILE_CACHE=<dir>`` and ``deap-tpu-serve`` takes
+``--compile-cache <dir>`` (see docs/performance.md).  Off by default —
+the cache trades disk for startup latency and is a deployment decision.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from pathlib import Path
+from typing import Optional
+
+__all__ = ["enable_compile_cache", "cache_dir_from_env", "ENV_VAR"]
+
+#: Environment variable the entry points honor.
+ENV_VAR = "DEAP_TPU_COMPILE_CACHE"
+
+
+def cache_dir_from_env() -> Optional[str]:
+    """The opt-in directory from ``DEAP_TPU_COMPILE_CACHE`` (None = off)."""
+    path = os.environ.get(ENV_VAR, "").strip()
+    return path or None
+
+
+def enable_compile_cache(path, *, min_compile_time_secs: float = 0.0,
+                         min_entry_size_bytes: int = 0) -> Optional[Path]:
+    """Persist XLA compilations under ``path`` (created if missing) and
+    reuse them across processes.
+
+    By default every compilation is cached (``min_compile_time_secs=0`` /
+    ``min_entry_size_bytes=0``) — the serving layer's bucket programs are
+    individually cheap but numerous, which is exactly the cold-start cost
+    the cache exists to amortize.  Returns the resolved cache directory,
+    or ``None`` (with a warning) when this jax build has no persistent
+    cache support — callers never have to gate on jax versions."""
+    path = Path(path).expanduser()
+    try:
+        path.mkdir(parents=True, exist_ok=True)
+    except OSError as e:
+        warnings.warn(f"compile cache disabled: cannot create {path}: {e}")
+        return None
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(path))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_secs))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          int(min_entry_size_bytes))
+    except (AttributeError, ValueError) as e:
+        warnings.warn(f"compile cache disabled: this jax build does not "
+                      f"support the persistent compilation cache ({e})")
+        return None
+    return path

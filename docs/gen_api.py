@@ -69,8 +69,13 @@ PAGES = {
                        "deap_tpu.observability.telemetry",
                        "deap_tpu.observability.sinks",
                        "deap_tpu.observability.tracing"]),
+    "serve": ("Serving layer (deap_tpu.serve)",
+              ["deap_tpu.serve.service", "deap_tpu.serve.dispatcher",
+               "deap_tpu.serve.buckets", "deap_tpu.serve.cache",
+               "deap_tpu.serve.metrics"]),
     "support": ("Observability & persistence (deap_tpu.utils)",
-                ["deap_tpu.utils.support", "deap_tpu.utils.checkpoint"]),
+                ["deap_tpu.utils.support", "deap_tpu.utils.checkpoint",
+                 "deap_tpu.utils.compilecache"]),
     "benchmarks": ("Problem library (deap_tpu.benchmarks)",
                    ["deap_tpu.benchmarks", "deap_tpu.benchmarks.binary",
                     "deap_tpu.benchmarks.gp",

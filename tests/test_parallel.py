@@ -79,6 +79,49 @@ def test_tpu_map_requires_batch():
         tpu_map(lambda g: g)
 
 
+# ---------------------------------------------------------------------------
+# pad-to-multiple + mask semantics: population sizes not divisible by the
+# mesh size must work by EXPLICIT padding, not by hoping for XLA defaults
+# ---------------------------------------------------------------------------
+
+
+def test_tpu_map_non_divisible_population_pads_and_matches_serial():
+    """pop=100 over 8 devices: default pad=True pads to 104, maps, slices
+    back — results equal the serial map, shape equals the true pop."""
+    key = jax.random.PRNGKey(3)
+    genomes = jax.random.uniform(key, (100, 8))
+    f = lambda g: jnp.sum(g * g - 10 * jnp.cos(2 * jnp.pi * g) + 10)
+    expected = jnp.stack([f(g) for g in genomes])
+    got = tpu_map(f, genomes, mesh=default_mesh())
+    assert got.shape == (100,)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-6)
+
+
+def test_tpu_map_pad_false_restores_strict_error():
+    with pytest.raises(ValueError):
+        tpu_map(lambda g: jnp.sum(g), jnp.ones((100, 4)),
+                mesh=default_mesh(), pad=False)
+
+
+def test_tpu_map_explicit_int_pad_without_mesh():
+    """An int pad (a serving row bucket) applies even unsharded, and pad
+    rows never leak into the result."""
+    got = tpu_map(lambda g: jnp.sum(g) + 1.0, jnp.ones((5, 3)), pad=16)
+    assert got.shape == (5,)
+    np.testing.assert_allclose(np.asarray(got), 4.0)
+
+
+def test_pad_to_multiple_helper():
+    from deap_tpu.parallel import pad_to_multiple
+    padded, n = pad_to_multiple({"g": jnp.ones((10, 2))}, 8)
+    assert n == 10 and padded["g"].shape == (16, 2)
+    # appended rows carry the fill value (mask semantics: caller discards)
+    np.testing.assert_array_equal(np.asarray(padded["g"][10:]), 0.0)
+    same, n2 = pad_to_multiple(jnp.ones((16, 2)), 8)
+    assert n2 == 16 and same.shape == (16, 2)
+
+
 def test_shard_population_placement_and_equality():
     key = jax.random.PRNGKey(1)
     pop = onemax_pop(key, 128)

@@ -1,0 +1,500 @@
+"""Serving-layer tests: the multi-tenant ask/tell ``EvolutionService``.
+
+The load-bearing assertions (ISSUE 3 acceptance criteria):
+
+* ≥ 4 concurrent sessions with mixed (pop, dim) shapes through ONE service
+  produce results **bitwise identical** to serving each session standalone;
+* steady-state compile count equals the number of shape buckets — no
+  per-request recompiles (the service AOT-compiles, so its ``compiles*``
+  counters are exact);
+* the content-addressed fitness cache reports a hit-rate > 0 under
+  duplicate genomes, identical genomes return bitwise-identical fitness
+  across sessions, and quarantined (NaN) evaluations are never cached.
+
+Everything runs on the 8-virtual-device CPU platform from ``conftest.py``;
+heavyweight multi-session soaks sit behind the ``slow`` marker.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deap_tpu import base
+from deap_tpu.ops import crossover, mutation, selection
+from deap_tpu.resilience import Quarantine
+from deap_tpu.serve import (EvolutionService, BucketPolicy, BucketOverflow,
+                            FitnessCache, ServeError, ServiceOverloaded,
+                            DeadlineExceeded, RequestCancelled,
+                            ServiceClosed, rep_indices, row_digests)
+from deap_tpu.serve.metrics import ServeMetrics
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _persistent_xla_cache(tmp_path_factory):
+    """Dogfood deap_tpu.utils.compilecache for the whole module: services
+    in different tests compile structurally identical bucket programs
+    (standalone-vs-multiplexed comparisons, checkpoint restores), and the
+    persistent cache collapses every repeat XLA compilation to a disk
+    hit — the same cold-start amortization a restarted service gets."""
+    from deap_tpu.utils.compilecache import enable_compile_cache
+    enable_compile_cache(tmp_path_factory.mktemp("xla_cache"))
+
+
+def onemax_toolbox():
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: (jnp.sum(g),))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    return tb
+
+
+def onemax_pop(key, n, nbits):
+    g = jax.random.bernoulli(key, 0.5, (n, nbits)).astype(jnp.float32)
+    return base.Population(genome=g, fitness=base.Fitness.empty(n, (1.0,)))
+
+
+#: mixed (pop, dim) fleet — two shape buckets under the default policy:
+#: 40→64 and 48→64 share (64, 8); 100→128 and 90→128 share (128, 12)
+FLEET = [(40, 8), (100, 12), (48, 8), (90, 12)]
+N_BUCKETS = 2
+
+
+def _final(session):
+    p = session.population()
+    return (np.asarray(p.genome), np.asarray(p.fitness.values),
+            np.asarray(p.fitness.valid))
+
+
+def _drive(service, tb, shapes, ngen, max_batch=4):
+    keys = jax.random.split(jax.random.PRNGKey(42), len(shapes))
+    sessions = [service.open_session(k, onemax_pop(k, n, d), tb,
+                                     cxpb=0.6, mutpb=0.3)
+                for k, (n, d) in zip(keys, shapes)]
+    futures = [s.step(ngen) for s in sessions]
+    for fs in futures:
+        for f in fs:
+            f.result(timeout=120)
+    return sessions
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance test: concurrency, bitwise identity, compile stability,
+# cache hit rate — one service, mixed shapes
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_sessions_bitwise_compiles_and_cache():
+    tb = onemax_toolbox()
+    ngen = 6
+    with EvolutionService(max_batch=4) as svc:
+        sessions = _drive(svc, tb, FLEET, ngen)
+
+        # (b) steady state reached: compile count == bucket count, and it
+        # must NOT grow when more requests of the same shapes arrive
+        steady = svc.stats().counters
+        assert steady["compiles_step"] == N_BUCKETS, steady
+        assert steady["compiles_init"] == N_BUCKETS, steady
+        for s in sessions:
+            for f in s.step(2):
+                f.result(timeout=120)
+        again = svc.stats().counters
+        assert again["compiles_step"] == N_BUCKETS, (
+            "per-request recompile detected")
+        assert again["compiles"] == steady["compiles"]
+        assert again["steps"] == len(FLEET) * (ngen + 2)
+        multiplexed = [_final(s) for s in sessions]
+
+        # (c) duplicate genomes across sessions hit the fitness cache with
+        # bitwise-identical values
+        probe = jax.random.bernoulli(jax.random.PRNGKey(9), 0.5,
+                                     (10, 8)).astype(jnp.float32)
+        v_first = sessions[0].evaluate(probe).result(timeout=60)
+        v_dup = sessions[2].evaluate(probe).result(timeout=60)  # same dim=8
+        assert np.array_equal(v_first, v_dup)
+        assert svc.stats().counters["cache_hits"] >= 10
+        assert svc.cache.hit_rate() > 0
+
+    # (a) bitwise identity: each session served ALONE (fresh service, same
+    # policy/max_batch, strictly sequential — a session's run completes
+    # before the next opens, so nothing is ever co-batched) must reproduce
+    # the multiplexed results exactly
+    with EvolutionService(max_batch=4) as alone:
+        for i, (n, d) in enumerate(FLEET):
+            key = jax.random.split(jax.random.PRNGKey(42), len(FLEET))[i]
+            s = alone.open_session(key, onemax_pop(key, n, d), tb,
+                                   cxpb=0.6, mutpb=0.3)
+            for f in s.step(ngen + 2):
+                f.result(timeout=120)
+            for got, want in zip(_final(s), multiplexed[i]):
+                np.testing.assert_array_equal(got, want)
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# bucketing policy
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_policy_rows():
+    p = BucketPolicy()
+    assert [p.rows_for(n) for n in (1, 8, 9, 100, 128)] == [8, 8, 16, 128,
+                                                           128]
+    p2 = BucketPolicy(sizes=(32, 256))
+    assert p2.rows_for(33) == 256
+    with pytest.raises(BucketOverflow):
+        p2.rows_for(257)
+    with pytest.raises(BucketOverflow):
+        BucketPolicy(max_rows=64).rows_for(100)
+
+
+def test_distinct_dims_distinct_buckets():
+    p = BucketPolicy()
+    a = p.bucket_for(onemax_pop(jax.random.PRNGKey(0), 40, 8))
+    b = p.bucket_for(onemax_pop(jax.random.PRNGKey(0), 40, 9))
+    c = p.bucket_for(onemax_pop(jax.random.PRNGKey(1), 48, 8))
+    assert a != b            # dim is never padded: different program
+    assert a == c            # same bucket rows + structure: shared program
+
+
+# ---------------------------------------------------------------------------
+# cache tiers
+# ---------------------------------------------------------------------------
+
+
+def test_rep_indices_groups_identical_rows():
+    rows = jnp.asarray([[1.0, 2.0], [3.0, 4.0], [1.0, 2.0], [5.0, 6.0],
+                        [3.0, 4.0], [1.0, 2.0]], jnp.float32)
+    rep, nuniq = jax.jit(rep_indices)(rows)
+    rep = np.asarray(rep)
+    assert int(nuniq) == 3
+    assert rep[2] == rep[0] and rep[4] == rep[1] and rep[5] == rep[0]
+    assert rep[0] == 0 and rep[1] == 1 and rep[3] == 3
+
+
+def test_cache_lru_eviction_and_nan_policy():
+    m = ServeMetrics()
+    cache = FitnessCache(capacity=2, metrics=m)
+    rows = np.arange(12, dtype=np.float32).reshape(4, 3)
+    digs = row_digests(rows)
+    values = np.asarray([[1.0], [2.0], [np.nan], [4.0]], np.float32)
+    assert cache.insert("ns", digs, values) == 3   # 3 finite rows in
+    assert len(cache) == 2                         # capacity bound held
+    assert m.counter("cache_nan_skipped") == 1
+    assert m.counter("cache_evictions") == 1       # first entry evicted
+    assert not cache.contains("ns", digs[2]), "NaN row must never be cached"
+    hits = cache.lookup("ns", digs)
+    assert hits[2] is None
+    assert [h is not None for h in hits].count(True) == 2
+
+
+def test_nan_evaluations_never_cached_end_to_end():
+    """A NaN-producing evaluator's rows are returned raw but never enter
+    the cache: re-evaluating the same genomes misses again, while finite
+    duplicate rows hit."""
+    tb = base.Toolbox()
+    tb.register("evaluate",
+                lambda g: (jnp.where(g[0] > 0.5, jnp.nan, jnp.sum(g)),))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    with EvolutionService(max_batch=2) as svc:
+        key = jax.random.PRNGKey(5)
+        s = svc.open_session(key, onemax_pop(key, 12, 6), tb)
+        batch = jnp.concatenate([jnp.full((2, 6), 0.9, jnp.float32),
+                                 jnp.full((2, 6), 0.1, jnp.float32)])
+        v1 = np.asarray(s.evaluate(batch).result(timeout=60)).ravel()
+        assert np.isnan(v1[:2]).all() and np.isfinite(v1[2:]).all()
+        before = svc.stats().counters
+        v2 = np.asarray(s.evaluate(batch).result(timeout=60)).ravel()
+        after = svc.stats().counters
+        assert np.array_equal(v1[2:], v2[2:])
+        # the 2 finite rows dedup to 1 digest -> hit; both NaN rows miss
+        assert after["cache_hits"] > before["cache_hits"]
+        assert after["cache_misses"] > before["cache_misses"]
+        assert after["cache_nan_skipped"] > 0
+
+
+# ---------------------------------------------------------------------------
+# quarantine on the internal step path
+# ---------------------------------------------------------------------------
+
+
+def test_step_path_quarantines_nan_fitness():
+    """An evaluator that intermittently NaNs must not poison session
+    state: with Quarantine('penalize') every stored fitness stays finite
+    and the run completes."""
+    tb = base.Toolbox()
+    tb.register("evaluate",
+                lambda g: (jnp.where(jnp.sum(g) > 4.0, jnp.nan,
+                                     jnp.sum(g)),))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.1)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    tb.quarantine = Quarantine("penalize")
+    with EvolutionService(max_batch=2) as svc:
+        key = jax.random.PRNGKey(11)
+        s = svc.open_session(key, onemax_pop(key, 24, 8), tb,
+                             cxpb=0.6, mutpb=0.4)
+        for f in s.step(5):
+            f.result(timeout=60)
+        p = s.population()
+        assert np.isfinite(np.asarray(p.fitness.values)).all()
+        assert bool(np.asarray(p.fitness.valid).all())
+
+
+# ---------------------------------------------------------------------------
+# admission control: deadlines, backpressure, cancellation, retries
+# ---------------------------------------------------------------------------
+
+
+def test_admission_control_deadline_backpressure_cancel():
+    """One service exercises all three edge behaviors: an expired deadline
+    fails the request (not the service), a full bounded queue rejects with
+    ServiceOverloaded, and cancellation wins any pre-dispatch race while
+    never advancing session state."""
+    tb = onemax_toolbox()
+    with EvolutionService(max_batch=2, max_pending=1) as svc:
+        key = jax.random.PRNGKey(1)
+        s = svc.open_session(key, onemax_pop(key, 16, 6), tb)
+
+        # deadline: expired before dispatch → DeadlineExceeded, no state
+        svc._dispatcher.pause()
+        [fut] = s.step(deadline=0.0)
+        svc._dispatcher.resume()
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=30)
+        assert svc.stats().counters["deadline_misses"] == 1
+        assert s.step()[0].result(timeout=60)["gen"] == 1  # still serving
+
+        # backpressure: max_pending=1 → second queued request is shed
+        svc._dispatcher.pause()
+        [first] = s.step()
+        with pytest.raises(ServiceOverloaded):
+            s.step()
+        assert svc.stats().counters["rejected"] == 1
+        svc._dispatcher.resume()
+        assert first.result(timeout=60)["gen"] == 2
+
+        # cancel: a queued request never executes and never advances state
+        svc._dispatcher.pause()
+        [fut] = s.step()
+        assert fut.cancel()
+        svc._dispatcher.resume()
+        with pytest.raises(RequestCancelled):
+            fut.result(timeout=30)
+        done = s.step()[0].result(timeout=60)
+        assert done["gen"] == 3, "cancelled step must not have advanced state"
+        assert not fut.cancel()           # an already-resolved future can't
+
+
+def test_transient_eval_faults_retry_through_with_retries():
+    """A transient fault during batch execution retries with backoff
+    (resilience.with_retries) and the request still succeeds; a
+    non-transient class propagates to the request."""
+    tb = onemax_toolbox()
+    boom = {"left": 2}
+
+    def flaky(kind, requests):
+        if kind == "step" and boom["left"]:
+            boom["left"] -= 1
+            raise OSError("transient device flake")
+
+    with EvolutionService(max_batch=2, eval_retries=3,
+                          retry_backoff=0.0, fault_hook=flaky) as svc:
+        key = jax.random.PRNGKey(4)
+        s = svc.open_session(key, onemax_pop(key, 16, 6), tb)
+        assert s.step()[0].result(timeout=60)["gen"] == 1
+        assert svc.stats().counters["retries"] == 2
+
+    def fatal(kind, requests):
+        if kind == "step":
+            raise ValueError("a bug, not a flake")
+
+    with EvolutionService(max_batch=2, fault_hook=fatal) as svc:
+        key = jax.random.PRNGKey(4)
+        s = svc.open_session(key, onemax_pop(key, 16, 6), tb)
+        with pytest.raises(ValueError):
+            s.step()[0].result(timeout=60)
+        assert svc.stats().counters["failed"] == 1
+
+
+def test_closed_session_and_closed_service():
+    tb = onemax_toolbox()
+    svc = EvolutionService(max_batch=2)
+    key = jax.random.PRNGKey(6)
+    s = svc.open_session(key, onemax_pop(key, 16, 6), tb)
+    s.close()
+    with pytest.raises(ServiceClosed):
+        s.step()
+    svc.close()
+    with pytest.raises(ServiceClosed):
+        svc.open_session(key, onemax_pop(key, 16, 6), tb)
+
+
+# ---------------------------------------------------------------------------
+# ask / tell protocol
+# ---------------------------------------------------------------------------
+
+
+def test_ask_tell_matches_internal_step_bitwise():
+    """Two sessions from the same key: one advanced by step(), one by
+    ask() + externally computed OneMax values + tell().  Trajectories
+    must agree bitwise (OneMax sums are exact in f32)."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(7)
+    pop = onemax_pop(key, 20, 10)
+    with EvolutionService(max_batch=2) as svc:
+        s_int = svc.open_session(key, pop, tb, cxpb=0.6, mutpb=0.3,
+                                 name="internal")
+        s_ext = svc.open_session(key, pop, tb, cxpb=0.6, mutpb=0.3,
+                                 name="external")
+        for _ in range(3):
+            s_int.step()[0].result(timeout=60)
+            off = s_ext.ask().result(timeout=60)
+            values = np.asarray(off).sum(axis=1)
+            s_ext.tell(values).result(timeout=60)
+        for got, want in zip(_final(s_ext), _final(s_int)):
+            np.testing.assert_array_equal(got, want)
+
+
+def test_ask_tell_state_machine():
+    tb = onemax_toolbox()
+    with EvolutionService(max_batch=2) as svc:
+        key = jax.random.PRNGKey(8)
+        s = svc.open_session(key, onemax_pop(key, 16, 6), tb)
+        with pytest.raises(ServeError):
+            s.tell(np.zeros(16))          # no outstanding ask
+        s.ask().result(timeout=60)
+        with pytest.raises(ServeError):
+            s.step()                      # mid-ask step is rejected
+        with pytest.raises(ServeError):
+            s.ask()                       # double-ask too
+        s.tell(np.zeros(16)).result(timeout=60)
+        assert s.phase == "idle"
+
+        # wrong-arity tell: zero-filling the gap would silently assign
+        # fitness 0.0, so it must raise instead
+        s.ask().result(timeout=60)
+        with pytest.raises(ValueError):
+            s.tell(np.zeros(10))
+        s.tell(np.zeros(16)).result(timeout=60)
+
+        # an ask that fails before dispatch (expired deadline) rolls the
+        # session back to idle instead of wedging it
+        svc._dispatcher.pause()
+        fut = s.ask(deadline=0.0)
+        svc._dispatcher.resume()
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=30)
+        assert s.phase == "idle"
+        assert s.step()[0].result(timeout=60)["gen"] == 3
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / restore of live sessions (resilience tier)
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_restore_sessions_bitwise(tmp_path):
+    tb = onemax_toolbox()
+    ckpt = tmp_path / "serve.ckpt"
+    keys = jax.random.split(jax.random.PRNGKey(12), 2)
+    # FLEET shapes + max_batch=4: the bucket programs here are
+    # structurally identical to the acceptance test's, so the persistent
+    # compile cache serves them from disk
+    shapes = [(40, 8), (100, 12)]
+
+    def fleet(svc):
+        return [svc.open_session(k, onemax_pop(k, n, d), tb, cxpb=0.6,
+                                 mutpb=0.3, name=f"run-{i}")
+                for i, (k, (n, d)) in enumerate(zip(keys, shapes))]
+
+    # uninterrupted reference: 4 + 4 generations
+    with EvolutionService(max_batch=4) as svc:
+        sessions = fleet(svc)
+        for s in sessions:
+            for f in s.step(4):
+                f.result(timeout=60)
+        svc.checkpoint(ckpt)
+        for s in sessions:
+            for f in s.step(4):
+                f.result(timeout=60)
+        want = [_final(s) for s in sessions]
+
+    # preempted service: restore from the checkpoint, run the last 4
+    with EvolutionService(max_batch=4) as svc2:
+        restored = svc2.restore_sessions(
+            ckpt, {f"run-{i}": tb for i in range(2)})
+        assert sorted(restored) == ["run-0", "run-1"]
+        for i in range(2):
+            s = restored[f"run-{i}"]
+            assert s.gen == 4
+            for f in s.step(4):
+                f.result(timeout=60)
+            for got, w in zip(_final(s), want[i]):
+                np.testing.assert_array_equal(got, w)
+
+
+# ---------------------------------------------------------------------------
+# observability surface
+# ---------------------------------------------------------------------------
+
+
+def test_stats_record_shape_and_latency_quantiles():
+    tb = onemax_toolbox()
+    with EvolutionService(max_batch=2) as svc:
+        key = jax.random.PRNGKey(13)
+        s = svc.open_session(key, onemax_pop(key, 16, 6), tb)
+        for f in s.step(3):
+            f.result(timeout=60)
+        rec = svc.stats()
+        assert rec.meta["source"] == "serve"
+        assert rec.counters["steps"] == 3
+        q = rec.gauges
+        assert q["latency_p50_ms"] > 0
+        assert q["latency_p99_ms"] >= q["latency_p50_ms"]
+        assert 0 < q["slot_occupancy"] <= 1
+
+
+# ---------------------------------------------------------------------------
+# heavyweight multi-session soak (slow: behind the tier-1 gate)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_soak_eight_sessions_interleaved_bitwise():
+    """8 sessions, 25 generations each, steps submitted in interleaved
+    waves with evaluate traffic mixed in: everything completes, compile
+    count stays at the bucket count, results stay bitwise equal to
+    standalone serving."""
+    tb = onemax_toolbox()
+    shapes = [(40, 8), (100, 12), (48, 8), (90, 12)] * 2
+    ngen = 25
+    keys = jax.random.split(jax.random.PRNGKey(99), len(shapes))
+    with EvolutionService(max_batch=8) as svc:
+        sessions = [svc.open_session(k, onemax_pop(k, n, d), tb,
+                                     cxpb=0.6, mutpb=0.3)
+                    for k, (n, d) in zip(keys, shapes)]
+        pend = []
+        for wave in range(ngen):
+            for i, s in enumerate(sessions):
+                pend.extend(s.step())
+                if wave % 7 == i % 7:
+                    pend.append(s.evaluate(
+                        s.population().genome[: 4 + (i % 3)]))
+        for f in pend:
+            f.result(timeout=300)
+        assert svc.stats().counters["compiles_step"] == 2
+        finals = [_final(s) for s in sessions]
+    for i, (n, d) in enumerate(shapes):
+        with EvolutionService(max_batch=8) as alone:
+            s = alone.open_session(keys[i], onemax_pop(keys[i], n, d), tb,
+                                   cxpb=0.6, mutpb=0.3)
+            for f in s.step(ngen):
+                f.result(timeout=300)
+            for got, want in zip(_final(s), finals[i]):
+                np.testing.assert_array_equal(got, want)

@@ -32,3 +32,60 @@ def test_checker_catches_a_planted_print(tmp_path):
     bad.write_text('x = 1\nprint("hi")\n# print("in a comment")\n'
                    's = "print(not a call)"\n')
     assert chk.find_bare_prints(bad) == [2]
+
+
+def test_no_blocking_sleep_on_serve_async_paths():
+    """The serving layer's worker/admission paths must wait on
+    interruptible primitives, never time.sleep;
+    ``tools/check_no_blocking_sleep.py`` pins it with ast."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "check_no_blocking_sleep.py")],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr or out.stdout
+
+
+def test_sleep_checker_catches_planted_sleeps(tmp_path):
+    """The sleep pass must detect the spellings it bans — module call,
+    alias, and from-import — and ignore non-time sleeps."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import check_no_blocking_sleep as chk
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\nimport time as t\nfrom time import sleep as zzz\n"
+        "time.sleep(1)\nt.sleep(2)\nzzz(3)\n"
+        "cv.wait(0.1)\nother.sleep(4)\n")
+    assert chk.find_blocking_sleeps(bad) == [4, 5, 6]
+
+
+def test_serve_entry_and_extra_wired():
+    """pyproject must expose the deap-tpu-serve console entry (pointing at
+    an importable callable) and a [serve] extra + serve pytest marker.
+    (Textual checks: tomllib needs python >= 3.11 and this gate runs on
+    3.10.)"""
+    with open(os.path.join(REPO, "pyproject.toml")) as f:
+        text = f.read()
+    entry = 'deap-tpu-serve = "deap_tpu.serve.cli:main"'
+    assert entry in text, "deap-tpu-serve console entry missing"
+    import importlib
+    assert callable(importlib.import_module("deap_tpu.serve.cli").main)
+    assert "\nserve = [" in text, "[serve] extra missing"
+    assert '"serve: ' in text, "serve pytest marker missing"
+
+
+def test_serve_cli_smoke():
+    """``deap-tpu-serve --smoke`` must stand up a real service, drive a
+    tiny fleet, and exit 0 with a JSON report on its last stdout line."""
+    import json
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "deap_tpu.serve.cli", "--smoke"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert out.returncode == 0, out.stderr or out.stdout
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    assert report["failures"] == 0
+    assert report["counters"]["steps"] == \
+        report["sessions"] * report["ngen"]

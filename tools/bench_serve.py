@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Serving-layer benchmark: multi-tenant throughput + latency vs the
+single-tenant baseline.
+
+Drives a mixed-shape fleet of GA sessions through ONE
+:class:`deap_tpu.serve.EvolutionService` (steps pipelined so the
+dispatcher can microbatch across sessions), then serves the identical
+fleet strictly one-session-at-a-time through a fresh service — the
+single-tenant baseline with the same padding/bucketing, so the measured
+delta is the multiplexing, not the padding.  Writes one JSON artifact:
+
+* ``multiplexed`` / ``single_tenant``: wall seconds, aggregate
+  generations/sec, per-step latency p50/p90/p99 ms (from the service's
+  own latency reservoir), compile counts, batch occupancy;
+* ``speedup``: multiplexed gens/sec over single-tenant gens/sec — > 1
+  when slot-packing amortizes dispatch overhead across tenants;
+* ``bitwise_identical``: the two runs' final populations compared
+  bit-for-bit (the serving layer's core correctness claim, re-checked in
+  the benchmark configuration).
+
+    python tools/bench_serve.py                       # defaults, CPU-sized
+    python tools/bench_serve.py --out BENCH_SERVE.json
+    python tools/bench_serve.py --sessions 8 --ngen 100 --pops 512,1024
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _toolbox():
+    import jax.numpy as jnp
+    from deap_tpu import base
+    from deap_tpu.benchmarks import rastrigin
+    from deap_tpu.ops import crossover, mutation, selection
+
+    tb = base.Toolbox()
+    tb.register("evaluate", rastrigin)
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=0.3,
+                indpb=0.1)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    return tb
+
+
+def _fleet_specs(sessions, pops, dims, seed):
+    import jax
+    specs = []
+    for i in range(sessions):
+        specs.append((jax.random.PRNGKey(seed + i),
+                      pops[i % len(pops)], dims[i % len(dims)]))
+    return specs
+
+
+def _population(key, n, d):
+    import jax
+    import jax.numpy as jnp
+    from deap_tpu import base
+    genome = jax.random.uniform(key, (n, d), jnp.float32, -5.12, 5.12)
+    return base.Population(genome=genome,
+                           fitness=base.Fitness.empty(n, (-1.0,)))
+
+
+def _finals(sessions):
+    import numpy as np
+    out = []
+    for s in sessions:
+        p = s.population()
+        out.append((np.asarray(p.genome), np.asarray(p.fitness.values)))
+    return out
+
+
+def _summarize(svc, wall, total_gens):
+    rec = svc.stats()
+    lat = {k: round(v, 3) for k, v in rec.gauges.items()
+           if k.startswith("latency_step_")}
+    return {
+        "wall_s": round(wall, 4),
+        "gens_per_sec": round(total_gens / wall, 2),
+        "compiles": rec.counters["compiles"],
+        "compiles_step": rec.counters["compiles_step"],
+        "batches": rec.counters["batches"],
+        "steps": rec.counters["steps"],
+        "mean_steps_per_batch": round(
+            rec.counters["steps"] / max(rec.counters["batches"], 1), 3),
+        **lat,
+    }
+
+
+def run_bench(sessions: int, pops, dims, ngen: int, max_batch: int,
+              seed: int) -> dict:
+    import numpy as np
+    from deap_tpu.serve import EvolutionService
+
+    tb = _toolbox()
+    specs = _fleet_specs(sessions, pops, dims, seed)
+    total_gens = sessions * ngen
+
+    # -- multiplexed: all sessions live at once, steps pipelined ------------
+    with EvolutionService(max_batch=max_batch) as svc:
+        fleet = [svc.open_session(k, _population(k, n, d), tb,
+                                  cxpb=0.7, mutpb=0.3) for k, n, d in specs]
+        # warmup one step each so AOT compiles are excluded from timing
+        for s in fleet:
+            s.step()[0].result(timeout=600)
+        t0 = time.perf_counter()
+        futures = [f for s in fleet for f in s.step(ngen)]
+        for f in futures:
+            f.result(timeout=600)
+        wall_multi = time.perf_counter() - t0
+        multi = _summarize(svc, wall_multi, total_gens)
+        multi_finals = _finals(fleet)
+
+    # -- single-tenant baseline: same fleet, one session at a time ----------
+    with EvolutionService(max_batch=max_batch) as svc:
+        singles = []
+        wall_single = 0.0
+        for k, n, d in specs:
+            s = svc.open_session(k, _population(k, n, d), tb,
+                                 cxpb=0.7, mutpb=0.3)
+            s.step()[0].result(timeout=600)     # per-bucket warmup
+            t0 = time.perf_counter()
+            for f in s.step(ngen):
+                f.result(timeout=600)
+            wall_single += time.perf_counter() - t0
+            singles.append(s)
+        single = _summarize(svc, wall_single, total_gens)
+        single_finals = _finals(singles)
+
+    bitwise = all(
+        np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+        for a, b in zip(multi_finals, single_finals))
+    return {
+        "metric": "serve_multitenant_gens_per_sec",
+        "value": multi["gens_per_sec"],
+        "unit": "generations/sec (aggregate across sessions)",
+        "config": {"sessions": sessions, "pops": pops, "dims": dims,
+                   "ngen": ngen, "max_batch": max_batch,
+                   "note": "warmup step per session excluded from timing"},
+        "multiplexed": multi,
+        "single_tenant": single,
+        "speedup": round(multi["gens_per_sec"]
+                         / max(single["gens_per_sec"], 1e-9), 3),
+        "bitwise_identical": bool(bitwise),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_serve",
+        description="multi-tenant serving throughput/latency vs "
+                    "single-tenant baseline")
+    ap.add_argument("--sessions", type=int, default=6)
+    ap.add_argument("--pops", default="100,180")
+    ap.add_argument("--dims", default="16,32")
+    ap.add_argument("--ngen", type=int, default=40)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON report to this path")
+    args = ap.parse_args(argv)
+
+    import jax
+    report = run_bench(args.sessions,
+                       [int(p) for p in args.pops.split(",")],
+                       [int(d) for d in args.dims.split(",")],
+                       args.ngen, args.max_batch, args.seed)
+    report["backend"] = jax.default_backend()
+    report["devices"] = len(jax.devices())
+    text = json.dumps(report, indent=2, sort_keys=True)
+    if args.out:
+        Path(args.out).write_text(text + "\n")
+    print(text)
+    return 0 if report["bitwise_identical"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,9 +11,9 @@ modules:
 
 * ``observability/sinks.py`` — the sink layer itself (the one sanctioned
   home of ``print`` for runtime output);
-* ``observability/cli.py``, ``selftest.py``, ``resilience/faultdrill.py``,
-  ``native/build.py`` — console entry points whose stdout IS their
-  interface.
+* ``observability/cli.py``, ``serve/cli.py``, ``selftest.py``,
+  ``resilience/faultdrill.py``, ``native/build.py`` — console entry
+  points whose stdout IS their interface.
 
 Run directly (``python tools/check_no_bare_print.py``) or through the
 tier-1 gate (``tests/test_tooling.py``).
@@ -32,6 +32,7 @@ PACKAGE = REPO / "deap_tpu"
 SANCTIONED = {
     "observability/sinks.py",
     "observability/cli.py",
+    "serve/cli.py",
     "selftest.py",
     "resilience/faultdrill.py",
     "native/build.py",

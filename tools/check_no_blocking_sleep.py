@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Static pass: no blocking ``time.sleep`` on the service's async paths.
+
+The serving layer (``deap_tpu/serve/``) runs all device dispatch on one
+worker thread and promises bounded-latency admission control; a blocking
+``time.sleep`` anywhere in that package stalls every queued session behind
+a wall-clock nap that no condition can interrupt.  Waiting there must go
+through interruptible primitives — ``threading.Condition.wait(timeout)``,
+``threading.Event.wait(timeout)``, ``queue`` timeouts — whose sleeps wake
+on notify.  (Retry backoff is fine: it lives in
+``deap_tpu/resilience/retry.py``, outside this package, and only runs
+between attempts of an already-failing dispatch.)
+
+This checker walks every module under ``deap_tpu/serve/`` with ``ast`` and
+fails on any call spelled ``time.sleep(...)`` or a bare ``sleep(...)``
+imported from ``time``.  Run directly or through the tier-1 gate
+(``tests/test_tooling.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "deap_tpu" / "serve"
+
+
+def find_blocking_sleeps(path: Path) -> list[int]:
+    """Line numbers of blocking-sleep calls in ``path``: ``time.sleep(...)``
+    (any module alias bound from ``import time``) and bare ``sleep(...)``
+    when ``from time import sleep`` appears in the module."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    time_aliases = {"time"}
+    sleep_names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    time_aliases.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "sleep":
+                    sleep_names.add(a.asname or "sleep")
+    lines = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr == "sleep"
+                and isinstance(f.value, ast.Name)
+                and f.value.id in time_aliases):
+            lines.append(node.lineno)
+        elif isinstance(f, ast.Name) and f.id in sleep_names:
+            lines.append(node.lineno)
+    return lines
+
+
+def main() -> int:
+    violations = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        rel = path.relative_to(REPO).as_posix()
+        for lineno in find_blocking_sleeps(path):
+            violations.append(f"{rel}:{lineno}")
+    if violations:
+        sys.stderr.write(
+            "blocking time.sleep on a service async path (use "
+            "threading.Condition/Event wait timeouts, which wake on "
+            "notify):\n" + "\n".join(f"  {v}" for v in violations) + "\n")
+        return 1
+    print("no blocking time.sleep under deap_tpu/serve/")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
