@@ -29,8 +29,18 @@ Three layouts, matching the framework's parallel axes (SURVEY §2.6):
 * ``island``: one deme per device with ring migration each generation —
   migration's collective-permute is the only communication.
 * ``mo``: ``sel_nsga2_sharded`` (deap_tpu/parallel/emo_sharded.py) — the
-  O(N²) dominance counting column-sharded with all-gathered row blocks
-  and psum-replicated peel decisions.
+  O(N²) dominance counting column-sharded against a once-gathered
+  resident population, with the front peel exchanging compacted int32
+  index payloads (r06 collective-lean protocol: zero reductions).
+
+Collective counts are FIRST-CLASS metrics here, reported two ways per
+layout: ``collectives_in_hlo`` (legacy substring count over the compiled
+text — inflated by operand references and kept for continuity with
+BENCH_r05) and ``collective_ops_in_hlo`` (HLO *instruction definitions*,
+the number the committed budget ``tools/collective_budget.json`` gates —
+see ``tools/check_collective_budget.py``; regenerate the budget with
+``python bench_weakscaling.py --update-budget`` after an intentional
+change).
 
 Prints ONE JSON object; bench.py embeds it in its own output.
 
@@ -41,6 +51,7 @@ BENCH_WEAK_REPEATS (default 3), BENCH_WEAK_MO_POP (default 8192).
 
 import json
 import os
+import re
 import sys
 import time
 
@@ -53,12 +64,191 @@ REPEATS = int(os.environ.get("BENCH_WEAK_REPEATS", 3))
 MO_POP = int(os.environ.get("BENCH_WEAK_MO_POP", 8192))
 DIM = 100
 
+COLLECTIVES = ("collective-permute", "all-gather", "all-reduce",
+               "all-to-all", "reduce-scatter")
+
 
 def _collective_counts(txt: str) -> dict:
-    return {name: txt.count(name)
-            for name in ("collective-permute", "all-gather", "all-reduce",
-                         "all-to-all", "reduce-scatter")
-            if txt.count(name)}
+    """Legacy substring counts over the compiled HLO text.  Inflated:
+    every operand *reference* to a collective's result re-matches the
+    name.  Kept so r05↔r06 rows stay comparable."""
+    return {name: txt.count(name) for name in COLLECTIVES if txt.count(name)}
+
+
+# The ONE counting rule for collective instruction definitions, shared
+# by the budget gate, the HLO-pin tests (tests/test_parallel.py), and
+# the per-scope profiler (tools/profile_nsga2_stages.py) — three
+# independent spellings of this rule WILL drift (the profiler's first
+# draft anchored on a `\S+` shape token that async ops' tuple shapes
+# break).  An opcode occurrence is the opcode name directly followed by
+# its operand list (sync ``name(`` or async ``name-start(``); operand
+# references ``%name.42`` and ``name-done(`` never produce either).
+_COLLECTIVE_OP_RE = re.compile(
+    r"\b(" + "|".join(COLLECTIVES) + r")(?:-start)?\(")
+
+
+def collective_op_on_line(line: str) -> str | None:
+    """Base opcode of the collective instruction defined on this HLO
+    text line, or None (HLO prints one instruction per line)."""
+    m = _COLLECTIVE_OP_RE.search(line)
+    return m.group(1) if m else None
+
+
+def _collective_ops(txt: str) -> dict:
+    """HLO collective *instruction definitions* — the count the
+    collective budget gates."""
+    out = {}
+    for line in txt.splitlines():
+        name = collective_op_on_line(line)
+        if name:
+            out[name] = out.get(name, 0) + 1
+    return out
+
+
+def build(layout: str, n_dev: int, pop_per_dev: int = None,
+          mo_pop: int = None, dim: int = None, n_groups: int = None):
+    """Construct one layout's scaling program at the FIXED total size
+    (``pop_per_dev * n_groups`` individuals / ``n_groups`` islands /
+    ``mo_pop`` points), partitioned over an ``n_dev``-device mesh
+    (``n_dev=1`` is the comparable baseline: identical program, trivial
+    mesh).  Returns ``(run, args)`` where ``run(ngen)`` is the jitted
+    program builder — shared by the timing harness below and by the
+    collective-budget gate (``tools/check_collective_budget.py``), which
+    lowers the same programs at small shapes and counts collectives
+    without timing anything."""
+    pop_per_dev = POP_PER_DEV if pop_per_dev is None else pop_per_dev
+    mo_pop = MO_POP if mo_pop is None else mo_pop
+    dim = DIM if dim is None else dim
+    n_groups = N_DEV if n_groups is None else n_groups
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from deap_tpu import base, benchmarks
+    from deap_tpu.algorithms import vary_genome, var_and, evaluate_population
+    from deap_tpu.ops import crossover, mutation, selection
+    from deap_tpu.ops.migration import mig_ring_stacked
+
+    tb = base.Toolbox()
+    tb.register("evaluate", benchmarks.rastrigin)
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=0.3, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3,
+                tie_break="rank")             # continuous fitness, as bench.py
+
+    key = jax.random.PRNGKey(0)
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("d",))
+    sh = NamedSharding(mesh, P("d"))
+
+    if layout == "mo":
+        from deap_tpu.parallel.emo_sharded import sel_nsga2_sharded
+        k_sel = mo_pop // 2
+        x = jax.random.uniform(key, (mo_pop, 3))
+        w = -jnp.stack([x[:, 0], x[:, 1] * (1.5 - x[:, 0]),
+                        x[:, 2] * (1.5 - x[:, 0])], axis=1)
+        w = jax.device_put(w, NamedSharding(mesh, P("d", None)))
+
+        fc = max(64, mo_pop // 16)     # fewer peel sub-rounds -> fewer
+                                       # per-round collectives
+
+        def sel_step(carry, _):
+            # thread w through the carry with a below-ulp perturbation
+            # derived from the previous selection, so XLA cannot hoist
+            # the loop-invariant selection out of the timed scan (the
+            # add rounds away bitwise: |acc|*1e-30 << f32 ulp of w)
+            wc, acc = carry
+            idx = sel_nsga2_sharded(None, wc, k_sel, mesh, axis="d",
+                                    front_chunk=fc)
+            acc = acc + jnp.sum(idx)
+            wc = wc + acc.astype(wc.dtype) * 1e-30
+            return (wc, acc), None
+
+        def run(ncalls):
+            @jax.jit
+            def r(w_):
+                (w_, acc), _ = lax.scan(sel_step, (w_, jnp.int32(0)),
+                                        None, length=ncalls)
+                return w_, acc[None]
+            return r
+
+        return run, (w,)
+
+    if layout == "pop":
+        pop_size = pop_per_dev * n_groups        # total fixed, mesh varies
+        genome = jax.device_put(
+            jax.random.uniform(key, (pop_size, dim), jnp.float32,
+                               -5.12, 5.12), sh)
+
+        def generation(carry, _):
+            k, g, fv = carry
+            k, k_sel, k_var = jax.random.split(k, 3)
+            fit = base.Fitness(values=fv, valid=jnp.ones(pop_size, bool),
+                               weights=(-1.0,))
+            idx = tb.select(k_sel, fit, pop_size)
+            g = g[idx]
+            g, _ = vary_genome(k_var, g, tb, 0.9, 0.5, pairing="halves")
+            fv = jax.vmap(lambda x: benchmarks.rastrigin(x)[0])(g)[:, None]
+            return (k, g, fv), jnp.min(fv)
+
+        fv0 = jax.vmap(lambda x: benchmarks.rastrigin(x)[0])(genome)[:, None]
+
+        def run(ngen):
+            @jax.jit
+            def r(key, g, fv):
+                return lax.scan(generation, (key, g, fv), None, length=ngen)
+            return r
+
+        return run, (key, genome, fv0)
+
+    # island layout: n_groups demes total, stacked axis sharded over the mesh
+    genome = jax.device_put(
+        jax.random.uniform(key, (n_groups, pop_per_dev, dim), jnp.float32,
+                           -5.12, 5.12), sh)
+
+    def island_gen(k, pop):
+        k_sel, k_var = jax.random.split(k)
+        idx = tb.select(k_sel, pop.fitness, pop.size)
+        off = pop.take(idx)
+        off = var_and(k_var, off, tb, 0.9, 0.5)
+        off, _ = evaluate_population(tb, off)
+        return off
+
+    def generation(carry, _):
+        k, g, fv, valid = carry
+        k, k_gen, k_mig = jax.random.split(k, 3)
+        pops = base.Population(g, base.Fitness(values=fv, valid=valid,
+                                               weights=(-1.0,)))
+        keys = jax.random.split(k_gen, n_groups)
+        pops = jax.vmap(island_gen)(keys, pops)
+        bundle = dict(genome=pops.genome, values=pops.fitness.values,
+                      valid=pops.fitness.valid)
+        w = jax.vmap(lambda f: f.masked_wvalues())(pops.fitness)
+        nb, _ = mig_ring_stacked(k_mig, bundle, w, 5,
+                                 selection.sel_best)
+        return (k, nb["genome"], nb["values"], nb["valid"]), jnp.min(nb["values"])
+
+    fv0 = jax.vmap(jax.vmap(lambda x: benchmarks.rastrigin(x)[0]))(genome)[..., None]
+    valid0 = jnp.ones((n_groups, pop_per_dev), bool)
+
+    def run(ngen):
+        @jax.jit
+        def r(key, g, fv, valid):
+            return lax.scan(generation, (key, g, fv, valid), None,
+                            length=ngen)
+        return r
+
+    return run, (key, genome, fv0, valid0)
+
+
+def collective_ops(layout: str, n_dev: int, ngen: int = 2, **sizes) -> dict:
+    """Lower one layout's program (no timing, no execution past compile)
+    and return its HLO collective instruction counts — the budget gate's
+    measurement, shared with the bench so the committed budget and the
+    reported metrics can never drift apart."""
+    run, args = build(layout, n_dev, **sizes)
+    txt = run(ngen).lower(*args).compile().as_text()
+    return _collective_ops(txt)
 
 
 def _marginal(run, args, ngen, repeats=REPEATS):
@@ -94,141 +284,24 @@ def _marginal_gated(run, args, ngen, max_ngen=512):
 
 
 def measure(layout: str, n_dev: int):
-    """Marginal per-generation time for ``layout`` at the FIXED total size
-    (POP_PER_DEV * N_DEV individuals / N_DEV islands / MO_POP points),
-    partitioned over an ``n_dev``-device mesh.  n_dev=1 is the comparable
-    baseline: identical program, trivial mesh."""
-    import numpy as np
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from deap_tpu import base, benchmarks
-    from deap_tpu.algorithms import vary_genome, var_and, evaluate_population
-    from deap_tpu.ops import crossover, mutation, selection
-    from deap_tpu.ops.migration import mig_ring_stacked
-
-    tb = base.Toolbox()
-    tb.register("evaluate", benchmarks.rastrigin)
-    tb.register("mate", crossover.cx_two_point)
-    tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=0.3, indpb=0.05)
-    tb.register("select", selection.sel_tournament, tournsize=3,
-                tie_break="rank")             # continuous fitness, as bench.py
-
-    key = jax.random.PRNGKey(0)
-    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("d",))
-    sh = NamedSharding(mesh, P("d"))
-
-    if layout == "mo":
-        from deap_tpu.parallel.emo_sharded import sel_nsga2_sharded
-        k_sel = MO_POP // 2
-        x = jax.random.uniform(key, (MO_POP, 3))
-        w = -jnp.stack([x[:, 0], x[:, 1] * (1.5 - x[:, 0]),
-                        x[:, 2] * (1.5 - x[:, 0])], axis=1)
-        w = jax.device_put(w, NamedSharding(mesh, P("d", None)))
-
-        fc = max(64, MO_POP // 16)     # fewer peel sub-rounds -> fewer
-                                       # per-round collectives
-
-        def sel_step(carry, _):
-            # thread w through the carry with a below-ulp perturbation
-            # derived from the previous selection, so XLA cannot hoist
-            # the loop-invariant selection out of the timed scan (the
-            # add rounds away bitwise: |acc|*1e-30 << f32 ulp of w)
-            wc, acc = carry
-            idx = sel_nsga2_sharded(None, wc, k_sel, mesh, axis="d",
-                                    front_chunk=fc)
-            acc = acc + jnp.sum(idx)
-            wc = wc + acc.astype(wc.dtype) * 1e-30
-            return (wc, acc), None
-
-        def run(ncalls):
-            @jax.jit
-            def r(w_):
-                (w_, acc), _ = lax.scan(sel_step, (w_, jnp.int32(0)),
-                                        None, length=ncalls)
-                return w_, acc[None]
-            return r
-
-        args = (w,)
-        txt = run(NGEN).lower(*args).compile().as_text()
-        marginal, ratio, spread, used = _marginal_gated(run, args, max(NGEN // 4, 2))
-        return marginal, ratio, spread, used, _collective_counts(txt)
-
-    if layout == "pop":
-        pop_size = POP_PER_DEV * N_DEV           # total fixed, mesh varies
-        genome = jax.device_put(
-            jax.random.uniform(key, (pop_size, DIM), jnp.float32,
-                               -5.12, 5.12), sh)
-
-        def generation(carry, _):
-            k, g, fv = carry
-            k, k_sel, k_var = jax.random.split(k, 3)
-            fit = base.Fitness(values=fv, valid=jnp.ones(pop_size, bool),
-                               weights=(-1.0,))
-            idx = tb.select(k_sel, fit, pop_size)
-            g = g[idx]
-            g, _ = vary_genome(k_var, g, tb, 0.9, 0.5, pairing="halves")
-            fv = jax.vmap(lambda x: benchmarks.rastrigin(x)[0])(g)[:, None]
-            return (k, g, fv), jnp.min(fv)
-
-        fv0 = jax.vmap(lambda x: benchmarks.rastrigin(x)[0])(genome)[:, None]
-
-        def run(ngen):
-            @jax.jit
-            def r(key, g, fv):
-                return lax.scan(generation, (key, g, fv), None, length=ngen)
-            return r
-
-        args = (key, genome, fv0)
-        txt = run(NGEN).lower(*args).compile().as_text()
-        marginal, ratio, spread, used = _marginal_gated(run, args, NGEN)
-        return marginal, ratio, spread, used, _collective_counts(txt)
-
-    # island layout: N_DEV demes total, stacked axis sharded over the mesh
-    genome = jax.device_put(
-        jax.random.uniform(key, (N_DEV, POP_PER_DEV, DIM), jnp.float32,
-                           -5.12, 5.12), sh)
-
-    def island_gen(k, pop):
-        k_sel, k_var = jax.random.split(k)
-        idx = tb.select(k_sel, pop.fitness, pop.size)
-        off = pop.take(idx)
-        off = var_and(k_var, off, tb, 0.9, 0.5)
-        off, _ = evaluate_population(tb, off)
-        return off
-
-    def generation(carry, _):
-        k, g, fv, valid = carry
-        k, k_gen, k_mig = jax.random.split(k, 3)
-        pops = base.Population(g, base.Fitness(values=fv, valid=valid,
-                                               weights=(-1.0,)))
-        keys = jax.random.split(k_gen, N_DEV)
-        pops = jax.vmap(island_gen)(keys, pops)
-        bundle = dict(genome=pops.genome, values=pops.fitness.values,
-                      valid=pops.fitness.valid)
-        w = jax.vmap(lambda f: f.masked_wvalues())(pops.fitness)
-        nb, _ = mig_ring_stacked(k_mig, bundle, w, 5,
-                                 selection.sel_best)
-        return (k, nb["genome"], nb["values"], nb["valid"]), jnp.min(nb["values"])
-
-    fv0 = jax.vmap(jax.vmap(lambda x: benchmarks.rastrigin(x)[0]))(genome)[..., None]
-    valid0 = jnp.ones((N_DEV, POP_PER_DEV), bool)
-
-    def run(ngen):
-        @jax.jit
-        def r(key, g, fv, valid):
-            return lax.scan(generation, (key, g, fv, valid), None,
-                            length=ngen)
-        return r
-
-    args = (key, genome, fv0, valid0)
+    """Marginal per-generation time + collective counts for ``layout``
+    partitioned over an ``n_dev``-device mesh."""
+    run, args = build(layout, n_dev)
+    ngen0 = max(NGEN // 4, 2) if layout == "mo" else NGEN
     txt = run(NGEN).lower(*args).compile().as_text()
-    marginal, ratio, spread, used = _marginal_gated(run, args, NGEN)
-    return marginal, ratio, spread, used, _collective_counts(txt)
+    marginal, ratio, spread, used = _marginal_gated(run, args, ngen0)
+    return (marginal, ratio, spread, used,
+            _collective_counts(txt), _collective_ops(txt))
 
 
 def main():
+    if "--update-budget" in sys.argv[1:]:
+        # delegate to the gate so the committed budget is always written
+        # at the gate's own (small, fast-to-lower) canonical shapes
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import check_collective_budget
+        raise SystemExit(check_collective_budget.main(["--update-budget"]))
     import jax
     if jax.default_backend() != "cpu" or len(jax.devices()) < N_DEV:
         raise SystemExit(
@@ -244,8 +317,8 @@ def main():
                     "real-pod efficiency ~ 1/overhead"),
            "layouts": {}}
     for layout in ("pop", "island", "mo"):
-        t1, r1, s1, n1, _ = measure(layout, 1)
-        tn, rn, sn, nn, colls = measure(layout, N_DEV)
+        t1, r1, s1, n1, _, _ = measure(layout, 1)
+        tn, rn, sn, nn, colls, ops = measure(layout, N_DEV)
         ok = (1.5 <= r1 <= 2.7) and (1.5 <= rn <= 2.7)
         out["layouts"][layout] = {
             "t1dev_per_gen_ms": round(t1 * 1e3, 2),
@@ -256,6 +329,7 @@ def main():
                                  f"t{N_DEV}dev": round(rn, 2),
                                  "ngen_used": [n1, nn], "ok": ok},
             "collectives_in_hlo": colls,
+            "collective_ops_in_hlo": ops,
         }
     print(json.dumps(out))
 

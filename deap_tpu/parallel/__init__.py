@@ -16,5 +16,5 @@ from .islands import (ea_simple_islands, stack_populations,
 from .multihost import (initialize_cluster, cluster_mesh,
                         distribute_population, fetch_global,
                         process_index, process_count)  # noqa: F401
-from .emo_sharded import (nondominated_ranks_sharded,
-                          sel_nsga2_sharded)  # noqa: F401
+from .emo_sharded import (nondominated_ranks_sharded, sel_nsga2_sharded,
+                          dominance_counts_sharded)  # noqa: F401

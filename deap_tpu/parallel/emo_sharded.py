@@ -8,33 +8,53 @@ but ``sel_nsga2``'s pairwise work ran replicated.  This module shards it.
 
 Design (``shard_map`` over one mesh axis, default ``"pop"``):
 
-* **columns sharded, rows gathered** — each device owns ``N/D`` of the
-  dominator-count *columns* (the per-point counts) and computes them
+* **columns sharded, rows gathered ONCE** — each device owns ``N/D`` of
+  the dominator-count *columns* (the per-point counts) and computes them
   against all ``N`` rows, gathered once per selection
   (``lax.all_gather``, the N·M bytes every device needs anyway).  Pair
   work per device is N²/D: linear speedup on the dominant term, and the
-  (chunked) N×C dominance blocks never materialize an N×N matrix.
-* **replicated peel decisions** — the incremental front peel
-  (:func:`deap_tpu.ops.emo.nondominated_ranks`'s ``peel`` method) runs
-  with per-device column state; every loop condition is derived from a
-  ``lax.psum``, so all devices take identical trips and the compiled
-  program stays SPMD-uniform.  Front members are compacted per device
-  into static ``(front_chunk,)`` buffers and all-gathered as
-  ``(D·front_chunk, nobj)`` row blocks for the count subtraction —
-  migration-sized collectives, not population-sized.
+  (chunked) N×C dominance blocks never materialize an N×N matrix.  On
+  TPU the blocks run through the Pallas dominance kernel
+  (:mod:`deap_tpu.ops.dominance_pallas`); off TPU the XLA broadcast form.
+* **collective-lean peel** (``exchange="indices"``, the default) — the
+  gathered population ``w_full`` stays resident for the whole peel, and
+  each front-subtraction round all-gathers only a compacted ``int32``
+  payload of ``front_chunk`` *indices* per device plus that device's
+  remaining-front count.  Rows are looked up in ``w_full`` locally, and
+  because every device decodes the identical gathered payload, every
+  loop condition (front width, sub-rounds left, rows still active,
+  ``stop_at_k``) is derived from it — the peel needs **zero psums**:
+  one small all-gather per subtraction round is the only collective.
+  The previous design re-gathered ``(D·front_chunk, m)`` float row
+  blocks every round AND ran 2 psums per front + 1 psum per sub-round;
+  the committed weak-scaling evidence (BENCH_r05) measured that layout
+  at 5.6× partition overhead on the 8-virtual-device CPU mesh, the
+  worst-scaling program in the framework.  ``tools/collective_budget.json``
+  pins the collective inventory of the lean build.
+* **row-gather fallback** (``exchange="rows"``) — the original
+  row-block protocol, kept selectable for cross-checking and for meshes
+  where a replicated ``(n_pad, m)`` buffer is unaffordable; its two
+  per-front psums (survivor count in ``body``, front count in
+  ``subtract_front``) are fused into ONE stacked psum per front.
 * **cheap tail replicated** — crowding distance and the final
   (rank, -crowding) lexsort are O(N log N) on data that already fits on
   every device; they run as ordinary global ops outside the shard_map
   so the result is bit-identical to the unsharded selector.
 
 Equivalence to :func:`deap_tpu.ops.emo.sel_nsga2` with ``nd="peel"`` is
-*exact* (integer counts, same front sequence, same crowding program):
-``tests/test_parallel.py`` pins index-identity on an 8-device mesh.
+*exact* in both exchange modes (integer counts, same front sequence,
+same crowding program): ``tests/test_parallel.py`` pins index-identity
+on an 8-device mesh, including the adversarial one-point-per-front
+``line`` regime.
 
 Reference anchor: ``deap/tools/emo.py:15-50`` (selNSGA2) — the reference
 has no distributed selection at all (its parallelism is ``toolbox.map``
 over evaluations, ``doc/tutorials/basic/part4.rst``); this is capability
 beyond parity, sized for the pop=10⁶ regime.
+
+Measured overhead, collective inventory, and the committed budget:
+``docs/performance.md`` § "Sharded multi-objective selection"; per-phase
+profile via ``tools/profile_nsga2_stages.py --sharded``.
 """
 
 from __future__ import annotations
@@ -44,15 +64,15 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..base import dominates
 from ..ops.emo import _wv_values, _rows_dominate_counts, assign_crowding_dist
 
 # jax >= 0.6 promotes shard_map to jax.shard_map; 0.4.x still ships it
 # under experimental, where the replication checker has no rule for
 # while_loop and must be disabled (the kernel keeps every loop condition
-# psum-uniform by construction, so the check adds nothing here)
+# uniform by construction — all devices decode the same gathered payload
+# — so the check adds nothing here)
 if hasattr(jax, "shard_map"):
     _shard_map = jax.shard_map
 else:
@@ -60,7 +80,8 @@ else:
     from jax.experimental.shard_map import shard_map as _xshard_map
     _shard_map = _partial(_xshard_map, check_rep=False)
 
-__all__ = ["nondominated_ranks_sharded", "sel_nsga2_sharded"]
+__all__ = ["nondominated_ranks_sharded", "sel_nsga2_sharded",
+           "dominance_counts_sharded"]
 
 
 def _pad_rows(x: jax.Array, target: int, fill) -> jax.Array:
@@ -71,11 +92,85 @@ def _pad_rows(x: jax.Array, target: int, fill) -> jax.Array:
         [x, jnp.full((pad,) + x.shape[1:], fill, x.dtype)], 0)
 
 
+def _vary_fn(axis: str):
+    """Constant-initialized loop carries must be typed as varying over
+    the mesh axis (jax's VMA tracking) since their updates are; on jax
+    builds without pcast (< 0.7) shard_map has no VMA typing and
+    everything inside the kernel is already per-device."""
+    if hasattr(lax, "pcast"):
+        return lambda x: lax.pcast(x, (axis,), to="varying")
+    return lambda x: x
+
+
+def _dom_counts_fn():
+    """Backend dispatch for the (C, n_loc) dominance-count blocks: the
+    Pallas kernel on TPU (transposed-lanes layout + unrolled SMEM front
+    rows, measured 2.1× the XLA broadcast compare at C=1024, N=2·10⁵ —
+    the same single-chip win the unsharded peel already takes), the XLA
+    form elsewhere (Pallas interpret mode would crawl in CPU tests;
+    integer-exact equality is pinned by
+    ``tests/test_support.py::test_pallas_dominance_counts_matches_xla``)."""
+    if jax.default_backend() == "tpu":
+        from ..ops.dominance_pallas import rows_dominate_counts_pallas
+        return rows_dominate_counts_pallas
+    return _rows_dominate_counts
+
+
+def _initial_counts(w_local, axis: str, n_loc: int, n_pad: int, rc: int,
+                    m: int, dom_counts, vary):
+    """One population all-gather + chunked dominance scan: dominator
+    counts for this device's columns against every row.  Returns
+    ``(counts, w_full)`` — callers keep ``w_full`` resident so the peel
+    never re-gathers population data."""
+    n_rows_pad = -(-n_pad // rc) * rc
+    with jax.named_scope("obs:dominance_count"):
+        w_full = lax.all_gather(w_local, axis, axis=0, tiled=True)
+        rows_chunks = _pad_rows(w_full, n_rows_pad, -jnp.inf
+                                ).reshape(-1, rc, m)
+
+        def count_body(acc, rows):
+            return acc + dom_counts(rows, w_local).astype(jnp.int32), None
+
+        counts, _ = lax.scan(count_body,
+                             vary(jnp.zeros((n_loc,), jnp.int32)),
+                             rows_chunks)
+    return counts, w_full
+
+
+@partial(jax.jit, static_argnames=("mesh", "axis", "row_chunk"))
+def dominance_counts_sharded(w: jax.Array, mesh: Mesh, axis: str = "pop",
+                             row_chunk: int = 1024) -> jax.Array:
+    """Per-point dominator counts (``#{i : w[i] dominates w[j]}``) with
+    the O(M·N²) pair work column-sharded over ``mesh.shape[axis]``
+    devices — the standalone first phase of
+    :func:`nondominated_ranks_sharded`, exposed for stage profiling
+    (``tools/profile_nsga2_stages.py --sharded``) and for callers that
+    want raw counts (e.g. dominance-depth statistics) without a peel."""
+    n, m = w.shape
+    D = int(mesh.shape[axis])
+    n_loc = -(-n // D)
+    n_pad = n_loc * D
+    wp = _pad_rows(w, n_pad, -jnp.inf)
+    rc = min(row_chunk, n_pad)
+    dom_counts = _dom_counts_fn()
+
+    def kernel(w_local):
+        counts, _ = _initial_counts(w_local, axis, n_loc, n_pad, rc, m,
+                                    dom_counts, _vary_fn(axis))
+        return counts
+
+    spec = P(axis)
+    counts = _shard_map(kernel, mesh=mesh, in_specs=(spec,),
+                        out_specs=spec)(wp)
+    return counts[:n]
+
+
 @partial(jax.jit, static_argnames=("mesh", "axis", "front_chunk",
-                                   "row_chunk", "stop_at_k"))
+                                   "row_chunk", "stop_at_k", "exchange"))
 def nondominated_ranks_sharded(w: jax.Array, mesh: Mesh, axis: str = "pop",
                                front_chunk: int = 256, row_chunk: int = 1024,
-                               stop_at_k: int | None = None):
+                               stop_at_k: int | None = None,
+                               exchange: str = "indices"):
     """Pareto-front ranks with the dominance work sharded over
     ``mesh.shape[axis]`` devices.  Same contract as
     :func:`deap_tpu.ops.emo.nondominated_ranks` (``method="peel"``):
@@ -85,6 +180,16 @@ def nondominated_ranks_sharded(w: jax.Array, mesh: Mesh, axis: str = "pop",
     nothing and is dominated by everything, so padding can never enter a
     peeled front before real rows are exhausted); the returned ranks are
     sliced back to ``n``.
+
+    ``exchange`` selects the front-subtraction protocol (identical
+    results, different collectives — see the module docstring):
+
+    * ``"indices"`` (default): all-gather ``front_chunk`` compacted
+      ``int32`` indices + a count per device per round, look rows up in
+      the resident ``w_full``.  Zero psums anywhere in the peel.
+    * ``"rows"``: all-gather ``(D·front_chunk, m)`` row blocks per round
+      (the pre-r06 protocol), one fused psum per front + one per
+      sub-round.
     """
     n, m = w.shape
     D = int(mesh.shape[axis])
@@ -94,52 +199,145 @@ def nondominated_ranks_sharded(w: jax.Array, mesh: Mesh, axis: str = "pop",
     stop = n if stop_at_k is None else min(int(stop_at_k), n)
     c = min(front_chunk, n_loc)
     rc = min(row_chunk, n_pad)
-    n_rows_pad = -(-n_pad // rc) * rc
+    if exchange not in ("indices", "rows"):
+        raise ValueError(f"unknown exchange {exchange!r}")
+    dom_counts = _dom_counts_fn()
 
     def kernel(w_local):                          # (n_loc, m) per device
-        # constant-initialized loop carries must be typed as varying over
-        # the mesh axis (jax's VMA tracking) since their updates are; on
-        # jax builds without pcast (< 0.7) shard_map has no VMA typing and
-        # everything inside the kernel is already per-device
-        if hasattr(lax, "pcast"):
-            vary = lambda x: lax.pcast(x, (axis,), to="varying")  # noqa: E731
-        else:
-            vary = lambda x: x                                    # noqa: E731
+        vary = _vary_fn(axis)
         # one population gather: every device needs all rows to count its
         # columns' dominators.  named_scope: the two O(N²/D) phases show
         # up as named ranges in a profiler capture
-        # (deap_tpu.observability.tracing.capture_trace)
-        with jax.named_scope("obs:dominance_count"):
-            w_full = lax.all_gather(w_local, axis, axis=0, tiled=True)
-            rows_chunks = _pad_rows(w_full, n_rows_pad, -jnp.inf
-                                    ).reshape(-1, rc, m)
+        # (deap_tpu.observability.tracing.capture_trace) and key the
+        # per-phase collective attribution in profile_nsga2_stages.py
+        counts, w_full = _initial_counts(w_local, axis, n_loc, n_pad, rc,
+                                         m, dom_counts, vary)
 
-            def count_body(acc, rows):
-                d = dominates(rows[:, None, :], w_local[None, :, :])
-                return acc + jnp.sum(d, axis=0, dtype=jnp.int32), None
+        if exchange == "indices":
+            # -inf sentinel row at global index n_pad: out-of-range
+            # compaction slots gather a row that dominates nothing
+            w_full_s = jnp.concatenate(
+                [w_full, jnp.full((1, m), -jnp.inf, w_full.dtype)], 0)
+            d_off = lax.axis_index(axis).astype(jnp.int32) * n_loc
 
-            counts, _ = lax.scan(count_body,
-                                 vary(jnp.zeros((n_loc,), jnp.int32)),
-                                 rows_chunks)
+            def subtract_front(counts, front):
+                """Subtract the front's dominance contribution from the
+                local counts.  Per round, each device ships
+                ``[remaining_count, c global indices]`` (int32, sentinel
+                ``n_pad``); the gathered payload is identical on every
+                device, so the trip count AND the global front size come
+                out of it for free — no reduction collectives.
 
-        # -inf sentinel row for out-of-range compaction fills
-        wp_local = jnp.concatenate(
-            [w_local, jnp.full((1, m), -jnp.inf, w_local.dtype)], 0)
+                The gathered ``(D·c,)`` index buffer is mostly sentinels
+                whenever the front is thinner than the compaction chunks
+                (the common case), so it is re-compacted LOCALLY and the
+                dominance blocks run over ``ceil(real/c)`` blocks of
+                ``c`` real rows — per-device subtraction work is
+                ``front·n_loc`` pair ops, the unsharded peel's cost
+                split D ways, instead of the ``D·c·n_loc`` a fixed
+                ``(D·c, n_loc)`` block pays (D× duplicated work, the
+                dominant term in the 5.6× BENCH_r05 overhead alongside
+                the per-round reductions).  Returns
+                ``(counts, front_total)``."""
+                def sub_cond(s):
+                    return s[2]
 
-        def sub_round(s):
-            counts, todo, _ = s
-            idx = jnp.nonzero(todo, size=c, fill_value=n_loc)[0]
-            rows = lax.all_gather(wp_local[idx], axis, axis=0, tiled=True)
-            counts = counts - _rows_dominate_counts(rows, w_local)
-            todo = todo.at[idx].set(False, mode="drop")
-            return counts, todo, lax.psum(jnp.sum(todo, dtype=jnp.int32),
-                                          axis)
+                def sub_round(s):
+                    counts, todo, _, t, front_total = s
+                    idx = jnp.nonzero(todo, size=c, fill_value=n_loc)[0]
+                    idx = idx.astype(jnp.int32)
+                    n_rem = jnp.sum(todo, dtype=jnp.int32)
+                    gidx = jnp.where(idx < n_loc, idx + d_off, n_pad)
+                    payload = jnp.concatenate([n_rem[None], gidx])
+                    g = lax.all_gather(payload, axis, axis=0,
+                                       tiled=True).reshape(D, c + 1)
+                    rem = g[:, 0]                 # per-device front left
+                    front_total = jnp.where(t == 0, jnp.sum(rem),
+                                            front_total)
+                    # compact the real indices (each device holds the
+                    # identical gathered buffer, so the compaction and
+                    # the block count below are uniform by construction)
+                    flat = g[:, 1:].reshape(-1)   # (D*c,) idx, sentinels
+                    pos = jnp.nonzero(flat < n_pad, size=D * c,
+                                      fill_value=D * c)[0]
+                    flat_s = jnp.concatenate(
+                        [flat, jnp.full((1,), n_pad, jnp.int32)])
+                    cidx = flat_s[pos]            # real rows first
+                    n_real = jnp.sum(jnp.minimum(rem, c))
+                    n_blocks = -(-n_real // c)
 
-        def subtract_front(counts, front):
-            n_todo0 = lax.psum(jnp.sum(front, dtype=jnp.int32), axis)
-            counts, _, _ = lax.while_loop(lambda s: s[2] > 0, sub_round,
-                                          (counts, front, n_todo0))
-            return counts
+                    def blk_cond(s2):
+                        return s2[1] < n_blocks
+
+                    def blk(s2):
+                        counts2, b = s2
+                        rows = w_full_s[
+                            lax.dynamic_slice(cidx, (b * c,), (c,))]
+                        counts2 = counts2 - dom_counts(
+                            rows, w_local).astype(jnp.int32)
+                        return counts2, b + 1
+
+                    counts, _ = lax.while_loop(
+                        blk_cond, blk, (counts, jnp.int32(0)))
+                    todo = todo.at[idx].set(False, mode="drop")
+                    return (counts, todo, jnp.any(rem > c), t + 1,
+                            front_total)
+
+                counts, _, _, _, front_total = lax.while_loop(
+                    sub_cond, sub_round,
+                    (counts, front, vary(jnp.bool_(True)), jnp.int32(0),
+                     vary(jnp.int32(0))))
+                return counts, front_total
+
+            def body(state):
+                ranks, counts, active, r, n_active = state
+                front = active & (counts == 0)
+                ranks = jnp.where(front, r, ranks)
+                counts, front_total = subtract_front(counts, front)
+                active = active & ~front
+                return (ranks, counts, active, r + 1,
+                        n_active - front_total)
+
+        else:                                     # exchange == "rows"
+            wp_local = jnp.concatenate(
+                [w_local, jnp.full((1, m), -jnp.inf, w_local.dtype)], 0)
+
+            def sub_round(s):
+                counts, todo, _ = s
+                idx = jnp.nonzero(todo, size=c, fill_value=n_loc)[0]
+                rows = lax.all_gather(wp_local[idx], axis, axis=0,
+                                      tiled=True)
+                counts = counts - dom_counts(rows, w_local
+                                             ).astype(jnp.int32)
+                todo = todo.at[idx].set(False, mode="drop")
+                return counts, todo, lax.psum(
+                    jnp.sum(todo, dtype=jnp.int32), axis)
+
+            def subtract_front(counts, front, n_todo0):
+                counts, _, _ = lax.while_loop(lambda s: s[2] > 0,
+                                              sub_round,
+                                              (counts, front, n_todo0))
+                return counts
+
+            def body(state):
+                ranks, counts, active, r, _ = state
+                front = active & (counts == 0)
+                ranks = jnp.where(front, r, ranks)
+                active_new = active & ~front
+                # ONE stacked psum per front: [front width, survivors]
+                # (the pre-r06 build psummed the same survivor mask twice
+                # — once here for the loop condition, once inside
+                # subtract_front for the sub-round count)
+                tot = lax.psum(
+                    jnp.stack([jnp.sum(front, dtype=jnp.int32),
+                               jnp.sum(active_new, dtype=jnp.int32)]),
+                    axis)
+                counts = subtract_front(counts, front, tot[0])
+                return ranks, counts, active_new, r + 1, tot[1]
+
+        # all rows (padding included) start active: the initial global
+        # count is the static n_pad in both modes — no psum needed
+        n_active0 = vary(jnp.int32(n_pad))
 
         def cond(state):
             _, _, _, _, n_active = state
@@ -147,19 +345,9 @@ def nondominated_ranks_sharded(w: jax.Array, mesh: Mesh, axis: str = "pop",
             # (n_pad - n_active) counts exactly the ranked real rows
             return (n_active > 0) & (n_pad - n_active < stop)
 
-        def body(state):
-            ranks, counts, active, r, _ = state
-            front = active & (counts == 0)
-            ranks = jnp.where(front, r, ranks)
-            counts = subtract_front(counts, front)
-            active = active & ~front
-            return (ranks, counts, active, r + 1,
-                    lax.psum(jnp.sum(active, dtype=jnp.int32), axis))
-
         with jax.named_scope("obs:front_peel"):
             ranks0 = vary(jnp.full((n_loc,), n, jnp.int32))  # sentinel = n
             active0 = vary(jnp.ones((n_loc,), bool))
-            n_active0 = lax.psum(jnp.sum(active0, dtype=jnp.int32), axis)
             ranks, _, _, nf, _ = lax.while_loop(
                 cond, body,
                 (ranks0, counts, active0, jnp.int32(0), n_active0))
@@ -172,21 +360,35 @@ def nondominated_ranks_sharded(w: jax.Array, mesh: Mesh, axis: str = "pop",
 
 
 def sel_nsga2_sharded(key, fitness, k, mesh: Mesh, axis: str = "pop",
-                      front_chunk: int = 256, row_chunk: int = 1024):
+                      front_chunk: int = 256, row_chunk: int = 1024,
+                      exchange: str = "indices"):
     """NSGA-II selection with dominance counting sharded over
     ``mesh.shape[axis]`` devices — index-identical to
     :func:`deap_tpu.ops.emo.sel_nsga2` with ``nd="peel"`` (reference
     selNSGA2, emo.py:15-50).  ``key`` unused (deterministic).
 
-    The O(M·N²) ranks come from :func:`nondominated_ranks_sharded`; the
-    O(N log N) crowding + final sort run replicated (they are noise at
-    the populations where sharding matters)."""
+    The O(M·N²) ranks come from :func:`nondominated_ranks_sharded`
+    (``exchange`` selects the collective protocol; the default
+    ``"indices"`` peel issues one small int32 all-gather per front round
+    and no reductions at all); the O(N log N) crowding + final sort run
+    replicated (they are noise at the populations where sharding
+    matters)."""
     del key
     w, values = _wv_values(fitness)
     ranks, _ = nondominated_ranks_sharded(
         w, mesh, axis=axis, front_chunk=front_chunk, row_chunk=row_chunk,
-        stop_at_k=int(k))
+        stop_at_k=int(k), exchange=exchange)
     with jax.named_scope("obs:crowding_tail"):
+        # the tail is replicated BY CONSTRAINT, not by hope: without the
+        # explicit resharding GSPMD partitions the crowding lexsorts and
+        # segment reductions over the pop axis and inserts ~10 all-reduces
+        # of its own (measured on the 8-device CPU mesh) — two up-front
+        # all-gathers (the int32 ranks and, when the caller's fitness
+        # lives sharded, the (N, nobj) float32 values) are the whole cost
+        # of keeping the O(N log N) tail reduction-free
+        rep = NamedSharding(mesh, P())
+        ranks = lax.with_sharding_constraint(ranks, rep)
+        values = lax.with_sharding_constraint(values, rep)
         dist = assign_crowding_dist(values, ranks)
         order = jnp.lexsort((-dist, ranks))
     return order[:k]

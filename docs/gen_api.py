@@ -57,7 +57,8 @@ PAGES = {
     "coev": ("Co-evolution (deap_tpu.coev)", ["deap_tpu.coev"]),
     "parallel": ("Distribution (deap_tpu.parallel)",
                  ["deap_tpu.parallel.mapper", "deap_tpu.parallel.islands",
-                  "deap_tpu.parallel.multihost"]),
+                  "deap_tpu.parallel.multihost",
+                  "deap_tpu.parallel.emo_sharded"]),
     "resilience": ("Resilient runtime (deap_tpu.resilience)",
                    ["deap_tpu.resilience.runner",
                     "deap_tpu.resilience.quarantine",

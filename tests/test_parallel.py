@@ -364,18 +364,117 @@ def test_sharded_nsga2_index_identical(n, m, k):
         np.asarray(sel_nsga2_sharded(None, w, k, mesh)))
 
 
+def _collective_instr(txt: str, name: str) -> int:
+    """HLO *instruction* count for one collective opcode, via the ONE
+    shared counting rule (bench_weakscaling — the same rule the
+    committed collective budget gates, so the pin and the gate can
+    never disagree)."""
+    from bench_weakscaling import _collective_ops
+    return _collective_ops(txt).get(name, 0)
+
+
 def test_sharded_nsga2_lowers_to_collectives():
-    """The compiled sharded selector must contain real XLA collectives
-    (all-gather for the row blocks, all-reduce for the replicated peel
-    decisions) — proof the dominance work is distributed, not gathered
-    to one device."""
+    """The compiled sharded selector must contain real XLA all-gathers
+    (population + index payloads) — proof the dominance work is
+    distributed, not gathered to one device — and, in the default
+    ``indices`` exchange, NO reduction collectives at all: every peel
+    decision is derived from the gathered index payloads
+    (the collective-lean contract; the absolute per-layout inventory is
+    gated by tools/check_collective_budget.py)."""
     from deap_tpu.parallel import sel_nsga2_sharded
     mesh = Mesh(np.array(jax.devices()[:8]), ("pop",))
     w = _mo_cloud(jax.random.PRNGKey(0), 512, 3)
     txt = (jax.jit(lambda w: sel_nsga2_sharded(None, w, 256, mesh))
            .lower(w).compile().as_text())
-    assert txt.count("all-gather") > 0, "no all-gather in sharded selection"
-    assert txt.count("all-reduce") > 0, "no all-reduce in sharded selection"
+    assert _collective_instr(txt, "all-gather") > 0, \
+        "no all-gather in sharded selection"
+    assert _collective_instr(txt, "all-reduce") == 0, \
+        "reduction collective leaked into the collective-lean peel"
+
+
+def test_sharded_nsga2_rows_fallback_fused_psum():
+    """The legacy row-gather exchange stays selectable and its per-front
+    reductions stay FUSED: one stacked psum in the peel body plus one in
+    the sub-round loop — two all-reduce sites, not the pre-r06 three
+    (body's survivor count + subtract_front's duplicate front count +
+    sub-round's todo count)."""
+    from deap_tpu.parallel import sel_nsga2_sharded
+    mesh = Mesh(np.array(jax.devices()[:8]), ("pop",))
+    w = _mo_cloud(jax.random.PRNGKey(0), 512, 3)
+    txt = (jax.jit(lambda w: sel_nsga2_sharded(None, w, 256, mesh,
+                                               exchange="rows"))
+           .lower(w).compile().as_text())
+    assert _collective_instr(txt, "all-gather") > 0
+    n_reduce = _collective_instr(txt, "all-reduce")
+    assert 0 < n_reduce <= 2, (
+        f"rows-exchange peel should psum at exactly two sites "
+        f"(fused body + sub-round), found {n_reduce}")
+
+
+def test_sharded_nsga2_rows_exchange_index_identical():
+    """The legacy rows exchange is the same selector: index-identical to
+    the single-device peel, including a non-divisible population (the
+    default indices exchange is covered by
+    test_sharded_nsga2_index_identical above)."""
+    from deap_tpu.parallel import sel_nsga2_sharded
+    from deap_tpu.ops.emo import sel_nsga2
+    mesh = Mesh(np.array(jax.devices()[:8]), ("pop",))
+    for n, m, k in ((512, 3, 256), (500, 3, 211)):
+        w = _mo_cloud(jax.random.PRNGKey(n + m), n, m)
+        np.testing.assert_array_equal(
+            np.asarray(sel_nsga2(None, w, k, nd="peel")),
+            np.asarray(sel_nsga2_sharded(None, w, k, mesh,
+                                         exchange="rows")))
+
+
+@pytest.mark.parametrize("exchange", ["indices", "rows"])
+def test_sharded_nsga2_multi_subround_chunks(exchange):
+    """front_chunk=2 forces every wide front through MANY compaction
+    sub-rounds (and, in the indices exchange, through multi-block local
+    subtraction) — the loop paths a comfortable chunk never enters."""
+    from deap_tpu.parallel import nondominated_ranks_sharded
+    from deap_tpu.ops.emo import nondominated_ranks
+    mesh = Mesh(np.array(jax.devices()[:8]), ("pop",))
+    w = _mo_cloud(jax.random.PRNGKey(2), 256, 3)
+    r_ref, nf_ref = nondominated_ranks(w, method="peel", stop_at_k=128)
+    r_sh, nf_sh = nondominated_ranks_sharded(w, mesh, front_chunk=2,
+                                             stop_at_k=128,
+                                             exchange=exchange)
+    np.testing.assert_array_equal(np.asarray(r_ref), np.asarray(r_sh))
+    assert int(nf_ref) == int(nf_sh)
+
+
+@pytest.mark.parametrize("exchange", ["indices", "rows"])
+@pytest.mark.parametrize("stop_at_k", [None, 17])
+def test_sharded_nsga2_line_regime(exchange, stop_at_k):
+    """Adversarial ``line`` regime: every point on one dominance chain,
+    so F = N single-member fronts — the peel's worst case (one exchange
+    round per point) and the regime where a front is never wider than
+    one device's chunk.  n=90 is non-divisible by 8, so padding rows
+    ride through all N rounds.  Ranks, n_fronts, the ``stop_at_k``
+    early exit, and the full selection must all be index-identical to
+    the unsharded peel."""
+    from deap_tpu.parallel import (sel_nsga2_sharded,
+                                   nondominated_ranks_sharded)
+    from deap_tpu.ops.emo import sel_nsga2, nondominated_ranks
+    mesh = Mesh(np.array(jax.devices()[:8]), ("pop",))
+    n = 90
+    t = jnp.arange(n, dtype=jnp.float32)
+    chain = jnp.stack([t, 2.0 * t, 0.5 * t], axis=1)   # one strict chain
+    w = chain[jax.random.permutation(jax.random.PRNGKey(11), n)]
+    r_ref, nf_ref = nondominated_ranks(w, method="peel",
+                                       stop_at_k=stop_at_k)
+    r_sh, nf_sh = nondominated_ranks_sharded(w, mesh, front_chunk=8,
+                                             stop_at_k=stop_at_k,
+                                             exchange=exchange)
+    np.testing.assert_array_equal(np.asarray(r_ref), np.asarray(r_sh))
+    assert int(nf_ref) == int(nf_sh) == (n if stop_at_k is None
+                                         else stop_at_k)
+    k = stop_at_k or n // 2
+    np.testing.assert_array_equal(
+        np.asarray(sel_nsga2(None, w, k, nd="peel")),
+        np.asarray(sel_nsga2_sharded(None, w, k, mesh, front_chunk=8,
+                                     exchange=exchange)))
 
 
 def test_sharded_nsga2_with_fitness_and_sharded_input():

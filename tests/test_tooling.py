@@ -61,6 +61,36 @@ def test_sleep_checker_catches_planted_sleeps(tmp_path):
     assert chk.find_blocking_sleeps(bad) == [4, 5, 6]
 
 
+def test_collective_budget_gate():
+    """The compiled collective inventory of the three weak-scaling
+    layouts (bench_weakscaling.build: pop / island / mo) must stay
+    within tools/collective_budget.json — the r06 collective-lean
+    sharded NSGA-II contract (the r05 peel's 26 all-reduces regressed
+    silently because nothing gated the HLO).  The script provisions its
+    own 8-virtual-device CPU mesh."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "check_collective_budget.py")],
+        capture_output=True, text=True, timeout=300,
+        env={k: v for k, v in os.environ.items() if k != "XLA_FLAGS"})
+    assert out.returncode == 0, out.stderr or out.stdout
+
+
+def test_collective_budget_catches_a_regression():
+    """The gate must actually be able to fail: feed the pure comparison
+    a measured inventory that exceeds budget (a psum snuck back into the
+    peel) and one within budget."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import check_collective_budget as chk
+    finally:
+        sys.path.pop(0)
+    budget = {"mo": {"all-gather": 4}}
+    bad = chk.compare({"mo": {"all-gather": 4, "all-reduce": 2}}, budget)
+    assert len(bad) == 1 and "all-reduce" in bad[0]
+    assert chk.compare({"mo": {"all-gather": 3}}, budget) == []
+
+
 def test_serve_entry_and_extra_wired():
     """pyproject must expose the deap-tpu-serve console entry (pointing at
     an importable callable) and a [serve] extra + serve pytest marker.

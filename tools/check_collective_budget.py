@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Collective-budget gate: lower the three weak-scaling layouts
+(``pop``, ``island``, ``mo`` — bench_weakscaling.py's programs, built by
+the same ``build()`` the bench times) on an 8-virtual-device CPU mesh
+and FAIL when any layout's HLO collective instruction count exceeds the
+committed budget (``tools/collective_budget.json``).
+
+Why a gate and not just a bench metric: collective regressions are
+silent.  The r05 sharded NSGA-II peel re-gathered float row blocks and
+psum-ed every loop condition — 17 all-gathers / 26 all-reduces in the
+compiled text and a measured 5.6× partition overhead — and nothing
+failed; the number just sat in a JSON nobody diffed.  The budget makes
+the collective inventory a tier-1 contract the same way the AST passes
+gate prints and sleeps (tests/test_tooling.py runs this script).
+
+Shapes are deliberately tiny (lowering is the cost; HLO collective
+*structure* — which loops carry which collectives — does not depend on
+array sizes, and the committed budget records the shapes it was taken
+at).  Counts are instruction definitions (``opcode(`` / ``opcode-start(``
+spellings), not substring hits — operand references would inflate those.
+
+Usage::
+
+    python tools/check_collective_budget.py            # gate (exit 1 on breach)
+    python tools/check_collective_budget.py --update-budget
+    python bench_weakscaling.py --update-budget        # same thing
+
+A breach with an intentional cause (a new collective the design calls
+for) is resolved by re-running ``--update-budget`` and committing the
+diff — the review then sees the inventory change explicitly.
+"""
+
+import json
+import os
+import sys
+
+N_DEV = 8
+
+# the gate's canonical shapes: small enough that the three lowerings fit
+# a test budget, large enough that every loop body still materializes
+GATE_SHAPES = dict(pop_per_dev=256, mo_pop=1024, dim=16, n_groups=N_DEV)
+GATE_NGEN = 2
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUDGET_PATH = os.path.join(_REPO, "tools", "collective_budget.json")
+LAYOUTS = ("pop", "island", "mo")
+
+
+def _init_devices():
+    """8 virtual CPU devices, set up BEFORE jax initializes (same dance
+    as tests/conftest.py — this script must also run standalone)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={N_DEV}"
+        ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < N_DEV:
+        raise SystemExit(f"need {N_DEV} virtual CPU devices, have "
+                         f"{len(jax.devices())}")
+
+
+def measure_counts() -> dict:
+    """{layout: {collective: instruction count}} for the three layouts
+    at the gate shapes, via bench_weakscaling's shared builder."""
+    sys.path.insert(0, _REPO)
+    import bench_weakscaling
+    return {layout: bench_weakscaling.collective_ops(
+                layout, N_DEV, ngen=GATE_NGEN, **GATE_SHAPES)
+            for layout in LAYOUTS}
+
+
+def compare(counts: dict, budget: dict) -> list:
+    """Pure comparison (unit-tested without any lowering): one violation
+    string per (layout, collective) whose measured count exceeds the
+    budgeted count.  Collectives absent from the budget are budgeted 0;
+    measured counts BELOW budget pass (improvements don't fail the gate
+    — refresh the budget to lock them in)."""
+    violations = []
+    for layout, ops in sorted(counts.items()):
+        allowed = budget.get(layout, {})
+        for name, got in sorted(ops.items()):
+            cap = int(allowed.get(name, 0))
+            if got > cap:
+                violations.append(
+                    f"{layout}: {name} x{got} exceeds budget {cap}")
+    return violations
+
+
+def update_budget(path: str = BUDGET_PATH) -> dict:
+    counts = measure_counts()
+    doc = {
+        "_note": ("HLO collective instruction budget for the three "
+                  "weak-scaling layouts, gated tier-1 by "
+                  "tools/check_collective_budget.py; regenerate with "
+                  "--update-budget (also reachable as "
+                  "bench_weakscaling.py --update-budget) and commit the "
+                  "diff when an inventory change is intentional"),
+        "n_devices": N_DEV,
+        "shapes": dict(GATE_SHAPES, ngen=GATE_NGEN),
+        "method": "instruction definitions: 'opcode(' + 'opcode-start('",
+        "budget": counts,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    budget_path = BUDGET_PATH
+    if "--budget-file" in argv:
+        budget_path = argv[argv.index("--budget-file") + 1]
+    _init_devices()
+    if "--update-budget" in argv:
+        doc = update_budget(budget_path)
+        print(json.dumps({"updated": budget_path,
+                          "budget": doc["budget"]}))
+        return 0
+    try:
+        with open(budget_path) as f:
+            budget = json.load(f)["budget"]
+    except (OSError, KeyError, ValueError) as e:
+        print(f"cannot read budget {budget_path}: {e}", file=sys.stderr)
+        return 2
+    counts = measure_counts()
+    violations = compare(counts, budget)
+    print(json.dumps({"counts": counts, "violations": violations}))
+    if violations:
+        for v in violations:
+            print(f"COLLECTIVE BUDGET EXCEEDED — {v}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

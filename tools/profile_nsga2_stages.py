@@ -5,16 +5,36 @@ variation+evaluation — measured on a STEADY-STATE pool (20 generations
 evolved first; front structure, which drives the peel's round count,
 differs wildly between random and evolved populations).
 
+``--sharded`` profiles the *sharded* selection path
+(deap_tpu/parallel/emo_sharded.py) instead: per-phase wall time and HLO
+collective counts keyed by the kernel's named scopes
+(``obs:dominance_count`` / ``obs:front_peel`` / ``obs:crowding_tail``)
+on a PROF_DEVICES-device mesh (default 8; virtual CPU devices are
+provisioned automatically when the host platform is CPU).  Phase times
+are differences of nested programs — counts-only, ranks(stop_at_k),
+full selection — each marginal-timed; collective attribution parses the
+compiled HLO's ``op_name`` metadata, where ``jax.named_scope`` leaves
+the phase labels.  Env: PROF_POP (default 8192 sharded), PROF_DEVICES.
+
 Same scan-marginal timing as tools/pallas_probe_ga.py.
 """
 
 import json
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
+
+if "--sharded" in sys.argv:
+    # must precede the jax import: virtual devices are an XLA init flag
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count="
+            + os.environ.get("PROF_DEVICES", "8")).strip()
 
 import jax
 import jax.numpy as jnp
@@ -149,7 +169,123 @@ def main():
     report("vary_plus_eval", sec, r)
 
 
+NAMED_SCOPES = ("obs:dominance_count", "obs:front_peel",
+                "obs:crowding_tail")
+
+
+def collectives_by_scope(txt: str) -> dict:
+    """HLO collective *instructions* bucketed by the ``obs:`` named
+    scope their ``op_name`` metadata carries (``other`` = outside every
+    phase scope).  This is how "N collectives per selection" becomes "N
+    in the peel loop, M in the tail" without guessing.  The instruction
+    recognizer is bench_weakscaling's — ONE rule for the budget gate,
+    the HLO-pin tests, and this attribution, so they can never disagree
+    about the same compiled program."""
+    from bench_weakscaling import collective_op_on_line
+    out = {s: {} for s in NAMED_SCOPES + ("other",)}
+    for line in txt.splitlines():
+        name = collective_op_on_line(line)
+        if name is None:
+            continue
+        nm = re.search(r'op_name="([^"]*)"', line)
+        scope = next((s for s in NAMED_SCOPES
+                      if nm and s in nm.group(1)), "other")
+        d = out[scope]
+        d[name] = d.get(name, 0) + 1
+    return {k: v for k, v in out.items() if v}
+
+
+def main_sharded():
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from deap_tpu.parallel.emo_sharded import (
+        dominance_counts_sharded, nondominated_ranks_sharded,
+        sel_nsga2_sharded)
+
+    n_dev = int(os.environ.get("PROF_DEVICES", 8))
+    if len(jax.devices()) < n_dev:
+        raise SystemExit(f"--sharded needs {n_dev} devices, have "
+                         f"{len(jax.devices())} (CPU hosts get virtual "
+                         "devices automatically; set PROF_DEVICES)")
+    pop = int(os.environ.get("PROF_POP", 8192))   # CPU-mesh-sized default
+    k_sel = pop // 2
+    fc = max(64, pop // 16)
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("pop",))
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.uniform(key, (pop, 3))
+    w = -jnp.stack([x[:, 0], x[:, 1] * (1.5 - x[:, 0]),
+                    x[:, 2] * (1.5 - x[:, 0])], axis=1)
+    w = jax.device_put(w, NamedSharding(mesh, P("pop", None)))
+
+    def perturb(ww, out):
+        return ww * (1.0 + 1e-12 * (out.astype(jnp.float32) % 3))
+
+    def make_counts(n):
+        def body(ww, _):
+            cnt = dominance_counts_sharded(ww, mesh)
+            return perturb(ww, cnt[0]), cnt[0]
+        return lambda v: lax.scan(body, v, None, length=n)
+
+    def make_ranks(n):
+        def body(ww, _):
+            rk, _ = nondominated_ranks_sharded(ww, mesh, front_chunk=fc,
+                                               stop_at_k=k_sel)
+            return perturb(ww, rk[0]), rk[0]
+        return lambda v: lax.scan(body, v, None, length=n)
+
+    def make_sel(n):
+        def body(ww, _):
+            idx = sel_nsga2_sharded(None, ww, k_sel, mesh,
+                                    front_chunk=fc)
+            return perturb(ww, idx[0]), idx[0]
+        return lambda v: lax.scan(body, v, None, length=n)
+
+    sec_c, r_c = marginal(make_counts, w, k=K)
+    report("sharded_dominance_counts", sec_c, r_c)
+    sec_r, r_r = marginal(make_ranks, w, k=K)
+    report("sharded_ranks_stop_at_k", sec_r, r_r)
+    sec_s, r_s = marginal(make_sel, w, k=K)
+    report("sharded_sel_nsga2_full", sec_s, r_s)
+
+    def phase(sec, *ratios):
+        """A phase is a DIFFERENCE of independently timed programs, so
+        it is only evidence when every involved probe passes its own
+        linearity gate — otherwise report the harness convention -1
+        (a failed gate once produced a negative 'crowding tail' here;
+        raise PROF_K until the gates pass)."""
+        ok = all(1.5 <= r <= 2.7 for r in ratios)
+        return round(sec * 1e3, 3) if ok else -1
+
+    txt = (jax.jit(lambda v: sel_nsga2_sharded(None, v, k_sel, mesh,
+                                               front_chunk=fc))
+           .lower(w).compile().as_text())
+    print(json.dumps({
+        "phase_ms": {
+            "obs:dominance_count": phase(sec_c, r_c),
+            "obs:front_peel": phase(sec_r - sec_c, r_c, r_r),
+            "obs:crowding_tail": phase(sec_s - sec_r, r_r, r_s),
+        },
+        "linearity": {"counts": round(r_c, 2), "ranks": round(r_r, 2),
+                      "sel": round(r_s, 2), "gate": [1.5, 2.7]},
+        "note": ("phase times are marginal-program differences "
+                 "(counts-only / ranks / full selection), -1 when any "
+                 "involved probe fails the linearity gate; collectives "
+                 "are HLO instructions attributed via named-scope "
+                 "op_name metadata"),
+        "collectives_by_scope": collectives_by_scope(txt),
+    }), flush=True)
+
+
 if __name__ == "__main__":
-    print(json.dumps({"platform": jax.devices()[0].platform, "pop": POP}),
-          flush=True)
-    main()
+    if "--sharded" in sys.argv:
+        print(json.dumps({"platform": jax.devices()[0].platform,
+                          "pop": int(os.environ.get("PROF_POP", 8192)),
+                          "n_devices": int(os.environ.get("PROF_DEVICES",
+                                                          8)),
+                          "mode": "sharded"}), flush=True)
+        main_sharded()
+    else:
+        print(json.dumps({"platform": jax.devices()[0].platform,
+                          "pop": POP}), flush=True)
+        main()
