@@ -20,26 +20,33 @@ the compiled evolution step:
 * :mod:`~deap_tpu.serve.metrics` — host counters/gauges/latency
   quantiles, snapshotting into the observability sink layer;
 * :mod:`~deap_tpu.serve.cli` — the ``deap-tpu-serve`` console entry
-  (multi-session demo load with a live stats view).
+  (``--listen`` network mode, demo fleet with a live stats view);
+* :mod:`~deap_tpu.serve.net` — the network frontend (imported explicitly,
+  not re-exported here): stdlib HTTP server, binary JSON+tensor wire
+  protocol, ``RemoteService``/``RemoteSession`` client, and the
+  drain/restore surface behind cross-instance failover.
 """
 
 from .buckets import (BucketPolicy, BucketKey, BucketOverflow,  # noqa: F401
                       genome_signature, pad_rows, unpad_rows,
-                      pad_population)
+                      pad_population, ShapeHistogram, derive_sizes)
 from .cache import FitnessCache, row_digests, rep_indices  # noqa: F401
 from .dispatcher import (BatchDispatcher, Request, ServeFuture,  # noqa: F401
                          ServeError, ServiceClosed, ServiceOverloaded,
-                         DeadlineExceeded, RequestCancelled)
-from .metrics import ServeMetrics, SERVE_COUNTERS, SERVE_GAUGES  # noqa: F401
+                         DeadlineExceeded, RequestCancelled,
+                         ServiceDraining, SessionUnknown)
+from .metrics import (ServeMetrics, SERVE_COUNTERS, SERVE_GAUGES,  # noqa: F401
+                      NET_COUNTERS)
 from .service import EvolutionService, Session  # noqa: F401
 
 __all__ = [
     "EvolutionService", "Session",
     "BucketPolicy", "BucketKey", "BucketOverflow", "genome_signature",
     "pad_rows", "unpad_rows", "pad_population",
+    "ShapeHistogram", "derive_sizes",
     "FitnessCache", "row_digests", "rep_indices",
     "BatchDispatcher", "Request", "ServeFuture",
     "ServeError", "ServiceClosed", "ServiceOverloaded", "DeadlineExceeded",
-    "RequestCancelled",
-    "ServeMetrics", "SERVE_COUNTERS", "SERVE_GAUGES",
+    "RequestCancelled", "ServiceDraining", "SessionUnknown",
+    "ServeMetrics", "SERVE_COUNTERS", "SERVE_GAUGES", "NET_COUNTERS",
 ]

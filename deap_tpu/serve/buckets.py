@@ -27,7 +27,8 @@ The bucketing policy is deliberately asymmetric:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Tuple
+import threading
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -35,7 +36,8 @@ import jax.numpy as jnp
 from ..base import Population, Fitness
 
 __all__ = ["BucketPolicy", "BucketKey", "BucketOverflow", "genome_signature",
-           "pad_rows", "unpad_rows", "pad_population"]
+           "pad_rows", "unpad_rows", "pad_population",
+           "ShapeHistogram", "derive_sizes"]
 
 
 class BucketOverflow(ValueError):
@@ -72,14 +74,18 @@ class BucketPolicy:
     """Row-bucket selection.
 
     ``sizes`` — explicit ascending bucket grid; a request lands in the
-    smallest listed size that fits (:class:`BucketOverflow` beyond the
-    largest).  Empty (default): next power of two, floored at
-    ``min_rows``, capped at ``max_rows`` when set.
+    smallest listed size that fits.  Beyond the largest listed size:
+    :class:`BucketOverflow` by default, or — with ``grow_beyond`` — fall
+    back to doubling from the largest size (how adaptively derived grids
+    stay open to tenants bigger than anything yet observed).  Empty
+    ``sizes`` (default): next power of two, floored at ``min_rows``.
+    ``max_rows``, when set, caps every path.
     """
 
     sizes: Tuple[int, ...] = ()
     min_rows: int = 8
     max_rows: Optional[int] = None
+    grow_beyond: bool = False
 
     def __post_init__(self):
         if self.sizes and tuple(sorted(self.sizes)) != tuple(self.sizes):
@@ -92,10 +98,17 @@ class BucketPolicy:
         if self.sizes:
             for s in self.sizes:
                 if n <= s:
+                    if self.max_rows is not None and s > self.max_rows:
+                        raise BucketOverflow(
+                            f"{n} rows lands in listed bucket {s} > "
+                            f"max_rows={self.max_rows}")
                     return int(s)
-            raise BucketOverflow(
-                f"{n} rows exceeds the largest bucket {self.sizes[-1]}")
-        rows = max(int(self.min_rows), 1)
+            if not self.grow_beyond:
+                raise BucketOverflow(
+                    f"{n} rows exceeds the largest bucket {self.sizes[-1]}")
+            rows = int(self.sizes[-1])
+        else:
+            rows = max(int(self.min_rows), 1)
         while rows < n:
             rows *= 2
         if self.max_rows is not None and rows > self.max_rows:
@@ -109,6 +122,96 @@ class BucketPolicy:
                          genome_sig=genome_signature(population.genome),
                          nobj=population.fitness.nobj,
                          weights=population.fitness.weights)
+
+
+class ShapeHistogram:
+    """Observed request-shape histogram: live row counts → occurrence
+    counts.  The service records every admitted shape (session opens,
+    restores, ad-hoc evaluate batches) here; at a quiesce point
+    :meth:`derive_policy` turns the histogram into an *explicit* bucket
+    grid fitted to the traffic actually seen, instead of the a-priori
+    power-of-two grid.  Thread-safe (request threads write, rebucket
+    reads)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[int, int] = {}
+
+    def observe(self, n: int, weight: int = 1) -> None:
+        """Record ``weight`` requests of ``n`` live rows."""
+        n = int(n)
+        if n < 1:
+            raise ValueError("row count must be >= 1")
+        with self._lock:
+            self._counts[n] = self._counts.get(n, 0) + int(weight)
+
+    def counts(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._counts)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+    def derive_policy(self, *, max_buckets: int = 8, min_rows: int = 8,
+                      round_to: int = 1,
+                      max_rows: Optional[int] = None) -> "BucketPolicy":
+        """Fit an explicit :class:`BucketPolicy` grid to the histogram
+        (see :func:`derive_sizes`).  Raises when nothing was observed —
+        an empty histogram has no traffic to fit.  The derived policy is
+        ``grow_beyond=True``: a tenant larger than anything yet observed
+        doubles up from the largest learned size instead of being
+        rejected (an observability-driven refit must never become an
+        admission regression).  ``max_rows`` carries the operator's hard
+        admission cap through the refit — a rebucket must never widen
+        what the previous policy admitted."""
+        sizes = derive_sizes(self.counts(), max_buckets=max_buckets,
+                             min_rows=min_rows, round_to=round_to)
+        return BucketPolicy(sizes=sizes, min_rows=min_rows,
+                            max_rows=max_rows, grow_beyond=True)
+
+
+def derive_sizes(counts: Dict[int, int], *, max_buckets: int = 8,
+                 min_rows: int = 8, round_to: int = 1) -> Tuple[int, ...]:
+    """Fit an ascending explicit bucket grid to an observed
+    ``{rows: count}`` histogram.
+
+    Every observed row count lands exactly on a grid size (rounded up to
+    ``round_to`` and floored at ``min_rows``), then adjacent sizes are
+    greedily coalesced until at most ``max_buckets`` remain — each merge
+    removes the size whose traffic pays the least total padding by moving
+    up to the next size (cost = count × row gap).  The result wastes the
+    minimum pad rows this greedy can find while capping the number of
+    compiled programs per request kind at ``max_buckets``."""
+    if not counts:
+        raise ValueError("cannot derive a bucket grid from an empty "
+                         "shape histogram")
+    if max_buckets < 1:
+        raise ValueError("max_buckets must be >= 1")
+    if round_to < 1:
+        raise ValueError("round_to must be >= 1")
+
+    def snap(n: int) -> int:
+        return max(int(min_rows), -(-int(n) // round_to) * round_to)
+
+    weight: Dict[int, int] = {}
+    for n, c in counts.items():
+        s = snap(n)
+        weight[s] = weight.get(s, 0) + int(c)
+    sizes = sorted(weight)
+    while len(sizes) > max_buckets:
+        # merging sizes[i] into sizes[i+1] pads each of its rows' requests
+        # up by the gap; drop the cheapest merge each round
+        costs = [weight[sizes[i]] * (sizes[i + 1] - sizes[i])
+                 for i in range(len(sizes) - 1)]
+        i = costs.index(min(costs))
+        weight[sizes[i + 1]] += weight.pop(sizes[i])
+        del sizes[i]
+    return tuple(sizes)
 
 
 def pad_rows(tree: Any, rows: int):

@@ -162,6 +162,23 @@ class FitnessCache:
         with self._lock:
             return (namespace, digest) in self._entries
 
+    def purge_namespace(self, evaluator_id: int) -> int:
+        """Drop every entry whose namespace belongs to ``evaluator_id``
+        (the leading element of the service's ``(evaluator_id, sig, nobj)``
+        namespace tuples).  The service calls this when an evaluator's pin
+        refcount hits zero: ``id()`` values recycle, so a later evaluator
+        allocated at the same address must never inherit the dead one's
+        cached fitness.  Returns the number of entries purged (also counted
+        as ``cache_purged``)."""
+        with self._lock:
+            stale = [k for k in self._entries
+                     if isinstance(k[0], tuple) and k[0]
+                     and k[0][0] == evaluator_id]
+            for k in stale:
+                del self._entries[k]
+        self._inc("cache_purged", len(stale))
+        return len(stale)
+
     def hit_rate(self) -> float:
         """Lifetime hit fraction (0.0 when nothing was looked up)."""
         if self._metrics is None:

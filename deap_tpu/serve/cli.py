@@ -1,16 +1,24 @@
-"""``deap-tpu-serve`` — multi-session service demo with a live stats view.
+"""``deap-tpu-serve`` — serve over the network, or demo a session fleet.
 
 The serving sibling of ``deap-tpu-selftest`` / ``deap-tpu-trace``: stand up
-an :class:`~deap_tpu.serve.service.EvolutionService` ON THE TARGET BACKEND,
-drive a mixed-shape fleet of synthetic GA sessions through it, and stream
-the service's own metrics (queue depth, batch occupancy, compile count,
-cache hit rate, latency p50/p99) while it runs — then print one JSON
-summary line.
+an :class:`~deap_tpu.serve.service.EvolutionService` ON THE TARGET BACKEND
+and either expose it over HTTP (``--listen``) or drive a mixed-shape fleet
+of synthetic GA sessions through it with a live stats view — then print
+one JSON summary line.
 
-    deap-tpu-serve                                   # defaults
+    deap-tpu-serve                                   # in-process demo fleet
+    deap-tpu-serve --listen 0.0.0.0:8077             # network frontend
+    deap-tpu-serve --listen 0.0.0.0:8077 --shard-threshold 65536
     deap-tpu-serve --sessions 8 --pops 100,256 --dims 16,32 --ngen 50
     deap-tpu-serve --compile-cache /tmp/xla_cache    # persistent compiles
     deap-tpu-serve --smoke                           # tiny CI smoke run
+
+``--listen`` serves the demo toolbox registry (``demo`` — Rastrigin GA)
+through :class:`deap_tpu.serve.net.NetServer` until interrupted; point
+:class:`deap_tpu.serve.net.RemoteService` (or curl) at it.  ``--smoke``
+exercises the full loopback network path — client → HTTP → service — and
+reads its JSON report back over the ``/v1/metrics`` endpoint, so a smoke
+pass certifies the wire stack, not just the in-process API.
 
 Exit status is non-zero when any session fails or goes non-finite — a
 smoke gate, not a benchmark (throughput numbers live in
@@ -69,11 +77,104 @@ def _stat_line(rec) -> str:
             f"p99={g.get('latency_p99_ms', 0.0):.1f}ms")
 
 
+def _run_listen(args) -> int:
+    """``--listen host:port`` — expose the service over HTTP until
+    interrupted."""
+    import threading
+
+    from .service import EvolutionService
+    from .net import NetServer
+
+    host, _, port = args.listen.rpartition(":")
+    if not host:
+        host, port = args.listen, "8077"
+    tb = _build_toolbox()
+    svc = EvolutionService(max_batch=args.max_batch,
+                           shard_threshold=args.shard_threshold)
+    with NetServer(svc, {"demo": tb}, host=host, port=int(port),
+                   verbose=True) as srv:
+        print(f"[serve] listening on {srv.url} "
+              f"(toolboxes: demo; ctrl-c to stop)")
+        try:
+            threading.Event().wait()          # serve until interrupted
+        except KeyboardInterrupt:
+            print("[serve] shutting down")
+    svc.close()
+    return 0
+
+
+def _run_smoke_net(args) -> int:
+    """``--smoke`` — drive a tiny fleet over the LOOPBACK NETWORK PATH
+    (client → HTTP → service) and report from the /v1/metrics endpoint."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from .. import base
+    from .service import EvolutionService
+    from .net import NetServer, RemoteService
+
+    pops = [int(p) for p in args.pops.split(",")]
+    dims = [int(d) for d in args.dims.split(",")]
+    tb = _build_toolbox()
+    t0 = time.perf_counter()
+    failures = 0
+    with EvolutionService(max_batch=args.max_batch) as svc, \
+            NetServer(svc, {"demo": tb}) as srv, \
+            RemoteService(srv.url, timeout=300) as cli:
+        fleet = []
+        for i in range(args.sessions):
+            n, d = pops[i % len(pops)], dims[i % len(dims)]
+            key = jax.random.PRNGKey(args.seed + i)
+            genome = jax.random.uniform(key, (n, d), jnp.float32,
+                                        -5.12, 5.12)
+            pop = base.Population(genome=genome,
+                                  fitness=base.Fitness.empty(n, (-1.0,)))
+            fleet.append(cli.open_session(key, pop, "demo", cxpb=0.7,
+                                          mutpb=0.3, name=f"demo-{i}"))
+        futures = [(s, s.step(args.ngen)) for s in fleet]
+        for s, fs in futures:
+            for f in fs:
+                exc = f.exception(timeout=300)
+                if exc is not None:
+                    failures += 1
+                    print(f"[serve] {s.name} step failed: {exc!r}",
+                          file=sys.stderr)
+        wall = time.perf_counter() - t0
+        bests = []
+        for s in fleet:
+            p = s.population()
+            bests.append(float(np.asarray(p.fitness.values[:, 0]).min()))
+        # the JSON report travels over the metrics endpoint — the smoke
+        # certifies the wire stack end to end
+        rec = cli.stats()
+        report = {
+            "mode": "net-smoke", "url": srv.url,
+            "sessions": args.sessions, "ngen": args.ngen,
+            "pops": pops, "dims": dims, "wall_s": wall,
+            "gens_per_sec": args.sessions * args.ngen / wall,
+            "counters": rec.counters, "gauges": rec.gauges,
+            "best_fitness": bests, "failures": failures,
+        }
+    print(json.dumps(report))
+    if failures or not all(np.isfinite(bests)):
+        print("FAILED: session failures or non-finite results",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="deap-tpu-serve",
-        description="drive a mixed-shape session fleet through one "
-                    "EvolutionService with a live stats view")
+        description="serve an EvolutionService over HTTP (--listen) or "
+                    "drive a mixed-shape session fleet with a live stats "
+                    "view")
+    ap.add_argument("--listen", metavar="HOST:PORT", default=None,
+                    help="serve over HTTP instead of running the demo "
+                         "fleet (deap_tpu.serve.net.NetServer)")
+    ap.add_argument("--shard-threshold", type=int, default=None,
+                    help="pop-shard sessions at/above this population "
+                         "size over the device mesh")
     ap.add_argument("--sessions", type=int, default=6)
     ap.add_argument("--pops", default="100,180",
                     help="comma-separated session population sizes")
@@ -88,7 +189,8 @@ def main(argv=None) -> int:
                          "(deap_tpu.utils.compilecache)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--smoke", action="store_true",
-                    help="tiny fixed configuration for CI smoke tests")
+                    help="tiny fixed configuration for CI smoke tests, "
+                         "driven over the loopback network path")
     args = ap.parse_args(argv)
     if args.smoke:
         args.sessions, args.pops, args.dims = 2, "12", "6"
@@ -97,6 +199,11 @@ def main(argv=None) -> int:
     if args.compile_cache:
         from ..utils.compilecache import enable_compile_cache
         enable_compile_cache(args.compile_cache)
+
+    if args.listen:
+        return _run_listen(args)
+    if args.smoke:
+        return _run_smoke_net(args)
 
     import numpy as np
     from ..observability.sinks import StdoutSink
@@ -109,7 +216,8 @@ def main(argv=None) -> int:
 
     t0 = time.perf_counter()
     failures = 0
-    with EvolutionService(max_batch=args.max_batch) as svc:
+    with EvolutionService(max_batch=args.max_batch,
+                          shard_threshold=args.shard_threshold) as svc:
         fleet = _open_fleet(svc, tb, args.sessions, pops, dims, args.seed)
         futures = {s.name: s.step(args.ngen) for s in fleet}
         last_line = 0

@@ -43,7 +43,7 @@ from ..resilience.retry import with_retries, RetriesExhausted
 
 __all__ = ["ServeFuture", "Request", "BatchDispatcher", "ServeError",
            "ServiceClosed", "ServiceOverloaded", "DeadlineExceeded",
-           "RequestCancelled"]
+           "RequestCancelled", "ServiceDraining", "SessionUnknown"]
 
 
 class ServeError(RuntimeError):
@@ -52,6 +52,15 @@ class ServeError(RuntimeError):
 
 class ServiceClosed(ServeError):
     """The service (or the request's session) was closed."""
+
+
+class ServiceDraining(ServeError):
+    """The service is draining for failover: no new work is admitted.
+    Clients should retry against the instance the sessions restore on."""
+
+
+class SessionUnknown(ServeError):
+    """No live session with that name (network frontend lookup miss)."""
 
 
 class ServiceOverloaded(ServeError):
@@ -211,6 +220,7 @@ class BatchDispatcher:
         self._cv = threading.Condition()
         self._pending: "collections.deque[Request]" = collections.deque()
         self._closed = False
+        self._draining = False
         self._paused = False
         self._busy = False
         self._batches = 0
@@ -228,6 +238,12 @@ class BatchDispatcher:
         with self._cv:
             if self._closed:
                 raise ServiceClosed("service is closed")
+            if self._draining:
+                # checked under the queue lock: once set_draining()
+                # returns, NOTHING can slip into the queue behind the
+                # drain wait — the failover snapshot sits at a boundary
+                # every client observed
+                raise ServiceDraining("service is draining for failover")
             if len(self._pending) >= self.max_pending:
                 # cancelled/expired entries still hold queue slots until
                 # the worker reaches them — resolve them here instead of
@@ -252,6 +268,14 @@ class BatchDispatcher:
                 self._metrics.set_gauge("queue_depth", len(self._pending))
             self._cv.notify_all()
         return request.future
+
+    def set_draining(self, value: bool = True) -> None:
+        """Reject (``ServiceDraining``) every submission from now on —
+        atomic with respect to in-flight :meth:`submit` calls, so after
+        this returns the pending queue can only shrink."""
+        with self._cv:
+            self._draining = bool(value)
+            self._cv.notify_all()
 
     def pause(self) -> None:
         """Stop dispatching new batches (in-flight one completes) —
@@ -293,6 +317,29 @@ class BatchDispatcher:
     @property
     def batches(self) -> int:
         with self._cv:
+            return self._batches
+
+    def remap_pending(self, fn: Callable[[Request], None]) -> None:
+        """Apply ``fn`` to every still-queued request under the queue
+        lock.  The rebucket quiesce uses this to rewrite queued requests'
+        ``program_key``/``capacity`` after sessions moved buckets —
+        without it, a request enqueued before the refit would dispatch
+        its new-shaped state through the stale compiled program."""
+        with self._cv:
+            for req in self._pending:
+                fn(req)
+
+    def wait_for_batches(self, seen: int,
+                         timeout: Optional[float] = None) -> int:
+        """Block until the dispatched-batch count exceeds ``seen`` (or the
+        dispatcher closes, or ``timeout`` elapses) and return the current
+        count.  A Condition wait, not a poll — the streaming metrics
+        endpoint tails service activity through this without burning a
+        busy loop."""
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self._batches > seen or self._closed,
+                timeout=timeout)
             return self._batches
 
     # -- worker side ---------------------------------------------------------

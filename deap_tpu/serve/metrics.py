@@ -19,20 +19,30 @@ from typing import Dict, Iterable, Optional
 
 from ..observability.sinks import MetricRecord, emit_record
 
-__all__ = ["ServeMetrics", "SERVE_COUNTERS", "SERVE_GAUGES"]
+__all__ = ["ServeMetrics", "SERVE_COUNTERS", "SERVE_GAUGES", "NET_COUNTERS"]
 
 #: Counters the service maintains (cumulative over the service lifetime).
 SERVE_COUNTERS = (
     "requests", "completed", "failed", "cancelled", "deadline_misses",
     "rejected", "batches", "retries", "compiles", "compiles_step",
     "compiles_init", "compiles_ask", "compiles_tell", "compiles_evaluate",
-    "steps", "evaluations", "cache_hits", "cache_misses", "cache_evictions",
-    "cache_nan_skipped", "dedup_rows", "quarantined",
+    "steps", "steps_sharded", "evaluations", "cache_hits", "cache_misses",
+    "cache_evictions", "cache_nan_skipped", "cache_purged", "dedup_rows",
+    "quarantined", "rebuckets",
+)
+
+#: Counters the network frontend (deap_tpu.serve.net) adds on top —
+#: maintained in the same ServeMetrics store so one /metrics snapshot
+#: covers both the HTTP edge and the device control plane.
+NET_COUNTERS = (
+    "net_requests", "net_errors", "net_streams",
+    "net_bytes_in", "net_bytes_out",
 )
 
 #: Gauges (last-value).
 SERVE_GAUGES = (
-    "queue_depth", "sessions", "slot_occupancy", "row_occupancy",
+    "queue_depth", "sessions", "sharded_sessions", "slot_occupancy",
+    "row_occupancy",
 )
 
 
@@ -42,7 +52,8 @@ class ServeMetrics:
 
     def __init__(self, latency_window: int = 2048):
         self._lock = threading.Lock()
-        self._counters: Dict[str, int] = {k: 0 for k in SERVE_COUNTERS}
+        self._counters: Dict[str, int] = {
+            k: 0 for k in SERVE_COUNTERS + NET_COUNTERS}
         self._gauges: Dict[str, float] = {k: 0.0 for k in SERVE_GAUGES}
         self._latency: Dict[str, collections.deque] = {}
         self._window = int(latency_window)

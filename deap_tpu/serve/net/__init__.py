@@ -1,0 +1,36 @@
+"""Network frontend for the serving layer: evolution over the wire.
+
+The in-process :class:`~deap_tpu.serve.service.EvolutionService`
+multiplexes tenants that live in the same interpreter; this package is the
+edge in front of it — a stdlib HTTP frontend (``[serve]`` extra stays
+dependency-free), a binary JSON+tensor wire format, and a thin remote
+client mirroring the in-process ``Session`` API:
+
+* :mod:`~deap_tpu.serve.net.protocol` — the frame codec (one JSON header +
+  contiguous raw little-endian tensor payloads; bit-exact round trips for
+  every genome/fitness dtype) and the HTTP error mapping;
+* :mod:`~deap_tpu.serve.net.server` — :class:`NetServer`: session
+  create/ask/tell/step/evaluate/close over HTTP, a streaming
+  ``/v1/metrics`` endpoint, and the ``/v1/admin`` drain/restore/rebucket
+  surface that cross-instance failover rides on;
+* :mod:`~deap_tpu.serve.net.client` — :class:`RemoteService` /
+  :class:`RemoteSession`: the future-based ask/tell/step/evaluate API of
+  the in-process session, backed by a pipelined HTTP worker; trajectories
+  are **bitwise identical** to serving the same session in-process
+  (pinned by ``tests/test_serve_net.py``).
+
+Kept out of ``deap_tpu.serve``'s import path on purpose: importing the
+service layer must not cost an HTTP stack, so ``from deap_tpu.serve.net
+import NetServer, RemoteService`` is the entry point.
+"""
+
+from .protocol import (encode_frame, decode_frame, remote_exception,  # noqa: F401
+                       status_of, CONTENT_TYPE, MAGIC)
+from .server import NetServer  # noqa: F401
+from .client import RemoteService, RemoteSession  # noqa: F401
+
+__all__ = [
+    "NetServer", "RemoteService", "RemoteSession",
+    "encode_frame", "decode_frame", "remote_exception", "status_of",
+    "CONTENT_TYPE", "MAGIC",
+]

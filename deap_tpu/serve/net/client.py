@@ -1,0 +1,452 @@
+""":class:`RemoteService` / :class:`RemoteSession` — the in-process
+``Session`` API over the wire.
+
+A ``RemoteSession`` mirrors :class:`deap_tpu.serve.service.Session`:
+``step(n)`` returns ``n`` :class:`~deap_tpu.serve.dispatcher.ServeFuture`
+objects, ``ask``/``tell``/``evaluate`` return one — the same shapes, the
+same typed exceptions (rebuilt from the wire error envelope), the same
+bitwise trajectories (pinned against in-process serving by
+``tests/test_serve_net.py``).  Ordering is preserved the same way the
+in-process dispatcher preserves it: one background worker thread owns the
+session-mutating HTTP connection and sends requests strictly in
+submission order, resolving futures as responses land.  ``step(n)``
+travels as ONE request carrying ``n`` (a per-generation result list comes
+back), so pipelined stepping costs one round trip per *call*, not per
+generation.
+
+Failover from the client's side is symmetric to the server's
+drain/restore::
+
+    snap = RemoteService(a_url).drain()      # instance A quiesces + snapshots
+    b = RemoteService(b_url)
+    b.restore(snap)                          # instance B adopts every session
+    s = b.attach("run-0")                    # continue, bitwise
+
+Synchronous reads (``population()``, ``stats()``, admin calls) use
+per-call connections so they never queue behind a long step pipeline.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+from urllib.parse import quote
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...base import Population, Fitness
+from ...observability.sinks import MetricRecord
+from ..dispatcher import ServeError, ServeFuture, ServiceClosed
+from . import protocol
+
+__all__ = ["RemoteService", "RemoteSession"]
+
+
+def _parse_address(address) -> Tuple[str, int]:
+    if isinstance(address, (tuple, list)):
+        return str(address[0]), int(address[1])
+    addr = str(address)
+    if addr.startswith("http://"):
+        addr = addr[len("http://"):]
+    addr = addr.rstrip("/")
+    host, _, port = addr.rpartition(":")
+    if not host:
+        raise ValueError(f"address {address!r} needs host:port")
+    return host, int(port)
+
+
+class _Worker:
+    """One thread + FIFO queue owning the ordered (session-mutating) HTTP
+    connection — the client-side mirror of the dispatcher's single worker
+    thread.  Jobs run strictly in submission order; a job's ``resolve``
+    callback receives ``(result, exception)``."""
+
+    def __init__(self, host: str, port: int, timeout: float):
+        self._host, self._port, self._timeout = host, port, timeout
+        self._conn: Optional[http.client.HTTPConnection] = None
+        self._jobs: "queue.Queue" = queue.Queue()
+        self._closed = False
+        self._thread = threading.Thread(target=self._run,
+                                        name="deap-tpu-remote", daemon=True)
+        self._thread.start()
+
+    def submit(self, job: Callable, resolve: Callable) -> None:
+        if self._closed:
+            raise ServiceClosed("remote client is closed")
+        self._jobs.put((job, resolve))
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._jobs.put(None)
+            self._thread.join(timeout=10.0)
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+    def _connection(self) -> http.client.HTTPConnection:
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                self._host, self._port, timeout=self._timeout)
+        return self._conn
+
+    def _run(self) -> None:
+        while True:
+            item = self._jobs.get()
+            if item is None:
+                while not self._jobs.empty():      # fail queued stragglers
+                    tail = self._jobs.get()
+                    if tail is not None:
+                        tail[1](None, ServiceClosed("remote client closed"))
+                return
+            job, resolve = item
+            try:
+                result = job(self._connection())
+            except _SendFailed:
+                # the request never hit the wire (stale keep-alive
+                # connection, server restart) — retrying on a fresh
+                # connection cannot double-execute anything
+                self._drop_connection()
+                try:
+                    result = job(self._connection())
+                except _SendFailed as e2:
+                    self._drop_connection()
+                    resolve(None, e2.cause)
+                    continue
+                except Exception as e2:  # noqa: BLE001
+                    self._drop_connection()
+                    resolve(None, e2)
+                    continue
+                resolve(result, None)
+                continue
+            except (http.client.HTTPException, OSError) as e:
+                # response-phase failure: the server MAY have executed the
+                # request (a step/tell is not idempotent), so fail the
+                # future instead of silently re-sending — the caller can
+                # resync via population()/attach()
+                self._drop_connection()
+                resolve(None, e)
+                continue
+            except Exception as e:  # noqa: BLE001
+                resolve(None, e)
+                continue
+            resolve(result, None)
+
+    def _drop_connection(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+
+class _SendFailed(Exception):
+    """Transport failure BEFORE the request reached the wire — the server
+    cannot have executed it, so a retry on a fresh connection is safe.
+    (A response-phase failure is NOT retried: the server may already have
+    applied a step/tell, and re-sending would silently double-apply.)"""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
+def _request(conn: http.client.HTTPConnection, method: str, path: str,
+             obj: Any = None) -> Any:
+    body = None if obj is None else protocol.encode_frame(obj)
+    headers = {"Content-Type": protocol.CONTENT_TYPE}
+    try:
+        conn.request(method, path, body=body, headers=headers)
+    except (http.client.HTTPException, OSError) as e:
+        # an incomplete HTTP request is never processed server-side
+        raise _SendFailed(e)
+    resp = conn.getresponse()
+    data = resp.read()
+    if resp.status >= 400:
+        try:
+            err = json.loads(data.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            raise ServeError(f"HTTP {resp.status}: {data[:200]!r}")
+        raise protocol.remote_exception(err.get("error", "ServeError"),
+                                        err.get("message", ""))
+    if not data:
+        return None
+    if data[:4] == protocol.MAGIC:
+        return protocol.decode_frame(data)
+    return json.loads(data.decode("utf-8"))
+
+
+class RemoteService:
+    """Client handle on one :class:`~deap_tpu.serve.net.server.NetServer`
+    instance (see module docstring).  ``address`` is ``"host:port"``,
+    ``(host, port)`` or an ``http://`` URL."""
+
+    def __init__(self, address, *, timeout: float = 600.0):
+        self.host, self.port = _parse_address(address)
+        self.timeout = float(timeout)
+        self._worker = _Worker(self.host, self.port, self.timeout)
+        self._closed = False
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _sync(self, method: str, path: str, obj: Any = None) -> Any:
+        """Out-of-band request on a fresh connection (never queues behind
+        the ordered worker)."""
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+        try:
+            return _request(conn, method, path, obj)
+        finally:
+            conn.close()
+
+    def _ordered_raw(self, method: str, path: str, obj: Any,
+                     resolve: Callable[[Any, Optional[BaseException]], None]
+                     ) -> None:
+        """Queue one request on the ordered worker connection;
+        ``resolve(result, exc)`` runs on the worker thread."""
+        def job(conn):
+            return _request(conn, method, path, obj)
+        self._worker.submit(job, resolve)
+
+    def _ordered(self, method: str, path: str, obj: Any,
+                 on_result: Callable[[Any, ServeFuture], None] = None
+                 ) -> ServeFuture:
+        future = ServeFuture()
+
+        def resolve(result, exc):
+            if exc is not None:
+                future._set_exception(exc)
+            elif on_result is not None:
+                on_result(result, future)
+            else:
+                future._set_result(result)
+
+        self._ordered_raw(method, path, obj, resolve)
+        return future
+
+    # -- service surface -----------------------------------------------------
+
+    def healthz(self) -> dict:
+        return self._sync("GET", "/v1/healthz")
+
+    def toolboxes(self) -> List[str]:
+        return self._sync("GET", "/v1/toolboxes")["toolboxes"]
+
+    def stats(self) -> MetricRecord:
+        rec = self._sync("GET", "/v1/metrics")
+        return MetricRecord(gen=rec["gen"], counters=rec["counters"],
+                            gauges=rec["gauges"], meta=rec.get("meta", {}))
+
+    def stream_metrics(self, *, max_records: int = 10,
+                       timeout: float = 30.0) -> Iterator[MetricRecord]:
+        """Tail the server's metrics stream: yields a
+        :class:`MetricRecord` per service activity wave (chunked ND-JSON
+        under the hood)."""
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+        try:
+            conn.request("GET", f"/v1/metrics?stream=1&max={int(max_records)}"
+                                f"&timeout={float(timeout)}")
+            resp = conn.getresponse()
+            if resp.status >= 400:
+                raise ServeError(f"HTTP {resp.status} on metrics stream")
+            while True:
+                line = resp.readline()
+                if not line:
+                    return
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line.decode("utf-8"))
+                yield MetricRecord(gen=rec["gen"], counters=rec["counters"],
+                                   gauges=rec["gauges"],
+                                   meta=rec.get("meta", {}))
+        finally:
+            conn.close()
+
+    def open_session(self, key, population: Population, toolbox: str, *,
+                     cxpb: float = 0.5, mutpb: float = 0.2,
+                     name: Optional[str] = None,
+                     evaluate_initial: bool = True) -> "RemoteSession":
+        """Mirror of :meth:`EvolutionService.open_session`, with
+        ``toolbox`` a *name* in the server's registry (functions don't
+        travel)."""
+        fit = population.fitness
+        body = {"toolbox": str(toolbox),
+                "key": _raw_key(key),
+                "genome": _host_tree(population.genome),
+                "weights": tuple(fit.weights),
+                "cxpb": float(cxpb), "mutpb": float(mutpb),
+                "evaluate_initial": bool(evaluate_initial)}
+        if bool(np.asarray(fit.valid).any()):
+            body["values"] = np.asarray(fit.values, np.float32)
+            body["valid"] = np.asarray(fit.valid)
+        if name is not None:
+            body["name"] = str(name)
+        out = self._sync("POST", "/v1/sessions", body)
+        return RemoteSession(self, out["name"], gen=int(out["gen"]),
+                             weights=tuple(fit.weights),
+                             pop=int(out["pop"]))
+
+    def attach(self, name: str) -> "RemoteSession":
+        """Handle on a session that already lives server-side (opened by
+        another client, or restored there by failover)."""
+        info = self._sync("GET", f"/v1/sessions/{quote(name, safe='')}")
+        return RemoteSession(self, name, gen=int(info["gen"]),
+                             weights=tuple(info["weights"]),
+                             pop=int(info["pop"]))
+
+    # -- failover ------------------------------------------------------------
+
+    def drain(self, timeout: float = 60.0) -> Dict[str, dict]:
+        """Quiesce the instance and fetch its full session snapshot (the
+        object :meth:`restore` feeds to the replacement instance)."""
+        return self._sync("POST", "/v1/admin/drain",
+                          {"timeout": float(timeout)})["sessions"]
+
+    def restore(self, snapshot: Dict[str, dict]) -> List[str]:
+        """Adopt a drained snapshot on this instance; returns the restored
+        session names (attach with :meth:`attach`)."""
+        return self._sync("POST", "/v1/admin/restore",
+                          {"sessions": snapshot})["restored"]
+
+    def rebucket(self, *, max_buckets: int = 8,
+                 warm: tuple = ("step",)) -> dict:
+        return self._sync("POST", "/v1/admin/rebucket",
+                          {"max_buckets": int(max_buckets),
+                           "warm": list(warm)})
+
+    def close(self) -> None:
+        """Close the client (the server and its sessions stay up)."""
+        self._closed = True
+        self._worker.close()
+
+    def __enter__(self) -> "RemoteService":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class RemoteSession:
+    """Wire mirror of :class:`deap_tpu.serve.service.Session` — same
+    future-based API, same typed failures, protocol state enforced
+    server-side (an out-of-order ``tell`` fails its future with the same
+    :class:`ServeError` the in-process session raises)."""
+
+    def __init__(self, service: RemoteService, name: str, *, gen: int = 0,
+                 weights: tuple = (), pop: Optional[int] = None):
+        self._service = service
+        self.name = name
+        self.gen = int(gen)
+        self.weights = tuple(weights)
+        self._pop = pop           # population size never changes server-side
+        self.closed = False
+
+    def _path(self, op: str = "") -> str:
+        # names are chosen by clients and may hold '/', spaces, '?', ... —
+        # percent-encode so every name that create accepted stays routable
+        base = f"/v1/sessions/{quote(self.name, safe='')}"
+        return f"{base}/{op}" if op else base
+
+    # -- request API (mirrors Session) ---------------------------------------
+
+    def step(self, n: int = 1,
+             deadline: Optional[float] = None) -> List[ServeFuture]:
+        """Advance ``n`` generations; returns ``n`` futures resolving to
+        ``{"gen", "nevals"}``.  One wire round trip for the whole call —
+        the per-generation results fan back out onto the futures (a
+        generation that failed server-side fails only its own future,
+        exactly like in-process serving)."""
+        futures = [ServeFuture() for _ in range(int(n))]
+
+        def resolve(result, exc):
+            if exc is not None:      # transport failure fails every gen
+                for f in futures:
+                    f._set_exception(exc)
+                return
+            for f, r in zip(futures, result["results"]):
+                if "error" in r:
+                    f._set_exception(protocol.remote_exception(
+                        r["error"], r.get("message", "")))
+                else:
+                    self.gen = int(r["ok"]["gen"])
+                    f._set_result(r["ok"])
+
+        self._service._ordered_raw("POST", self._path("step"),
+                                   {"n": int(n), "deadline": deadline},
+                                   resolve)
+        return futures
+
+    def ask(self, deadline: Optional[float] = None) -> ServeFuture:
+        """Resolves to the offspring genome rows awaiting external
+        evaluation (host numpy, same bits the in-process ask returns)."""
+        def keep_gen(result, future):
+            self.gen = int(result["gen"])
+            future._set_result(result["offspring"])
+        return self._service._ordered("POST", self._path("ask"),
+                                      {"deadline": deadline},
+                                      on_result=keep_gen)
+
+    def tell(self, values,
+             deadline: Optional[float] = None) -> ServeFuture:
+        def keep_gen(result, future):
+            self.gen = int(result["ok"]["gen"])
+            future._set_result(result["ok"])
+        return self._service._ordered(
+            "POST", self._path("tell"),
+            {"values": np.asarray(values), "deadline": deadline},
+            on_result=keep_gen)
+
+    def evaluate(self, genomes,
+                 deadline: Optional[float] = None) -> ServeFuture:
+        def unwrap(result, future):
+            future._set_result(result["values"])
+        return self._service._ordered(
+            "POST", self._path("evaluate"),
+            {"genome": _host_tree(genomes), "deadline": deadline},
+            on_result=unwrap)
+
+    # -- introspection -------------------------------------------------------
+
+    def population(self) -> Population:
+        """Current population, fetched synchronously (mirrors the
+        in-process accessor)."""
+        info = self._service._sync("GET", self._path())
+        self.gen = int(info["gen"])
+        self._pop = int(info["pop"])
+        return Population(
+            genome=jax.tree_util.tree_map(jnp.asarray, info["genome"]),
+            fitness=Fitness(values=jnp.asarray(info["values"], jnp.float32),
+                            valid=jnp.asarray(info["valid"], bool),
+                            weights=tuple(info["weights"])))
+
+    @property
+    def pop_size(self) -> int:
+        # cached from create/attach — a session's size is immutable, and
+        # the full-state GET would ship the whole population for one int
+        if self._pop is None:
+            self._pop = int(self._service._sync("GET", self._path())["pop"])
+        return self._pop
+
+    def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            self._service._sync("DELETE", self._path())
+
+
+def _raw_key(key) -> np.ndarray:
+    key = jnp.asarray(key) if not isinstance(key, jax.Array) else key
+    if jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key):
+        key = jax.random.key_data(key)
+    return np.asarray(key).astype(np.uint32)
+
+
+def _host_tree(tree):
+    """Genome pytree → host numpy leaves, container structure preserved
+    (what the frame codec serializes)."""
+    return jax.tree_util.tree_map(np.asarray, tree)

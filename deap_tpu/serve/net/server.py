@@ -1,0 +1,463 @@
+""":class:`NetServer` — the HTTP frontend of one
+:class:`~deap_tpu.serve.service.EvolutionService` instance.
+
+Pure stdlib (``http.server.ThreadingHTTPServer``): one handler thread per
+connection blocks on the service's futures — socket waits and Condition
+waits only, never ``time.sleep`` (``tools/check_no_blocking_sleep.py``
+walks this package too).  Toolboxes cannot travel over a wire, so the
+server owns a **toolbox registry**: clients name a registered toolbox at
+session create, and the name is remembered per session so a drain
+snapshot can be restored on any instance holding the same registry.
+
+Surface (all frames — see :mod:`~deap_tpu.serve.net.protocol` — unless
+noted)::
+
+    GET    /v1/healthz                      liveness + drain state (JSON)
+    GET    /v1/toolboxes                    registry names (JSON)
+    POST   /v1/sessions                     create (key/genome/weights/...)
+    GET    /v1/sessions/{name}              current population + phase
+    DELETE /v1/sessions/{name}              close
+    POST   /v1/sessions/{name}/step         {"n": k} -> k per-gen results
+    POST   /v1/sessions/{name}/ask          -> offspring genome rows
+    POST   /v1/sessions/{name}/tell         {"values": tensor}
+    POST   /v1/sessions/{name}/evaluate     {"genome": tensor} -> values
+    GET    /v1/metrics                      one MetricRecord (JSON); add
+                                            ?stream=1&max=K&timeout=S for
+                                            chunked ND-JSON tailing
+    POST   /v1/admin/drain                  failover step 1: quiesce +
+                                            snapshot every live session
+    POST   /v1/admin/restore                failover step 2: adopt a
+                                            drained snapshot
+    POST   /v1/admin/rebucket               adaptive bucket-grid refit
+
+Cross-instance failover is drain → ship the frame → restore: the snapshot
+carries each session's toolbox *name*, bucket rows and raw PRNG key, so
+the restoring instance continues every trajectory **bitwise** when its
+policy/registry match (pinned by the tier-1 failover drill in
+``tests/test_serve_net.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Sequence
+from urllib.parse import parse_qs, unquote, urlparse
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...base import Population, Fitness
+from ...observability.sinks import emit_text
+from ..dispatcher import SessionUnknown
+from . import protocol
+
+__all__ = ["NetServer"]
+
+
+class NetServer:
+    """Serve an :class:`~deap_tpu.serve.service.EvolutionService` over
+    HTTP (see module docstring).
+
+    Parameters
+    ----------
+    service:
+        The (already constructed) in-process service instance.
+    toolboxes:
+        Name → toolbox registry clients may open sessions against.
+    host / port:
+        Bind address; ``port=0`` picks a free port (read it back from
+        :attr:`address` / :attr:`url`).
+    result_timeout:
+        Server-side cap on waiting for one request's device futures.
+    sinks / verbose:
+        Request-log routing (library output goes through the
+        observability sink layer, never bare prints).
+    """
+
+    def __init__(self, service, toolboxes: Dict[str, Any], *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 result_timeout: float = 600.0, sinks: Sequence = (),
+                 verbose: bool = False):
+        self.service = service
+        self.toolboxes = dict(toolboxes)
+        self.result_timeout = float(result_timeout)
+        self.sinks = list(sinks)
+        self.verbose = bool(verbose)
+        self._session_toolbox: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        net = self
+
+        class Handler(_Handler):
+            server_ctx = net
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "NetServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="deap-tpu-serve-http", daemon=True)
+            self._thread.start()
+            if self.verbose:
+                emit_text(f"[serve.net] listening on {self.url}", self.sinks)
+        return self
+
+    def close(self) -> None:
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self._httpd.server_close()
+
+    def __enter__(self) -> "NetServer":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    @property
+    def address(self) -> tuple:
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    # -- session helpers -----------------------------------------------------
+
+    def _session(self, name: str):
+        s = self.service.sessions().get(name)
+        if s is None:
+            raise SessionUnknown(f"no live session named {name!r}")
+        return s
+
+    def _result(self, future):
+        return future.result(timeout=self.result_timeout)
+
+    # -- route bodies (called from the handler; return encodable objects) ----
+
+    def h_healthz(self) -> dict:
+        return {"status": "draining" if self.service.draining else "ok",
+                "sessions": len(self.service.sessions()),
+                "draining": bool(self.service.draining)}
+
+    def h_create(self, body: dict) -> dict:
+        tb_name = body["toolbox"]
+        toolbox = self.toolboxes.get(tb_name)
+        if toolbox is None:
+            raise SessionUnknown(f"no registered toolbox named {tb_name!r}")
+        genome = _as_device(body["genome"])
+        n = _rows_of(genome)
+        weights = tuple(float(w) for w in body["weights"])
+        if body.get("values") is not None:
+            fitness = Fitness(values=jnp.asarray(body["values"], jnp.float32),
+                              valid=jnp.asarray(body["valid"], bool),
+                              weights=weights)
+        else:
+            fitness = Fitness.empty(n, weights)
+        pop = Population(genome=genome, fitness=fitness)
+        session = self.service.open_session(
+            jnp.asarray(np.asarray(body["key"])), pop, toolbox,
+            cxpb=float(body.get("cxpb", 0.5)),
+            mutpb=float(body.get("mutpb", 0.2)),
+            name=body.get("name"),
+            evaluate_initial=bool(body.get("evaluate_initial", True)),
+            timeout=self.result_timeout)
+        with self._lock:
+            self._session_toolbox[session.name] = tb_name
+        return {"name": session.name, "gen": session.gen,
+                "pop": session.pop_size, "rows": session.bucket.rows,
+                "sharded": session.sharded}
+
+    def h_get_session(self, name: str) -> dict:
+        s = self._session(name)
+        p = s.population()
+        return {"name": s.name, "gen": s.gen, "phase": s.phase,
+                "pop": s.pop_size, "rows": s.bucket.rows,
+                "sharded": s.sharded, "weights": s.bucket.weights,
+                "genome": p.genome, "values": np.asarray(p.fitness.values),
+                "valid": np.asarray(p.fitness.valid)}
+
+    def h_close_session(self, name: str) -> dict:
+        self._session(name).close()
+        with self._lock:
+            self._session_toolbox.pop(name, None)
+        return {"closed": name}
+
+    def h_step(self, name: str, body: dict) -> dict:
+        s = self._session(name)
+        futures = s.step(int(body.get("n", 1)),
+                         deadline=body.get("deadline"))
+        results = []
+        for f in futures:
+            try:
+                results.append({"ok": self._result(f)})
+            except Exception as e:  # noqa: BLE001 — per-gen error travels
+                results.append({"error": type(e).__name__,
+                                "message": str(e)})
+        return {"results": results, "gen": s.gen}
+
+    def h_ask(self, name: str, body: dict) -> dict:
+        s = self._session(name)
+        off = self._result(s.ask(deadline=body.get("deadline")))
+        return {"offspring": off, "gen": s.gen}
+
+    def h_tell(self, name: str, body: dict) -> dict:
+        s = self._session(name)
+        out = self._result(s.tell(np.asarray(body["values"]),
+                                  deadline=body.get("deadline")))
+        return {"ok": out}
+
+    def h_evaluate(self, name: str, body: dict) -> dict:
+        s = self._session(name)
+        values = self._result(s.evaluate(_as_device(body["genome"]),
+                                         deadline=body.get("deadline")))
+        return {"values": np.asarray(values)}
+
+    def h_drain(self, body: dict) -> dict:
+        snaps = self.service.drain(timeout=body.get("timeout", 60.0))
+        # resolve toolbox names AFTER the drain: the session set is frozen
+        # now, so a create that raced the drain gate is either in the
+        # snapshot (and resolvable below) or was rejected — never admitted
+        # yet unnamed
+        with self._lock:
+            names = dict(self._session_toolbox)
+        # sessions opened OUTSIDE this frontend (in-process, or restored
+        # from a disk checkpoint) have no recorded registry name —
+        # reverse-map their toolbox object so the snapshot stays
+        # restorable on any instance holding the same registry
+        rev = {id(tb): tn for tn, tb in self.toolboxes.items()}
+        for name, sess in self.service.sessions().items():
+            if name not in names:
+                tn = rev.get(id(sess.toolbox))
+                if tn is not None:
+                    names[name] = tn
+        for name, snap in snaps.items():
+            snap["toolbox"] = names.get(name)
+        if self.verbose:
+            emit_text(f"[serve.net] drained {len(snaps)} sessions",
+                      self.sinks)
+        return {"sessions": snaps}
+
+    def h_restore(self, body: dict) -> dict:
+        snaps = body["sessions"]
+        toolboxes: Dict[str, Any] = {}
+        skipped: Dict[str, str] = {}
+        for name, snap in snaps.items():
+            tb_name = snap.get("toolbox")
+            toolbox = self.toolboxes.get(tb_name)
+            if toolbox is None:
+                # one orphan (session drained with a toolbox this
+                # registry doesn't hold) must not block the restorable
+                # majority's failover — skip it and say so
+                skipped[name] = (f"toolbox {tb_name!r} not in this "
+                                 "instance's registry")
+                continue
+            toolboxes[name] = toolbox
+        if snaps and not toolboxes:
+            raise SessionUnknown(
+                "no session in the snapshot names a toolbox in this "
+                f"instance's registry (skipped: {skipped})")
+        restored = self.service.adopt_sessions(
+            {n: snaps[n] for n in toolboxes}, toolboxes)
+        with self._lock:
+            for name in restored:
+                self._session_toolbox[name] = snaps[name].get("toolbox")
+        if self.verbose:
+            emit_text(f"[serve.net] restored {sorted(restored)} "
+                      f"skipped {sorted(skipped)}", self.sinks)
+        return {"restored": sorted(restored), "skipped": skipped}
+
+    def h_rebucket(self, body: dict) -> dict:
+        return self.service.rebucket(
+            max_buckets=int(body.get("max_buckets", 8)),
+            warm=tuple(body.get("warm", ("step",))))
+
+
+def _as_device(tree):
+    """Decoded wire genome (numpy arrays in plain containers) → device
+    arrays, container structure preserved (pytree genomes allowed)."""
+    import jax
+    return jax.tree_util.tree_map(jnp.asarray, tree)
+
+
+def _rows_of(genome) -> int:
+    import jax
+    return jax.tree_util.tree_leaves(genome)[0].shape[0]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Routes one connection's requests into the :class:`NetServer`
+    context.  Keep-alive HTTP/1.1 with explicit Content-Length (chunked
+    only on the metrics stream)."""
+
+    protocol_version = "HTTP/1.1"
+    server_ctx: NetServer = None  # bound by NetServer
+
+    # -- plumbing ------------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # stdlib default prints to stderr
+        net = self.server_ctx
+        if net is not None and net.verbose:
+            emit_text(f"[serve.net] {self.address_string()} {fmt % args}",
+                      net.sinks)
+
+    def _body(self) -> Any:
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        data = self.rfile.read(length) if length else b""
+        self._body_consumed = True
+        if self.server_ctx is not None:
+            self.server_ctx.service.metrics.inc("net_bytes_in", len(data))
+        if not data:
+            return {}
+        if data[:4] == protocol.MAGIC:
+            return protocol.decode_frame(data)
+        return json.loads(data.decode("utf-8"))
+
+    def _drain_body(self) -> None:
+        """Consume an unread request body before replying on an error
+        path — leftover body bytes would be parsed as the NEXT request
+        line on this keep-alive connection, poisoning every subsequent
+        exchange."""
+        if getattr(self, "_body_consumed", False):
+            return
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        if length:
+            self.rfile.read(length)
+        self._body_consumed = True
+
+    def _send(self, payload: bytes, status: int = 200,
+              content_type: str = protocol.CONTENT_TYPE) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+        self.server_ctx.service.metrics.inc("net_bytes_out", len(payload))
+
+    def _send_obj(self, obj: Any, status: int = 200) -> None:
+        self._send(protocol.encode_frame(obj), status=status)
+
+    def _send_json(self, obj: Any, status: int = 200) -> None:
+        self._send(json.dumps(obj).encode("utf-8"), status=status,
+                   content_type="application/json")
+
+    def _send_error_obj(self, exc: BaseException) -> None:
+        self.server_ctx.service.metrics.inc("net_errors")
+        self._drain_body()
+        self._send(protocol.error_payload(exc),
+                   status=protocol.status_of(exc),
+                   content_type="application/json")
+
+    def _route(self, method: str) -> None:
+        net = self.server_ctx
+        net.service.metrics.inc("net_requests")
+        self._body_consumed = False
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        try:
+            if parts[:1] != ["v1"]:
+                raise SessionUnknown(f"unknown path {url.path!r}")
+            rest = parts[1:]
+            if method == "GET" and rest == ["healthz"]:
+                return self._send_json(net.h_healthz())
+            if method == "GET" and rest == ["toolboxes"]:
+                return self._send_json(
+                    {"toolboxes": sorted(net.toolboxes)})
+            if method == "GET" and rest == ["metrics"]:
+                return self._metrics(parse_qs(url.query))
+            if rest[:1] == ["sessions"]:
+                if method == "POST" and len(rest) == 1:
+                    return self._send_obj(net.h_create(self._body()))
+                # names arrive percent-encoded (clients quote arbitrary
+                # session names into the path)
+                if len(rest) == 2:
+                    if method == "GET":
+                        return self._send_obj(
+                            net.h_get_session(unquote(rest[1])))
+                    if method == "DELETE":
+                        return self._send_obj(
+                            net.h_close_session(unquote(rest[1])))
+                if method == "POST" and len(rest) == 3:
+                    name, op = unquote(rest[1]), rest[2]
+                    fn = {"step": net.h_step, "ask": net.h_ask,
+                          "tell": net.h_tell,
+                          "evaluate": net.h_evaluate}.get(op)
+                    if fn is not None:
+                        return self._send_obj(fn(name, self._body()))
+            if method == "POST" and rest[:1] == ["admin"] and len(rest) == 2:
+                fn = {"drain": net.h_drain, "restore": net.h_restore,
+                      "rebucket": net.h_rebucket}.get(rest[1])
+                if fn is not None:
+                    return self._send_obj(fn(self._body()))
+            raise SessionUnknown(f"unknown path {url.path!r}")
+        except BrokenPipeError:
+            raise
+        except Exception as e:  # noqa: BLE001 — typed over the wire
+            try:
+                self._send_error_obj(e)
+            except BrokenPipeError:
+                pass
+
+    # -- metrics stream ------------------------------------------------------
+
+    def _metrics(self, query: Dict[str, list]) -> None:
+        net = self.server_ctx
+        svc = net.service
+        if query.get("stream", ["0"])[0] not in ("1", "true"):
+            return self._send_json(json.loads(svc.stats().to_json()))
+        svc.metrics.inc("net_streams")
+        max_records = int(query.get("max", ["10"])[0])
+        timeout = float(query.get("timeout", ["30"])[0])
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(line: str) -> None:
+            data = (line + "\n").encode("utf-8")
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            svc.metrics.inc("net_bytes_out", len(data))
+
+        seen = -1
+        per_wait = min(timeout, 1.0)
+        deadline = timeout
+        waited = 0.0
+        emitted = 0
+        try:
+            while emitted < max_records:
+                # Condition-based tail of service activity (no polling
+                # sleep): emit a record whenever the batch counter moves,
+                # give up after `timeout` quiet seconds
+                now = svc.wait_for_activity(seen, timeout=per_wait)
+                if now == seen:
+                    waited += per_wait
+                    if waited >= deadline:
+                        break
+                    continue
+                waited = 0.0
+                seen = now
+                chunk(svc.stats().to_json())
+                emitted += 1
+            self.wfile.write(b"0\r\n\r\n")
+        except BrokenPipeError:
+            pass
+
+    # -- verbs ---------------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        self._route("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._route("POST")
+
+    def do_DELETE(self):  # noqa: N802
+        self._route("DELETE")

@@ -42,6 +42,8 @@ semantics live in :class:`~deap_tpu.serve.dispatcher.BatchDispatcher`.
 from __future__ import annotations
 
 import contextlib
+import copy
+import dataclasses
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
@@ -49,16 +51,17 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as _P
 
 from ..base import Population, Fitness
 from ..algorithms import ea_step, ea_ask, ea_tell, _norm_eval
 from ..observability import events as _events
 from ..observability.sinks import emit_text
-from .buckets import (BucketPolicy, BucketKey, pad_rows, unpad_rows,
-                      pad_population, genome_signature)
+from .buckets import (BucketPolicy, BucketKey, ShapeHistogram, pad_rows,
+                      unpad_rows, pad_population, genome_signature)
 from .cache import FitnessCache, flatten_rows, row_digests, rep_indices
 from .dispatcher import (BatchDispatcher, Request, ServeFuture, ServeError,
-                         ServiceClosed)
+                         ServiceClosed, ServiceDraining)
 from .metrics import ServeMetrics
 
 __all__ = ["EvolutionService", "Session"]
@@ -98,7 +101,8 @@ class Session:
 
     def __init__(self, service: "EvolutionService", name: str, toolbox,
                  bucket: BucketKey, state: Dict[str, jax.Array],
-                 gen: int = 0, phase: str = "idle", pending=None):
+                 gen: int = 0, phase: str = "idle", pending=None,
+                 sharded: bool = False):
         self._service = service
         self.name = name
         self.toolbox = toolbox
@@ -108,6 +112,14 @@ class Session:
         self.gen = int(gen)
         self.phase = phase           # idle | asked
         self.closed = False
+        #: population placed pop-axis-sharded over the service mesh and
+        #: stepped by a dedicated whole-mesh program (no slot-packing)
+        self.sharded = bool(sharded)
+        #: objects pinned on this session's behalf (toolbox, evaluators) —
+        #: captured at open/adopt time, released exactly once at close, so
+        #: re-registering toolbox attributes mid-run can never skew the
+        #: service's refcounts
+        self._pins: List[Any] = []
         # guards the phase check-and-transition (concurrent ask()/step()
         # from two client threads must not both pass the guard); NEVER
         # held across a submit — the dispatcher takes its own lock first
@@ -229,6 +241,15 @@ class EvolutionService:
     eval_retries / retry_backoff:
         Transient-fault retry budget around every device dispatch
         (:func:`deap_tpu.resilience.with_retries`).
+    shard_threshold / mesh:
+        Pop-sharded sessions: a session whose population reaches
+        ``shard_threshold`` rows is placed with its pop axis sharded over
+        ``mesh`` (default: :func:`deap_tpu.parallel.default_mesh` over all
+        visible devices) and stepped by a dedicated whole-mesh program —
+        no slot-packing, and an NSGA-II ``select`` is transparently routed
+        through :func:`deap_tpu.parallel.sel_nsga2_sharded` (bitwise
+        index-identical to the single-device peel).  ``None`` (default)
+        disables sharded placement.
     sinks / stats_every:
         Observability: emit a stats :class:`MetricRecord` to ``sinks``
         every N batches (0 = never); compile events also go to the
@@ -244,6 +265,7 @@ class EvolutionService:
                  dedup_max_flat_dim: int = 512, eval_retries: int = 2,
                  retry_backoff: float = 0.05, sinks: Sequence = (),
                  stats_every: int = 0, verbose: bool = False,
+                 shard_threshold: Optional[int] = None, mesh=None,
                  fault_hook=None, clock=time.monotonic):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -253,8 +275,12 @@ class EvolutionService:
         self.sinks = list(sinks)
         self.stats_every = int(stats_every)
         self.verbose = bool(verbose)
+        self.shard_threshold = (None if shard_threshold is None
+                                else int(shard_threshold))
+        self._mesh = mesh
         self.metrics = ServeMetrics()
         self.cache = FitnessCache(cache_capacity, metrics=self.metrics)
+        self.shapes = ShapeHistogram()
         self._fault_hook = fault_hook
         self._clock = clock
         self._programs: Dict[tuple, Any] = {}
@@ -265,10 +291,13 @@ class EvolutionService:
         # compiled programs instead of leaking them forever
         self._refs: Dict[int, Any] = {}
         self._refcounts: Dict[int, int] = {}
+        self._sharded_tbs: Dict[int, Any] = {}   # id(toolbox) -> shadow
         self._sessions: Dict[str, Session] = {}
+        self._reserved: set = set()   # names mid-admission (see _admit)
         self._names = 0
         self._lock = threading.Lock()
         self._closed = False
+        self._draining = False
         self._dispatcher = BatchDispatcher(
             self._execute, max_pending=max_pending,
             batch_window=batch_window, metrics=self.metrics,
@@ -302,7 +331,62 @@ class EvolutionService:
         counters (requests/compiles/cache/...) + gauges (queue depth,
         occupancy, latency p50/p90/p99)."""
         self.metrics.set_gauge("sessions", len(self._sessions))
+        self.metrics.set_gauge(
+            "sharded_sessions",
+            sum(1 for s in self.sessions().values() if s.sharded))
         return self.metrics.snapshot(self._dispatcher.batches)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def wait_for_activity(self, seen: int,
+                          timeout: Optional[float] = None) -> int:
+        """Block until the dispatched-batch count exceeds ``seen`` (or
+        ``timeout``); returns the current count.  Condition-based — the
+        streaming metrics endpoint tails service activity through this."""
+        return self._dispatcher.wait_for_batches(seen, timeout=timeout)
+
+    def drain(self, timeout: Optional[float] = 60.0) -> Dict[str, dict]:
+        """Failover step 1 of 2: stop admitting work, flush the queue, and
+        return the final host snapshot of every live session (the payload
+        :meth:`restore_sessions` / :meth:`adopt_sessions` consumes on the
+        replacement instance).
+
+        After ``drain()`` every further submission raises
+        :class:`~deap_tpu.serve.dispatcher.ServiceDraining`; the already
+        queued requests execute to completion first, so the snapshot sits
+        at a request boundary every client observed.  If the queue fails
+        to flush within ``timeout`` the drain RAISES (still draining —
+        retry with a larger timeout) rather than snapshotting state that
+        queued requests would advance past.  The service stays up for
+        metrics/introspection until :meth:`close`."""
+        self._draining = True
+        # the dispatcher-level flag is the authoritative gate: it flips
+        # under the queue lock, so a submit racing this drain either
+        # lands BEFORE it (and flushes below) or is rejected — never
+        # between the flush and the snapshot
+        self._dispatcher.set_draining(True)
+        if not self._dispatcher.drain(timeout=timeout):
+            raise ServeError(
+                f"drain timed out after {timeout}s with "
+                f"{self._dispatcher.queue_depth} requests still pending — "
+                "the service remains draining; retry with a larger "
+                "timeout (snapshotting now would lose queued progress)")
+        snaps = self.snapshot_sessions()
+        with self._lock:
+            sessions = list(self._sessions.values())
+        for s in sessions:
+            s.closed = True
+        return snaps
+
+    def mesh(self):
+        """The service's population-sharding mesh (created on first use
+        when sharding is enabled and none was passed)."""
+        if self._mesh is None:
+            from ..parallel.mapper import default_mesh
+            self._mesh = default_mesh()
+        return self._mesh
 
     # -- sessions ------------------------------------------------------------
 
@@ -312,39 +396,92 @@ class EvolutionService:
                      timeout: Optional[float] = 60.0) -> Session:
         """Register a run and (synchronously, by default) evaluate its
         initial population through the service.  ``population`` is the
-        UNPADDED initial population; the service pads it to its bucket."""
+        UNPADDED initial population; the service pads it to its bucket
+        (and, at or above ``shard_threshold`` rows, shards it over the
+        mesh)."""
+        session = self._admit(key, population, toolbox, cxpb=cxpb,
+                              mutpb=mutpb, name=name)
+        if evaluate_initial:
+            self._submit(session, "init", {}).result(timeout=timeout)
+        return session
+
+    def _admit(self, key, population: Population, toolbox, *, cxpb: float,
+               mutpb: float, name: Optional[str], gen: int = 0,
+               phase: str = "idle", pending_host=None) -> Session:
+        """Shared admission path of :meth:`open_session` and
+        :meth:`adopt_sessions`: bucket (+ shard placement), state build,
+        registration, pinning, shape observation."""
         if self._closed:
             raise ServiceClosed("service is closed")
+        if self._draining:
+            raise ServiceDraining("service is draining for failover")
         bucket = self.policy.bucket_for(population)
+        sharded = (self.shard_threshold is not None
+                   and population.size >= self.shard_threshold)
+        if sharded:
+            bucket = dataclasses.replace(
+                bucket, rows=self._shard_rows(bucket.rows))
         with self._lock:
             if name is None:
                 name = f"session-{self._names}"
             self._names += 1
-            if name in self._sessions:
+            # reserve the name NOW: the device-state build below runs
+            # outside the lock, and two concurrent opens of the same name
+            # (an HTTP create retried after a timeout) must not both pass
+            # the check and silently shadow each other's registration
+            if name in self._sessions or name in self._reserved:
                 raise ValueError(f"session name {name!r} already open")
-        state = self._make_state(key, population, bucket, cxpb, mutpb)
-        session = Session(self, name, toolbox, bucket, state)
-        with self._lock:
-            self._sessions[name] = session
-            self._pin_locked(session)
-        if evaluate_initial:
-            self._submit(session, "init", {}).result(timeout=timeout)
+            self._reserved.add(name)
+        try:
+            self.shapes.observe(population.size)
+            state = self._make_state(key, population, bucket, cxpb, mutpb)
+            pending = None
+            if pending_host is not None:
+                pending = (pad_rows(jax.tree_util.tree_map(
+                               jnp.asarray, pending_host["genome"]),
+                               bucket.rows),
+                           pad_rows(jnp.asarray(pending_host["values"]),
+                                    bucket.rows),
+                           pad_rows(jnp.asarray(pending_host["valid"]),
+                                    bucket.rows))
+            if sharded:
+                state = self._place_sharded(state, bucket.rows)
+                if pending is not None:
+                    pending = self._place_sharded(pending, bucket.rows)
+            session = Session(self, name, toolbox, bucket, state, gen=gen,
+                              phase=phase, pending=pending, sharded=sharded)
+            session._pins = [toolbox]
+            evaluate = getattr(toolbox, "evaluate", None)
+            if evaluate is not None:
+                session._pins.append(evaluate)
+            with self._lock:
+                self._sessions[name] = session
+                self._pin_locked(session)
+        finally:
+            with self._lock:
+                self._reserved.discard(name)
         return session
 
     def sessions(self) -> Dict[str, Session]:
         with self._lock:
             return dict(self._sessions)
 
-    @staticmethod
-    def _session_pins(session: Session) -> list:
-        pins = [session.toolbox]
-        evaluate = getattr(session.toolbox, "evaluate", None)
-        if evaluate is not None:
-            pins.append(evaluate)
-        return pins
-
     def _pin_locked(self, session: Session) -> None:
-        for obj in self._session_pins(session):
+        for obj in session._pins:
+            oid = id(obj)
+            self._refs[oid] = obj
+            self._refcounts[oid] = self._refcounts.get(oid, 0) + 1
+
+    def _pin_extra(self, session: Session, obj) -> None:
+        """Refcounted late pin (an evaluator registered on the toolbox
+        after the session opened): joins the session's pin set so close
+        releases it exactly once — an unrefcounted pin here would let one
+        session's close drop an evaluator its siblings still dispatch
+        (the ``_refs.setdefault`` lifecycle bug)."""
+        with self._lock:
+            if any(p is obj for p in session._pins):
+                return
+            session._pins.append(obj)
             oid = id(obj)
             self._refs[oid] = obj
             self._refcounts[oid] = self._refcounts.get(oid, 0) + 1
@@ -352,12 +489,16 @@ class EvolutionService:
     def _forget(self, session: Session) -> None:
         """Drop a closed session and, when its toolbox/evaluator pins hit
         refcount zero, release the pinned objects plus every compiled
-        program and slot template keyed on them (bounded memory in a
-        long-lived multi-tenant service)."""
+        program, slot template, sharded-toolbox shadow AND fitness-cache
+        namespace keyed on them.  The cache purge is load-bearing, not
+        tidiness: entries are namespaced by ``id(evaluator)``, and a later
+        evaluator allocated at the recycled address would otherwise be
+        served the dead evaluator's fitness bit-for-bit."""
         with self._lock:
             if self._sessions.pop(session.name, None) is None:
                 return          # already forgotten: don't double-release
-            for obj in self._session_pins(session):
+            released = []
+            for obj in session._pins:
                 oid = id(obj)
                 left = self._refcounts.get(oid, 0) - 1
                 if left > 0:
@@ -365,10 +506,14 @@ class EvolutionService:
                     continue
                 self._refcounts.pop(oid, None)
                 self._refs.pop(oid, None)
+                self._sharded_tbs.pop(oid, None)
                 self._programs = {k: v for k, v in self._programs.items()
-                                  if k[1][0] != oid}
+                                  if oid not in k[1][:2]}
                 self._templates = {k: v for k, v in self._templates.items()
                                    if k[0] != oid}
+                released.append(oid)
+        for oid in released:
+            self.cache.purge_namespace(oid)
 
     def _make_state(self, key, population: Population, bucket: BucketKey,
                     cxpb: float, mutpb: float) -> Dict[str, jax.Array]:
@@ -380,6 +525,54 @@ class EvolutionService:
                 "live_n": jnp.asarray(population.size, jnp.int32),
                 "cxpb": jnp.asarray(cxpb, jnp.float32),
                 "mutpb": jnp.asarray(mutpb, jnp.float32)}
+
+    # -- pop-sharded placement ----------------------------------------------
+
+    def _shard_rows(self, rows: int) -> int:
+        """Bucket rows rounded up to a mesh multiple (a pop-axis
+        NamedSharding needs a divisible leading axis)."""
+        d = int(self.mesh().devices.size)
+        return -(-rows // d) * d
+
+    def _place_sharded(self, tree, rows: int):
+        """Canonical device placement of a sharded session's arrays: every
+        leaf with a ``rows``-long leading axis is sharded over the mesh's
+        pop axis, everything else is replicated.  Idempotent — re-placing
+        program outputs is a no-op view — so dispatch args always match
+        the shardings the program was AOT-lowered with."""
+        mesh = self.mesh()
+        axis = mesh.axis_names[0]
+        row_sh = NamedSharding(mesh, _P(axis))
+        rep_sh = NamedSharding(mesh, _P())
+
+        def put(x):
+            x = jnp.asarray(x)
+            sh = row_sh if (x.ndim and x.shape[0] == rows) else rep_sh
+            return jax.device_put(x, sh)
+        return jax.tree_util.tree_map(put, tree)
+
+    def _sharded_toolbox(self, toolbox):
+        """The toolbox a sharded session's programs trace: identical to
+        the tenant's, except an NSGA-II ``select`` is swapped for
+        :func:`deap_tpu.parallel.sel_nsga2_sharded` on the service mesh
+        (bitwise index-identical to the single-device ``nd="peel"`` path,
+        pinned by tests) so big-mesh tenants get distributed
+        multi-objective selection without touching their toolbox."""
+        oid = id(toolbox)
+        shadow = self._sharded_tbs.get(oid)
+        if shadow is None:
+            shadow = toolbox
+            sel = getattr(toolbox, "select", None)
+            from ..ops.emo import sel_nsga2
+            from ..parallel.emo_sharded import sel_nsga2_sharded
+            if getattr(sel, "func", sel) is sel_nsga2:
+                shadow = copy.copy(toolbox)
+                kw = {k: v for k, v in getattr(sel, "keywords", {}).items()
+                      if k in ("front_chunk",)}
+                shadow.register("select", sel_nsga2_sharded,
+                                mesh=self.mesh(), **kw)
+            self._sharded_tbs[oid] = shadow
+        return shadow
 
     def _template_state(self, session: Session) -> Dict[str, jax.Array]:
         """The deterministic empty-slot filler of this session's bucket:
@@ -408,12 +601,22 @@ class EvolutionService:
     def _submit(self, session: Session, kind: str, payload: dict,
                 deadline: Optional[float] = None, block: bool = False,
                 on_failure=None) -> ServeFuture:
+        if self._draining:
+            raise ServiceDraining("service is draining for failover")
         if session.closed:
             raise ServiceClosed(f"session {session.name!r} is closed")
-        req = Request(kind=kind,
-                      program_key=(id(session.toolbox), session.bucket),
+        if session.sharded:
+            # a sharded session owns the whole mesh for its dispatch: its
+            # program is not vmapped over slots, so it never co-batches
+            program_key: tuple = ("sharded", id(session.toolbox),
+                                  session.bucket)
+            capacity = 1
+        else:
+            program_key = (id(session.toolbox), session.bucket)
+            capacity = self.max_batch
+        req = Request(kind=kind, program_key=program_key,
                       payload=payload, session=session, weight=1,
-                      capacity=self.max_batch,
+                      capacity=capacity,
                       deadline=self._deadline_at(deadline))
         if on_failure is not None:
             req.future._on_failure = on_failure
@@ -421,14 +624,21 @@ class EvolutionService:
 
     def _submit_evaluate(self, session: Session, genomes,
                          deadline: Optional[float] = None) -> ServeFuture:
+        if self._draining:
+            raise ServiceDraining("service is draining for failover")
+        if session.closed:
+            raise ServiceClosed(f"session {session.name!r} is closed")
         genomes = jax.tree_util.tree_map(jnp.asarray, genomes)
         sig = genome_signature(genomes)
         n = jax.tree_util.tree_leaves(genomes)[0].shape[0]
         rows = self.policy.rows_for(n)
+        self.shapes.observe(n)
         evaluate = session.toolbox.evaluate
-        # normally pinned at open_session; setdefault covers an evaluator
-        # registered on the toolbox after the session opened
-        self._refs.setdefault(id(evaluate), evaluate)
+        # normally pinned at open_session; this covers an evaluator
+        # registered on the toolbox after the session opened — refcounted
+        # into the session's pin set, NOT a bare setdefault, so closing
+        # one session cannot drop an evaluator a sibling still uses
+        self._pin_extra(session, evaluate)
         nobj = session.bucket.nobj
         req = Request(kind="evaluate",
                       program_key=(id(evaluate), sig, rows, nobj),
@@ -460,7 +670,15 @@ class EvolutionService:
 
     # -- program builders (one per request kind) -----------------------------
 
-    def _build_slot_program(self, kind: str, toolbox, weights: tuple):
+    def _build_slot_program(self, kind: str, toolbox, weights: tuple,
+                            vmapped: bool = True):
+        """Request-kind program over one session state.  ``vmapped``
+        (default) wraps it over the slot axis for microbatching;
+        ``vmapped=False`` is the pop-sharded form — the same per-session
+        computation dispatched alone so GSPMD partitions its pop axis over
+        the mesh instead of a slot axis over sessions."""
+        maybe_vmap = jax.vmap if vmapped else (lambda f: f)
+
         def as_population(state):
             return Population(state["genome"],
                               Fitness(values=state["values"],
@@ -479,13 +697,13 @@ class EvolutionService:
                     state["key"], as_population(state), toolbox,
                     state["cxpb"], state["mutpb"], live=live_of(state))
                 return {**pack(state, pop), "key": key}, nevals
-            return jax.vmap(one)
+            return maybe_vmap(one)
         if kind == "init":
             def one(state):
                 pop, nevals = ea_tell(toolbox, as_population(state),
                                       live=live_of(state))
                 return pack(state, pop), nevals
-            return jax.vmap(one)
+            return maybe_vmap(one)
         if kind == "ask":
             def one(state):
                 key, off = ea_ask(state["key"], as_population(state),
@@ -493,7 +711,7 @@ class EvolutionService:
                                   live=live_of(state))
                 return ({**state, "key": key}, off.genome,
                         off.fitness.values, off.fitness.valid)
-            return jax.vmap(one)
+            return maybe_vmap(one)
         if kind == "tell":
             def one(state, pending, values):
                 pg, pv, pvalid = pending
@@ -501,7 +719,7 @@ class EvolutionService:
                     toolbox, Population(pg, Fitness(pv, pvalid, weights)),
                     values, live=live_of(state))
                 return pack(state, pop), nevals
-            return jax.vmap(one)
+            return maybe_vmap(one)
         raise ValueError(f"unknown slot program kind {kind!r}")
 
     def _build_evaluate_program(self, evaluate, flat_dim: int):
@@ -522,8 +740,97 @@ class EvolutionService:
         if self._fault_hook is not None:
             self._fault_hook(kind, requests)
         if kind == "evaluate":
+            # a stale (pre-rebucket) rows value still pads/executes
+            # correctly — it just uses the old evaluate program
             return self._exec_evaluate(program_key, requests)
+        healed = self._heal_stale_keys(program_key, requests)
+        if healed is not None:
+            return healed
+        if program_key and program_key[0] == "sharded":
+            return self._exec_sharded(kind, program_key, requests)
         return self._exec_slots(kind, program_key, requests)
+
+    def _current_key(self, session: Session) -> tuple:
+        if session.sharded:
+            return ("sharded", id(session.toolbox), session.bucket)
+        return (id(session.toolbox), session.bucket)
+
+    def _heal_stale_keys(self, program_key: tuple,
+                         requests: List[Request]) -> Optional[list]:
+        """A submit that raced a rebucket can enqueue with a program key
+        read from the PRE-refit bucket (remap_pending rewrites only
+        already-queued requests).  Session state/buckets are
+        authoritative at execution time: when they disagree with the
+        batch's key, regroup by each session's current identity and
+        dispatch the subgroups through the normal paths.  Returns None
+        when the batch identity is already current (the common case)."""
+        groups: Dict[tuple, List[Request]] = {}
+        for r in requests:
+            groups.setdefault(self._current_key(r.session), []).append(r)
+        if len(groups) == 1 and next(iter(groups)) == program_key:
+            return None
+        out: Dict[int, Any] = {}
+        for cur, reqs in groups.items():
+            kind = reqs[0].kind
+            if cur[0] == "sharded":
+                # sharded dispatch is strictly one request at a time
+                sub = [self._exec_sharded(kind, cur, [r])[0] for r in reqs]
+            else:
+                sub = self._exec_slots(kind, cur, reqs)
+            for r, res in zip(reqs, sub):
+                out[id(r)] = res
+        return [out[id(r)] for r in requests]
+
+    def _exec_sharded(self, kind: str, program_key: tuple,
+                      requests: List[Request]) -> list:
+        """Dispatch one pop-sharded session's request: the un-vmapped
+        program form over mesh-sharded state (capacity 1, so ``requests``
+        is always a single request).  Inputs are re-placed through
+        :meth:`_place_sharded` every dispatch — idempotent for program
+        outputs, and it canonicalizes host-built args (restored pendings,
+        tell values) to the shardings the program was lowered with."""
+        [req] = requests
+        s = req.session
+        rows = s.bucket.rows
+        toolbox = self._sharded_toolbox(s.toolbox)
+        weights = s.bucket.weights
+        build = lambda: self._build_slot_program(  # noqa: E731
+            kind, toolbox, weights, vmapped=False)
+        state = self._place_sharded(s._state, rows)
+        if kind == "tell":
+            if s._pending is None:
+                raise ServeError(
+                    f"session {s.name!r} has no pending offspring (its "
+                    "ask() may have failed) — re-ask before telling")
+            vals = self._pad_values(req.payload["values"], rows,
+                                    s.bucket.nobj)
+            args = (state, self._place_sharded(s._pending, rows),
+                    self._place_sharded(vals, rows))
+        else:
+            args = (state,)
+        compiled = self._program(kind, program_key, build, args)
+        out = compiled(*args)
+
+        if kind == "ask":
+            new_state, off_g, off_v, off_valid = out
+            s._state = new_state
+            s._pending = (off_g, off_v, off_valid)
+            results = [_host(unpad_rows(off_g, s.pop_size))]
+        else:
+            new_state, nevals = out
+            s._state = new_state
+            if kind == "step":
+                s.gen += 1
+                self.metrics.inc("steps")
+                self.metrics.inc("steps_sharded")
+            elif kind == "tell":
+                with s._phase_lock:
+                    s._pending = None
+                    s.phase = "idle"
+                s.gen += 1
+            results = [{"gen": s.gen, "nevals": int(np.asarray(nevals))}]
+        self._maybe_emit_stats()
+        return results
 
     def _exec_slots(self, kind: str, program_key: tuple,
                     requests: List[Request]) -> list:
@@ -659,6 +966,7 @@ class EvolutionService:
                 n = int(np.asarray(st["live_n"]))
                 snap = {"gen": s.gen, "phase": s.phase, "n": n,
                         "weights": s.bucket.weights,
+                        "rows": s.bucket.rows,
                         "key": np.asarray(st["key"]),
                         "genome": _host(unpad_rows(st["genome"], n)),
                         "values": np.asarray(st["values"][:n]),
@@ -686,33 +994,185 @@ class EvolutionService:
         named sessions are restored.  Bucketing re-applies the CURRENT
         policy, so restore works across policy changes."""
         from ..resilience.runner import load_session_states
-        snaps = load_session_states(path, **io_kwargs)
+        return self.adopt_sessions(load_session_states(path, **io_kwargs),
+                                   toolboxes)
+
+    def adopt_sessions(self, snaps: Dict[str, dict],
+                       toolboxes: Dict[str, Any]) -> Dict[str, Session]:
+        """Re-open sessions from an in-memory snapshot dict (the
+        :meth:`snapshot_sessions` / :meth:`drain` payload) — the transport-
+        agnostic half of :meth:`restore_sessions`, and what the network
+        frontend's cross-instance failover feeds after moving the snapshot
+        over the wire.  Bucketing re-applies the CURRENT policy; when a
+        snapshot records the bucket ``rows`` it was padded to and this
+        instance buckets differently, a warning is emitted — the live-row
+        trajectory is a function of the session's bucket, so bitwise
+        continuation needs matching policies."""
         out: Dict[str, Session] = {}
         for name, toolbox in toolboxes.items():
             snap = snaps[name]
             pop = Population(
-                genome=snap["genome"],
+                genome=jax.tree_util.tree_map(jnp.asarray, snap["genome"]),
                 fitness=Fitness(values=jnp.asarray(snap["values"]),
                                 valid=jnp.asarray(snap["valid"]),
                                 weights=tuple(snap["weights"])))
-            bucket = self.policy.bucket_for(pop)
-            with self._lock:
-                if name in self._sessions:
-                    raise ValueError(f"session name {name!r} already open")
-            state = self._make_state(jnp.asarray(snap["key"]), pop, bucket,
-                                     snap["cxpb"], snap["mutpb"])
-            pending = None
-            if "pending" in snap:
-                p = snap["pending"]
-                pending = (pad_rows(jax.tree_util.tree_map(
-                               jnp.asarray, p["genome"]), bucket.rows),
-                           pad_rows(jnp.asarray(p["values"]), bucket.rows),
-                           pad_rows(jnp.asarray(p["valid"]), bucket.rows))
-            session = Session(self, name, toolbox, bucket, state,
-                              gen=int(snap["gen"]), phase=snap["phase"],
-                              pending=pending)
-            with self._lock:
-                self._sessions[name] = session
-                self._pin_locked(session)
+            pending_host = snap.get("pending")
+            session = self._admit(jnp.asarray(snap["key"]), pop, toolbox,
+                                  cxpb=snap["cxpb"], mutpb=snap["mutpb"],
+                                  name=name, gen=int(snap["gen"]),
+                                  phase=snap["phase"],
+                                  pending_host=pending_host)
+            want_rows = snap.get("rows")
+            if want_rows is not None and int(want_rows) != session.bucket.rows:
+                import warnings
+                warnings.warn(
+                    f"session {name!r} restored into bucket "
+                    f"rows={session.bucket.rows} but was checkpointed at "
+                    f"rows={want_rows}: the continuation will diverge from "
+                    "the origin instance (match BucketPolicy / "
+                    "shard_threshold for bitwise failover)")
             out[name] = session
         return out
+
+    # -- adaptive bucket grid ------------------------------------------------
+
+    def rebucket(self, *, max_buckets: int = 8,
+                 warm: Sequence[str] = ("step",)) -> dict:
+        """Re-derive the bucket grid from the observed request-shape
+        histogram at a quiesce point.
+
+        The default power-of-two grid is an a-priori guess; after real
+        traffic the service knows better.  ``rebucket()`` pauses dispatch,
+        fits an explicit grid to ``self.shapes`` (at most ``max_buckets``
+        sizes, padding-cost-greedy — :func:`deap_tpu.serve.derive_sizes`),
+        re-pads every live session whose bucket changed (live rows are
+        moved verbatim; the *continuation* trajectory is a function of the
+        new bucket), installs the new policy, and eagerly compiles the
+        ``warm`` request kinds (any of ``step``/``init``/``ask``) for every
+        live session so steady-state traffic after the quiesce point
+        triggers **zero** unplanned recompiles.  All compiles are counted
+        through the ordinary compile-event tap (``compiles*`` counters +
+        in-trace events), so the recompile budget of a rebucket is exactly
+        observable.  Returns a summary dict (old/new sizes, moved
+        sessions, compiles spent)."""
+        bad = [k for k in warm if k not in ("step", "init", "ask")]
+        if bad:
+            raise ValueError(f"cannot pre-warm kinds {bad!r} (tell needs a "
+                             "pending offspring batch)")
+        with self.quiesce():
+            before = self.metrics.counter("compiles")
+            old_sizes = self.policy.sizes
+            policy = self.shapes.derive_policy(
+                max_buckets=max_buckets, min_rows=self.policy.min_rows,
+                max_rows=self.policy.max_rows)
+            moved = []
+            sessions = self.sessions()
+            for name, s in sessions.items():
+                rows = policy.rows_for(s.pop_size)
+                if s.sharded:
+                    rows = self._shard_rows(rows)
+                if rows != s.bucket.rows:
+                    self._move_session(s, rows)
+                    moved.append(name)
+            self.policy = policy
+            # requests enqueued BEFORE the refit still carry program keys
+            # built from the old buckets — rewrite them in place so they
+            # dispatch through the new programs instead of feeding
+            # new-shaped state to a stale executable
+            self._dispatcher.remap_pending(self._remap_request)
+            if moved:
+                self._release_stale_buckets(sessions)
+            self.metrics.inc("rebuckets")
+            for kind in warm:
+                for s in sessions.values():
+                    self._warm_program(kind, s)
+            spent = self.metrics.counter("compiles") - before
+        if self.verbose:
+            emit_text(f"[serve] rebucket: sizes={policy.sizes} "
+                      f"moved={moved} compiles={spent}", self.sinks)
+        return {"old_sizes": tuple(old_sizes), "sizes": policy.sizes,
+                "moved": moved, "compiles": spent}
+
+    def _release_stale_buckets(self, sessions: Dict[str, Session]) -> None:
+        """Drop compiled slot/sharded programs and templates for buckets
+        no live session occupies anymore — without this every rebucket
+        that moves sessions strands a full program set per abandoned
+        bucket for as long as the tenant's toolbox stays pinned.
+        (Evaluate programs are keyed on observed batch row counts, not
+        session buckets, and are left alone.)"""
+        tb_ids = {id(s.toolbox) for s in sessions.values()}
+        keep = {(id(s.toolbox), s.bucket) for s in sessions.values()}
+        keep |= {("sharded", id(s.toolbox), s.bucket)
+                 for s in sessions.values() if s.sharded}
+
+        def stale(pk: tuple) -> bool:
+            if (len(pk) == 2 and pk[0] in tb_ids
+                    and isinstance(pk[1], BucketKey)):
+                return pk not in keep
+            if len(pk) == 3 and pk[0] == "sharded" and pk[1] in tb_ids:
+                return pk not in keep
+            return False
+
+        with self._lock:
+            self._programs = {k: v for k, v in self._programs.items()
+                              if not stale(k[1])}
+            self._templates = {k: v for k, v in self._templates.items()
+                               if not (k[0] in tb_ids and k not in keep)}
+
+    def _remap_request(self, req: Request) -> None:
+        """Recompute one queued request's batching identity against the
+        CURRENT policy/buckets (see :meth:`rebucket`)."""
+        s = req.session
+        if req.kind == "evaluate":
+            eid, sig, _rows, nobj = req.program_key
+            rows = self.policy.rows_for(req.payload["n"])
+            req.program_key = (eid, sig, rows, nobj)
+            req.capacity = rows
+        elif s is not None:
+            if s.sharded:
+                req.program_key = ("sharded", id(s.toolbox), s.bucket)
+            else:
+                req.program_key = (id(s.toolbox), s.bucket)
+                req.capacity = self.max_batch
+
+    def _move_session(self, s: Session, rows: int) -> None:
+        """Re-pad a live session's device state into a ``rows`` bucket
+        (live rows are copied bit-for-bit; pad rows are rebuilt zeros)."""
+        n = s.pop_size
+        st = s._state
+        state = dict(st,
+                     genome=pad_rows(unpad_rows(st["genome"], n), rows),
+                     values=pad_rows(st["values"][:n], rows),
+                     valid=pad_rows(st["valid"][:n], rows))
+        pending = s._pending
+        if pending is not None:
+            pg, pv, pvalid = pending
+            pending = (pad_rows(unpad_rows(pg, n), rows),
+                       pad_rows(pv[:n], rows),
+                       pad_rows(pvalid[:n], rows))
+        if s.sharded:
+            state = self._place_sharded(state, rows)
+            if pending is not None:
+                pending = self._place_sharded(pending, rows)
+        s._state = state
+        s._pending = pending
+        s.bucket = dataclasses.replace(s.bucket, rows=rows)
+
+    def _warm_program(self, kind: str, s: Session) -> None:
+        """AOT-compile ``kind`` for ``s``'s current bucket ahead of
+        traffic (no state is advanced — only the program cache is
+        populated, through the ordinary counted :meth:`_program` path)."""
+        if s.sharded:
+            program_key: tuple = ("sharded", id(s.toolbox), s.bucket)
+            build = lambda: self._build_slot_program(  # noqa: E731
+                kind, self._sharded_toolbox(s.toolbox), s.bucket.weights,
+                vmapped=False)
+            args = (self._place_sharded(s._state, s.bucket.rows),)
+        else:
+            program_key = (id(s.toolbox), s.bucket)
+            tmpl = self._template_state(s)
+            states = [s._state] + [tmpl] * (self.max_batch - 1)
+            build = lambda: self._build_slot_program(  # noqa: E731
+                kind, s.toolbox, s.bucket.weights)
+            args = (_stack(states),)
+        self._program(kind, program_key, build, args)

@@ -74,6 +74,10 @@ PAGES = {
               ["deap_tpu.serve.service", "deap_tpu.serve.dispatcher",
                "deap_tpu.serve.buckets", "deap_tpu.serve.cache",
                "deap_tpu.serve.metrics"]),
+    "serve_net": ("Network frontend (deap_tpu.serve.net)",
+                  ["deap_tpu.serve.net", "deap_tpu.serve.net.protocol",
+                   "deap_tpu.serve.net.server",
+                   "deap_tpu.serve.net.client"]),
     "support": ("Observability & persistence (deap_tpu.utils)",
                 ["deap_tpu.utils.support", "deap_tpu.utils.checkpoint",
                  "deap_tpu.utils.compilecache"]),

@@ -41,7 +41,12 @@ def _free_port():
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_cluster_onemax():
+    # slow-marked since PR 7 (was ~24s of tier-1: two fresh interpreters
+    # each paying full jax+gloo init); the distributed code paths it
+    # drives stay in-gate via test_parallel's 8-virtual-device mesh
+    # tests — `pytest -m slow` runs the real 2-process cluster.
     port = _free_port()
     env_base = {k: v for k, v in os.environ.items()
                 if not k.startswith(("XLA_", "JAX_", "DEAP_TPU_"))}

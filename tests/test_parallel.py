@@ -344,8 +344,14 @@ def _mo_cloud(key, n, m):
     return -jnp.stack(cols, axis=1)
 
 
-@pytest.mark.parametrize("n,m,k", [(512, 3, 256), (500, 3, 211),
-                                   (512, 2, 256), (1024, 4, 512)])
+# the two heaviest shapes (non-divisible 3-obj, 4-obj) are slow-marked
+# since PR 7 — tier-1 keeps one 3-obj and one 2-obj parity pin plus the
+# line-regime/front-chunk/rows-fallback tests; `pytest -m slow` runs all
+@pytest.mark.parametrize("n,m,k", [
+    (512, 3, 256),
+    pytest.param(500, 3, 211, marks=pytest.mark.slow),
+    (512, 2, 256),
+    pytest.param(1024, 4, 512, marks=pytest.mark.slow)])
 def test_sharded_nsga2_index_identical(n, m, k):
     """sel_nsga2_sharded over 8 devices must return the *identical* index
     sequence as the single-device peel — sharding changes placement,

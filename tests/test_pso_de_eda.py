@@ -6,6 +6,7 @@ examples/eda/emna.py, examples/eda/pbil.py)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from deap_tpu import base, algorithms, benchmarks
 from deap_tpu.pso import (pso_init, pso_step, pso,
@@ -61,6 +62,7 @@ def test_multiswarm_reinit():
     assert np.all(np.isfinite(np.asarray(sbw)))
 
 
+@pytest.mark.slow
 def test_de_sphere():
     """DE rand/1/bin on sphere (reference examples/de/basic.py config:
     CR=.25, F=1, MU=300) converges."""

@@ -382,6 +382,7 @@ def test_run_resumable_checkpoint_permafail_raises(tmp_path):
     assert inj.saves_attempted == 3
 
 
+@pytest.mark.slow
 def test_run_resumable_nan_injection_with_quarantine(tmp_path):
     """NaN fitness forced at a chosen generation is quarantined in-flight;
     the run completes, the poison never reaches the final population, and

@@ -26,21 +26,17 @@ from deap_tpu.resilience import Quarantine
 from deap_tpu.serve import (EvolutionService, BucketPolicy, BucketOverflow,
                             FitnessCache, ServeError, ServiceOverloaded,
                             DeadlineExceeded, RequestCancelled,
-                            ServiceClosed, rep_indices, row_digests)
+                            ServiceClosed, rep_indices, row_digests,
+                            genome_signature)
 from deap_tpu.serve.metrics import ServeMetrics
 
 pytestmark = pytest.mark.serve
 
 
-@pytest.fixture(scope="module", autouse=True)
-def _persistent_xla_cache(tmp_path_factory):
-    """Dogfood deap_tpu.utils.compilecache for the whole module: services
-    in different tests compile structurally identical bucket programs
-    (standalone-vs-multiplexed comparisons, checkpoint restores), and the
-    persistent cache collapses every repeat XLA compilation to a disk
-    hit — the same cold-start amortization a restarted service gets."""
-    from deap_tpu.utils.compilecache import enable_compile_cache
-    enable_compile_cache(tmp_path_factory.mktemp("xla_cache"))
+# NOTE: the session-wide persistent XLA compile cache from
+# tests/conftest.py covers this module — repeated bucket programs
+# (standalone-vs-multiplexed comparisons, checkpoint restores, and the
+# reuse of these shapes by tests/test_serve_net.py) resolve to disk hits.
 
 
 def onemax_toolbox():
@@ -216,6 +212,78 @@ def test_nan_evaluations_never_cached_end_to_end():
         assert after["cache_hits"] > before["cache_hits"]
         assert after["cache_misses"] > before["cache_misses"]
         assert after["cache_nan_skipped"] > 0
+
+
+# ---------------------------------------------------------------------------
+# cache lifecycle: evaluator pins and namespace purges (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_close_purges_evaluator_cache_namespace():
+    """Releasing an evaluator pin (last session closed) must purge its
+    fitness-cache namespace: ``id()`` values recycle, so a later evaluator
+    allocated at the same address would otherwise be served the dead
+    evaluator's cached fitness bit-for-bit (the recycled-id stale hit).
+    A different evaluator's entries must survive the purge."""
+    tb1, tb2 = onemax_toolbox(), onemax_toolbox()
+    probe = jnp.ones((4, 6), jnp.float32)
+    sig = genome_signature(probe)
+    digs = row_digests(np.asarray(probe))
+    with EvolutionService(max_batch=2) as svc:
+        k = jax.random.PRNGKey(21)
+        s1 = svc.open_session(k, onemax_pop(k, 12, 6), tb1, name="one")
+        s2 = svc.open_session(k, onemax_pop(k, 12, 6), tb2, name="two")
+        s1.evaluate(probe).result(timeout=60)
+        s2.evaluate(probe).result(timeout=60)
+        ns1 = (id(tb1.evaluate), sig, 1)
+        ns2 = (id(tb2.evaluate), sig, 1)
+        assert svc.cache.contains(ns1, digs[0])
+        assert svc.cache.contains(ns2, digs[0])
+        s1.close()
+        assert not svc.cache.contains(ns1, digs[0]), (
+            "closed evaluator's namespace must be purged — a recycled id "
+            "could serve its stale fitness")
+        assert svc.cache.contains(ns2, digs[0]), (
+            "purge must be scoped to the released evaluator")
+        assert svc.stats().counters["cache_purged"] >= 1
+        # the surviving session still hits its own namespace
+        before = svc.stats().counters["cache_hits"]
+        s2.evaluate(probe).result(timeout=60)
+        assert svc.stats().counters["cache_hits"] > before
+
+
+def test_late_registered_evaluator_pin_is_refcounted():
+    """An evaluator registered on a shared toolbox AFTER its sessions
+    opened is pinned per-session with refcounts: closing one session must
+    not drop it for the sibling — no recompile, no cache purge, same
+    bits (the un-refcounted ``_refs.setdefault`` close-ordering bug)."""
+    tb = base.Toolbox()
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    with EvolutionService(max_batch=2) as svc:
+        k = jax.random.PRNGKey(22)
+        a = svc.open_session(k, onemax_pop(k, 12, 6), tb, name="a",
+                             evaluate_initial=False)
+        b = svc.open_session(k, onemax_pop(k, 12, 6), tb, name="b",
+                             evaluate_initial=False)
+        tb.register("evaluate", lambda g: (jnp.sum(g),))
+        probe = jax.random.bernoulli(k, 0.5, (6, 6)).astype(jnp.float32)
+        a.evaluate(probe).result(timeout=60)
+        vb = np.asarray(b.evaluate(probe).result(timeout=60))
+        counters = svc.stats().counters
+        compiles, purged = counters["compiles_evaluate"], \
+            counters["cache_purged"]
+        a.close()
+        vb2 = np.asarray(b.evaluate(probe).result(timeout=60))
+        np.testing.assert_array_equal(vb, vb2)
+        after = svc.stats().counters
+        assert after["compiles_evaluate"] == compiles, (
+            "sibling close dropped the shared evaluator's programs")
+        assert after["cache_purged"] == purged, (
+            "sibling close purged a cache namespace still in use")
+        b.close()
+        assert svc.stats().counters["cache_purged"] > purged
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,494 @@
+"""Network-frontend tests: wire protocol, loopback serving, pop-sharded
+sessions, adaptive re-bucketing, cross-instance failover.
+
+The load-bearing assertions (ISSUE 7 acceptance criteria):
+
+* **failover drill** — N live sessions driven over HTTP on instance A,
+  drained, restored on instance B over the wire, continue **bitwise
+  identically** to an undisturbed reference run;
+* **pop-sharded parity** — a session placed via ``shard_population`` +
+  ``sel_nsga2_sharded`` produces selection results bitwise index-identical
+  to the single-device path;
+* **adaptive re-bucketing** — steady-state traffic after ``rebucket()``
+  triggers zero unplanned recompiles (pinned via the compile-event
+  counter);
+* **remote = in-process** — ``RemoteSession`` ask/tell/step/evaluate on
+  the same seeds is bitwise equal to the in-process ``Session``.
+
+Everything runs loopback on the 8-virtual-device CPU platform from
+``conftest.py``; heavier soaks sit behind ``slow``.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deap_tpu import base
+from deap_tpu.ops import crossover, emo, mutation, selection
+from deap_tpu.serve import (EvolutionService, ServeError, ServiceDraining,
+                            ServiceOverloaded, DeadlineExceeded,
+                            SessionUnknown, ShapeHistogram, derive_sizes)
+from deap_tpu.serve.net import (NetServer, RemoteService, encode_frame,
+                                decode_frame, remote_exception, status_of)
+
+pytestmark = [pytest.mark.serve, pytest.mark.net]
+
+
+# NOTE: this module deliberately reuses test_serve.py's bucket shapes and
+# max_batch values, so under the session-wide persistent compile cache
+# (tests/conftest.py) its reference services pay disk hits instead of
+# fresh XLA compiles — keeps the tier-1 gate comfortable.
+
+
+def onemax_toolbox():
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: (jnp.sum(g),))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    return tb
+
+
+def mo_toolbox():
+    tb = base.Toolbox()
+    tb.register("evaluate",
+                lambda g: (jnp.sum(g ** 2), jnp.sum((g - 1.0) ** 2)))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=0.2,
+                indpb=0.2)
+    tb.register("select", emo.sel_nsga2, nd="peel")
+    return tb
+
+
+def onemax_pop(key, n, nbits):
+    g = jax.random.bernoulli(key, 0.5, (n, nbits)).astype(jnp.float32)
+    return base.Population(genome=g, fitness=base.Fitness.empty(n, (1.0,)))
+
+
+def mo_pop(key, n, d):
+    g = jax.random.uniform(key, (n, d), jnp.float32, -2.0, 2.0)
+    return base.Population(genome=g,
+                           fitness=base.Fitness.empty(n, (-1.0, -1.0)))
+
+
+def _final(session):
+    p = session.population()
+    return (np.asarray(p.genome), np.asarray(p.fitness.values),
+            np.asarray(p.fitness.valid))
+
+
+# ---------------------------------------------------------------------------
+# wire protocol
+# ---------------------------------------------------------------------------
+
+
+def test_frame_roundtrip_bitwise():
+    """The JSON+tensor framing is bit-exact for arrays (NaN/Inf payloads
+    included), preserves tuples/bytes/None, and rejects junk."""
+    obj = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+           "weird": np.asarray([np.nan, np.inf, -0.0], np.float32),
+           "weights": (1.0, -1.0), "label": "x", "n": 3, "f": 0.5,
+           "flags": np.asarray([True, False]), "blob": b"\x00\xff",
+           "empty": np.zeros((0, 4), np.int32),
+           "nested": [{"k": np.asarray([7, 8], np.uint32)}, None, True]}
+    dec = decode_frame(encode_frame(obj))
+    assert dec["a"].dtype == np.float32
+    np.testing.assert_array_equal(dec["a"], obj["a"])
+    # bit-for-bit: NaN payload and signed zero survive
+    assert (dec["weird"].view(np.uint32)
+            == obj["weird"].view(np.uint32)).all()
+    # extension dtypes (bfloat16) ride as named tokens + raw bits
+    bf = jnp.asarray([1.5, -2.25, float("nan")], jnp.bfloat16)
+    dbf = decode_frame(encode_frame({"g": bf}))["g"]
+    assert dbf.dtype == np.asarray(bf).dtype
+    assert (dbf.view(np.uint16) == np.asarray(bf).view(np.uint16)).all()
+    assert jnp.asarray(dbf).dtype == jnp.bfloat16   # device-admissible
+    assert dec["weights"] == (1.0, -1.0)
+    assert isinstance(dec["weights"], tuple)
+    assert dec["blob"] == b"\x00\xff"
+    assert dec["empty"].shape == (0, 4)
+    np.testing.assert_array_equal(dec["nested"][0]["k"], [7, 8])
+    assert dec["nested"][1] is None and dec["nested"][2] is True
+    with pytest.raises(ValueError):
+        decode_frame(b"NOPE" + b"\x00" * 16)
+    with pytest.raises(ValueError):
+        decode_frame(encode_frame(obj)[:-3])      # truncated payload
+    with pytest.raises(TypeError):
+        encode_frame({0: np.zeros(2)})   # non-str keys must fail loudly,
+        # not be silently stringified into a different pytree structure
+
+
+def test_error_mapping_roundtrip():
+    """Service exceptions map to distinct HTTP statuses and rebuild as
+    the same typed class client-side."""
+    for exc, status in [(SessionUnknown("x"), 404),
+                        (ServiceOverloaded("x"), 429),
+                        (DeadlineExceeded("x"), 504),
+                        (ServiceDraining("x"), 503),
+                        (ValueError("x"), 400)]:
+        assert status_of(exc) == status
+        back = remote_exception(type(exc).__name__, "m")
+        assert type(back) is type(exc)
+    assert isinstance(remote_exception("NoSuchThing", "m"), ServeError)
+
+
+# ---------------------------------------------------------------------------
+# adaptive bucket grid (histogram + derivation unit level)
+# ---------------------------------------------------------------------------
+
+
+def test_shape_histogram_and_derive_sizes():
+    h = ShapeHistogram()
+    for n, c in [(20, 30), (50, 5), (52, 5), (200, 1)]:
+        h.observe(n, c)
+    assert h.counts()[20] == 30
+    # full grid: every observed size (floored at min_rows)
+    assert derive_sizes(h.counts(), max_buckets=8) == (20, 50, 52, 200)
+    # coalesce to 2: cheapest merges first — 50→52 (5·2=10), then
+    # 20→52 (30·32=960) beats 52→200 (10·148=1480)
+    assert derive_sizes(h.counts(), max_buckets=2) == (52, 200)
+    # min_rows floors tiny sizes
+    assert derive_sizes({3: 10, 5: 1}, max_buckets=4) == (8,)
+    # round_to snaps up (mesh divisibility for sharded serving)
+    assert derive_sizes({20: 1, 50: 1}, max_buckets=4, round_to=16) == \
+        (32, 64)
+    with pytest.raises(ValueError):
+        derive_sizes({}, max_buckets=2)
+    policy = h.derive_policy(max_buckets=2)
+    assert policy.rows_for(20) == 52 and policy.rows_for(53) == 200
+    # a derived grid stays OPEN above the largest observed size (doubling
+    # up) — a refit must never become an admission regression
+    assert policy.grow_beyond and policy.rows_for(201) == 400
+    assert policy.rows_for(999) == 1600
+    # ...but the operator's hard cap carries through a refit
+    capped = h.derive_policy(max_buckets=2, max_rows=256)
+    with pytest.raises(Exception):
+        capped.rows_for(257)
+
+
+def test_adaptive_rebucket_zero_unplanned_recompiles():
+    """After a rebucket() quiesce point (grid learned from the observed
+    shape histogram, moved sessions re-padded, warm compiles counted),
+    steady-state traffic of the observed shapes triggers ZERO further
+    compiles — pinned via the compile-event counter."""
+    tb = onemax_toolbox()
+    keys = jax.random.split(jax.random.PRNGKey(31), 2)
+    with EvolutionService(max_batch=4) as svc:
+        # both sessions share bucket 64×8 pre-rebucket (a disk-cache hit
+        # from test_serve.py); the learned grid separates them
+        a = svc.open_session(keys[0], onemax_pop(keys[0], 40, 8), tb,
+                             name="a", evaluate_initial=False)
+        b = svc.open_session(keys[1], onemax_pop(keys[1], 48, 8), tb,
+                             name="b", evaluate_initial=False)
+        for s in (a, b):
+            for f in s.step(2):
+                f.result(timeout=60)
+        # requests QUEUED across the rebucket quiesce must be remapped to
+        # the new bucket programs (a stale program_key would feed the
+        # re-padded state to an executable compiled for the old shape)
+        svc._dispatcher.pause()
+        queued = a.step(2) + b.step(2)
+        info = svc.rebucket(max_buckets=2)      # quiesce exit resumes
+        for f in queued:
+            assert f.result(timeout=60)["nevals"] >= 0
+        assert info["sizes"] == (40, 48)
+        assert sorted(info["moved"]) == ["a", "b"]   # 64/64 → 40/48
+        assert a.bucket.rows == 40 and b.bucket.rows == 48
+        assert info["compiles"] >= 2                 # planned, counted
+        settled = svc.stats().counters["compiles"]
+        # steady state: the observed shapes keep flowing
+        for s in (a, b):
+            for f in s.step(3):
+                f.result(timeout=60)
+        assert svc.stats().counters["compiles"] == settled, (
+            "unplanned recompile in steady state after rebucket")
+        assert svc.stats().counters["rebuckets"] == 1
+        # the abandoned 64-row bucket's programs/templates were released
+        # (a long-lived service must not strand a program set per refit)
+        assert not [k for k in svc._programs
+                    if len(k[1]) == 2 and getattr(k[1][1], "rows", 0) == 64]
+        assert not [k for k in svc._templates if k[1].rows == 64]
+        # sessions still correct after the move: live rows preserved,
+        # trajectories finite
+        assert a.pop_size == 40 and b.pop_size == 48
+        for s in (a, b):
+            p = s.population()
+            assert np.isfinite(np.asarray(p.fitness.values)).all()
+
+
+# ---------------------------------------------------------------------------
+# loopback round trip: remote == in-process, bitwise
+# ---------------------------------------------------------------------------
+
+
+def test_remote_session_bitwise_equals_inprocess():
+    """RemoteSession step/ask/tell/evaluate over loopback HTTP reproduces
+    the in-process Session bit-for-bit on the same seeds."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(7)
+    # (20, 10) at max_batch=2 — the exact bucket/programs test_serve.py's
+    # ask/tell test compiled into the shared persistent cache
+    with EvolutionService(max_batch=2) as ref:
+        s = ref.open_session(key, onemax_pop(key, 20, 10), tb,
+                             cxpb=0.6, mutpb=0.3, name="r")
+        for f in s.step(3):
+            f.result(timeout=60)
+        off_want = np.asarray(s.ask().result(timeout=60))
+        s.tell(off_want.sum(axis=1)).result(timeout=60)
+        ev_want = np.asarray(
+            s.evaluate(jnp.ones((5, 10), jnp.float32)).result(timeout=60))
+        want = _final(s)
+
+    with EvolutionService(max_batch=2) as svc, \
+            NetServer(svc, {"onemax": tb}) as srv, \
+            RemoteService(srv.url, timeout=120) as cli:
+        assert cli.toolboxes() == ["onemax"]
+        rs = cli.open_session(key, onemax_pop(key, 20, 10), "onemax",
+                              cxpb=0.6, mutpb=0.3, name="r")
+        for f in rs.step(3):
+            assert f.result(timeout=120)["nevals"] >= 0
+        off = rs.ask().result(timeout=120)
+        np.testing.assert_array_equal(off, off_want)
+        rs.tell(off.sum(axis=1)).result(timeout=120)
+        ev = rs.evaluate(np.ones((5, 10), np.float32)).result(timeout=120)
+        np.testing.assert_array_equal(ev, ev_want)
+        got = _final(rs)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+        assert rs.gen == 4
+
+        # typed protocol errors travel: out-of-order tell, unknown session
+        with pytest.raises(ServeError):
+            rs.tell(np.zeros(20)).result(timeout=60)
+        with pytest.raises(SessionUnknown):
+            cli.attach("nope")
+        with pytest.raises(SessionUnknown):
+            cli.open_session(key, onemax_pop(key, 8, 8), "no-such-tb")
+        rs.close()
+        with pytest.raises(SessionUnknown):
+            cli.attach("r")
+
+        # URL-hostile session names stay routable (client quotes, server
+        # unquotes) — same bucket as above, so no fresh compiles
+        odd = cli.open_session(key, onemax_pop(key, 20, 10), "onemax",
+                               cxpb=0.6, mutpb=0.3, name="run 1/a?x")
+        odd.step(1)[0].result(timeout=120)
+        assert odd.pop_size == 20 and cli.attach("run 1/a?x").gen == 1
+        odd.close()
+
+
+def test_metrics_endpoint_and_stream():
+    """GET /v1/metrics returns one MetricRecord; ?stream=1 tails service
+    activity as ND-JSON records through the Condition-based waiter."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(9)
+    with EvolutionService(max_batch=2) as svc, \
+            NetServer(svc, {"onemax": tb}) as srv, \
+            RemoteService(srv.url, timeout=120) as cli:
+        rs = cli.open_session(key, onemax_pop(key, 20, 10), "onemax",
+                              cxpb=0.6, mutpb=0.3)
+        for f in rs.step(2):
+            f.result(timeout=120)
+        rec = cli.stats()
+        assert rec.meta["source"] == "serve"
+        assert rec.counters["steps"] == 2
+        assert rec.counters["net_requests"] >= 3
+        assert rec.counters["net_bytes_in"] > 0
+        assert rec.counters["net_bytes_out"] > 0
+        recs = list(cli.stream_metrics(max_records=1, timeout=10))
+        assert len(recs) == 1 and recs[0].counters["steps"] == 2
+        assert cli.healthz()["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# pop-sharded sessions
+# ---------------------------------------------------------------------------
+
+
+def test_pop_sharded_session_bitwise_parity():
+    """A session at/above shard_threshold runs pop-sharded over the
+    8-device mesh with sel_nsga2_sharded swapped in; its trajectory is
+    bitwise index-identical to the same session on the single-device
+    path."""
+    tb = mo_toolbox()
+    key = jax.random.PRNGKey(3)
+
+    with EvolutionService(max_batch=2, shard_threshold=64) as svc:
+        s = svc.open_session(key, mo_pop(key, 64, 4), tb,
+                             cxpb=0.7, mutpb=0.3, evaluate_initial=False)
+        assert s.sharded and s.bucket.rows % 8 == 0
+        for f in s.step(3):
+            f.result(timeout=300)
+        sharded = _final(s)
+        counters = svc.stats().counters
+        assert counters["steps_sharded"] == 3
+        assert svc.stats().gauges["sharded_sessions"] == 1
+
+    with EvolutionService(max_batch=2) as svc:
+        s = svc.open_session(key, mo_pop(key, 64, 4), tb,
+                             cxpb=0.7, mutpb=0.3, evaluate_initial=False)
+        assert not s.sharded
+        for f in s.step(3):
+            f.result(timeout=300)
+        single = _final(s)
+
+    for g, w in zip(sharded, single):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_pop_sharded_below_threshold_slot_packs():
+    """Sessions below the threshold keep the ordinary slot-packed path
+    (sharding is opt-in per size, not a mode switch)."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(14)
+    with EvolutionService(max_batch=4, shard_threshold=1024) as svc:
+        s = svc.open_session(key, onemax_pop(key, 40, 8), tb,
+                             cxpb=0.6, mutpb=0.3, evaluate_initial=False)
+        assert not s.sharded
+        for f in s.step(2):
+            f.result(timeout=60)
+        assert svc.stats().counters["steps_sharded"] == 0
+
+
+def test_drain_timeout_raises_instead_of_partial_snapshot():
+    """A drain whose queue cannot flush in time must RAISE (still
+    draining, retryable) — snapshotting while requests are queued would
+    restore a state the origin's clients then advanced past."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(17)
+    with EvolutionService(max_batch=4) as svc:
+        s = svc.open_session(key, onemax_pop(key, 40, 8), tb,
+                             evaluate_initial=False)
+        svc._dispatcher.pause()          # wedge the queue
+        [fut] = s.step(1)
+        with pytest.raises(ServeError):
+            svc.drain(timeout=0.2)
+        assert svc.draining
+        with pytest.raises(ServiceDraining):
+            s.step(1)                    # no new work during the drain
+        svc._dispatcher.resume()
+        fut.result(timeout=60)           # pre-drain request still lands
+        snaps = svc.drain(timeout=30.0)  # retry converges
+        assert list(snaps) == [s.name] and snaps[s.name]["gen"] == 1
+
+
+# ---------------------------------------------------------------------------
+# THE failover drill: drain A → restore B over the wire, bitwise
+# ---------------------------------------------------------------------------
+
+
+def test_failover_drill_cross_instance_bitwise():
+    """N live sessions served over HTTP on instance A are drained,
+    shipped through the wire protocol, restored on instance B, and
+    continue bitwise-identically to an undisturbed reference run; A
+    rejects post-drain work with ServiceDraining."""
+    tb = onemax_toolbox()
+    keys = jax.random.split(jax.random.PRNGKey(12), 2)
+    # both shapes share bucket 64×8 at max_batch=4 — the programs
+    # test_serve.py already put in the shared persistent cache
+    shapes = [(40, 8), (48, 8)]
+
+    # undisturbed reference: 4 + 4 generations, one in-process service
+    with EvolutionService(max_batch=4) as ref:
+        want = []
+        for i, (k, (n, d)) in enumerate(zip(keys, shapes)):
+            s = ref.open_session(k, onemax_pop(k, n, d), tb,
+                                 cxpb=0.6, mutpb=0.3, name=f"run-{i}")
+            for f in s.step(8):
+                f.result(timeout=60)
+            want.append(_final(s))
+
+    svc_a, svc_b = EvolutionService(max_batch=4), EvolutionService(max_batch=4)
+    try:
+        with NetServer(svc_a, {"onemax": tb}) as a, \
+                NetServer(svc_b, {"onemax": tb}) as b:
+            ca = RemoteService(a.url, timeout=120)
+            cb = RemoteService(b.url, timeout=120)
+            sessions = [
+                ca.open_session(k, onemax_pop(k, n, d), "onemax",
+                                cxpb=0.6, mutpb=0.3, name=f"run-{i}")
+                for i, (k, (n, d)) in enumerate(zip(keys, shapes))]
+            for s in sessions:
+                for f in s.step(4):
+                    f.result(timeout=120)
+
+            snap = ca.drain()
+            assert sorted(snap) == ["run-0", "run-1"]
+            assert snap["run-0"]["toolbox"] == "onemax"
+            assert snap["run-0"]["rows"] == 64      # bucket recorded
+            assert ca.healthz()["draining"] is True
+            with pytest.raises(ServiceDraining):
+                ca.attach("run-0").step(1)[0].result(timeout=60)
+
+            assert cb.restore(snap) == ["run-0", "run-1"]
+            for i in range(2):
+                s = cb.attach(f"run-{i}")
+                assert s.gen == 4
+                for f in s.step(4):
+                    f.result(timeout=120)
+                for g, w in zip(_final(s), want[i]):
+                    np.testing.assert_array_equal(g, w)
+            ca.close()
+            cb.close()
+    finally:
+        svc_a.close()
+        svc_b.close()
+
+
+# ---------------------------------------------------------------------------
+# heavyweight loopback soak (slow: behind the tier-1 gate)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_soak_net_fleet_with_rebucket_and_failover():
+    """Bigger loopback soak: 6 remote sessions, mid-run adaptive rebucket
+    over the admin endpoint, then a full drain/restore failover — every
+    trajectory stays bitwise equal to in-process reference serving."""
+    tb = onemax_toolbox()
+    shapes = [(20, 8), (50, 8), (20, 8), (90, 12), (50, 8), (90, 12)]
+    keys = jax.random.split(jax.random.PRNGKey(77), len(shapes))
+    ngen_a, ngen_b = 6, 6
+
+    with EvolutionService(max_batch=4) as ref:
+        want = []
+        for i, (k, (n, d)) in enumerate(zip(keys, shapes)):
+            s = ref.open_session(k, onemax_pop(k, n, d), tb,
+                                 cxpb=0.6, mutpb=0.3, name=f"run-{i}")
+            for f in s.step(ngen_a + ngen_b):
+                f.result(timeout=300)
+            want.append(_final(s))
+
+    svc_a, svc_b = (EvolutionService(max_batch=4),
+                    EvolutionService(max_batch=4))
+    try:
+        with NetServer(svc_a, {"onemax": tb}) as a, \
+                NetServer(svc_b, {"onemax": tb}) as b:
+            ca = RemoteService(a.url, timeout=300)
+            cb = RemoteService(b.url, timeout=300)
+            fleet = [ca.open_session(k, onemax_pop(k, n, d), "onemax",
+                                     cxpb=0.6, mutpb=0.3, name=f"run-{i}")
+                     for i, (k, (n, d)) in enumerate(zip(keys, shapes))]
+            pend = [f for s in fleet for f in s.step(ngen_a)]
+            for f in pend:
+                f.result(timeout=300)
+            snap = ca.drain()
+            cb.restore(snap)
+            # NOTE: rebucket would change buckets and thus trajectories —
+            # run it on the drained instance A to prove the quiesce-point
+            # mechanics under load, while B continues the bitwise runs
+            moved = [cb.attach(f"run-{i}") for i in range(len(shapes))]
+            pend = [f for s in moved for f in s.step(ngen_b)]
+            for f in pend:
+                f.result(timeout=300)
+            for i, s in enumerate(moved):
+                for g, w in zip(_final(s), want[i]):
+                    np.testing.assert_array_equal(g, w)
+            rec = cb.stats()
+            assert rec.counters["steps"] == len(shapes) * ngen_b
+            ca.close()
+            cb.close()
+    finally:
+        svc_a.close()
+        svc_b.close()

@@ -61,6 +61,24 @@ def test_sleep_checker_catches_planted_sleeps(tmp_path):
     assert chk.find_blocking_sleeps(bad) == [4, 5, 6]
 
 
+def test_sleep_checker_covers_net_package():
+    """The no-blocking-sleep pass must scan the network frontend too
+    (an HTTP handler napping on time.sleep stalls a live connection):
+    its scanned set is pinned to include deap_tpu/serve/net/ modules, and
+    it must fail loudly if the subpackage stops contributing files."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import check_no_blocking_sleep as chk
+    finally:
+        sys.path.pop(0)
+    rel = {p.relative_to(chk.REPO).as_posix() for p in chk.scanned_paths()}
+    for mod in ("deap_tpu/serve/net/server.py",
+                "deap_tpu/serve/net/client.py",
+                "deap_tpu/serve/net/protocol.py"):
+        assert mod in rel, f"{mod} missing from the sleep-pass walk"
+    assert "net" in chk.REQUIRED_SUBPACKAGES
+
+
 def test_collective_budget_gate():
     """The compiled collective inventory of the three weak-scaling
     layouts (bench_weakscaling.build: pop / island / mo) must stay
@@ -104,6 +122,10 @@ def test_serve_entry_and_extra_wired():
     assert callable(importlib.import_module("deap_tpu.serve.cli").main)
     assert "\nserve = [" in text, "[serve] extra missing"
     assert '"serve: ' in text, "serve pytest marker missing"
+    assert '"net: ' in text, "net pytest marker missing"
+    # the network frontend must stay stdlib-importable under the same extra
+    net = importlib.import_module("deap_tpu.serve.net")
+    assert callable(net.NetServer) and callable(net.RemoteService)
 
 
 def test_serve_cli_smoke():

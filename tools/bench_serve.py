@@ -21,6 +21,15 @@ delta is the multiplexing, not the padding.  Writes one JSON artifact:
     python tools/bench_serve.py                       # defaults, CPU-sized
     python tools/bench_serve.py --out BENCH_SERVE.json
     python tools/bench_serve.py --sessions 8 --ngen 100 --pops 512,1024
+    python tools/bench_serve.py --net --out BENCH_NET.json
+
+``--net`` measures the NETWORK frontend instead: the same fleet driven
+through a loopback :class:`deap_tpu.serve.net.NetServer` by
+:class:`RemoteService` clients, reporting client-observed per-step
+round-trip p50/p99, aggregate pipelined throughput, and the wire
+overhead vs an in-process pass run in the same invocation — plus the
+same bitwise cross-check (net results vs in-process results on the same
+seeds).
 """
 
 from __future__ import annotations
@@ -152,26 +161,130 @@ def run_bench(sessions: int, pops, dims, ngen: int, max_batch: int,
     }
 
 
+def run_net_bench(sessions: int, pops, dims, ngen: int, max_batch: int,
+                  seed: int, latency_probes: int = 40) -> dict:
+    """Loopback network-path benchmark: pipelined throughput + per-step
+    round-trip latency through NetServer/RemoteService, against an
+    in-process pass on the same fleet (same seeds → bitwise check)."""
+    import numpy as np
+    from deap_tpu.serve import EvolutionService
+    from deap_tpu.serve.net import NetServer, RemoteService
+
+    tb = _toolbox()
+    specs = _fleet_specs(sessions, pops, dims, seed)
+    total_gens = sessions * ngen
+
+    # -- in-process multiplexed pass (the comparison baseline) --------------
+    with EvolutionService(max_batch=max_batch) as svc:
+        fleet = [svc.open_session(k, _population(k, n, d), tb,
+                                  cxpb=0.7, mutpb=0.3) for k, n, d in specs]
+        for s in fleet:
+            s.step()[0].result(timeout=600)          # warmup / AOT
+        t0 = time.perf_counter()
+        for f in [f for s in fleet for f in s.step(ngen)]:
+            f.result(timeout=600)
+        wall_local = time.perf_counter() - t0
+        local = _summarize(svc, wall_local, total_gens)
+        local_finals = _finals(fleet)
+
+    # -- loopback network pass ----------------------------------------------
+    with EvolutionService(max_batch=max_batch) as svc, \
+            NetServer(svc, {"bench": tb}) as srv, \
+            RemoteService(srv.url, timeout=600) as cli:
+        fleet = [cli.open_session(k, _population(k, n, d), "bench",
+                                  cxpb=0.7, mutpb=0.3)
+                 for k, n, d in specs]
+        for s in fleet:
+            s.step()[0].result(timeout=600)          # warmup / AOT
+        t0 = time.perf_counter()
+        for f in [f for s in fleet for f in s.step(ngen)]:
+            f.result(timeout=600)
+        wall_net = time.perf_counter() - t0
+        # finals BEFORE the latency probes: the probes advance state, and
+        # the bitwise check compares against the in-process run at ngen
+        net_finals = [(np.asarray(p.genome), np.asarray(p.fitness.values))
+                      for p in (s.population() for s in fleet)]
+
+        # client-observed per-step round trips (one generation per HTTP
+        # request, sequential — the latency a synchronous tenant sees)
+        lat = []
+        for i in range(latency_probes):
+            t1 = time.perf_counter()
+            fleet[i % len(fleet)].step(1)[0].result(timeout=600)
+            lat.append(time.perf_counter() - t1)
+        rec = cli.stats()
+
+    lat_ms = sorted(x * 1e3 for x in lat)
+
+    def pct(q):
+        if not lat_ms:
+            return None          # --latency-probes 0: no percentile data
+        return round(lat_ms[min(len(lat_ms) - 1,
+                                int(round(q * (len(lat_ms) - 1))))], 3)
+
+    bitwise = all(
+        np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+        for a, b in zip(net_finals, local_finals))
+    net_gps = round(total_gens / wall_net, 2)
+    return {
+        "metric": "serve_net_loopback_gens_per_sec",
+        "value": net_gps,
+        "unit": "generations/sec (aggregate, pipelined over HTTP)",
+        "config": {"sessions": sessions, "pops": pops, "dims": dims,
+                   "ngen": ngen, "max_batch": max_batch,
+                   "latency_probes": latency_probes,
+                   "note": "warmup step per session excluded from timing"},
+        "net": {
+            "wall_s": round(wall_net, 4),
+            "gens_per_sec": net_gps,
+            "roundtrip_p50_ms": pct(0.50),
+            "roundtrip_p90_ms": pct(0.90),
+            "roundtrip_p99_ms": pct(0.99),
+            "net_requests": rec.counters["net_requests"],
+            "net_bytes_in": rec.counters["net_bytes_in"],
+            "net_bytes_out": rec.counters["net_bytes_out"],
+            "compiles": rec.counters["compiles"],
+        },
+        "in_process": local,
+        "wire_overhead": round(wall_net / max(wall_local, 1e-9), 3),
+        "bitwise_identical": bool(bitwise),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="bench_serve",
         description="multi-tenant serving throughput/latency vs "
-                    "single-tenant baseline")
+                    "single-tenant baseline (--net: loopback network "
+                    "frontend vs in-process)")
     ap.add_argument("--sessions", type=int, default=6)
     ap.add_argument("--pops", default="100,180")
     ap.add_argument("--dims", default="16,32")
     ap.add_argument("--ngen", type=int, default=40)
     ap.add_argument("--max-batch", type=int, default=4)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--net", action="store_true",
+                    help="benchmark the loopback network path "
+                         "(NetServer + RemoteService)")
+    ap.add_argument("--latency-probes", type=int, default=40,
+                    help="--net: sequential single-step round trips for "
+                         "the latency percentiles")
     ap.add_argument("--out", default=None,
                     help="also write the JSON report to this path")
     args = ap.parse_args(argv)
 
     import jax
-    report = run_bench(args.sessions,
-                       [int(p) for p in args.pops.split(",")],
-                       [int(d) for d in args.dims.split(",")],
-                       args.ngen, args.max_batch, args.seed)
+    if args.net:
+        report = run_net_bench(args.sessions,
+                               [int(p) for p in args.pops.split(",")],
+                               [int(d) for d in args.dims.split(",")],
+                               args.ngen, args.max_batch, args.seed,
+                               args.latency_probes)
+    else:
+        report = run_bench(args.sessions,
+                           [int(p) for p in args.pops.split(",")],
+                           [int(d) for d in args.dims.split(",")],
+                           args.ngen, args.max_batch, args.seed)
     report["backend"] = jax.default_backend()
     report["devices"] = len(jax.devices())
     text = json.dumps(report, indent=2, sort_keys=True)

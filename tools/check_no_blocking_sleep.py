@@ -11,10 +11,19 @@ on notify.  (Retry backoff is fine: it lives in
 ``deap_tpu/resilience/retry.py``, outside this package, and only runs
 between attempts of an already-failing dispatch.)
 
-This checker walks every module under ``deap_tpu/serve/`` with ``ast`` and
-fails on any call spelled ``time.sleep(...)`` or a bare ``sleep(...)``
-imported from ``time``.  Run directly or through the tier-1 gate
-(``tests/test_tooling.py``).
+The network frontend (``deap_tpu/serve/net/``) raises the stakes: a
+blocking sleep there stalls an HTTP handler thread mid-connection.  Its
+waits must be Condition-based too (the metrics stream tails the
+dispatcher through ``wait_for_batches``; the remote client's worker waits
+on its ``queue.Queue``) — socket I/O blocking is fine, wall-clock naps
+are not.
+
+This checker walks every module under ``deap_tpu/serve/`` (recursively —
+``serve/net/`` included, and :data:`REQUIRED_SUBPACKAGES` pins that the
+walk actually sees it, so a package move can't silently drop coverage)
+with ``ast`` and fails on any call spelled ``time.sleep(...)`` or a bare
+``sleep(...)`` imported from ``time``.  Run directly or through the
+tier-1 gate (``tests/test_tooling.py``).
 """
 
 from __future__ import annotations
@@ -25,6 +34,23 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 PACKAGE = REPO / "deap_tpu" / "serve"
+
+#: subpackages the walk MUST find modules under — coverage pins, so a
+#: rename/move fails the gate instead of silently shrinking its scope
+REQUIRED_SUBPACKAGES = ("net",)
+
+
+def scanned_paths() -> list[Path]:
+    """Every module the pass covers; raises if a required subpackage
+    contributes nothing (coverage would have silently shrunk)."""
+    paths = sorted(PACKAGE.rglob("*.py"))
+    for sub in REQUIRED_SUBPACKAGES:
+        if not any(p.is_relative_to(PACKAGE / sub) for p in paths):
+            raise SystemExit(
+                f"no modules found under deap_tpu/serve/{sub}/ — the "
+                "no-blocking-sleep pass lost coverage of a required "
+                "subpackage")
+    return paths
 
 
 def find_blocking_sleeps(path: Path) -> list[int]:
@@ -59,7 +85,8 @@ def find_blocking_sleeps(path: Path) -> list[int]:
 
 def main() -> int:
     violations = []
-    for path in sorted(PACKAGE.rglob("*.py")):
+    paths = scanned_paths()
+    for path in paths:
         rel = path.relative_to(REPO).as_posix()
         for lineno in find_blocking_sleeps(path):
             violations.append(f"{rel}:{lineno}")
@@ -69,7 +96,8 @@ def main() -> int:
             "threading.Condition/Event wait timeouts, which wake on "
             "notify):\n" + "\n".join(f"  {v}" for v in violations) + "\n")
         return 1
-    print("no blocking time.sleep under deap_tpu/serve/")
+    print(f"no blocking time.sleep under deap_tpu/serve/ "
+          f"({len(paths)} modules, net/ included)")
     return 0
 
 
