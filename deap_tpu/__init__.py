@@ -12,11 +12,45 @@ checkpointing, benchmark library) — designed for JAX/XLA rather than ported:
   for fitness parallelism, island-axis sharding with ppermute migration —
   behind the same toolbox ``map``/``register`` plugin boundary the reference
   uses for multiprocessing/SCOOP.
+
+The package init is **lazy** (PEP 562): ``import deap_tpu`` binds nothing
+heavy, and each subpackage — or the ``Toolbox``/``Fitness``/``Population``
+re-exports — imports on first attribute access.  This keeps jax entirely
+out of lightweight consumers: ``deap_tpu.lint`` (the static-analysis
+framework, which must run on boxes with no accelerator stack) imports in
+milliseconds, and CLI/tooling startup no longer pays the array-stack
+import for code paths that never touch a device.
 """
+
+import importlib
 
 __version__ = "0.1.0"
 __revision__ = "0.1.0"
 
-from . import base, creator, tools, algorithms, cma, benchmarks, ops, utils, parallel  # noqa: F401
-from . import pso, de, eda, coev, resilience, observability, serve  # noqa: F401
-from .base import Toolbox, Fitness, Population  # noqa: F401
+#: subpackages/submodules resolved on first attribute access
+_SUBMODULES = (
+    "base", "creator", "tools", "algorithms", "cma", "benchmarks", "ops",
+    "utils", "parallel", "pso", "de", "eda", "coev", "gp", "resilience",
+    "observability", "serve", "lint", "selftest",
+)
+#: conveniences re-exported from deap_tpu.base on first access
+_BASE_EXPORTS = ("Toolbox", "Fitness", "Population")
+
+__all__ = list(_SUBMODULES) + list(_BASE_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        module = importlib.import_module("." + name, __name__)
+        globals()[name] = module
+        return module
+    if name in _BASE_EXPORTS:
+        base = importlib.import_module(".base", __name__)
+        value = getattr(base, name)
+        globals()[name] = value
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

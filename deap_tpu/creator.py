@@ -84,6 +84,12 @@ class IndividualSpec:
         init.py:3-25)."""
         keys = jax.random.split(key, n)
         genome = jax.vmap(attr)(keys)
+        # retire `key` before drawing extra leaves: it was just consumed
+        # by the split above, and split(key, 2) is a prefix of
+        # split(key, n) — re-splitting it would hand the first extra leaf
+        # the SAME stream as individual 1's genome initializer (the
+        # rng-key-reuse lint pass pins this)
+        key = jax.random.fold_in(key, n)
         extras = {}
         for name, fn in self.leaves.items():
             if name in extra_leaves or fn is None:

@@ -120,7 +120,10 @@ def make_generator(pset, cap: int, kind: str = "half_and_half") -> Callable:
 
             tpick = jax.random.randint(k_pick, (), 0,
                                        jnp.maximum(t_term_cnt, 1))
-            ppick = jax.random.randint(k_pick, (), 0,
+            # deliberate shared key: exactly ONE of tpick/ppick is
+            # consumed (choose_term selects), and the committed GP trees
+            # / bench streams pin these bits
+            ppick = jax.random.randint(k_pick, (), 0,  # lint: disable=rng-key-reuse -- only one draw is consumed; stream pinned by committed GP benches
                                        jnp.maximum(t_prim_cnt, 1))
             hot_t = ((jnp.arange(term_arr.shape[0])[:, None] == t)
                      & (jnp.arange(term_arr.shape[1])[None, :] == tpick))

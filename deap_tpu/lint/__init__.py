@@ -1,0 +1,57 @@
+"""``deap_tpu.lint`` — the repo's unified static-analysis framework.
+
+The toolbox boundary gates all parallelism behind ``jit``/``scan``
+programs, which moves the dominant correctness hazards out of ordinary
+Python semantics and into *trace time*: a host side effect baked into a
+compiled body runs once at trace instead of per step, a reused PRNG key
+silently correlates whole populations (the dominant user-facing bug
+class EvoJAX and evosax both document), and the serving fleet's shared
+state races when written off-lock.  Each of those invariants used to be
+policed by a one-off script under ``tools/``; this package replaces them
+with one framework:
+
+* **one AST parse per file** shared across every pass
+  (:class:`~deap_tpu.lint.core.PyFile`);
+* a uniform :class:`~deap_tpu.lint.core.Finding` record (rule id,
+  severity, ``file:line``, stable message) and a rule registry;
+* inline ``# lint: disable=<rule> -- reason`` suppressions;
+* a committed baseline (``tools/lint_baseline.json``) for grandfathered
+  findings, refreshed with ``deap-tpu-lint --update-baseline``;
+* text / JSON / SARIF reporters and a ``deap-tpu-lint`` console entry;
+* a single tier-1 gate test (``tests/test_tooling.py``).
+
+**No JAX import is required to lint**: every pass here is pure
+``ast``/``json`` analysis (``deap_tpu``'s package init is lazy, so
+``import deap_tpu.lint`` does not pull the array stack in), and the one
+pass that does need a lowering — ``collective-budget`` — is default-off
+and shells out to ``tools/check_collective_budget.py``.
+
+Rule catalog (see ``docs/static_analysis.md`` for bad/good examples):
+
+================== ========================================================
+``no-bare-print``    library output must route through observability sinks
+``no-blocking-sleep`` no ``time.sleep`` / polled ``asyncio.sleep`` in serve/
+``lock-discipline``  ``_GUARDED_BY`` attrs written only under their lock
+``trace-impurity``   host side effects reachable inside traced functions
+``rng-key-reuse``    a PRNG key consumed twice without split/fold_in
+``tracer-leak``      ``int()``/``bool()``/``if`` on traced values
+``bench-json``       committed BENCH/MULTICHIP/budget JSONs match schema
+``collective-budget`` HLO collective counts within budget (heavy, opt-in)
+================== ========================================================
+"""
+
+from .core import (Finding, PyFile, Rule, LintContext, LintResult,
+                   iter_rules, get_rule, run_lint, rule)
+from .baseline import (load_baseline, write_baseline, apply_baseline,
+                       DEFAULT_BASELINE)
+from .reporters import render_text, render_json, render_sarif
+
+# importing the rule modules registers their passes
+from . import rules_repo, rules_jax, rules_data  # noqa: F401  (registration)
+
+__all__ = [
+    "Finding", "PyFile", "Rule", "LintContext", "LintResult",
+    "iter_rules", "get_rule", "run_lint", "rule",
+    "load_baseline", "write_baseline", "apply_baseline", "DEFAULT_BASELINE",
+    "render_text", "render_json", "render_sarif",
+]

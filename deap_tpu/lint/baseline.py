@@ -1,0 +1,106 @@
+"""Committed-baseline handling: grandfather findings without losing them.
+
+A new pass over a grown tree usually fires on pre-existing code that is
+not worth fixing in the same PR.  Instead of weakening the rule or
+littering suppressions, the gate consults ``tools/lint_baseline.json``:
+findings whose fingerprint (rule + path + stable message — deliberately
+line-independent, see :meth:`~deap_tpu.lint.core.Finding.fingerprint`)
+appear there are *baselined* — reported in the summary, never failing
+the gate.  The workflow:
+
+* a finding fires on old code you can't fix now →
+  ``deap-tpu-lint --update-baseline`` and commit the diff (the review
+  sees exactly which findings were grandfathered);
+* the code gets fixed later → the entry is *expired* (reported in the
+  summary); ``--update-baseline`` drops it, so the baseline only ever
+  shrinks back toward empty;
+* a NEW finding (not in the baseline) always fails the gate — the
+  baseline can never mask regressions, only history.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Sequence, Tuple
+
+from .core import Finding, REPO
+
+__all__ = ["DEFAULT_BASELINE", "load_baseline", "write_baseline",
+           "apply_baseline", "occurrence_fingerprints"]
+
+DEFAULT_BASELINE = REPO / "tools" / "lint_baseline.json"
+
+_NOTE = ("grandfathered deap-tpu-lint findings, keyed by line-independent "
+         "fingerprint (rule+path+message); regenerate with "
+         "deap-tpu-lint --update-baseline and commit the diff -- a finding "
+         "absent from this file always fails the gate")
+
+
+def load_baseline(path: Path = DEFAULT_BASELINE) -> Dict[str, dict]:
+    """Fingerprint → entry dict.  A missing file is an empty baseline
+    (the committed default); a malformed one raises — a broken baseline
+    must fail loudly, not silently un-grandfather the tree."""
+    path = Path(path)
+    if not path.exists():
+        return {}
+    doc = json.loads(path.read_text())
+    entries = doc.get("entries", {})
+    if not isinstance(entries, dict):
+        raise ValueError(f"{path}: 'entries' must be an object")
+    return entries
+
+
+def occurrence_fingerprints(findings: Sequence[Finding]) -> List[str]:
+    """One baseline key per finding: the line-independent fingerprint,
+    suffixed ``#k`` for the k-th IDENTICAL finding (same rule + path +
+    message) in line order.  The suffix makes the baseline count-aware:
+    grandfathering N occurrences of a defect admits exactly N — a new
+    (N+1)-th occurrence of the same defect in the same file gets a key
+    absent from the baseline and fails the gate, and fixing one of the
+    N expires the highest ordinal."""
+    ordered = sorted(range(len(findings)),
+                     key=lambda i: (findings[i].path, findings[i].line,
+                                    findings[i].col, findings[i].rule))
+    seen: Dict[str, int] = {}
+    out = [""] * len(findings)
+    for i in ordered:
+        base = findings[i].fingerprint()
+        k = seen.get(base, 0)
+        seen[base] = k + 1
+        out[i] = base if k == 0 else f"{base}#{k}"
+    return out
+
+
+def write_baseline(findings: Sequence[Finding],
+                   path: Path = DEFAULT_BASELINE) -> dict:
+    """Rewrite ``path`` to grandfather exactly ``findings`` (pass the
+    current run's live findings: entries that stopped firing are thereby
+    dropped — the expire half of the workflow)."""
+    entries = {}
+    for f, fp in zip(findings, occurrence_fingerprints(findings)):
+        entries[fp] = {"rule": f.rule, "path": f.path,
+                       "message": f.message}
+    doc = {"_note": _NOTE, "version": 1, "entries": entries}
+    Path(path).write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+    return doc
+
+
+def apply_baseline(findings: Sequence[Finding], baseline: Dict[str, dict]
+                   ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    """Partition ``findings`` into (live, baselined) and compute the
+    baseline entries that no longer fire (*expired*).  Matching is
+    count-aware (see :func:`occurrence_fingerprints`): a baselined
+    defect with N grandfathered occurrences admits at most N."""
+    live: List[Finding] = []
+    baselined: List[Finding] = []
+    hit = set()
+    for f, fp in zip(findings, occurrence_fingerprints(findings)):
+        if fp in baseline:
+            hit.add(fp)
+            baselined.append(f)
+        else:
+            live.append(f)
+    expired = [dict(baseline[fp], fingerprint=fp)
+               for fp in sorted(set(baseline) - hit)]
+    return live, baselined, expired

@@ -1,0 +1,136 @@
+"""``deap-tpu-lint`` — console entry of the static-analysis framework.
+
+::
+
+    deap-tpu-lint                        # all default passes, whole repo
+    deap-tpu-lint deap_tpu/serve        # restrict the scanned paths
+    deap-tpu-lint --select rng-key-reuse,tracer-leak
+    deap-tpu-lint --select collective-budget   # the heavy opt-in gate
+    deap-tpu-lint --format json|sarif   # machine output on stdout
+    deap-tpu-lint --update-baseline     # grandfather the current findings
+    deap-tpu-lint --list-rules
+
+Exit codes: 0 clean (baselined/suppressed findings don't fail), 1 live
+findings, 2 usage or internal error.  The tier-1 gate
+(``tests/test_tooling.py``) runs the default pass set over the whole
+repo and asserts 0.
+
+This module is the one sanctioned ``print`` site of the lint package
+(its stdout IS its interface — same contract the no-bare-print pass
+enforces everywhere else).  It never imports jax: linting must work,
+fast, on a box with no accelerator stack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .core import REPO, iter_rules, run_lint
+from .baseline import (DEFAULT_BASELINE, load_baseline, write_baseline)
+from .reporters import render_text, render_json, render_sarif
+
+
+def _split_rules(value):
+    return [v.strip() for v in value.split(",") if v.strip()]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="deap-tpu-lint",
+        description="Unified static analysis for deap_tpu: JAX "
+                    "trace-safety, RNG discipline, lock discipline, "
+                    "output routing, benchmark-artifact schemas.")
+    ap.add_argument("paths", nargs="*", type=Path,
+                    help="files/directories to scan (default: the repo)")
+    ap.add_argument("--repo", type=Path, default=REPO,
+                    help="repository root (default: auto-detected)")
+    ap.add_argument("--select", type=_split_rules, default=None,
+                    metavar="RULE[,RULE...]",
+                    help="run ONLY these rules (also the way to run "
+                         "default-off heavy rules like collective-budget)")
+    ap.add_argument("--ignore", type=_split_rules, default=None,
+                    metavar="RULE[,RULE...]", help="skip these rules")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
+    ap.add_argument("--baseline", type=Path, default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything live)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to grandfather exactly the "
+                         "current findings, then exit 0")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="text format: also list baselined findings")
+    return ap
+
+
+def main(argv=None) -> int:
+    try:
+        return _main(argv)
+    except BrokenPipeError:
+        # stdout went away mid-report (`deap-tpu-lint | head`): exit
+        # quietly instead of spraying a traceback onto stderr
+        try:
+            sys.stdout.close()
+        except BrokenPipeError:
+            pass
+        return 0
+
+
+def _main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for r in iter_rules():
+            tag = "" if r.default else "  [opt-in: --select]"
+            print(f"{r.name:20s} {r.severity:7s} {r.doc}{tag}")
+        return 0
+
+    if args.update_baseline and (args.select or args.ignore or args.paths):
+        # a partial run sees a subset of findings; rewriting the whole
+        # baseline from it would silently drop every other rule's/path's
+        # grandfathered entries
+        print("deap-tpu-lint: --update-baseline requires a full run "
+              "(no --select/--ignore/paths)", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline
+    if baseline_path is None:
+        baseline_path = Path(args.repo) / "tools" / "lint_baseline.json"
+
+    try:
+        baseline = {} if (args.no_baseline or args.update_baseline) \
+            else load_baseline(baseline_path)
+    except ValueError as e:
+        print(f"deap-tpu-lint: {e}", file=sys.stderr)
+        return 2
+
+    try:
+        result = run_lint(repo=args.repo, paths=args.paths or None,
+                          select=args.select, ignore=args.ignore,
+                          baseline=baseline)
+    except KeyError as e:   # unknown rule name from --select/--ignore
+        print(f"deap-tpu-lint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        doc = write_baseline(result.findings, baseline_path)
+        print(f"baseline updated: {baseline_path} "
+              f"({len(doc['entries'])} entries)")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps(render_json(result), indent=2, sort_keys=True))
+    elif args.format == "sarif":
+        print(json.dumps(render_sarif(result), indent=2, sort_keys=True))
+    else:
+        print(render_text(result, verbose=args.verbose))
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

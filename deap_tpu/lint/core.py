@@ -1,0 +1,300 @@
+"""Framework core: findings, parsed files, the rule registry, the engine.
+
+Design contract (what every pass can rely on):
+
+* a :class:`PyFile` is created once per source file per run; its
+  ``tree`` property parses lazily and caches, so N passes over M files
+  cost exactly M ``ast.parse`` calls;
+* passes share per-file derived analysis through ``PyFile.cache`` (the
+  JAX passes memoize their traced-function set there);
+* suppression comments are resolved by the *engine*, not by passes —
+  a pass only reports, and ``# lint: disable=<rule> -- reason`` on the
+  finding's line retires it (counted, never silently dropped);
+* pure stdlib: importing this module (or running any default pass) must
+  never import jax — linting has to work on a box with no accelerator
+  stack at all, and has to stay fast enough for tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import re
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["REPO", "Finding", "PyFile", "Rule", "LintContext", "LintResult",
+           "rule", "iter_rules", "get_rule", "run_lint"]
+
+#: repository root (deap_tpu/lint/core.py -> repo)
+REPO = Path(__file__).resolve().parents[2]
+
+#: directories never collected (anywhere in the path)
+EXCLUDED_DIRS = {"__pycache__", ".git", ".pytest_cache", ".ruff_cache",
+                 "node_modules", ".venv", "venv", ".eggs", "build", "dist"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic: ``rule`` id, repo-relative ``path``, 1-based
+    ``line``, and a *stable* message (no line numbers inside the message
+    — the baseline fingerprints ``rule + path + message``, and messages
+    that drift with unrelated edits would churn the baseline)."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    severity: str = "error"
+    col: int = 0
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def fingerprint(self) -> str:
+        """Line-independent identity used by the baseline: findings move
+        with their code, they don't expire because a neighbor edit
+        shifted line numbers."""
+        raw = f"{self.rule}::{self.path}::{self.message}"
+        return hashlib.blake2b(raw.encode(), digest_size=8).hexdigest()
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "severity": self.severity,
+                "message": self.message,
+                "fingerprint": self.fingerprint()}
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*disable=([A-Za-z0-9_,\-]+)(?:\s+--\s*(\S.*))?")
+
+
+class PyFile:
+    """One Python source file: text read once, AST parsed once (lazily,
+    shared by every pass through this object), suppression comments
+    mapped by line, and a free-form ``cache`` dict for passes to memoize
+    derived per-file analysis into."""
+
+    def __init__(self, path: Path, repo: Path = REPO):
+        self.path = Path(path)
+        self.repo = Path(repo)
+        try:
+            self.rel = self.path.resolve().relative_to(
+                self.repo.resolve()).as_posix()
+        except ValueError:
+            # explicit path outside the repo root: lint it under its
+            # absolute name (repo-scoped rules simply won't match it)
+            self.rel = self.path.resolve().as_posix()
+        self.text = self.path.read_text()
+        self.lines = self.text.splitlines()
+        self.cache: dict = {}
+        self._tree: Optional[ast.AST] = None
+        self._parse_error: Optional[SyntaxError] = None
+        self._suppress: Optional[Dict[int, Tuple[frozenset, Optional[str]]]] \
+            = None
+
+    @property
+    def tree(self) -> Optional[ast.Module]:
+        """The module AST (parsed on first access, ``None`` if the file
+        does not parse — the engine reports that as a ``parse-error``
+        finding so passes can just skip it)."""
+        if self._tree is None and self._parse_error is None:
+            try:
+                self._tree = ast.parse(self.text, filename=str(self.path))
+            except SyntaxError as e:
+                self._parse_error = e
+        return self._tree
+
+    @property
+    def parse_error(self) -> Optional[SyntaxError]:
+        self.tree  # force the parse attempt
+        return self._parse_error
+
+    def _suppressions(self) -> Dict[int, Tuple[frozenset, Optional[str]]]:
+        if self._suppress is None:
+            out: Dict[int, Tuple[frozenset, Optional[str]]] = {}
+            for i, line in enumerate(self.lines, start=1):
+                if "lint:" not in line:
+                    continue
+                m = _SUPPRESS_RE.search(line)
+                if m:
+                    rules = frozenset(
+                        r.strip() for r in m.group(1).split(",") if r.strip())
+                    out[i] = (rules, m.group(2))
+            self._suppress = out
+        return self._suppress
+
+    def suppressed(self, line: int, rule_name: str) -> bool:
+        """True iff ``line`` carries ``# lint: disable=`` naming
+        ``rule_name`` (or ``all``)."""
+        entry = self._suppressions().get(line)
+        if entry is None:
+            return False
+        rules, _reason = entry
+        return rule_name in rules or "all" in rules
+
+    def suppression_reason(self, line: int) -> Optional[str]:
+        entry = self._suppressions().get(line)
+        return entry[1] if entry else None
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One registered pass.  ``check(ctx)`` yields :class:`Finding`\\ s;
+    ``default=False`` marks heavy opt-in passes (run only via
+    ``--select``, e.g. the HLO-lowering collective budget)."""
+
+    name: str
+    doc: str
+    check: Callable[["LintContext"], Iterable[Finding]]
+    severity: str = "error"
+    default: bool = True
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def rule(name: str, doc: str, *, severity: str = "error",
+         default: bool = True):
+    """Decorator registering a pass under ``name``."""
+    def deco(fn):
+        if name in _REGISTRY:
+            raise ValueError(f"lint rule {name!r} registered twice")
+        _REGISTRY[name] = Rule(name=name, doc=doc, check=fn,
+                               severity=severity, default=default)
+        return fn
+    return deco
+
+
+def iter_rules() -> List[Rule]:
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def get_rule(name: str) -> Rule:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"unknown lint rule {name!r} "
+                       f"(have: {', '.join(sorted(_REGISTRY))})") from None
+
+
+class LintContext:
+    """One run's shared state: the collected :class:`PyFile` set (built
+    once, reused by every pass) and the repo root data passes resolve
+    their committed files against."""
+
+    def __init__(self, repo: Path = REPO,
+                 paths: Optional[Sequence[Path]] = None):
+        self.repo = Path(repo)
+        #: True when the caller restricted the scanned paths — coverage
+        #: pins (``serve/net must contribute files``) only apply to
+        #: whole-repo runs
+        self.path_restricted = bool(paths)
+        self.py_files: List[PyFile] = []
+        seen = set()
+        for p in self._collect(paths):
+            rp = p.resolve()
+            if rp not in seen:
+                seen.add(rp)
+                self.py_files.append(PyFile(p, repo=self.repo))
+        self.by_rel: Dict[str, PyFile] = {pf.rel: pf for pf in self.py_files}
+
+    def _collect(self, paths: Optional[Sequence[Path]]) -> List[Path]:
+        roots = [Path(p) for p in paths] if paths else [self.repo]
+        out: List[Path] = []
+        for root in roots:
+            if root.is_file():
+                out.append(root)
+                continue
+            for p in sorted(root.rglob("*.py")):
+                if not any(part in EXCLUDED_DIRS or part.startswith(".")
+                           for part in p.relative_to(root).parts):
+                    out.append(p)
+        return out
+
+    def files_under(self, *prefixes: str) -> List[PyFile]:
+        """The run's files whose repo-relative path starts with any of
+        ``prefixes`` (all files when none given)."""
+        if not prefixes:
+            return list(self.py_files)
+        return [pf for pf in self.py_files
+                if any(pf.rel.startswith(pre) for pre in prefixes)]
+
+
+@dataclasses.dataclass
+class LintResult:
+    """Outcome of one engine run.  ``findings`` are the live (non-
+    suppressed, non-baselined) diagnostics the gate fails on;
+    ``baselined``/``suppressed`` are retired-but-counted; ``expired``
+    are baseline entries that no longer fire (clean them up with
+    ``--update-baseline``)."""
+
+    findings: List[Finding]
+    suppressed: List[Finding]
+    baselined: List[Finding]
+    expired: List[dict]
+    rules_run: List[str]
+    files_scanned: int
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+
+def _select_rules(select: Optional[Sequence[str]],
+                  ignore: Optional[Sequence[str]]) -> List[Rule]:
+    if select:
+        rules = [get_rule(n) for n in select]
+    else:
+        rules = [r for r in iter_rules() if r.default]
+    if ignore:
+        for n in ignore:
+            get_rule(n)  # typo check
+        rules = [r for r in rules if r.name not in set(ignore)]
+    return rules
+
+
+def run_lint(*, repo: Path = REPO, paths: Optional[Sequence[Path]] = None,
+             select: Optional[Sequence[str]] = None,
+             ignore: Optional[Sequence[str]] = None,
+             baseline: Optional[dict] = None) -> LintResult:
+    """Run the selected passes (default: every ``default=True`` rule)
+    over ``paths`` (default: the whole repo) and partition the findings
+    against ``baseline`` (a :func:`~deap_tpu.lint.baseline.load_baseline`
+    dict; ``None`` = no baseline)."""
+    ctx = LintContext(repo=repo, paths=paths)
+    rules = _select_rules(select, ignore)
+
+    raw: List[Finding] = []
+    for pf in ctx.py_files:
+        if pf.parse_error is not None:
+            e = pf.parse_error
+            raw.append(Finding(
+                rule="parse-error", path=pf.rel, line=e.lineno or 1,
+                message=f"file does not parse: {e.msg}"))
+    for r in rules:
+        for f in r.check(ctx):
+            raw.append(f)
+
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in raw:
+        pf = ctx.by_rel.get(f.path)
+        if pf is not None and pf.suppressed(f.line, f.rule):
+            suppressed.append(f)
+        else:
+            findings.append(f)
+
+    baselined: List[Finding] = []
+    expired: List[dict] = []
+    if baseline:
+        from .baseline import apply_baseline
+        findings, baselined, expired = apply_baseline(findings, baseline)
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return LintResult(findings=findings, suppressed=suppressed,
+                      baselined=baselined, expired=expired,
+                      rules_run=[r.name for r in rules],
+                      files_scanned=len(ctx.py_files))

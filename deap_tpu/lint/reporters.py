@@ -1,0 +1,103 @@
+"""Reporters: render a :class:`~deap_tpu.lint.core.LintResult` as
+human text, machine JSON, or SARIF 2.1.0 (the interchange shape code
+hosts ingest for inline review annotations).
+
+All three render from the same result object; none of them prints —
+the CLI owns stdout (and is the one sanctioned ``print`` site, the same
+contract the no-bare-print pass enforces on the rest of the tree).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .core import LintResult, iter_rules
+
+__all__ = ["render_text", "render_json", "render_sarif"]
+
+
+def render_text(result: LintResult, *, verbose: bool = False) -> str:
+    """One ``path:line: rule severity: message`` line per live finding
+    plus a summary tail (files scanned, suppressed/baselined/expired
+    counts) — grep-friendly, and the shape the gate's failure output
+    surfaces in CI logs."""
+    lines: List[str] = []
+    for f in result.findings:
+        lines.append(f"{f.path}:{f.line}: [{f.rule}] {f.severity}: "
+                     f"{f.message}")
+    if verbose:
+        for f in result.baselined:
+            lines.append(f"{f.path}:{f.line}: [{f.rule}] baselined: "
+                         f"{f.message}")
+    summary = (f"{len(result.findings)} finding(s) in "
+               f"{result.files_scanned} files "
+               f"({len(result.rules_run)} rules; "
+               f"{len(result.suppressed)} suppressed, "
+               f"{len(result.baselined)} baselined)")
+    if result.expired:
+        summary += (f"; {len(result.expired)} baseline entr"
+                    f"{'y' if len(result.expired) == 1 else 'ies'} no "
+                    "longer fire -- run --update-baseline to drop them")
+    lines.append(summary)
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult) -> dict:
+    """Stable machine shape: finding dicts (with fingerprints, so a
+    caller can build a baseline out-of-band) + the run summary."""
+    return {
+        "findings": [f.as_dict() for f in result.findings],
+        "baselined": [f.as_dict() for f in result.baselined],
+        "suppressed": [f.as_dict() for f in result.suppressed],
+        "expired_baseline_entries": result.expired,
+        "summary": {"files_scanned": result.files_scanned,
+                    "rules_run": result.rules_run,
+                    "findings": len(result.findings),
+                    "exit_code": result.exit_code},
+    }
+
+
+_SARIF_LEVEL = {"error": "error", "warning": "warning"}
+
+
+def render_sarif(result: LintResult) -> dict:
+    """Minimal valid SARIF 2.1.0 log: one run, one driver
+    (``deap-tpu-lint``), rule metadata from the registry, one result per
+    live finding with a physical location."""
+    known = {r.name: r for r in iter_rules()}
+    rule_ids = sorted({f.rule for f in result.findings} | set(known))
+    rules = []
+    for rid in rule_ids:
+        entry = {"id": rid}
+        if rid in known:
+            entry["shortDescription"] = {"text": known[rid].doc}
+        rules.append(entry)
+    index = {rid: i for i, rid in enumerate(rule_ids)}
+    results = []
+    for f in result.findings:
+        results.append({
+            "ruleId": f.rule,
+            "ruleIndex": index[f.rule],
+            "level": _SARIF_LEVEL.get(f.severity, "error"),
+            "message": {"text": f.message},
+            "fingerprints": {"deapTpuLint/v1": f.fingerprint()},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line,
+                               "startColumn": max(1, f.col + 1)},
+                },
+            }],
+        })
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": "deap-tpu-lint",
+                                "informationUri":
+                                    "docs/static_analysis.md",
+                                "rules": rules}},
+            "results": results,
+        }],
+    }

@@ -1,0 +1,194 @@
+"""Data passes over committed artifacts (not Python source).
+
+``bench-json`` validates the committed benchmark/trajectory JSONs —
+``BENCH_*.json`` / ``MULTICHIP_*.json`` / ``tools/collective_budget.json``
+— against a small schema, so a malformed benchmark commit fails tier-1
+instead of silently breaking the trajectory tooling that diffs them.
+
+``collective-budget`` is the framework registration of the HLO
+collective-inventory gate: it is **default-off** (select it explicitly)
+because it lowers three weak-scaling programs on an 8-virtual-device
+mesh — the one pass that needs JAX.  It shells out to
+``tools/check_collective_budget.py`` in a subprocess, so even selecting
+it never imports jax into the linting process.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import subprocess
+import sys
+from pathlib import Path
+from typing import Iterable, List, Tuple
+
+from .core import Finding, LintContext, rule
+
+__all__ = ["bench_json_targets", "bench_json_findings"]
+
+#: string values that smuggle a non-finite float past JSON (trajectory
+#: tooling would coerce them to NaN or crash)
+_NAN_STRINGS = {"nan", "-nan", "inf", "-inf", "infinity", "-infinity"}
+
+
+def bench_json_targets(repo: Path) -> List[Tuple[str, Path]]:
+    """(schema kind, path) for every committed artifact the pass owns."""
+    out: List[Tuple[str, Path]] = []
+    for p in sorted(repo.glob("BENCH_*.json")):
+        out.append(("bench", p))
+    for p in sorted(repo.glob("MULTICHIP_*.json")):
+        out.append(("multichip", p))
+    budget = repo / "tools" / "collective_budget.json"
+    if budget.exists():
+        out.append(("budget", budget))
+    return out
+
+
+def _reject_constant(value: str):
+    raise ValueError(f"non-finite JSON constant {value!r}")
+
+
+def _walk_values(doc, path: str = "$"):
+    yield path, doc
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            yield from _walk_values(v, f"{path}.{k}")
+    elif isinstance(doc, list):
+        for i, v in enumerate(doc):
+            yield from _walk_values(v, f"{path}[{i}]")
+
+
+def _schema_errors(kind: str, doc) -> List[str]:
+    """Schema violations for one parsed document (strings, no lines —
+    JSON line numbers are formatting noise)."""
+    errors: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"top level must be a JSON object, got "
+                f"{type(doc).__name__}"]
+
+    def require(key, types, typename):
+        if key not in doc:
+            errors.append(f"required key '{key}' missing")
+            return None
+        v = doc[key]
+        if isinstance(v, bool) or not isinstance(v, types):
+            errors.append(f"key '{key}' must be {typename}, got "
+                          f"{type(v).__name__}")
+            return None
+        return v
+
+    if kind == "bench":
+        # three committed shapes: a metric record (bench.py JSON line), a
+        # raw runner log (n/cmd/rc/tail), or an annotated result document
+        # (cmd + result object, e.g. BENCH_WEAKSCALING_*)
+        if "metric" in doc:
+            require("metric", str, "a string")
+            value = require("value", (int, float), "a number")
+            require("unit", str, "a string")
+            if isinstance(value, float) and not math.isfinite(value):
+                errors.append("key 'value' must be finite")
+        elif "rc" in doc:
+            if not isinstance(doc["rc"], int) or isinstance(doc["rc"], bool):
+                errors.append("key 'rc' must be an integer")
+            require("tail", str, "a string")
+        elif "result" in doc:
+            require("cmd", str, "a string")
+            if not isinstance(doc["result"], dict):
+                errors.append("key 'result' must be an object")
+        else:
+            errors.append("bench record needs a 'metric'/'value'/'unit' "
+                          "triple, an 'rc'/'tail' runner log, or a "
+                          "'cmd'/'result' document")
+    elif kind == "multichip":
+        if not isinstance(doc.get("rc"), int) or isinstance(doc.get("rc"),
+                                                            bool):
+            errors.append("key 'rc' must be an integer")
+        if not isinstance(doc.get("ok"), bool):
+            errors.append("key 'ok' must be a boolean")
+    elif kind == "budget":
+        n_dev = doc.get("n_devices")
+        if not isinstance(n_dev, int) or isinstance(n_dev, bool):
+            errors.append("key 'n_devices' must be an integer")
+        budget = doc.get("budget")
+        if not isinstance(budget, dict):
+            errors.append("key 'budget' must be an object "
+                          "{layout: {collective: count}}")
+        else:
+            for layout, ops in budget.items():
+                if not isinstance(ops, dict):
+                    errors.append(f"budget[{layout!r}] must be an object")
+                    continue
+                for op, count in ops.items():
+                    if not isinstance(count, int) or isinstance(count, bool) \
+                            or count < 0:
+                        errors.append(f"budget[{layout!r}][{op!r}] must be "
+                                      "a non-negative integer")
+        if not isinstance(doc.get("shapes"), dict):
+            errors.append("key 'shapes' must be an object")
+
+    # universal: no NaN smuggled as a string where a number belongs
+    for vpath, v in _walk_values(doc):
+        if isinstance(v, str) and v.strip().lower() in _NAN_STRINGS:
+            errors.append(f"{vpath} is the string {v!r} -- a non-finite "
+                          "number must not be committed as a string")
+    return errors
+
+
+def bench_json_findings(repo: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    for kind, path in bench_json_targets(repo):
+        rel = path.relative_to(repo).as_posix()
+        try:
+            doc = json.loads(path.read_text(),
+                             parse_constant=_reject_constant)
+        except ValueError as e:
+            findings.append(Finding(
+                rule="bench-json", path=rel, line=1,
+                message=f"invalid JSON: {e}"))
+            continue
+        for err in _schema_errors(kind, doc):
+            findings.append(Finding(
+                rule="bench-json", path=rel, line=1,
+                message=f"schema violation ({kind} record): {err}"))
+    return findings
+
+
+@rule("bench-json",
+      "committed BENCH_*/MULTICHIP_*/collective_budget JSONs must parse "
+      "(no NaN/Infinity constants) and match their record schema")
+def _check_bench_json(ctx: LintContext) -> Iterable[Finding]:
+    return bench_json_findings(ctx.repo)
+
+
+@rule("collective-budget",
+      "HLO collective instruction counts of the three weak-scaling "
+      "layouts must stay within tools/collective_budget.json (heavy: "
+      "lowers on an 8-device virtual mesh; select explicitly)",
+      default=False)
+def _check_collective_budget(ctx: LintContext) -> Iterable[Finding]:
+    script = ctx.repo / "tools" / "check_collective_budget.py"
+    if not script.exists():
+        yield Finding(rule="collective-budget", path="tools", line=1,
+                      message="tools/check_collective_budget.py missing -- "
+                              "the collective-budget gate lost its "
+                              "implementation")
+        return
+    out = subprocess.run([sys.executable, str(script)],
+                         capture_output=True, text=True, timeout=600)
+    if out.returncode == 0:
+        return
+    tail = (out.stderr or out.stdout).strip().splitlines()
+    # the script prints one "COLLECTIVE BUDGET EXCEEDED — ..." line per
+    # violation on stderr; surface each as its own finding
+    breaches = [ln for ln in tail if "COLLECTIVE BUDGET" in ln]
+    if breaches:
+        for ln in breaches:
+            yield Finding(rule="collective-budget",
+                          path="tools/collective_budget.json", line=1,
+                          message=ln.strip())
+    else:
+        yield Finding(rule="collective-budget",
+                      path="tools/collective_budget.json", line=1,
+                      message=("collective budget gate failed (rc="
+                               f"{out.returncode}): "
+                               + "; ".join(tail[-3:])))

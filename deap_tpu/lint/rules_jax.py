@@ -1,0 +1,1045 @@
+"""JAX trace-safety passes: ``trace-impurity``, ``rng-key-reuse``,
+``tracer-leak``.
+
+All three share one per-file analysis (memoized in ``PyFile.cache``):
+an import-alias map (which local names mean ``jax``, ``jax.random``,
+``jax.lax``, ``numpy``, stdlib ``random``/``time``/``datetime``) and the
+**traced-function set** — every function that JAX will retrace:
+
+* decorated ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)`` /
+  ``@jax.vmap`` / ``@jax.pmap``;
+* referenced by name at a tracing position of a call anywhere in the
+  module: ``jit(f)``, ``vmap(f)``, ``pmap(f)``, ``lax.scan(f, ...)``,
+  ``lax.map(f, ...)``, ``lax.fori_loop(_, _, f, ...)``,
+  ``lax.while_loop(c, b, ...)``, ``lax.cond(_, t, f, ...)``,
+  ``lax.switch(_, [f, ...])``, ``jax.checkpoint(f)`` (lambdas at those
+  positions count too);
+* lexically nested inside, or called by name from, a traced function
+  (one-module transitive closure — a helper inlined into a trace
+  inherits its constraints).
+
+Functions referenced as **host callbacks** (``io_callback`` /
+``pure_callback`` / ``jax.debug.callback`` positions) are explicitly
+exempt: they run on the host by design, so host side effects there are
+the point, not a hazard.
+
+The analysis is purely lexical and module-local — it never imports the
+linted code and never imports jax.  Cross-module tracing (a toolbox
+registered callable traced by another module's scan) is out of scope;
+the baseline/suppression machinery absorbs the residue.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, LintContext, PyFile, rule
+
+__all__ = ["JaxNames", "jax_names", "traced_functions",
+           "trace_impurity_findings", "rng_key_reuse_findings",
+           "tracer_leak_findings", "JAX_RULE_EXCLUDED_PREFIXES"]
+
+#: paths the three JAX passes skip by default: tests deliberately reuse
+#: keys (determinism assertions: same key twice must give the same
+#: bits), so running the RNG pass there would flag the test suite's
+#: most legitimate pattern
+JAX_RULE_EXCLUDED_PREFIXES = ("tests/",)
+
+
+# ---------------------------------------------------------------------------
+# shared per-file analysis
+
+@dataclasses.dataclass
+class JaxNames:
+    """Local spellings of the modules the passes care about."""
+    jax: Set[str]
+    jax_random: Set[str]          # names aliasing the jax.random MODULE
+    jax_random_funcs: Dict[str, str]  # local name -> jax.random function
+    lax: Set[str]
+    lax_funcs: Dict[str, str]     # local name -> lax function
+    jit_like: Set[str]            # local names for jit/vmap/pmap/checkpoint
+    numpy: Set[str]
+    numpy_random: Set[str]        # names aliasing the np.random MODULE
+    std_random: Set[str]          # names aliasing STDLIB random module
+    std_random_funcs: Set[str]    # from random import randint, ...
+    time: Set[str]
+    time_funcs: Set[str]          # from time import time/perf_counter/...
+    datetime_mod: Set[str]
+    datetime_cls: Set[str]        # from datetime import datetime/date
+    partial: Set[str]             # functools / partial spellings
+    callback_funcs: Set[str]      # io_callback/pure_callback local names
+
+
+_JIT_LIKE = {"jit", "vmap", "pmap", "checkpoint", "remat"}
+_CALLBACKS = {"io_callback", "pure_callback", "callback"}
+_TIME_FUNCS = {"time", "perf_counter", "monotonic", "process_time",
+               "time_ns", "perf_counter_ns", "monotonic_ns", "sleep",
+               "ctime", "localtime", "gmtime"}
+
+
+def jax_names(pf: PyFile) -> JaxNames:
+    """Import-alias map for ``pf`` (memoized in ``pf.cache``)."""
+    if "jax_names" in pf.cache:
+        return pf.cache["jax_names"]
+    jn = JaxNames(jax=set(), jax_random=set(), jax_random_funcs={},
+                  lax=set(), lax_funcs={}, jit_like=set(), numpy=set(),
+                  numpy_random=set(), std_random=set(),
+                  std_random_funcs=set(), time=set(), time_funcs=set(),
+                  datetime_mod=set(), datetime_cls=set(), partial=set(),
+                  callback_funcs=set())
+    jn.partial.add("functools")
+    tree = pf.tree
+    if tree is None:
+        pf.cache["jax_names"] = jn
+        return jn
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = a.asname or a.name.split(".")[0]
+                if a.name == "jax":
+                    jn.jax.add(name)
+                elif a.name == "jax.random":
+                    if a.asname:
+                        jn.jax_random.add(a.asname)
+                    else:   # plain `import jax.random` binds `jax`
+                        jn.jax.add("jax")
+                elif a.name == "jax.numpy":
+                    pass
+                elif a.name == "jax.lax":
+                    if a.asname:
+                        jn.lax.add(a.asname)
+                    else:
+                        jn.jax.add("jax")
+                elif a.name == "numpy":
+                    jn.numpy.add(name)
+                elif a.name == "numpy.random":
+                    jn.numpy_random.add(a.asname or "numpy")
+                elif a.name == "random":
+                    jn.std_random.add(name)
+                elif a.name == "time":
+                    jn.time.add(name)
+                elif a.name == "datetime":
+                    jn.datetime_mod.add(name)
+                elif a.name == "functools":
+                    jn.partial.add(name)
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for a in node.names:
+                local = a.asname or a.name
+                if mod == "jax":
+                    if a.name == "random":
+                        jn.jax_random.add(local)
+                    elif a.name == "lax":
+                        jn.lax.add(local)
+                    elif a.name == "numpy":
+                        pass
+                    elif a.name in _JIT_LIKE:
+                        jn.jit_like.add(local)
+                elif mod == "jax.random":
+                    jn.jax_random_funcs[local] = a.name
+                elif mod in ("jax.lax", "jax.experimental"):
+                    if a.name in _CALLBACKS:
+                        jn.callback_funcs.add(local)
+                    else:
+                        jn.lax_funcs[local] = a.name
+                elif mod == "jax.experimental.io_callback":
+                    jn.callback_funcs.add(local)
+                elif mod == "numpy":
+                    if a.name == "random":
+                        jn.numpy_random.add(local)
+                elif mod == "random":
+                    jn.std_random_funcs.add(local)
+                elif mod == "time":
+                    jn.time_funcs.add(local)
+                elif mod == "datetime":
+                    if a.name in ("datetime", "date"):
+                        jn.datetime_cls.add(local)
+                elif mod == "functools":
+                    if a.name == "partial":
+                        jn.partial.add(local)
+    pf.cache["jax_names"] = jn
+    return jn
+
+
+def _attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """``a.b.c`` → ["a", "b", "c"], None for non-name-rooted chains."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def _lax_func_of(func: ast.AST, jn: JaxNames) -> Optional[str]:
+    """The ``jax.lax`` function name a call target spells, if any."""
+    chain = _attr_chain(func)
+    if chain is None:
+        return None
+    if len(chain) == 1:
+        return jn.lax_funcs.get(chain[0])
+    if len(chain) == 2 and chain[0] in jn.lax:
+        # `from jax import lax; lax.scan` OR `import jax.lax; jax.lax...`
+        # (the latter lands here only as ["jax","lax"] root, 3 parts)
+        return chain[1]
+    if len(chain) == 3 and chain[0] in jn.jax and chain[1] == "lax":
+        return chain[2]
+    return None
+
+
+def _jit_like_of(func: ast.AST, jn: JaxNames) -> Optional[str]:
+    """"jit"/"vmap"/"pmap"/"checkpoint" when the call target spells one."""
+    chain = _attr_chain(func)
+    if chain is None:
+        return None
+    if len(chain) == 1 and chain[0] in jn.jit_like:
+        return chain[0]
+    if len(chain) == 2 and chain[0] in jn.jax and chain[1] in _JIT_LIKE:
+        return chain[1]
+    return None
+
+
+def _callback_of(func: ast.AST, jn: JaxNames) -> bool:
+    """True when the call target is a host-callback entry (io_callback /
+    pure_callback / jax.debug.callback / jax.experimental.io_callback)."""
+    chain = _attr_chain(func)
+    if chain is None:
+        return False
+    if len(chain) == 1:
+        return chain[0] in jn.callback_funcs
+    if chain[0] in jn.jax:
+        tail = chain[1:]
+        if tail[-1] in _CALLBACKS:
+            return True
+    return False
+
+
+def _static_params_of(call: ast.Call) -> Tuple[Set[str], Set[int]]:
+    """``static_argnames``/``static_argnums`` literals from a jit-like
+    call's keywords (``jax.jit(f, static_argnums=0)``,
+    ``@partial(jax.jit, static_argnames=("method",))``) — those
+    parameters are Python values at trace time, never tracers."""
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for el in elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value,
+                                                               str):
+                    names.add(el.value)
+        elif kw.arg == "static_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for el in elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value,
+                                                               int):
+                    nums.add(el.value)
+    return names, nums
+
+
+#: callable-argument positions per tracing entry: indices into the
+#: positional args that are traced callables
+_TRACING_ARG_POSITIONS = {
+    "scan": (0,), "map": (0,), "fori_loop": (2,), "while_loop": (0, 1),
+    "cond": (1, 2), "switch": (1,), "associative_scan": (0,),
+    "reduce": (2,),
+}
+
+
+@dataclasses.dataclass
+class _FnInfo:
+    node: ast.AST                      # FunctionDef/AsyncFunctionDef/Lambda
+    name: Optional[str]
+    parent: Optional["_FnInfo"]
+    traced: bool = False
+    #: traced DIRECTLY (decorator / tracing argument position) — the
+    #: tracer-leak pass only taints these: a helper merely *called* from
+    #: traced code usually receives a mix of traced and static arguments
+    #: the lexical analysis cannot apportion
+    direct: bool = False
+    host: bool = False                 # referenced as a host callback
+    reason: str = ""
+    static_names: Set[str] = dataclasses.field(default_factory=set)
+    static_nums: Set[int] = dataclasses.field(default_factory=set)
+
+    @property
+    def display(self) -> str:
+        return self.name or "<lambda>"
+
+    def is_ancestor_or_self(self, other: "_FnInfo") -> bool:
+        node: Optional[_FnInfo] = other
+        while node is not None:
+            if node is self:
+                return True
+            node = node.parent
+        return False
+
+
+def traced_functions(pf: PyFile) -> List[_FnInfo]:
+    """Every function node of ``pf`` with its traced/host classification
+    (memoized — the three passes share one computation)."""
+    if "traced_fns" in pf.cache:
+        return pf.cache["traced_fns"]
+    jn = jax_names(pf)
+    tree = pf.tree
+    infos: List[_FnInfo] = []
+    by_node: Dict[ast.AST, _FnInfo] = {}
+    by_name: Dict[str, List[_FnInfo]] = {}
+    if tree is None or not (jn.jax or jn.jit_like or jn.lax
+                            or jn.lax_funcs):
+        # no tracing entry point can be spelled without these imports
+        pf.cache["traced_fns"] = infos
+        return infos
+
+    # 1. index every function node with lexical parent links
+    def index(node: ast.AST, parent: Optional[_FnInfo]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                name = getattr(child, "name", None)
+                info = _FnInfo(node=child, name=name, parent=parent)
+                infos.append(info)
+                by_node[child] = info
+                if name:
+                    by_name.setdefault(name, []).append(info)
+                index(child, info)
+            else:
+                index(child, parent)
+
+    index(tree, None)
+
+    def mark(info: _FnInfo, reason: str, *, direct: bool = False,
+             statics: Optional[ast.Call] = None) -> None:
+        if not info.traced:
+            info.traced = True
+            info.reason = reason
+        if direct:
+            info.direct = True
+        if statics is not None:
+            names, nums = _static_params_of(statics)
+            info.static_names |= names
+            info.static_nums |= nums
+
+    def mark_name(name: str, reason: str, *, direct: bool = False,
+                  statics: Optional[ast.Call] = None) -> None:
+        for info in by_name.get(name, []):
+            mark(info, reason, direct=direct, statics=statics)
+
+    # 2. decorators
+    for info in infos:
+        for dec in getattr(info.node, "decorator_list", []):
+            kind = _jit_like_of(dec, jn)
+            if kind:
+                mark(info, f"@{kind}", direct=True)
+                continue
+            if isinstance(dec, ast.Call):
+                kind = _jit_like_of(dec.func, jn)
+                if kind:   # @jax.jit(...) decorator factory form
+                    mark(info, f"@{kind}(...)", direct=True, statics=dec)
+                    continue
+                chain = _attr_chain(dec.func)
+                if chain and chain[-1] == "partial":
+                    for arg in dec.args:
+                        kind = _jit_like_of(arg, jn)
+                        if kind:
+                            mark(info, f"@partial({kind}, ...)",
+                                 direct=True, statics=dec)
+
+    # 3. call-site tracing positions (+ host-callback positions)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        positions: Tuple[int, ...] = ()
+        reason = ""
+        kind = _jit_like_of(node.func, jn)
+        if kind:
+            positions, reason = (0,), f"passed to {kind}()"
+        else:
+            lax_fn = _lax_func_of(node.func, jn)
+            if lax_fn in _TRACING_ARG_POSITIONS:
+                positions = _TRACING_ARG_POSITIONS[lax_fn]
+                reason = f"passed to lax.{lax_fn}()"
+        if _callback_of(node.func, jn) and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                for info in by_name.get(arg.id, []):
+                    info.host = True
+            elif arg in by_node:
+                by_node[arg].host = True
+            continue
+        if not positions:
+            continue
+        statics = node if kind else None   # jit(f, static_argnums=...)
+        for i in positions:
+            if i >= len(node.args):
+                continue
+            arg = node.args[i]
+            if isinstance(arg, ast.Name):
+                mark_name(arg.id, reason, direct=True, statics=statics)
+            elif isinstance(arg, ast.Lambda):
+                mark(by_node[arg], reason, direct=True, statics=statics)
+            elif isinstance(arg, (ast.List, ast.Tuple)):  # lax.switch
+                for el in arg.elts:
+                    if isinstance(el, ast.Name):
+                        mark_name(el.id, reason, direct=True)
+                    elif isinstance(el, ast.Lambda):
+                        mark(by_node[el], reason, direct=True)
+
+    # 4. closure: nested defs + called-by-name helpers, to fixpoint
+    changed = True
+    while changed:
+        changed = False
+        for info in infos:
+            if info.traced or info.host:
+                continue
+            if info.parent is not None and info.parent.traced \
+                    and not info.parent.host:
+                mark(info, f"defined inside traced "
+                           f"'{info.parent.display}'")
+                changed = True
+        for info in infos:
+            if not info.traced or info.host:
+                continue
+            for node in _own_body_walk(info.node):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)):
+                    for callee in by_name.get(node.func.id, []):
+                        # only functions lexically VISIBLE from the
+                        # caller (module-level, or nested in one of the
+                        # caller's ancestors) — a same-named def inside
+                        # an unrelated function is a different object
+                        visible = (callee.parent is None
+                                   or callee.parent.is_ancestor_or_self(
+                                       info))
+                        if visible and not callee.traced \
+                                and not callee.host:
+                            mark(callee, f"called from traced "
+                                         f"'{info.display}'")
+                            changed = True
+
+    pf.cache["traced_fns"] = infos
+    return infos
+
+
+def _own_body_walk(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function's body WITHOUT descending into nested function
+    definitions (those are separate scopes with their own classification)."""
+    if isinstance(fn, ast.Lambda):
+        stack: List[ast.AST] = [fn.body]
+    else:
+        stack = list(getattr(fn, "body", []) or [])
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _bound_names(fn: ast.AST) -> Set[str]:
+    """Names bound inside the function (params + assignments + loop/with
+    targets + comprehension targets) — the complement is its free
+    variables."""
+    bound: Set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            bound.add(a.arg)
+        if args.vararg:
+            bound.add(args.vararg.arg)
+        if args.kwarg:
+            bound.add(args.kwarg.arg)
+    for node in _own_body_walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                     (ast.Store, ast.Del)):
+            bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            bound.add(node.name)
+        elif isinstance(node, ast.comprehension):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    bound.add(t.id)
+    return bound
+
+
+# ---------------------------------------------------------------------------
+# trace-impurity
+
+#: receiver-mutating method names (free-variable mutation from inside a
+#: trace persists across retraces — the classic "accumulate into an
+#: outer list from a jitted body" bug)
+_MUTATING_METHODS = {"append", "appendleft", "extend", "insert", "update",
+                     "setdefault", "add", "remove", "discard", "clear",
+                     "pop", "popleft", "popitem", "write"}
+
+
+def _impure_call(node: ast.Call, jn: JaxNames,
+                 np_rng_names: Set[str]) -> Optional[str]:
+    """A description of the host effect this call performs, or None."""
+    chain = _attr_chain(node.func)
+    if chain is None:
+        return None
+    head, tail = chain[0], chain[1:]
+    if len(chain) == 1:
+        if head in jn.time_funcs:
+            return f"host clock call '{head}()'"
+        if head in jn.std_random_funcs:
+            return f"stdlib random call '{head}()'"
+        if head in ("input",):
+            return f"host I/O call '{head}()'"
+        if head == "open":
+            return "host I/O call 'open()'"
+        if head == "print":
+            return "host I/O call 'print()'"
+        return None
+    dotted = ".".join(chain)
+    if head in jn.time:
+        return f"host clock call '{dotted}()'"
+    if head in jn.std_random and head not in jn.jax_random:
+        return f"stdlib random call '{dotted}()'"
+    if head in jn.numpy and len(chain) >= 3 and chain[1] == "random":
+        return f"numpy RNG call '{dotted}()'"
+    if head in jn.numpy_random:
+        return f"numpy RNG call '{dotted}()'"
+    if head in np_rng_names:
+        return f"numpy RNG call '{dotted}()'"
+    if head in jn.datetime_mod or head in jn.datetime_cls:
+        if chain[-1] in ("now", "utcnow", "today"):
+            return f"host clock call '{dotted}()'"
+    return None
+
+
+def _numpy_rng_bindings(pf: PyFile) -> Set[str]:
+    """Names assigned from ``np.random.RandomState(...)`` /
+    ``np.random.default_rng(...)`` anywhere in the module — calls on
+    them inside traced code are host RNG draws."""
+    jn = jax_names(pf)
+    names: Set[str] = set()
+    if pf.tree is None:
+        return names
+    for node in ast.walk(pf.tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        chain = _attr_chain(node.value.func)
+        if not chain or chain[-1] not in ("RandomState", "default_rng",
+                                          "Generator"):
+            continue
+        if (chain[0] in jn.numpy or chain[0] in jn.numpy_random):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def trace_impurity_findings(pf: PyFile) -> List[Finding]:
+    findings: List[Finding] = []
+    jn = jax_names(pf)
+    np_rngs = _numpy_rng_bindings(pf)
+    for info in traced_functions(pf):
+        if not info.traced or info.host:
+            continue
+        bound = _bound_names(info.node)
+        for node in _own_body_walk(info.node):
+            if isinstance(node, ast.Call):
+                why = _impure_call(node, jn, np_rngs)
+                if why is not None:
+                    findings.append(Finding(
+                        rule="trace-impurity", path=pf.rel,
+                        line=node.lineno, col=node.col_offset,
+                        message=(f"{why} inside traced function "
+                                 f"'{info.display}' ({info.reason}): it "
+                                 "runs once at trace time and its result "
+                                 "is baked into the compiled program -- "
+                                 "hoist it out of the traced code, or "
+                                 "route through io_callback")))
+                    continue
+            elif isinstance(node, ast.Expr) and isinstance(node.value,
+                                                           ast.Call):
+                # statement-expression calls only: a mutator whose result
+                # is USED (``state = strategy.update(state, pop)``) is
+                # the functional-update idiom, not a mutation
+                f = node.value.func
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in _MUTATING_METHODS
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id not in bound):
+                    findings.append(Finding(
+                        rule="trace-impurity", path=pf.rel,
+                        line=node.lineno, col=node.col_offset,
+                        message=(f"mutation '{f.value.id}.{f.attr}(...)' "
+                                 "of a closed-over object inside traced "
+                                 f"function '{info.display}' "
+                                 f"({info.reason}): the mutation happens "
+                                 "at trace time and repeats on every "
+                                 "retrace -- return the value instead, "
+                                 "or route through io_callback")))
+            elif isinstance(node, ast.Global):
+                findings.append(Finding(
+                    rule="trace-impurity", path=pf.rel, line=node.lineno,
+                    message=(f"'global' statement inside traced function "
+                             f"'{info.display}' ({info.reason}): global "
+                             "mutation is a trace-time side effect")))
+    return findings
+
+
+@rule("trace-impurity",
+      "host side effects (clocks, host RNG, I/O, closure mutation) must "
+      "not be reachable inside functions JAX traces -- they run once at "
+      "trace time, not per call")
+def _check_trace_impurity(ctx: LintContext) -> Iterable[Finding]:
+    for pf in ctx.py_files:
+        if pf.rel.startswith(JAX_RULE_EXCLUDED_PREFIXES):
+            continue
+        yield from trace_impurity_findings(pf)
+
+
+# ---------------------------------------------------------------------------
+# rng-key-reuse
+
+#: jax.random functions that do NOT consume their key argument:
+#: constructors, converters, and fold_in (deriving many streams from one
+#: key with distinct data is the sanctioned pattern).  ``split`` is NOT
+#: here: using a key after splitting it replays the split's bits.
+_NONCONSUMING = {"fold_in", "PRNGKey", "key", "key_data", "wrap_key_data",
+                 "clone", "key_impl"}
+
+
+def _jax_random_func_of(func: ast.AST, jn: JaxNames) -> Optional[str]:
+    """The jax.random function name a call target spells, or None."""
+    chain = _attr_chain(func)
+    if chain is None:
+        return None
+    if len(chain) == 1:
+        return jn.jax_random_funcs.get(chain[0])
+    if len(chain) == 2 and chain[0] in jn.jax_random:
+        return chain[1]
+    if len(chain) == 3 and chain[0] in jn.jax and chain[1] == "random":
+        return chain[2]
+    return None
+
+
+def _key_arg(node: ast.Call) -> Optional[str]:
+    """The key argument's name when it is a plain variable."""
+    if node.args and isinstance(node.args[0], ast.Name):
+        return node.args[0].id
+    for kw in node.keywords:
+        if kw.arg == "key" and isinstance(kw.value, ast.Name):
+            return kw.value.id
+    return None
+
+
+def _assigned_names(stmt: ast.stmt) -> Set[str]:
+    """Names (re)bound by this statement's own targets."""
+    out: Set[str] = set()
+    targets: Sequence[ast.AST] = ()
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        targets = [i.optional_vars for i in stmt.items if i.optional_vars]
+    for t in targets:
+        for node in ast.walk(t):
+            if isinstance(node, ast.Name):
+                out.add(node.id)
+    # comprehension targets leak no binding into the scope, but they DO
+    # shadow the name for the consumption the comprehension performs —
+    # treat them as rebindings so `[f(k) for k in keys]` clears `k`
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.comprehension):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _walk_pruned(root: ast.AST) -> Iterable[ast.AST]:
+    """Walk ``root``'s subtree WITHOUT descending into nested function
+    definitions or lambdas (separate scopes, analyzed on their own)."""
+    stack: List[ast.AST] = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _rebound_in(stmts: Sequence[ast.stmt]) -> Set[str]:
+    """Every name bound anywhere under ``stmts`` (nested defs excluded)."""
+    out: Set[str] = set()
+    for stmt in stmts:
+        for node in _walk_pruned(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                out.add(node.id)
+    return out
+
+
+def _scope_bodies(pf: PyFile) -> List[Tuple[str, List[ast.stmt]]]:
+    """(display name, statement list) per statement scope: the module
+    and every def.  Nested defs and lambdas are pruned from the
+    enclosing scope by the statement walker (lambdas are analyzed
+    separately as single-expression scopes)."""
+    out: List[Tuple[str, List[ast.stmt]]] = []
+    tree = pf.tree
+    if tree is None:
+        return out
+    out.append(("<module>", list(tree.body)))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append((node.name, list(node.body)))
+    return out
+
+
+def rng_key_reuse_findings(pf: PyFile) -> List[Finding]:
+    """Per scope, in statement order: a name consumed by a jax.random
+    sampler (or ``split``) and consumed AGAIN without an intervening
+    rebinding is a finding — two draws from one key return identical
+    bits, silently correlating whatever they feed.  ``fold_in`` and key
+    constructors don't consume.  Branches are analyzed independently
+    (an if/else that each consume the key once is fine); a consumption
+    inside a loop whose key is never rebound in the loop body fires the
+    every-iteration form of the bug."""
+    findings: List[Finding] = []
+    jn = jax_names(pf)
+    if not (jn.jax or jn.jax_random or jn.jax_random_funcs):
+        return findings
+
+    def calls_in(*roots: ast.AST) -> List[ast.Call]:
+        calls = []
+        for root in roots:
+            for node in _walk_pruned(root):
+                if isinstance(node, ast.Call):
+                    calls.append(node)
+        return sorted(calls, key=lambda c: (c.lineno, c.col_offset))
+
+    def consume(call: ast.Call, consumed: Dict[str, str], scope: str,
+                loop_ctx: Optional[Sequence[ast.stmt]]) -> None:
+        fname = _jax_random_func_of(call.func, jn)
+        if fname is None or fname in _NONCONSUMING:
+            return
+        keyname = _key_arg(call)
+        if keyname is None:
+            return
+        if keyname in consumed:
+            findings.append(Finding(
+                rule="rng-key-reuse", path=pf.rel, line=call.lineno,
+                col=call.col_offset,
+                message=(f"PRNG key '{keyname}' passed to jax.random."
+                         f"{fname} in '{scope}' was already consumed by "
+                         f"jax.random.{consumed[keyname]} -- reusing a "
+                         "key replays the same bits (split or fold_in "
+                         "first)")))
+        elif loop_ctx is not None:
+            rebound = _rebound_in(loop_ctx)
+            if keyname not in rebound:
+                findings.append(Finding(
+                    rule="rng-key-reuse", path=pf.rel, line=call.lineno,
+                    col=call.col_offset,
+                    message=(f"PRNG key '{keyname}' consumed by jax."
+                             f"random.{fname} on every iteration of a "
+                             f"loop in '{scope}' without being rebound "
+                             "-- every iteration draws identical bits "
+                             "(split per iteration, or fold_in the loop "
+                             "index)")))
+        consumed[keyname] = fname
+
+    def walk(stmts: Sequence[ast.stmt], consumed: Dict[str, str],
+             scope: str, loop_ctx: Optional[Sequence[ast.stmt]]) -> bool:
+        """Analyze ``stmts`` in order, mutating ``consumed``.  Returns
+        True when control cannot fall off the end (return/raise/break/
+        continue) — a terminated branch's consumption never merges into
+        the continuation, so early-return dispatch chains that consume
+        the same key in each mutually-exclusive arm stay clean."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue   # separate scope
+            if isinstance(stmt, ast.ClassDef):
+                walk(stmt.body, consumed, scope, loop_ctx)
+                continue
+            if isinstance(stmt, ast.If):
+                for call in calls_in(stmt.test):
+                    consume(call, consumed, scope, loop_ctx)
+                body_c = dict(consumed)
+                t_body = walk(stmt.body, body_c, scope, loop_ctx)
+                else_c = dict(consumed)
+                t_else = walk(stmt.orelse, else_c, scope, loop_ctx) \
+                    if stmt.orelse else False
+                if not t_body:
+                    consumed.update(body_c)
+                if stmt.orelse and not t_else:
+                    consumed.update(else_c)
+                if t_body and t_else and stmt.orelse:
+                    return True
+                continue
+            if isinstance(stmt, ast.Try):
+                walk(stmt.body, consumed, scope, loop_ctx)
+                for h in stmt.handlers:
+                    walk(h.body, dict(consumed), scope, loop_ctx)
+                walk(stmt.orelse, consumed, scope, loop_ctx)
+                walk(stmt.finalbody, consumed, scope, loop_ctx)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                headers = ([stmt.iter] if isinstance(stmt, (ast.For,
+                                                            ast.AsyncFor))
+                           else [stmt.test])
+                for call in calls_in(*headers):
+                    consume(call, consumed, scope, loop_ctx)
+                inner = dict(consumed)
+                for t in _assigned_names(stmt):
+                    inner.pop(t, None)
+                walk(stmt.body, inner, scope, stmt.body)
+                walk(stmt.orelse, consumed, scope, loop_ctx)
+                consumed.update(inner)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for call in calls_in(*(i.context_expr
+                                       for i in stmt.items)):
+                    consume(call, consumed, scope, loop_ctx)
+                if walk(stmt.body, consumed, scope, loop_ctx):
+                    return True
+                continue
+            # simple statement: consume calls in evaluation order, then
+            # apply its bindings
+            for call in calls_in(stmt):
+                consume(call, consumed, scope, loop_ctx)
+            for name in _assigned_names(stmt):
+                consumed.pop(name, None)
+            if isinstance(stmt, (ast.Return, ast.Raise, ast.Break,
+                                 ast.Continue)):
+                return True
+        return False
+
+    for scope_name, body in _scope_bodies(pf):
+        walk(body, {}, scope_name, None)
+
+    # every lambda is its own single-expression scope: consume its calls
+    # in order with a fresh key set (its params shadow enclosing names)
+    tree = pf.tree
+    if tree is not None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Lambda):
+                consumed: Dict[str, str] = {}
+                for call in calls_in(node.body):
+                    consume(call, consumed, "<lambda>", None)
+    return findings
+
+
+@rule("rng-key-reuse",
+      "a PRNG key consumed by a jax.random sampler (or split) must not "
+      "be consumed again without an intervening split/fold_in -- reuse "
+      "replays identical bits and silently correlates populations")
+def _check_rng_key_reuse(ctx: LintContext) -> Iterable[Finding]:
+    for pf in ctx.py_files:
+        if pf.rel.startswith(JAX_RULE_EXCLUDED_PREFIXES):
+            continue
+        yield from rng_key_reuse_findings(pf)
+
+
+# ---------------------------------------------------------------------------
+# tracer-leak
+
+#: attribute accesses that are STATIC on a traced array (never leak)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+                 "weak_type", "itemsize", "nbytes"}
+_CAST_FUNCS = {"int", "float", "bool", "complex"}
+_HOST_METHODS = {"item", "tolist", "__index__"}
+
+
+def _tainted_names_in(expr: ast.AST, tainted: Set[str]) -> Set[str]:
+    """Tainted names *loaded* by ``expr``, ignoring uses that stay
+    static under tracing: ``x.shape``/``x.ndim``/``x.dtype`` chains,
+    ``isinstance(x, ...)``, ``x is None`` comparisons, and nested
+    function bodies (their own scope)."""
+    hits: Set[str] = set()
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain and chain[-1] in ("isinstance", "getattr", "hasattr",
+                                       "len"):
+                return
+        if isinstance(node, ast.Compare):
+            ops_static = all(isinstance(op, (ast.Is, ast.IsNot))
+                             for op in node.ops)
+            if ops_static:
+                return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id in tainted:
+            hits.add(node.id)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(expr)
+    return hits
+
+
+def tracer_leak_findings(pf: PyFile) -> List[Finding]:
+    """Inside traced functions, values derived from the traced
+    parameters must never reach Python control flow or host casts:
+    ``int()/float()/bool()`` / ``.item()`` / ``np.asarray`` calls and
+    ``if``/``while``/``assert`` tests on them raise (or silently
+    constant-fold) at trace time.  Taint = the function's parameters,
+    propagated through assignments in statement order; ``.shape`` /
+    ``.ndim`` / ``.dtype`` and ``is None`` checks are static and never
+    taint."""
+    findings: List[Finding] = []
+    jn = jax_names(pf)
+    for info in traced_functions(pf):
+        # direct only: a helper merely CALLED from traced code receives a
+        # mix of traced and static arguments this lexical analysis cannot
+        # apportion — flagging all its params would drown real leaks
+        if not info.direct or info.host:
+            continue
+        fn = info.node
+        args = getattr(fn, "args", None)
+        if args is None:
+            continue
+        tainted: Set[str] = set()
+        positional = args.posonlyargs + args.args
+        for i, a in enumerate(positional):
+            if a.arg in ("self", "cls"):
+                continue
+            if a.arg in info.static_names or i in info.static_nums:
+                continue   # python value at trace time, not a tracer
+            tainted.add(a.arg)
+        for a in args.kwonlyargs:
+            if a.arg not in ("self", "cls") \
+                    and a.arg not in info.static_names:
+                tainted.add(a.arg)
+        if not tainted:
+            continue
+
+        def flag(line: int, col: int, what: str, names: Set[str]) -> None:
+            shown = ", ".join(sorted(names))
+            findings.append(Finding(
+                rule="tracer-leak", path=pf.rel, line=line, col=col,
+                message=(f"{what} on traced value(s) [{shown}] inside "
+                         f"traced function '{info.display}' "
+                         f"({info.reason}): tracers have no concrete "
+                         "value at trace time -- use lax.cond/jnp.where "
+                         "for data-dependent control flow, or mark the "
+                         "argument static")))
+
+        def scan_expr_for_casts(expr: ast.AST) -> None:
+            for node in ast.walk(expr):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = _attr_chain(node.func)
+                if chain is None:
+                    continue
+                if (len(chain) == 1 and chain[0] in _CAST_FUNCS
+                        and node.args):
+                    names = _tainted_names_in(node.args[0], tainted)
+                    if names:
+                        flag(node.lineno, node.col_offset,
+                             f"Python cast {chain[0]}()", names)
+                elif chain[-1] in _HOST_METHODS:
+                    names = _tainted_names_in(node.func, tainted)
+                    if names:
+                        flag(node.lineno, node.col_offset,
+                             f".{chain[-1]}() host transfer", names)
+                elif (len(chain) >= 2 and chain[0] in jn.numpy
+                        and chain[-1] in ("asarray", "array", "float64",
+                                          "float32", "int32", "int64")):
+                    names = set()
+                    for arg in node.args[:1]:
+                        names |= _tainted_names_in(arg, tainted)
+                    if names:
+                        flag(node.lineno, node.col_offset,
+                             f"numpy host conversion {'.'.join(chain)}()",
+                             names)
+
+        def walk(stmts: Sequence[ast.stmt]) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue   # separate scope
+                if isinstance(stmt, (ast.If, ast.While)):
+                    names = _tainted_names_in(stmt.test, tainted)
+                    if names:
+                        kind = "if" if isinstance(stmt, ast.If) else "while"
+                        flag(stmt.lineno, stmt.col_offset,
+                             f"Python '{kind}' branch", names)
+                    scan_expr_for_casts(stmt.test)
+                    walk(stmt.body)
+                    walk(stmt.orelse)
+                elif isinstance(stmt, ast.Assert):
+                    names = _tainted_names_in(stmt.test, tainted)
+                    if names:
+                        flag(stmt.lineno, stmt.col_offset,
+                             "Python 'assert'", names)
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    scan_expr_for_casts(stmt.iter)
+                    if _tainted_names_in(stmt.iter, tainted):
+                        for name in _assigned_names(stmt):
+                            tainted.add(name)
+                    walk(stmt.body)
+                    walk(stmt.orelse)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for item in stmt.items:
+                        scan_expr_for_casts(item.context_expr)
+                    walk(stmt.body)
+                elif isinstance(stmt, ast.Try):
+                    walk(stmt.body)
+                    for h in stmt.handlers:
+                        walk(h.body)
+                    walk(stmt.orelse)
+                    walk(stmt.finalbody)
+                else:
+                    # simple statement: casts anywhere in it, then taint
+                    # propagation through its bindings
+                    scan_expr_for_casts(stmt)
+                    if isinstance(stmt, ast.Assign):
+                        rhs_tainted = bool(_tainted_names_in(stmt.value,
+                                                             tainted))
+                        for name in _assigned_names(stmt):
+                            if rhs_tainted:
+                                tainted.add(name)
+                            else:
+                                tainted.discard(name)
+                    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                        if stmt.value is not None and _tainted_names_in(
+                                stmt.value, tainted):
+                            for name in _assigned_names(stmt):
+                                tainted.add(name)
+
+        body = [fn.body] if isinstance(fn, ast.Lambda) else list(fn.body)
+        if isinstance(fn, ast.Lambda):
+            scan_expr_for_casts(fn.body)
+        else:
+            walk(body)
+    return findings
+
+
+@rule("tracer-leak",
+      "int()/float()/bool()/.item()/if on values derived from a traced "
+      "function's parameters -- tracers have no concrete value at trace "
+      "time")
+def _check_tracer_leak(ctx: LintContext) -> Iterable[Finding]:
+    for pf in ctx.py_files:
+        if pf.rel.startswith(JAX_RULE_EXCLUDED_PREFIXES):
+            continue
+        yield from tracer_leak_findings(pf)

@@ -1,0 +1,385 @@
+"""Repo-invariant passes: output routing, service wait discipline, and
+the serve fleet's lock discipline.
+
+``no-bare-print`` and ``no-blocking-sleep`` are the two original
+standalone checkers (``tools/check_no_bare_print.py`` /
+``check_no_blocking_sleep.py``) migrated into the framework — the
+scripts remain as thin shims over the helpers exported here, so direct
+invocations and their unit tests keep working.  ``lock-discipline`` is
+new: a lightweight static race detector for the serving fleet's shared
+state.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Finding, LintContext, rule
+
+__all__ = ["SANCTIONED_PRINT_MODULES", "REQUIRED_SLEEP_SUBPACKAGES",
+           "bare_print_lines", "blocking_sleep_lines",
+           "async_poll_sleep_lines", "guarded_declarations",
+           "lock_discipline_findings"]
+
+
+# ---------------------------------------------------------------------------
+# no-bare-print
+
+#: posix-relative paths (under deap_tpu/) allowed to call print(): the
+#: sink layer itself plus console entries whose stdout IS their interface
+SANCTIONED_PRINT_MODULES = {
+    "observability/sinks.py",
+    "observability/cli.py",
+    "serve/cli.py",
+    "selftest.py",
+    "resilience/faultdrill.py",
+    "native/build.py",
+    "lint/cli.py",
+}
+
+
+def bare_print_lines(tree: ast.AST) -> List[int]:
+    """Line numbers of ``print(...)`` calls in ``tree``."""
+    return sorted(node.lineno for node in ast.walk(tree)
+                  if isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Name)
+                  and node.func.id == "print")
+
+
+@rule("no-bare-print",
+      "runtime output in library code must route through the "
+      "observability sink layer, never a bare print()")
+def _check_bare_print(ctx: LintContext) -> Iterable[Finding]:
+    for pf in ctx.files_under("deap_tpu/"):
+        if pf.tree is None:
+            continue
+        rel = pf.rel[len("deap_tpu/"):]
+        if rel in SANCTIONED_PRINT_MODULES:
+            continue
+        for lineno in bare_print_lines(pf.tree):
+            yield Finding(
+                rule="no-bare-print", path=pf.rel, line=lineno,
+                message=("bare print() in library code -- route through "
+                         "deap_tpu.observability.sinks.emit_text, or add "
+                         "the module to SANCTIONED_PRINT_MODULES if its "
+                         "stdout is its interface"))
+
+
+# ---------------------------------------------------------------------------
+# no-blocking-sleep
+
+#: subpackages of deap_tpu/serve/ the walk MUST find modules under — a
+#: rename/move fails the gate instead of silently shrinking its scope
+REQUIRED_SLEEP_SUBPACKAGES = ("net",)
+
+
+def _time_sleep_spellings(tree: ast.AST) -> Tuple[Set[str], Set[str]]:
+    """(module aliases of ``time``, local names bound to ``time.sleep``)."""
+    time_aliases = {"time"}
+    sleep_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    time_aliases.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "sleep":
+                    sleep_names.add(a.asname or "sleep")
+    return time_aliases, sleep_names
+
+
+def blocking_sleep_lines(tree: ast.AST) -> List[int]:
+    """Line numbers of blocking-sleep calls: ``time.sleep(...)`` through
+    any module alias, and bare ``sleep(...)`` imported from ``time``."""
+    time_aliases, sleep_names = _time_sleep_spellings(tree)
+    lines = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr == "sleep"
+                and isinstance(f.value, ast.Name)
+                and f.value.id in time_aliases):
+            lines.append(node.lineno)
+        elif isinstance(f, ast.Name) and f.id in sleep_names:
+            lines.append(node.lineno)
+    return sorted(lines)
+
+
+def _asyncio_sleep_call(node: ast.Call, asyncio_aliases: Set[str],
+                        sleep_names: Set[str]) -> bool:
+    f = node.func
+    if (isinstance(f, ast.Attribute) and f.attr == "sleep"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in asyncio_aliases):
+        return True
+    return isinstance(f, ast.Name) and f.id in sleep_names
+
+
+def async_poll_sleep_lines(tree: ast.AST) -> List[int]:
+    """Line numbers of ``asyncio.sleep(...)`` calls lexically inside a
+    ``while``/``for`` loop — the async spelling of a polling nap.  The
+    serve invariant (PR 3/7) is that all waiting wakes on notify
+    (Condition/Event/queue timeouts); a sleep-loop polls instead, adding
+    its full period to every wakeup's latency."""
+    asyncio_aliases = {"asyncio"}
+    sleep_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "asyncio":
+                    asyncio_aliases.add(a.asname or "asyncio")
+        elif isinstance(node, ast.ImportFrom) and node.module == "asyncio":
+            for a in node.names:
+                if a.name == "sleep":
+                    sleep_names.add(a.asname or "sleep")
+
+    lines: List[int] = []
+
+    def walk(node: ast.AST, in_loop: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            loop_now = in_loop or isinstance(child, (ast.While, ast.For,
+                                                     ast.AsyncFor))
+            if (isinstance(child, ast.Call) and in_loop
+                    and _asyncio_sleep_call(child, asyncio_aliases,
+                                            sleep_names)):
+                lines.append(child.lineno)
+            walk(child, loop_now)
+
+    walk(tree, False)
+    return sorted(lines)
+
+
+@rule("no-blocking-sleep",
+      "no blocking time.sleep (or polled asyncio.sleep) on the serving "
+      "layer's async paths -- waits must wake on notify")
+def _check_blocking_sleep(ctx: LintContext) -> Iterable[Finding]:
+    serve_files = ctx.files_under("deap_tpu/serve/")
+    # coverage pin, for whole-repo runs over a real deap_tpu package: the
+    # walk must see the serve tree AND every required subpackage — a
+    # package rename must fail the gate, never silently shrink its scope.
+    # (Path-restricted runs and fixture repos without a package init are
+    # exempt: there is no coverage to lose there.)
+    pin_applies = (not ctx.path_restricted
+                   and (ctx.repo / "deap_tpu" / "__init__.py").exists())
+    if pin_applies:
+        missing = []
+        if not serve_files:
+            missing.append("deap_tpu/serve/")
+        missing += [f"deap_tpu/serve/{sub}/"
+                    for sub in REQUIRED_SLEEP_SUBPACKAGES
+                    if not any(pf.rel.startswith(f"deap_tpu/serve/{sub}/")
+                               for pf in serve_files)]
+        for lost in missing:
+            yield Finding(
+                rule="no-blocking-sleep", path="deap_tpu/serve", line=1,
+                message=(f"no modules found under {lost} -- the "
+                         "no-blocking-sleep pass lost coverage of a "
+                         "required package"))
+    for pf in serve_files:
+        if pf.tree is None:
+            continue
+        for lineno in blocking_sleep_lines(pf.tree):
+            yield Finding(
+                rule="no-blocking-sleep", path=pf.rel, line=lineno,
+                message=("blocking time.sleep on a service async path -- "
+                         "use threading.Condition/Event wait timeouts, "
+                         "which wake on notify"))
+        for lineno in async_poll_sleep_lines(pf.tree):
+            yield Finding(
+                rule="no-blocking-sleep", path=pf.rel, line=lineno,
+                message=("asyncio.sleep polling loop on a service async "
+                         "path -- wait on a Condition/Event (or an "
+                         "asyncio.Event) that wakes on notify instead of "
+                         "polling"))
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+
+#: method-call names that mutate their receiver (list/deque/dict/set/
+#: OrderedDict surface) — a call ``self.<guarded>.<one of these>(...)``
+#: is a write
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "pop", "popleft", "popitem", "clear", "update", "setdefault", "add",
+    "discard", "move_to_end", "rotate", "sort", "reverse",
+}
+
+
+def guarded_declarations(tree: ast.AST
+                         ) -> List[Tuple[ast.ClassDef, Dict[str, Set[str]]]]:
+    """Classes declaring ``_GUARDED_BY = {"<lock attr>": ("attr", ...)}``
+    as a class-level *literal* dict — the in-code registration the pass
+    enforces.  Non-literal declarations are ignored (the pass never
+    executes code)."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for stmt in node.body:
+            if not (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "_GUARDED_BY"
+                    and isinstance(stmt.value, ast.Dict)):
+                continue
+            decl: Dict[str, Set[str]] = {}
+            for k, v in zip(stmt.value.keys, stmt.value.values):
+                if not (isinstance(k, ast.Constant) and isinstance(k.value,
+                                                                   str)):
+                    continue
+                attrs: Set[str] = set()
+                if isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+                    for el in v.elts:
+                        if isinstance(el, ast.Constant) and isinstance(
+                                el.value, str):
+                            attrs.add(el.value)
+                elif isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    attrs.add(v.value)
+                decl[k.value] = attrs
+            if decl:
+                out.append((node, decl))
+    return out
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.<attr>`` → attr name, else None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _own_expressions(stmt: ast.stmt) -> List[ast.AST]:
+    """The nodes a compound statement owns DIRECTLY (its header), so the
+    mutator scan never descends into nested statements — those are
+    visited by the walker at their own (possibly lock-held) context."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.target, stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, (ast.Try, ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return []
+    return [stmt]
+
+
+def _written_guarded_attrs(stmt: ast.stmt, guarded: Set[str]
+                           ) -> List[Tuple[int, str, str]]:
+    """(line, attr, how) for every write this single statement makes to
+    a guarded ``self.<attr>``: rebinding, augmented assignment, item or
+    slice store/delete, and mutating method calls.  Compound statements
+    contribute only their header expressions (bodies are the walker's
+    job)."""
+    hits: List[Tuple[int, str, str]] = []
+
+    def targets_of(node):
+        if isinstance(node, ast.Assign):
+            return node.targets
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            return [node.target]
+        if isinstance(node, ast.Delete):
+            return node.targets
+        return []
+
+    for t in targets_of(stmt):
+        attr = _self_attr(t)
+        if attr in guarded:
+            hits.append((t.lineno, attr, "rebound"))
+        # self._entries[k] = v / del self._entries[k]
+        if isinstance(t, ast.Subscript):
+            attr = _self_attr(t.value)
+            if attr in guarded:
+                hits.append((t.lineno, attr, "item-assigned"))
+        # unpacking targets: (self._a, x) = ...
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                a = _self_attr(el)
+                if a in guarded:
+                    hits.append((el.lineno, a, "rebound"))
+
+    # mutating method calls in the statement's own expressions
+    for root in _own_expressions(stmt):
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr in _MUTATORS):
+                attr = _self_attr(f.value)
+                if attr in guarded:
+                    hits.append((node.lineno, attr, f"mutated (.{f.attr})"))
+    return hits
+
+
+def lock_discipline_findings(tree: ast.AST, path: str) -> List[Finding]:
+    """Enforce every ``_GUARDED_BY`` declaration in ``tree``: a write to
+    a registered attribute outside a ``with self.<its lock>:`` block is
+    a finding.  Exemptions, by convention:
+
+    * ``__init__`` — construction precedes publication to other threads;
+    * methods whose name ends ``_locked`` — the caller holds the lock
+      (the serve codebase's existing convention).
+
+    Reads are deliberately NOT checked (opportunistic racy reads of
+    gauges/flags are an accepted pattern in the fleet, and flagging them
+    would drown the real races)."""
+    findings: List[Finding] = []
+    for cls, decl in guarded_declarations(tree):
+        attr_lock = {a: lock for lock, attrs in decl.items() for a in attrs}
+        guarded = set(attr_lock)
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if meth.name == "__init__" or meth.name.endswith("_locked"):
+                continue
+
+            def walk(stmts: List[ast.stmt], held: Set[str]) -> None:
+                for stmt in stmts:
+                    for line, attr, how in _written_guarded_attrs(stmt,
+                                                                  guarded):
+                        if attr_lock[attr] not in held:
+                            findings.append(Finding(
+                                rule="lock-discipline", path=path,
+                                line=line,
+                                message=(f"{cls.name}.{attr} {how} in "
+                                         f"'{meth.name}' outside 'with "
+                                         f"self.{attr_lock[attr]}:' -- it "
+                                         "is registered lock-guarded in "
+                                         f"{cls.name}._GUARDED_BY (hold "
+                                         "the lock, or rename the method "
+                                         "*_locked if every caller "
+                                         "already does)")))
+                    now = set(held)
+                    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                        for item in stmt.items:
+                            a = _self_attr(item.context_expr)
+                            if a in decl:
+                                now = now | {a}
+                        walk(stmt.body, now)
+                        continue
+                    for body in (getattr(stmt, "body", None),
+                                 getattr(stmt, "orelse", None),
+                                 getattr(stmt, "finalbody", None)):
+                        if body:
+                            walk(body, held)
+                    for h in getattr(stmt, "handlers", []):
+                        walk(h.body, held)
+
+            walk(meth.body, set())
+    return findings
+
+
+@rule("lock-discipline",
+      "attributes registered in a class's _GUARDED_BY dict must only be "
+      "written under 'with self.<lock>:' (static race detector for the "
+      "serve fleet's shared state)")
+def _check_lock_discipline(ctx: LintContext) -> Iterable[Finding]:
+    for pf in ctx.py_files:
+        if pf.tree is None:
+            continue
+        yield from lock_discipline_findings(pf.tree, pf.rel)

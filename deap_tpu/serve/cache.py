@@ -94,6 +94,11 @@ class FitnessCache:
     entries.  Values are defensive copies of ``(nobj,)`` float arrays.
     Thread-safe (the dispatcher thread writes; stats readers poll)."""
 
+    #: lock-guarded shared state (``lock-discipline`` lint pass): the
+    #: LRU map is written by the dispatcher thread and read by any
+    #: client/stats thread — every mutation must hold ``self._lock``
+    _GUARDED_BY = {"_lock": ("_entries",)}
+
     def __init__(self, capacity: int = 4096, metrics=None):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")

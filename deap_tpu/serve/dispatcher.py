@@ -191,6 +191,15 @@ class BatchDispatcher:
     ``backoff`` (transient classes only).  ``clock`` is the monotonic
     deadline clock, injectable for tests."""
 
+    #: lock-guarded shared state, enforced statically by the
+    #: ``lock-discipline`` lint pass: every write to these attributes
+    #: must sit under ``with self._cv:`` (or in a ``*_locked`` method
+    #: whose callers all hold it) — the queue, the worker's lifecycle
+    #: flags, and the batch counter are shared between every client
+    #: thread and the dispatch worker
+    _GUARDED_BY = {"_cv": ("_pending", "_closed", "_draining", "_paused",
+                           "_busy", "_batches")}
+
     def __init__(self, execute: Callable[[str, tuple, List[Request]], list],
                  *, max_pending: int = 256, batch_window: float = 0.0,
                  metrics=None, retries: int = 2, backoff: float = 0.05,

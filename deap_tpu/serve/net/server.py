@@ -76,6 +76,11 @@ class NetServer:
         observability sink layer, never bare prints).
     """
 
+    #: lock-guarded shared state (``lock-discipline`` lint pass): the
+    #: session→toolbox name map is written by concurrent HTTP handler
+    #: threads (create/close/restore) — writes only under ``self._lock``
+    _GUARDED_BY = {"_lock": ("_session_toolbox",)}
+
     def __init__(self, service, toolboxes: Dict[str, Any], *,
                  host: str = "127.0.0.1", port: int = 0,
                  result_timeout: float = 600.0, sinks: Sequence = (),

@@ -259,6 +259,18 @@ class EvolutionService:
         batch execution (raise to inject an evaluation fault).
     """
 
+    #: lock-guarded shared state, enforced statically by the
+    #: ``lock-discipline`` lint pass: the session table, the pin
+    #: refcounts, and the admission name reservations are written from
+    #: any client thread and read by the dispatch worker — writes only
+    #: under ``with self._lock:`` (or in ``*_locked`` helpers).  NOT
+    #: registered: ``_programs``/``_templates``/``_sharded_tbs`` (worker-
+    #: thread-owned in steady state, locked only where client paths
+    #: touch them) and ``_draining`` (opportunistic flag; the
+    #: authoritative gate is the dispatcher's, under ITS queue lock).
+    _GUARDED_BY = {"_lock": ("_sessions", "_refs", "_refcounts",
+                             "_reserved", "_names")}
+
     def __init__(self, *, policy: Optional[BucketPolicy] = None,
                  max_batch: int = 4, max_pending: int = 256,
                  batch_window: float = 0.0, cache_capacity: int = 4096,

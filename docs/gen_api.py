@@ -88,6 +88,11 @@ PAGES = {
                     "deap_tpu.benchmarks.tools"]),
     "tools": ("Reference-compatibility facade (deap_tpu.tools)",
               ["deap_tpu.tools"]),
+    "lint": ("Static analysis (deap_tpu.lint)",
+             ["deap_tpu.lint.core", "deap_tpu.lint.baseline",
+              "deap_tpu.lint.reporters", "deap_tpu.lint.rules_repo",
+              "deap_tpu.lint.rules_jax", "deap_tpu.lint.rules_data",
+              "deap_tpu.lint.cli"]),
 }
 
 

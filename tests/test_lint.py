@@ -1,0 +1,567 @@
+"""Unit tests of the ``deap_tpu.lint`` static-analysis framework.
+
+Every rule gets a *can-fail* fixture (a tiny bad snippet the pass must
+flag — a checker that can't fail is not a gate) and, where the analysis
+is non-trivial, a *must-not-flag* fixture pinning the precision
+refinements (early-return dispatch, functional ``.update``, static
+argnames, lambda scoping).  Framework behaviors — suppression comments,
+baseline add/expire, reporter shapes, jax-free import — are pinned here
+too.  The whole-repo gate itself lives in ``tests/test_tooling.py``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from deap_tpu.lint import (Finding, run_lint, iter_rules, get_rule,  # noqa: E402
+                           load_baseline, write_baseline,
+                           render_text, render_json, render_sarif)
+
+
+def _write(tmp_path, rel, text):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(text))
+    return path
+
+
+def _findings(tmp_path, rule=None, **kw):
+    select = [rule] if rule else None
+    result = run_lint(repo=tmp_path, select=select, **kw)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# per-rule can-fail fixtures
+
+
+def test_no_bare_print_fires_and_sanctions(tmp_path):
+    _write(tmp_path, "deap_tpu/mod.py", 'x = 1\nprint("hi")\n')
+    _write(tmp_path, "deap_tpu/selftest.py", 'print("ok")\n')  # sanctioned
+    r = _findings(tmp_path, "no-bare-print")
+    assert [(f.path, f.line) for f in r.findings] == [("deap_tpu/mod.py", 2)]
+
+
+def test_no_blocking_sleep_fires_on_all_spellings(tmp_path):
+    _write(tmp_path, "deap_tpu/serve/net/__init__.py", "")
+    _write(tmp_path, "deap_tpu/serve/mod.py", """\
+        import time
+        import time as t
+        from time import sleep as zzz
+        def f():
+            time.sleep(1)
+            t.sleep(2)
+            zzz(3)
+            cv.wait(0.1)
+            other.sleep(4)
+        """)
+    r = _findings(tmp_path, "no-blocking-sleep")
+    assert [f.line for f in r.findings] == [5, 6, 7]
+
+
+def test_no_blocking_sleep_flags_asyncio_polling_loop(tmp_path):
+    """The satellite form: asyncio.sleep inside a loop is a polling nap;
+    a one-shot asyncio.sleep outside a loop is not flagged."""
+    _write(tmp_path, "deap_tpu/serve/net/__init__.py", "")
+    _write(tmp_path, "deap_tpu/serve/amod.py", """\
+        import asyncio
+        async def poller():
+            while not done():
+                await asyncio.sleep(0.05)
+        async def oneshot():
+            await asyncio.sleep(0.05)
+        """)
+    r = _findings(tmp_path, "no-blocking-sleep")
+    assert [f.line for f in r.findings] == [4]
+    assert "polling" in r.findings[0].message
+
+
+def test_no_blocking_sleep_coverage_pin(tmp_path):
+    """On a whole-repo run over a real package (deap_tpu/__init__.py
+    present), serve/net/ missing -> the pass reports lost coverage
+    instead of silently shrinking its scope; a path-restricted run of
+    the same tree is exempt (there is no coverage to lose)."""
+    _write(tmp_path, "deap_tpu/__init__.py", "")
+    _write(tmp_path, "deap_tpu/serve/mod.py", "x = 1\n")
+    r = _findings(tmp_path, "no-blocking-sleep")
+    assert len(r.findings) == 1
+    assert "lost coverage" in r.findings[0].message
+    r2 = run_lint(repo=tmp_path, select=["no-blocking-sleep"],
+                  paths=[tmp_path / "deap_tpu" / "serve"])
+    assert r2.findings == []
+
+
+def test_no_blocking_sleep_coverage_pin_whole_tree_gone(tmp_path):
+    """The harder rename: deap_tpu/serve/ itself vanishes from a real
+    package -> the gate must fail, not scan nothing and pass."""
+    _write(tmp_path, "deap_tpu/__init__.py", "")
+    _write(tmp_path, "deap_tpu/serving/mod.py", "x = 1\n")   # renamed
+    r = _findings(tmp_path, "no-blocking-sleep")
+    assert len(r.findings) == 2   # serve/ and serve/net/ both lost
+    assert all("lost coverage" in f.message for f in r.findings)
+
+
+def test_lock_discipline_fires_off_lock(tmp_path):
+    _write(tmp_path, "deap_tpu/serve/locky.py", """\
+        import threading
+
+        class Table:
+            _GUARDED_BY = {"_lock": ("_entries", "_count")}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._entries = {}
+                self._count = 0     # __init__ exempt: pre-publication
+
+            def good(self, k, v):
+                with self._lock:
+                    self._entries[k] = v
+                    self._count += 1
+
+            def _drop_locked(self, k):
+                del self._entries[k]      # *_locked exempt by convention
+
+            def bad(self, k, v):
+                self._entries[k] = v      # item store off-lock
+                self._entries.pop(k)      # mutator off-lock
+                self._count += 1          # rebind off-lock
+
+            def read_ok(self):
+                return len(self._entries)   # reads are not checked
+        """)
+    r = _findings(tmp_path, "lock-discipline")
+    assert [f.line for f in r.findings] == [20, 21, 22]
+    assert all("with self._lock" in f.message for f in r.findings)
+
+
+def test_trace_impurity_fires_on_host_effects(tmp_path):
+    _write(tmp_path, "deap_tpu/imp.py", """\
+        import time
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def clocky(x):
+            return x + time.time()
+
+        def scan_body(carry, _):
+            carry = carry + np.random.uniform()
+            return carry, None
+
+        def run(x):
+            return jax.lax.scan(scan_body, x, None, length=3)
+
+        acc = []
+
+        @jax.jit
+        def leaky(x):
+            acc.append(x)
+            return x
+        """)
+    r = _findings(tmp_path, "trace-impurity")
+    msgs = {f.line: f.message for f in r.findings}
+    assert 7 in msgs and "clock" in msgs[7]
+    assert 10 in msgs and "numpy RNG" in msgs[10]
+    assert 20 in msgs and "mutation" in msgs[20]
+
+
+def test_trace_impurity_exempts_host_callbacks_and_functional_update(
+        tmp_path):
+    """io_callback targets run on host by design; `state = obj.update(...)`
+    is the functional-update idiom, not a dict mutation."""
+    _write(tmp_path, "deap_tpu/cb.py", """\
+        import time
+        import jax
+        from jax.experimental import io_callback
+
+        def flush(x):
+            sink.append(time.time())    # host callback: sanctioned
+
+        def gen(carry, _):
+            io_callback(flush, None, carry)
+            state = strategy.update(carry, 1)
+            return state, None
+
+        def run(x):
+            return jax.lax.scan(gen, x, None, length=2)
+        """)
+    r = _findings(tmp_path, "trace-impurity")
+    assert r.findings == []
+
+
+def test_rng_key_reuse_fires(tmp_path):
+    _write(tmp_path, "deap_tpu/rng.py", """\
+        import jax
+
+        def bad(key):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.normal(key, (3,))
+            return a + b
+
+        def bad_after_split(key):
+            k1, k2 = jax.random.split(key)
+            return jax.random.uniform(key)
+
+        def loop_bad(key, xs):
+            tot = 0.0
+            for x in xs:
+                tot = tot + jax.random.uniform(key)
+            return tot
+        """)
+    r = _findings(tmp_path, "rng-key-reuse")
+    lines = [f.line for f in r.findings]
+    assert lines == [5, 10, 15]
+    assert "every iteration" in r.findings[2].message
+
+
+def test_rng_key_reuse_clean_patterns(tmp_path):
+    """The disciplined spellings must NOT flag: rebinding through split,
+    fold_in fan-out, mutually-exclusive early-return branches, per-branch
+    single use, lambdas as separate scopes, and reuse in tests/ (which
+    asserts determinism on purpose)."""
+    _write(tmp_path, "deap_tpu/ok.py", """\
+        import jax
+
+        def chain(key):
+            key, k1 = jax.random.split(key)
+            a = jax.random.normal(k1, (3,))
+            key, k2 = jax.random.split(key)
+            return a + jax.random.normal(k2, (3,))
+
+        def fanout(key, n):
+            return [jax.random.normal(jax.random.fold_in(key, i))
+                    for i in range(n)]
+
+        def dispatch(key, regime):
+            if regime == "a":
+                return jax.random.uniform(key, (2,))
+            if regime == "b":
+                return jax.random.normal(key, (2,))
+            return jax.random.bernoulli(key)
+
+        def lambdas(keys):
+            f = lambda k: jax.random.normal(k, (2,))
+            g = lambda k: jax.random.uniform(k, (2,))
+            return f, g
+        """)
+    _write(tmp_path, "tests/test_det.py", """\
+        import jax
+        def test_same_key_same_bits():
+            key = jax.random.PRNGKey(0)
+            assert (jax.random.uniform(key, (4,))
+                    == jax.random.uniform(key, (4,))).all()
+        """)
+    r = _findings(tmp_path, "rng-key-reuse")
+    assert r.findings == []
+
+
+def test_tracer_leak_fires(tmp_path):
+    _write(tmp_path, "deap_tpu/leak.py", """\
+        import jax
+
+        @jax.jit
+        def casts(x):
+            y = x * 2
+            n = int(y)
+            v = y.item()
+            return n + v
+
+        @jax.jit
+        def branches(x):
+            if x > 0:
+                return x
+            return -x
+        """)
+    r = _findings(tmp_path, "tracer-leak")
+    lines = sorted(f.line for f in r.findings)
+    assert lines == [6, 7, 12]
+
+
+def test_tracer_leak_respects_static_and_shape(tmp_path):
+    """static_argnames/nums params are Python values; .shape/.ndim and
+    `is None` tests never taint; helpers merely CALLED from traced code
+    are not tainted wholesale."""
+    _write(tmp_path, "deap_tpu/okleak.py", """\
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("method",))
+        def select(w, method="peel"):
+            if method == "peel":
+                return w * 2
+            return w
+
+        @jax.jit
+        def shapes(x, live=None):
+            n = int(x.shape[0])
+            if live is None:
+                live = x
+            if x.ndim == 2:
+                return live
+            return x * n
+
+        def helper(w, mode):
+            if mode == "fast":
+                return w * 2
+            return w
+
+        @jax.jit
+        def caller(w):
+            return helper(w, "fast")
+        """)
+    r = _findings(tmp_path, "tracer-leak")
+    assert r.findings == []
+
+
+def test_bench_json_fires(tmp_path):
+    (tmp_path / "BENCH_bad.json").write_text(
+        '{"metric": "m", "value": NaN, "unit": "x"}')
+    (tmp_path / "BENCH_str.json").write_text(
+        '{"metric": "m", "value": 1.5, "unit": "x", "extra": "NaN"}')
+    (tmp_path / "MULTICHIP_bad.json").write_text('{"rc": "0"}')
+    (tmp_path / "tools").mkdir()
+    (tmp_path / "tools" / "collective_budget.json").write_text(
+        '{"n_devices": 8, "shapes": {}, '
+        '"budget": {"mo": {"all-gather": -1}}}')
+    r = _findings(tmp_path, "bench-json")
+    by_path = {}
+    for f in r.findings:
+        by_path.setdefault(f.path, []).append(f.message)
+    assert any("invalid JSON" in m for m in by_path["BENCH_bad.json"])
+    assert any("string 'NaN'" in m for m in by_path["BENCH_str.json"])
+    assert any("'rc' must be an integer" in m
+               for m in by_path["MULTICHIP_bad.json"])
+    assert any("non-negative integer" in m
+               for m in by_path["tools/collective_budget.json"])
+
+
+def test_bench_json_accepts_committed_shapes():
+    """The real committed artifacts must validate (this doubles as the
+    schema's regression pin when new BENCH files land)."""
+    r = run_lint(repo=REPO, select=["bench-json"])
+    assert r.findings == [], render_text(r)
+
+
+# ---------------------------------------------------------------------------
+# framework behaviors
+
+
+def test_suppression_comment_retires_finding(tmp_path):
+    _write(tmp_path, "deap_tpu/sup.py", """\
+        import jax
+
+        def bad(key):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.normal(key, (3,))  # lint: disable=rng-key-reuse -- determinism probe
+            return a + b
+        """)
+    r = _findings(tmp_path, "rng-key-reuse")
+    assert r.findings == []
+    assert len(r.suppressed) == 1
+    assert r.suppressed[0].rule == "rng-key-reuse"
+
+
+def test_suppression_is_rule_specific(tmp_path):
+    _write(tmp_path, "deap_tpu/sup2.py", """\
+        import jax
+
+        def bad(key):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.normal(key, (3,))  # lint: disable=tracer-leak -- wrong rule
+            return a + b
+        """)
+    r = _findings(tmp_path, "rng-key-reuse")
+    assert len(r.findings) == 1
+
+
+def test_baseline_add_and_expire(tmp_path):
+    bad = _write(tmp_path, "deap_tpu/base1.py", """\
+        import jax
+
+        def bad(key):
+            a = jax.random.normal(key, (3,))
+            return a + jax.random.normal(key, (3,))
+        """)
+    baseline_path = tmp_path / "lint_baseline.json"
+
+    # 1. finding fires live with no baseline
+    r = _findings(tmp_path, "rng-key-reuse")
+    assert len(r.findings) == 1
+
+    # 2. grandfather it: same run is now clean, finding counted baselined
+    write_baseline(r.findings, baseline_path)
+    baseline = load_baseline(baseline_path)
+    r2 = run_lint(repo=tmp_path, select=["rng-key-reuse"],
+                  baseline=baseline)
+    assert r2.findings == [] and len(r2.baselined) == 1
+    assert r2.exit_code == 0
+
+    # 3. baseline matching is line-independent: shift the code down
+    bad.write_text("# pushed\n# down\n" + bad.read_text())
+    r3 = run_lint(repo=tmp_path, select=["rng-key-reuse"],
+                  baseline=baseline)
+    assert r3.findings == [] and len(r3.baselined) == 1
+
+    # 4. fix the code: the entry expires (reported, not failing)
+    bad.write_text(textwrap.dedent("""\
+        import jax
+
+        def good(key):
+            k1, k2 = jax.random.split(key)
+            return jax.random.normal(k1, (3,)) + jax.random.normal(k2, (3,))
+        """))
+    r4 = run_lint(repo=tmp_path, select=["rng-key-reuse"],
+                  baseline=baseline)
+    assert r4.findings == [] and len(r4.expired) == 1
+    assert "no longer fire" in render_text(r4)
+
+    # 5. --update-baseline semantics: rewriting from the current findings
+    # drops the expired entry
+    write_baseline(r4.findings, baseline_path)
+    assert load_baseline(baseline_path) == {}
+
+    # 6. a NEW finding is never masked by the baseline
+    _write(tmp_path, "deap_tpu/base2.py", """\
+        import jax
+
+        def fresh(key):
+            a = jax.random.uniform(key)
+            return a + jax.random.uniform(key)
+        """)
+    r5 = run_lint(repo=tmp_path, select=["rng-key-reuse"],
+                  baseline=baseline)
+    assert len(r5.findings) == 1 and r5.exit_code == 1
+
+
+def test_baseline_is_count_aware(tmp_path):
+    """Identical findings in one file get per-occurrence baseline keys:
+    grandfathering one bare print must NOT mask a second, new one."""
+    mod = _write(tmp_path, "deap_tpu/dup.py", 'print("a")\n')
+    r = _findings(tmp_path, "no-bare-print")
+    assert len(r.findings) == 1
+    baseline_path = tmp_path / "lint_baseline.json"
+    write_baseline(r.findings, baseline_path)
+    baseline = load_baseline(baseline_path)
+
+    mod.write_text('print("a")\nx = 1\nprint("b")\n')   # second occurrence
+    r2 = run_lint(repo=tmp_path, select=["no-bare-print"],
+                  baseline=baseline)
+    assert len(r2.findings) == 1 and len(r2.baselined) == 1
+    assert r2.exit_code == 1, "new duplicate finding must fail the gate"
+
+    # grandfather both, then fix one: the extra entry expires
+    write_baseline(r2.findings + r2.baselined, baseline_path)
+    baseline = load_baseline(baseline_path)
+    assert len(baseline) == 2
+    mod.write_text('print("a")\n')
+    r3 = run_lint(repo=tmp_path, select=["no-bare-print"],
+                  baseline=baseline)
+    assert r3.findings == [] and len(r3.baselined) == 1
+    assert len(r3.expired) == 1
+
+
+def test_update_baseline_refuses_partial_runs():
+    """Rewriting the baseline from a --select/path-restricted run would
+    silently drop every other rule's grandfathered entries."""
+    out = subprocess.run(
+        [sys.executable, "-m", "deap_tpu.lint.cli",
+         "--select", "no-bare-print", "--update-baseline"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 2
+    assert "full run" in out.stderr
+
+
+def test_lint_path_outside_repo_does_not_crash(tmp_path):
+    """An explicit file outside the repo root lints under its absolute
+    name instead of crashing on relative_to."""
+    bad = tmp_path / "elsewhere.py"
+    bad.write_text("import jax\n\ndef f(key):\n"
+                   "    a = jax.random.normal(key, (2,))\n"
+                   "    return a + jax.random.normal(key, (2,))\n")
+    r = run_lint(repo=REPO, paths=[bad], select=["rng-key-reuse"])
+    assert len(r.findings) == 1
+    assert r.findings[0].path.endswith("elsewhere.py")
+
+
+def test_json_report_shape(tmp_path):
+    _write(tmp_path, "deap_tpu/j.py", 'print("x")\n')
+    r = _findings(tmp_path, "no-bare-print")
+    doc = render_json(r)
+    assert doc["summary"]["findings"] == 1
+    assert doc["summary"]["exit_code"] == 1
+    (f,) = doc["findings"]
+    assert f["rule"] == "no-bare-print"
+    assert f["path"] == "deap_tpu/j.py" and f["line"] == 1
+    assert isinstance(f["fingerprint"], str) and len(f["fingerprint"]) == 16
+    json.dumps(doc)   # must be serializable as-is
+
+
+def test_sarif_report_shape(tmp_path):
+    _write(tmp_path, "deap_tpu/s.py", 'print("x")\n')
+    r = _findings(tmp_path, "no-bare-print")
+    doc = render_sarif(r)
+    assert doc["version"] == "2.1.0"
+    (run,) = doc["runs"]
+    assert run["tool"]["driver"]["name"] == "deap-tpu-lint"
+    rules = {x["id"] for x in run["tool"]["driver"]["rules"]}
+    assert "no-bare-print" in rules and "rng-key-reuse" in rules
+    (res,) = run["results"]
+    assert res["ruleId"] == "no-bare-print"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "deap_tpu/s.py"
+    assert loc["region"]["startLine"] == 1
+    assert run["tool"]["driver"]["rules"][res["ruleIndex"]]["id"] \
+        == "no-bare-print"
+    json.dumps(doc)
+
+
+def test_parse_error_is_reported_not_crashing(tmp_path):
+    _write(tmp_path, "deap_tpu/syn.py", "def broken(:\n")
+    r = run_lint(repo=tmp_path)
+    assert any(f.rule == "parse-error" for f in r.findings)
+
+
+def test_rule_registry_and_defaults():
+    names = {r.name for r in iter_rules()}
+    assert {"no-bare-print", "no-blocking-sleep", "lock-discipline",
+            "trace-impurity", "rng-key-reuse", "tracer-leak",
+            "bench-json", "collective-budget"} <= names
+    assert get_rule("collective-budget").default is False, \
+        "the HLO-lowering pass must stay opt-in (it needs jax)"
+    with pytest.raises(KeyError):
+        get_rule("no-such-rule")
+
+
+def test_finding_fingerprint_is_line_independent():
+    a = Finding(rule="r", path="p.py", line=3, message="m")
+    b = Finding(rule="r", path="p.py", line=99, message="m")
+    c = Finding(rule="r", path="p.py", line=3, message="other")
+    assert a.fingerprint() == b.fingerprint() != c.fingerprint()
+
+
+def test_lint_imports_without_jax():
+    """The acceptance contract: linting must not require the array stack.
+    (deap_tpu's package init is lazy precisely so this holds.)"""
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; import deap_tpu.lint.cli; "
+         "assert 'jax' not in sys.modules, 'jax imported by lint'; "
+         "print('ok')"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "ok"
+
+
+def test_cli_select_unknown_rule_is_usage_error():
+    out = subprocess.run(
+        [sys.executable, "-m", "deap_tpu.lint.cli", "--select", "nope"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 2
+    assert "unknown lint rule" in out.stderr

@@ -1,28 +1,66 @@
-"""Tier-1 wiring for the static tooling passes under ``tools/``."""
+"""Tier-1 wiring for the static tooling: the ``deap-tpu-lint`` framework
+gate (one run of every default pass over the whole repo), the heavy
+collective-budget pass routed through the same framework, and the unit
+surface of the thin ``tools/`` shims kept for historical invocations.
 
+Framework internals (per-rule can-fail fixtures, suppression/baseline
+behavior, reporter shapes) are covered in ``tests/test_lint.py``.
+"""
+
+import json
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_no_bare_print_in_library_code():
-    """Runtime output must route through the observability sink layer;
-    ``tools/check_no_bare_print.py`` walks deap_tpu/ with ast and fails on
-    ``print(`` outside the sanctioned emitter modules."""
+def test_lint_gate():
+    """THE static-analysis gate: every default pass (no-bare-print,
+    no-blocking-sleep, lock-discipline, trace-impurity, rng-key-reuse,
+    tracer-leak, bench-json) over the whole repo must be clean —
+    zero non-baselined findings — and fast (the framework parses each
+    file once and never imports jax; budget < 10s)."""
+    t0 = time.monotonic()
     out = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools",
-                                      "check_no_bare_print.py")],
-        capture_output=True, text=True)
-    assert out.returncode == 0, out.stderr or out.stdout
+        [sys.executable, "-m", "deap_tpu.lint.cli", "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+    wall = time.monotonic() - t0
+    assert out.returncode == 0, out.stdout or out.stderr
+    report = json.loads(out.stdout)
+    assert report["summary"]["findings"] == 0
+    assert {"no-bare-print", "no-blocking-sleep", "lock-discipline",
+            "trace-impurity", "rng-key-reuse", "tracer-leak",
+            "bench-json"} <= set(report["summary"]["rules_run"])
+    assert "collective-budget" not in report["summary"]["rules_run"], \
+        "the heavy lowering pass must not run in the default gate"
+    assert wall < 10.0, f"lint gate took {wall:.1f}s (budget 10s)"
 
 
-def test_checker_catches_a_planted_print(tmp_path):
-    """The pass must actually detect violations (a checker that can't
-    fail is not a gate): run its finder on a file with a bare print."""
+def test_lint_gate_runs_without_jax():
+    """Linting must work on a box with no accelerator stack: the CLI
+    module (and the whole default pass set) never imports jax."""
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys\n"
+         "from deap_tpu.lint import run_lint\n"
+         "r = run_lint()\n"
+         "assert 'jax' not in sys.modules, 'jax imported while linting'\n"
+         "print(len(r.findings))"],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "0"
+
+
+# -- thin shims (historical entry points) -----------------------------------
+
+
+def test_bare_print_shim_and_planted_print(tmp_path):
+    """The shim must keep its historical surface (``find_bare_prints`` on
+    a path, ``SANCTIONED``) and still detect violations."""
     sys.path.insert(0, os.path.join(REPO, "tools"))
     try:
         import check_no_bare_print as chk
@@ -32,22 +70,13 @@ def test_checker_catches_a_planted_print(tmp_path):
     bad.write_text('x = 1\nprint("hi")\n# print("in a comment")\n'
                    's = "print(not a call)"\n')
     assert chk.find_bare_prints(bad) == [2]
+    assert "observability/sinks.py" in chk.SANCTIONED
+    assert "lint/cli.py" in chk.SANCTIONED   # lint CLI stdout is its interface
 
 
-def test_no_blocking_sleep_on_serve_async_paths():
-    """The serving layer's worker/admission paths must wait on
-    interruptible primitives, never time.sleep;
-    ``tools/check_no_blocking_sleep.py`` pins it with ast."""
-    out = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools",
-                                      "check_no_blocking_sleep.py")],
-        capture_output=True, text=True)
-    assert out.returncode == 0, out.stderr or out.stdout
-
-
-def test_sleep_checker_catches_planted_sleeps(tmp_path):
-    """The sleep pass must detect the spellings it bans — module call,
-    alias, and from-import — and ignore non-time sleeps."""
+def test_sleep_shim_catches_planted_sleeps(tmp_path):
+    """The shim must detect the spellings it bans — module call, alias,
+    from-import — and ignore non-time sleeps."""
     sys.path.insert(0, os.path.join(REPO, "tools"))
     try:
         import check_no_blocking_sleep as chk
@@ -61,11 +90,31 @@ def test_sleep_checker_catches_planted_sleeps(tmp_path):
     assert chk.find_blocking_sleeps(bad) == [4, 5, 6]
 
 
-def test_sleep_checker_covers_net_package():
+def test_sleep_shim_catches_asyncio_polling(tmp_path):
+    """PR 3/7's Condition-wait invariant now covers the async spelling:
+    asyncio.sleep inside a loop is a polling nap (one-shot sleeps and
+    Condition waits are not flagged)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import check_no_blocking_sleep as chk
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import asyncio\n"
+        "async def poller():\n"
+        "    while True:\n"
+        "        await asyncio.sleep(0.1)\n"
+        "async def oneshot():\n"
+        "    await asyncio.sleep(0.1)\n")
+    assert chk.find_async_poll_sleeps(bad) == [4]
+
+
+def test_sleep_shim_covers_net_package():
     """The no-blocking-sleep pass must scan the network frontend too
     (an HTTP handler napping on time.sleep stalls a live connection):
-    its scanned set is pinned to include deap_tpu/serve/net/ modules, and
-    it must fail loudly if the subpackage stops contributing files."""
+    the scanned set is pinned to include deap_tpu/serve/net/ modules,
+    and it fails loudly if the subpackage stops contributing files."""
     sys.path.insert(0, os.path.join(REPO, "tools"))
     try:
         import check_no_blocking_sleep as chk
@@ -79,19 +128,23 @@ def test_sleep_checker_covers_net_package():
     assert "net" in chk.REQUIRED_SUBPACKAGES
 
 
+# -- collective budget (heavy pass, via the framework) -----------------------
+
+
 def test_collective_budget_gate():
     """The compiled collective inventory of the three weak-scaling
     layouts (bench_weakscaling.build: pop / island / mo) must stay
     within tools/collective_budget.json — the r06 collective-lean
-    sharded NSGA-II contract (the r05 peel's 26 all-reduces regressed
-    silently because nothing gated the HLO).  The script provisions its
-    own 8-virtual-device CPU mesh."""
+    sharded NSGA-II contract.  Routed through the lint framework as its
+    one opt-in heavy pass (``--select collective-budget``), which shells
+    out to tools/check_collective_budget.py on its own 8-virtual-device
+    CPU mesh."""
     out = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools",
-                                      "check_collective_budget.py")],
-        capture_output=True, text=True, timeout=300,
+        [sys.executable, "-m", "deap_tpu.lint.cli",
+         "--select", "collective-budget"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
         env={k: v for k, v in os.environ.items() if k != "XLA_FLAGS"})
-    assert out.returncode == 0, out.stderr or out.stdout
+    assert out.returncode == 0, out.stdout or out.stderr
 
 
 def test_collective_budget_catches_a_regression():
@@ -107,6 +160,26 @@ def test_collective_budget_catches_a_regression():
     bad = chk.compare({"mo": {"all-gather": 4, "all-reduce": 2}}, budget)
     assert len(bad) == 1 and "all-reduce" in bad[0]
     assert chk.compare({"mo": {"all-gather": 3}}, budget) == []
+
+
+# -- console entries / packaging wiring --------------------------------------
+
+
+def test_lint_entry_and_baseline_wired():
+    """pyproject must expose the deap-tpu-lint console entry (pointing at
+    an importable callable), and the committed baseline must exist and
+    be loadable.  (Textual pyproject checks: tomllib needs python >= 3.11
+    and this gate runs on 3.10.)"""
+    with open(os.path.join(REPO, "pyproject.toml")) as f:
+        text = f.read()
+    assert 'deap-tpu-lint = "deap_tpu.lint.cli:main"' in text, \
+        "deap-tpu-lint console entry missing"
+    import importlib
+    assert callable(importlib.import_module("deap_tpu.lint.cli").main)
+    from deap_tpu.lint import load_baseline, DEFAULT_BASELINE
+    assert os.path.exists(DEFAULT_BASELINE), \
+        "tools/lint_baseline.json must be committed (empty is fine)"
+    assert isinstance(load_baseline(), dict)
 
 
 def test_serve_entry_and_extra_wired():
@@ -131,7 +204,6 @@ def test_serve_entry_and_extra_wired():
 def test_serve_cli_smoke():
     """``deap-tpu-serve --smoke`` must stand up a real service, drive a
     tiny fleet, and exit 0 with a JSON report on its last stdout line."""
-    import json
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     out = subprocess.run(
         [sys.executable, "-m", "deap_tpu.serve.cli", "--smoke"],

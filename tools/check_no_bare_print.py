@@ -1,22 +1,18 @@
 #!/usr/bin/env python
-"""Static pass: no bare ``print(`` in library code.
+"""Thin shim over the ``no-bare-print`` pass of ``deap_tpu.lint``.
 
-Runtime output must flow through the observability sink layer
-(``deap_tpu.observability.sinks.emit_text`` / the ``Sink`` classes) so it
-is capturable and process-0-only on multihost — a bare ``print`` in
-library code bypasses both.  This checker walks every module under
-``deap_tpu/`` with ``ast`` (no false positives from strings or comments)
-and fails on any ``print(...)`` call outside the sanctioned emitter
-modules:
+The pass itself (one shared AST parse, suppressions, baseline) lives in
+:mod:`deap_tpu.lint.rules_repo`; this script keeps the historical
+entry point (``python tools/check_no_bare_print.py``) and the helper
+surface (:data:`SANCTIONED`, :func:`find_bare_prints`) that
+``tests/test_tooling.py`` unit-tests, so existing invocations keep
+working.  The tier-1 gate now runs the whole framework once
+(``deap-tpu-lint``) instead of this script per-rule.
 
-* ``observability/sinks.py`` — the sink layer itself (the one sanctioned
-  home of ``print`` for runtime output);
-* ``observability/cli.py``, ``serve/cli.py``, ``selftest.py``,
-  ``resilience/faultdrill.py``, ``native/build.py`` — console entry
-  points whose stdout IS their interface.
-
-Run directly (``python tools/check_no_bare_print.py``) or through the
-tier-1 gate (``tests/test_tooling.py``).
+Rationale (unchanged): runtime output must flow through the
+observability sink layer (``deap_tpu.observability.sinks.emit_text`` /
+the ``Sink`` classes) so it is capturable and process-0-only on
+multihost — a bare ``print`` in library code bypasses both.
 """
 
 from __future__ import annotations
@@ -26,46 +22,27 @@ import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-PACKAGE = REPO / "deap_tpu"
+sys.path.insert(0, str(REPO))
 
-#: posix-relative paths (under deap_tpu/) allowed to call print()
-SANCTIONED = {
-    "observability/sinks.py",
-    "observability/cli.py",
-    "serve/cli.py",
-    "selftest.py",
-    "resilience/faultdrill.py",
-    "native/build.py",
-}
+from deap_tpu.lint import run_lint, render_text                  # noqa: E402
+from deap_tpu.lint.rules_repo import (                           # noqa: E402
+    SANCTIONED_PRINT_MODULES as SANCTIONED, bare_print_lines)
 
 
-def find_bare_prints(path: Path) -> list[int]:
-    """Line numbers of ``print(...)`` calls in ``path``."""
-    tree = ast.parse(path.read_text(), filename=str(path))
-    lines = []
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "print"):
-            lines.append(node.lineno)
-    return lines
+def find_bare_prints(path: Path) -> list:
+    """Line numbers of ``print(...)`` calls in ``path`` (historical
+    helper surface — delegates to the framework's AST matcher)."""
+    tree = ast.parse(Path(path).read_text(), filename=str(path))
+    return bare_print_lines(tree)
 
 
 def main() -> int:
-    violations = []
-    for path in sorted(PACKAGE.rglob("*.py")):
-        rel = path.relative_to(PACKAGE).as_posix()
-        if rel in SANCTIONED:
-            continue
-        for lineno in find_bare_prints(path):
-            violations.append(f"deap_tpu/{rel}:{lineno}")
-    if violations:
-        sys.stderr.write(
-            "bare print() in library code (route through "
-            "deap_tpu.observability.sinks.emit_text, or add the module to "
-            "SANCTIONED in tools/check_no_bare_print.py if its stdout is "
-            "its interface):\n"
-            + "\n".join(f"  {v}" for v in violations) + "\n")
+    # path-restricted: the rule only looks under deap_tpu/, so only
+    # parse that subtree (the framework gate runs whole-repo separately)
+    result = run_lint(repo=REPO, select=["no-bare-print"],
+                      paths=[REPO / "deap_tpu"])
+    if result.findings:
+        sys.stderr.write(render_text(result) + "\n")
         return 1
     print(f"no bare print() outside sanctioned emitters "
           f"({len(SANCTIONED)} sanctioned modules)")

@@ -1,29 +1,19 @@
 #!/usr/bin/env python
-"""Static pass: no blocking ``time.sleep`` on the service's async paths.
+"""Thin shim over the ``no-blocking-sleep`` pass of ``deap_tpu.lint``.
 
-The serving layer (``deap_tpu/serve/``) runs all device dispatch on one
-worker thread and promises bounded-latency admission control; a blocking
-``time.sleep`` anywhere in that package stalls every queued session behind
-a wall-clock nap that no condition can interrupt.  Waiting there must go
-through interruptible primitives — ``threading.Condition.wait(timeout)``,
-``threading.Event.wait(timeout)``, ``queue`` timeouts — whose sleeps wake
-on notify.  (Retry backoff is fine: it lives in
-``deap_tpu/resilience/retry.py``, outside this package, and only runs
-between attempts of an already-failing dispatch.)
+The pass lives in :mod:`deap_tpu.lint.rules_repo`; this script keeps the
+historical entry point (``python tools/check_no_blocking_sleep.py``) and
+the helper surface (:func:`find_blocking_sleeps`, :func:`scanned_paths`,
+:data:`REQUIRED_SUBPACKAGES`) that ``tests/test_tooling.py`` unit-tests.
+The tier-1 gate now runs the whole framework once (``deap-tpu-lint``).
 
-The network frontend (``deap_tpu/serve/net/``) raises the stakes: a
-blocking sleep there stalls an HTTP handler thread mid-connection.  Its
-waits must be Condition-based too (the metrics stream tails the
-dispatcher through ``wait_for_batches``; the remote client's worker waits
-on its ``queue.Queue``) — socket I/O blocking is fine, wall-clock naps
-are not.
-
-This checker walks every module under ``deap_tpu/serve/`` (recursively —
-``serve/net/`` included, and :data:`REQUIRED_SUBPACKAGES` pins that the
-walk actually sees it, so a package move can't silently drop coverage)
-with ``ast`` and fails on any call spelled ``time.sleep(...)`` or a bare
-``sleep(...)`` imported from ``time``.  Run directly or through the
-tier-1 gate (``tests/test_tooling.py``).
+Rationale (unchanged): the serving layer promises bounded-latency
+admission control on Condition-based waits — a blocking ``time.sleep``
+anywhere under ``deap_tpu/serve/`` stalls every queued session behind a
+wall-clock nap no notify can interrupt.  The framework pass also bans
+the async spelling of the same bug: an ``asyncio.sleep`` polling loop
+(:func:`find_async_poll_sleeps`), which adds its full period to every
+wakeup's latency where a Condition wait would wake immediately.
 """
 
 from __future__ import annotations
@@ -33,14 +23,17 @@ import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from deap_tpu.lint import run_lint, render_text                  # noqa: E402
+from deap_tpu.lint.rules_repo import (                           # noqa: E402
+    REQUIRED_SLEEP_SUBPACKAGES as REQUIRED_SUBPACKAGES,
+    blocking_sleep_lines, async_poll_sleep_lines)
+
 PACKAGE = REPO / "deap_tpu" / "serve"
 
-#: subpackages the walk MUST find modules under — coverage pins, so a
-#: rename/move fails the gate instead of silently shrinking its scope
-REQUIRED_SUBPACKAGES = ("net",)
 
-
-def scanned_paths() -> list[Path]:
+def scanned_paths() -> list:
     """Every module the pass covers; raises if a required subpackage
     contributes nothing (coverage would have silently shrunk)."""
     paths = sorted(PACKAGE.rglob("*.py"))
@@ -53,51 +46,32 @@ def scanned_paths() -> list[Path]:
     return paths
 
 
-def find_blocking_sleeps(path: Path) -> list[int]:
-    """Line numbers of blocking-sleep calls in ``path``: ``time.sleep(...)``
-    (any module alias bound from ``import time``) and bare ``sleep(...)``
-    when ``from time import sleep`` appears in the module."""
-    tree = ast.parse(path.read_text(), filename=str(path))
-    time_aliases = {"time"}
-    sleep_names = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name == "time":
-                    time_aliases.add(a.asname or "time")
-        elif isinstance(node, ast.ImportFrom) and node.module == "time":
-            for a in node.names:
-                if a.name == "sleep":
-                    sleep_names.add(a.asname or "sleep")
-    lines = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        if (isinstance(f, ast.Attribute) and f.attr == "sleep"
-                and isinstance(f.value, ast.Name)
-                and f.value.id in time_aliases):
-            lines.append(node.lineno)
-        elif isinstance(f, ast.Name) and f.id in sleep_names:
-            lines.append(node.lineno)
-    return lines
+def find_blocking_sleeps(path: Path) -> list:
+    """Line numbers of blocking-sleep calls in ``path``:
+    ``time.sleep(...)`` (any module alias) and bare ``sleep(...)``
+    imported from ``time``."""
+    tree = ast.parse(Path(path).read_text(), filename=str(path))
+    return blocking_sleep_lines(tree)
+
+
+def find_async_poll_sleeps(path: Path) -> list:
+    """Line numbers of ``asyncio.sleep(...)`` calls inside while/for
+    loops — the async polling nap the Condition-wait invariant bans."""
+    tree = ast.parse(Path(path).read_text(), filename=str(path))
+    return async_poll_sleep_lines(tree)
 
 
 def main() -> int:
-    violations = []
-    paths = scanned_paths()
-    for path in paths:
-        rel = path.relative_to(REPO).as_posix()
-        for lineno in find_blocking_sleeps(path):
-            violations.append(f"{rel}:{lineno}")
-    if violations:
-        sys.stderr.write(
-            "blocking time.sleep on a service async path (use "
-            "threading.Condition/Event wait timeouts, which wake on "
-            "notify):\n" + "\n".join(f"  {v}" for v in violations) + "\n")
+    paths = scanned_paths()          # coverage pin, raises on loss
+    # path-restricted: only parse the serve tree the rule covers (the
+    # framework gate runs whole-repo separately, with its own pin)
+    result = run_lint(repo=REPO, select=["no-blocking-sleep"],
+                      paths=[PACKAGE])
+    if result.findings:
+        sys.stderr.write(render_text(result) + "\n")
         return 1
-    print(f"no blocking time.sleep under deap_tpu/serve/ "
-          f"({len(paths)} modules, net/ included)")
+    print(f"no blocking time.sleep (or polled asyncio.sleep) under "
+          f"deap_tpu/serve/ ({len(paths)} modules, net/ included)")
     return 0
 
 

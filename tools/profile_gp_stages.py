@@ -49,7 +49,9 @@ def main():
     key = jax.random.PRNGKey(0)
     keys = jax.random.split(key, POP)
     codes, consts, lengths = jax.vmap(lambda k: gen_init(k, 1, 3))(keys)
-    fit = jax.random.uniform(key, (POP, 1))
+    # fold_in, not a reuse of `key`: split(key, POP) already consumed it,
+    # and uniform(key) would replay bits correlated with keys[0]'s stream
+    fit = jax.random.uniform(jax.random.fold_in(key, 1), (POP, 1))
 
     # profile at STEADY STATE: evolve 300 generations first so tree
     # lengths carry the bench's real bloat, not the (1,3)-depth init
