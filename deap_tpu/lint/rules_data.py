@@ -32,10 +32,13 @@ _NAN_STRINGS = {"nan", "-nan", "inf", "-inf", "infinity", "-infinity"}
 
 
 def bench_json_targets(repo: Path) -> List[Tuple[str, Path]]:
-    """(schema kind, path) for every committed artifact the pass owns."""
+    """(schema kind, path) for every committed artifact the pass owns.
+    ``BENCH_TRACE.json`` (the tracing-overhead artifact from
+    ``tools/bench_serve.py --net --trace``) gets its own stricter
+    schema."""
     out: List[Tuple[str, Path]] = []
     for p in sorted(repo.glob("BENCH_*.json")):
-        out.append(("bench", p))
+        out.append(("trace" if p.name == "BENCH_TRACE.json" else "bench", p))
     for p in sorted(repo.glob("MULTICHIP_*.json")):
         out.append(("multichip", p))
     budget = repo / "tools" / "collective_budget.json"
@@ -99,6 +102,26 @@ def _schema_errors(kind: str, doc) -> List[str]:
             errors.append("bench record needs a 'metric'/'value'/'unit' "
                           "triple, an 'rc'/'tail' runner log, or a "
                           "'cmd'/'result' document")
+    elif kind == "trace":
+        # BENCH_TRACE.json: the tracing-overhead record — a metric triple
+        # plus the two loopback latency legs it was computed from, so a
+        # malformed commit (missing leg, NaN overhead) fails tier-1
+        require("metric", str, "a string")
+        value = require("value", (int, float), "a number")
+        require("unit", str, "a string")
+        if isinstance(value, float) and not math.isfinite(value):
+            errors.append("key 'value' must be finite")
+        for leg in ("traced", "untraced"):
+            sub = doc.get(leg)
+            if not isinstance(sub, dict):
+                errors.append(f"key '{leg}' must be an object with the "
+                              "leg's latency quantiles")
+                continue
+            p50 = sub.get("roundtrip_p50_ms")
+            if isinstance(p50, bool) or not isinstance(p50, (int, float)) \
+                    or not math.isfinite(float(p50)):
+                errors.append(f"key '{leg}.roundtrip_p50_ms' must be a "
+                              "finite number")
     elif kind == "multichip":
         if not isinstance(doc.get("rc"), int) or isinstance(doc.get("rc"),
                                                             bool):

@@ -13,6 +13,7 @@ state.
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from .core import Finding, LintContext, rule
@@ -20,7 +21,10 @@ from .core import Finding, LintContext, rule
 __all__ = ["SANCTIONED_PRINT_MODULES", "REQUIRED_SLEEP_SUBPACKAGES",
            "bare_print_lines", "blocking_sleep_lines",
            "async_poll_sleep_lines", "guarded_declarations",
-           "lock_discipline_findings"]
+           "lock_discipline_findings",
+           "METRIC_REGISTRY_MODULE", "METRIC_REGISTRY_TUPLES",
+           "SANCTIONED_METRIC_PREFIXES", "metric_registry",
+           "metric_discipline_findings"]
 
 
 # ---------------------------------------------------------------------------
@@ -383,3 +387,151 @@ def _check_lock_discipline(ctx: LintContext) -> Iterable[Finding]:
         if pf.tree is None:
             continue
         yield from lock_discipline_findings(pf.tree, pf.rel)
+
+
+# ---------------------------------------------------------------------------
+# metric-discipline
+
+#: the module whose registry tuples are THE committed metric-name list —
+#: every constant name at an inc()/set_gauge()/inc_tenant() site diffs
+#: against it, so an inc-site typo ("cache_hit" for "cache_hits") fails
+#: the gate instead of silently creating a parallel counter nobody reads
+METRIC_REGISTRY_MODULE = "deap_tpu/serve/metrics.py"
+
+#: registry tuple name -> the writer methods it governs
+METRIC_REGISTRY_TUPLES = {
+    "SERVE_COUNTERS": ("inc",),
+    "NET_COUNTERS": ("inc",),
+    "SERVE_GAUGES": ("set_gauge",),
+    "TENANT_COUNTERS": ("inc_tenant",),
+}
+
+#: static f-string prefixes a *dynamic* metric name may carry: the
+#: latency quantile family, the per-kind compile counters, and the
+#: per-tenant namespace.  Any other f-string metric name is an
+#: unreviewable cardinality/typo hazard and is flagged.
+SANCTIONED_METRIC_PREFIXES = ("latency_", "compiles_", "tenant_")
+
+#: writer method -> index of its metric-name argument
+_METRIC_WRITERS = {"inc": 0, "set_gauge": 0, "inc_tenant": 1}
+
+_SNAKE_RE = re.compile(r"[a-z][a-z0-9_]*\Z")
+
+
+def metric_registry(tree: ast.AST) -> Dict[str, Set[str]]:
+    """Parse the committed name registries out of the metrics module's
+    AST: ``{writer method: allowed names}``.  Pure AST — the lint
+    process never imports the serve package."""
+    allowed: Dict[str, Set[str]] = {m: set()
+                                    for ms in METRIC_REGISTRY_TUPLES.values()
+                                    for m in ms}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id in METRIC_REGISTRY_TUPLES
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            continue
+        names = {el.value for el in node.value.elts
+                 if isinstance(el, ast.Constant) and isinstance(el.value,
+                                                                str)}
+        for meth in METRIC_REGISTRY_TUPLES[node.targets[0].id]:
+            allowed[meth] |= names
+    return allowed
+
+
+def _is_metrics_receiver(func: ast.Attribute) -> bool:
+    """``<something>.metrics.inc(...)`` / ``self._metrics.inc(...)`` /
+    bare ``metrics.inc(...)`` — the receiver's last segment must name a
+    metrics object, so unrelated ``.inc()`` methods stay out of scope."""
+    v = func.value
+    if isinstance(v, ast.Name):
+        return v.id in ("metrics", "_metrics")
+    if isinstance(v, ast.Attribute):
+        return v.attr in ("metrics", "_metrics")
+    return False
+
+
+def metric_discipline_findings(tree: ast.AST, path: str,
+                               allowed: Dict[str, Set[str]]
+                               ) -> List[Finding]:
+    """Findings for one file's metric writer sites: non-snake_case
+    constant names, constant names missing from the committed registry,
+    and dynamic f-string names outside the sanctioned prefixes.
+    Non-literal name expressions (a ``name`` variable forwarded by a
+    helper) are out of scope — the registry diff catches their callers'
+    constants instead."""
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_WRITERS
+                and _is_metrics_receiver(node.func)):
+            continue
+        idx = _METRIC_WRITERS[node.func.attr]
+        if len(node.args) <= idx:
+            continue
+        arg = node.args[idx]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            name = arg.value
+            if not _SNAKE_RE.match(name):
+                findings.append(Finding(
+                    rule="metric-discipline", path=path, line=node.lineno,
+                    message=(f"metric name {name!r} is not snake_case -- "
+                             "counter/gauge names must match "
+                             "[a-z][a-z0-9_]*")))
+            elif allowed.get(node.func.attr) and \
+                    name not in allowed[node.func.attr]:
+                findings.append(Finding(
+                    rule="metric-discipline", path=path, line=node.lineno,
+                    message=(f"metric name {name!r} is not in the "
+                             "committed registry of "
+                             f"{METRIC_REGISTRY_MODULE} -- an inc-site "
+                             "typo creates a parallel series nobody "
+                             "reads; fix the name or register it")))
+        elif isinstance(arg, ast.JoinedStr):
+            prefix = ""
+            if arg.values and isinstance(arg.values[0], ast.Constant) \
+                    and isinstance(arg.values[0].value, str):
+                prefix = arg.values[0].value
+            if not prefix.startswith(SANCTIONED_METRIC_PREFIXES):
+                findings.append(Finding(
+                    rule="metric-discipline", path=path, line=node.lineno,
+                    message=(f"dynamic f-string metric name (prefix "
+                             f"{prefix!r}) outside the sanctioned "
+                             f"prefixes {SANCTIONED_METRIC_PREFIXES} -- "
+                             "dynamic names defeat the registry diff and "
+                             "can explode series cardinality; use a "
+                             "static name, or a per-tenant/latency "
+                             "prefix")))
+    return findings
+
+
+@rule("metric-discipline",
+      "serve-layer metric names must be snake_case, match the committed "
+      "registry in serve/metrics.py at constant inc/set_gauge sites, and "
+      "never be dynamic f-strings outside the per-tenant/latency/compile "
+      "prefixes")
+def _check_metric_discipline(ctx: LintContext) -> Iterable[Finding]:
+    reg_file = ctx.by_rel.get(METRIC_REGISTRY_MODULE)
+    allowed: Dict[str, Set[str]] = {}
+    if reg_file is not None and reg_file.tree is not None:
+        allowed = metric_registry(reg_file.tree)
+        if not any(allowed.values()):
+            allowed = {}
+    pin_applies = (not ctx.path_restricted
+                   and (ctx.repo / "deap_tpu" / "__init__.py").exists())
+    if not allowed and pin_applies:
+        # whole-repo run over the real package with no parseable
+        # registry: the diff lost its reference list — fail loudly
+        # instead of silently checking nothing
+        yield Finding(
+            rule="metric-discipline", path=METRIC_REGISTRY_MODULE, line=1,
+            message=("metric name registry (SERVE_COUNTERS/SERVE_GAUGES/"
+                     "NET_COUNTERS/TENANT_COUNTERS tuples) not found -- "
+                     "the metric-discipline pass lost its committed name "
+                     "list"))
+        return
+    for pf in ctx.files_under("deap_tpu/serve/"):
+        if pf.tree is None:
+            continue
+        yield from metric_discipline_findings(pf.tree, pf.rel, allowed)

@@ -21,7 +21,9 @@ runtime where an entire evolution run is one ``lax.scan`` dispatch:
   reports; surfaced by the ``deap-tpu-trace`` console entry.
 """
 
-from . import events, metrics, sinks, telemetry, tracing   # noqa: F401
+from . import events, fleettrace, metrics, sinks, telemetry, tracing   # noqa: F401
+from .fleettrace import (FleetTracer, TraceContext, SpanRecord,  # noqa: F401
+                         new_trace_id, new_span_id)
 from .metrics import (MetricBuffer, buffer_init, cross_host_sum,  # noqa: F401
                       psum_counters)
 from .sinks import (MetricRecord, Sink, InMemorySink, JsonlSink,  # noqa: F401
@@ -32,6 +34,8 @@ from .tracing import (Span, span, PhaseTimes, aot_phase_times,  # noqa: F401
                       capture_trace, device_memory_report)
 
 __all__ = [
+    "FleetTracer", "TraceContext", "SpanRecord", "new_trace_id",
+    "new_span_id",
     "MetricBuffer", "buffer_init", "cross_host_sum", "psum_counters",
     "MetricRecord", "Sink", "InMemorySink", "JsonlSink", "LogbookSink",
     "StdoutSink", "TensorBoardSink", "emit_record", "emit_text",

@@ -36,7 +36,8 @@ from .dispatcher import (BatchDispatcher, Request, ServeFuture,  # noqa: F401
                          DeadlineExceeded, RequestCancelled,
                          ServiceDraining, SessionUnknown)
 from .metrics import (ServeMetrics, SERVE_COUNTERS, SERVE_GAUGES,  # noqa: F401
-                      NET_COUNTERS)
+                      NET_COUNTERS, TENANT_COUNTERS, prometheus_text)
+from .rebucket import RebucketPolicy, pad_waste_of  # noqa: F401
 from .service import EvolutionService, Session  # noqa: F401
 
 __all__ = [
@@ -49,4 +50,6 @@ __all__ = [
     "ServeError", "ServiceClosed", "ServiceOverloaded", "DeadlineExceeded",
     "RequestCancelled", "ServiceDraining", "SessionUnknown",
     "ServeMetrics", "SERVE_COUNTERS", "SERVE_GAUGES", "NET_COUNTERS",
+    "TENANT_COUNTERS", "prometheus_text",
+    "RebucketPolicy", "pad_waste_of",
 ]

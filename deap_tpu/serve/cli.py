@@ -66,15 +66,33 @@ def _open_fleet(svc, tb, sessions, pops, dims, seed):
     return fleet
 
 
-def _stat_line(rec) -> str:
+def _per_kind_quantiles(gauges) -> dict:
+    """``{kind: (p50_ms, p99_ms)}`` parsed back out of the
+    ``latency_<kind>_p*_ms`` gauges ServeMetrics already reports (the
+    pooled ``latency_p*_ms`` keys are excluded)."""
+    kinds = {}
+    for key in gauges:
+        if key.startswith("latency_") and key.endswith("_p50_ms"):
+            kind = key[len("latency_"):-len("_p50_ms")]
+            if kind:
+                kinds[kind] = (gauges[key],
+                               gauges.get(f"latency_{kind}_p99_ms", 0.0))
+    return kinds
+
+
+def _stat_line(rec, per_kind: bool = False) -> str:
     c, g = rec.counters, rec.gauges
-    return ("[serve] "
+    line = ("[serve] "
             f"batches={rec.gen} queue={g['queue_depth']:.0f} "
             f"slot_occ={g['slot_occupancy']:.2f} "
             f"compiles={c['compiles']} steps={c['steps']} "
             f"cache_hit={c['cache_hits']}/{c['cache_hits'] + c['cache_misses']} "
             f"p50={g.get('latency_p50_ms', 0.0):.1f}ms "
             f"p99={g.get('latency_p99_ms', 0.0):.1f}ms")
+    if per_kind:
+        for kind, (p50, p99) in sorted(_per_kind_quantiles(g).items()):
+            line += f" {kind}[p50={p50:.1f}ms p99={p99:.1f}ms]"
+    return line
 
 
 def _run_listen(args) -> int:
@@ -184,6 +202,10 @@ def main(argv=None) -> int:
     ap.add_argument("--max-batch", type=int, default=4)
     ap.add_argument("--stats-every", type=int, default=10,
                     help="emit a live stats line every N dispatched batches")
+    ap.add_argument("--per-kind", action="store_true",
+                    help="append per-request-kind latency quantiles "
+                         "(step/ask/tell/evaluate) to every stats line "
+                         "instead of only the pooled p50/p99")
     ap.add_argument("--compile-cache", metavar="DIR", default=None,
                     help="persist XLA compilations under DIR "
                          "(deap_tpu.utils.compilecache)")
@@ -235,7 +257,7 @@ def main(argv=None) -> int:
                     del outstanding[name]
             rec = svc.stats()
             if args.stats_every and rec.gen - last_line >= args.stats_every:
-                sink.write_text(_stat_line(rec))
+                sink.write_text(_stat_line(rec, per_kind=args.per_kind))
                 last_line = rec.gen
             if outstanding:
                 next(iter(outstanding.values()))[0].exception(timeout=60)

@@ -167,7 +167,10 @@ class Request:
     """One unit of queued work.  ``program_key`` is the batching identity
     (same compiled program + bucket); ``weight``/``capacity`` implement
     slot- or row-packing; ``session`` scopes the per-session FIFO rule
-    (``None`` → unconstrained)."""
+    (``None`` → unconstrained); ``trace`` is this request's
+    :class:`~deap_tpu.observability.fleettrace.TraceContext` (``None``
+    when tracing is off) — the span every phase the request crosses
+    hangs its child spans off."""
 
     kind: str
     program_key: tuple
@@ -179,6 +182,12 @@ class Request:
     future: ServeFuture = dataclasses.field(default_factory=ServeFuture)
     submitted: float = 0.0
     seq: int = dataclasses.field(default_factory=lambda: next(_req_ids))
+    trace: Any = None
+
+    @property
+    def tenant(self) -> Optional[str]:
+        """Session name for per-tenant metric attribution."""
+        return getattr(self.session, "name", None)
 
 
 class BatchDispatcher:
@@ -205,11 +214,22 @@ class BatchDispatcher:
                  metrics=None, retries: int = 2, backoff: float = 0.05,
                  retry_on: tuple = (OSError, TimeoutError, ConnectionError),
                  clock: Callable[[], float] = time.monotonic,
-                 on_retry: Optional[Callable] = None):
+                 on_retry: Optional[Callable] = None,
+                 tracer=None, after_batch: Optional[Callable] = None):
         if max_pending < 1:
             raise ValueError("max_pending must be >= 1")
         self._execute_once = execute
         self._metrics = metrics
+        #: fleettrace.FleetTracer (or None): queue-wait phase spans and
+        #: the per-request "serve.<kind>" spans are recorded here
+        self._tracer = tracer
+        #: called on the worker thread after every dispatched batch,
+        #: OUTSIDE the queue lock and with the worker not busy — the
+        #: service hangs its auto-rebucket policy tick here (it may
+        #: pause/resume this dispatcher, which is re-entrant from this
+        #: position).  Exceptions are contained: a policy bug must not
+        #: kill the one thread that owns device dispatch.
+        self._after_batch = after_batch
 
         def _note_retry(attempt, exc, delay):
             if metrics is not None:
@@ -266,6 +286,7 @@ class BatchDispatcher:
                         timeout=timeout):
                     if self._metrics is not None:
                         self._metrics.inc("rejected")
+                        self._metrics.inc_tenant(request.tenant, "rejected")
                     raise ServiceOverloaded(
                         f"{len(self._pending)} requests pending "
                         f"(max_pending={self.max_pending})")
@@ -274,6 +295,7 @@ class BatchDispatcher:
             self._pending.append(request)
             if self._metrics is not None:
                 self._metrics.inc("requests")
+                self._metrics.inc_tenant(request.tenant, "requests")
                 self._metrics.set_gauge("queue_depth", len(self._pending))
             self._cv.notify_all()
         return request.future
@@ -369,6 +391,12 @@ class BatchDispatcher:
                 "before dispatch"))
             if self._metrics is not None:
                 self._metrics.inc("deadline_misses")
+                self._metrics.inc_tenant(req.tenant, "deadline_misses")
+            if self._tracer is not None and req.trace is not None:
+                self._tracer.record(
+                    f"serve.{req.kind}", req.trace, req.submitted,
+                    self._clock(), attrs={"error": "DeadlineExceeded",
+                                          "session": req.tenant})
             return True
         return False
 
@@ -441,17 +469,39 @@ class BatchDispatcher:
                     self._busy = False
                     self._batches += 1
                     self._cv.notify_all()
+            if self._after_batch is not None:
+                try:
+                    self._after_batch()
+                except Exception:  # noqa: BLE001 — the hook reports its
+                    pass           # own failures; the worker must survive
 
     def _dispatch(self, batch: List[Request]) -> None:
         live = [r for r in batch if r.future._start()]
         if not live:
             return
         kind, program_key = live[0].kind, live[0].program_key
+        tracer = self._tracer
+        start = self._clock()
+        if tracer is not None:
+            # queue-wait phase: submission to the moment this batch
+            # claimed the worker (explicit bounds — t0 happened long
+            # before the tracer saw the request)
+            for r in live:
+                if r.trace is not None:
+                    tracer.phase("queue_wait", r.trace, r.submitted, start,
+                                 attrs={"session": r.tenant})
         try:
             results = self._execute(kind, program_key, live)
         except (Exception, RetriesExhausted) as e:  # noqa: BLE001
+            now = self._clock()
             for r in live:
                 r.future._set_exception(e)
+                if self._metrics is not None:
+                    self._metrics.inc_tenant(r.tenant, "failed")
+                if tracer is not None and r.trace is not None:
+                    tracer.record(f"serve.{kind}", r.trace, r.submitted, now,
+                                  attrs={"error": type(e).__name__,
+                                         "session": r.tenant})
             if self._metrics is not None:
                 self._metrics.inc("failed", len(live))
             return
@@ -460,6 +510,10 @@ class BatchDispatcher:
             r.future._set_result(res)
             if self._metrics is not None:
                 self._metrics.observe_latency(kind, now - r.submitted)
+                self._metrics.inc_tenant(r.tenant, "completed")
+            if tracer is not None and r.trace is not None:
+                tracer.record(f"serve.{kind}", r.trace, r.submitted, now,
+                              attrs={"session": r.tenant})
         if self._metrics is not None:
             self._metrics.inc("completed", len(live))
             self._metrics.inc("batches")

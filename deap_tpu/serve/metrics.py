@@ -1,4 +1,5 @@
-"""Host-side service metrics: counters, gauges and latency quantiles.
+"""Host-side service metrics: counters, gauges, latency quantiles, and
+per-tenant attribution.
 
 The generation loops' :class:`~deap_tpu.observability.metrics.MetricBuffer`
 accumulates ON DEVICE because a whole run is one dispatch; the serving
@@ -9,7 +10,22 @@ already speaks — one stats pipeline, two producers.
 
 Latency is tracked as a bounded reservoir of recent per-request wall times
 per request kind; :meth:`ServeMetrics.latency_quantiles` reports p50/p90/p99
-over the window (steady-state service quantiles, not all-time)."""
+over the window (steady-state service quantiles, not all-time).  The
+reservoirs are **snapshotted under the lock and sorted outside it** — a
+metrics scrape sorting thousands of samples while holding the lock would
+stall the dispatch worker's ``observe_latency`` mid-batch (regression-
+pinned by ``tests/test_fleettrace.py``).
+
+Per-tenant attribution: :meth:`ServeMetrics.inc_tenant` maintains a
+second, session-name-keyed counter table (:data:`TENANT_COUNTERS` — the
+SLO set: deadline misses, backpressure rejects, cache hits/misses, ...)
+that rides in the snapshot's ``meta["tenants"]`` and becomes labelled
+series in the Prometheus exposition (:func:`prometheus_text`, served at
+``/v1/metrics?format=prometheus``).  Metric NAMES are static snake_case
+identifiers from the registries below; tenant identity lives in the
+table key / label, never in the metric name — the ``metric-discipline``
+lint pass enforces exactly this split.
+"""
 
 from __future__ import annotations
 
@@ -19,7 +35,8 @@ from typing import Dict, Iterable, Optional
 
 from ..observability.sinks import MetricRecord, emit_record
 
-__all__ = ["ServeMetrics", "SERVE_COUNTERS", "SERVE_GAUGES", "NET_COUNTERS"]
+__all__ = ["ServeMetrics", "SERVE_COUNTERS", "SERVE_GAUGES", "NET_COUNTERS",
+           "TENANT_COUNTERS", "prometheus_text"]
 
 #: Counters the service maintains (cumulative over the service lifetime).
 SERVE_COUNTERS = (
@@ -28,7 +45,7 @@ SERVE_COUNTERS = (
     "compiles_init", "compiles_ask", "compiles_tell", "compiles_evaluate",
     "steps", "steps_sharded", "evaluations", "cache_hits", "cache_misses",
     "cache_evictions", "cache_nan_skipped", "cache_purged", "dedup_rows",
-    "quarantined", "rebuckets",
+    "quarantined", "rebuckets", "rebuckets_auto", "rebucket_policy_errors",
 )
 
 #: Counters the network frontend (deap_tpu.serve.net) adds on top —
@@ -42,27 +59,57 @@ NET_COUNTERS = (
 #: Gauges (last-value).
 SERVE_GAUGES = (
     "queue_depth", "sessions", "sharded_sessions", "slot_occupancy",
-    "row_occupancy",
+    "row_occupancy", "pad_waste",
+)
+
+#: Per-tenant (per-session) counters — the SLO attribution set.  Tenant
+#: identity is the table key (and the Prometheus label), NEVER part of a
+#: metric name.
+TENANT_COUNTERS = (
+    "requests", "completed", "failed", "rejected", "deadline_misses",
+    "steps", "cache_hits", "cache_misses",
 )
 
 
 class ServeMetrics:
     """Thread-safe counter/gauge/latency store for one
-    :class:`~deap_tpu.serve.service.EvolutionService`."""
+    :class:`~deap_tpu.serve.service.EvolutionService`.
 
-    def __init__(self, latency_window: int = 2048):
+    ``max_tenants`` bounds the per-tenant table: when a fresh tenant
+    would exceed it, the oldest tenant's row is evicted (the table is a
+    live attribution view, not an accounting ledger — long-lived fleets
+    must not leak a row per dead session forever)."""
+
+    def __init__(self, latency_window: int = 2048, max_tenants: int = 4096):
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {
             k: 0 for k in SERVE_COUNTERS + NET_COUNTERS}
         self._gauges: Dict[str, float] = {k: 0.0 for k in SERVE_GAUGES}
         self._latency: Dict[str, collections.deque] = {}
         self._window = int(latency_window)
+        self._tenants: "collections.OrderedDict[str, Dict[str, int]]" = \
+            collections.OrderedDict()
+        self.max_tenants = int(max_tenants)
 
     # -- writers -------------------------------------------------------------
 
     def inc(self, name: str, value: int = 1) -> None:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + int(value)
+
+    def inc_tenant(self, tenant: Optional[str], name: str,
+                   value: int = 1) -> None:
+        """Count ``value`` under ``tenant``'s row (no-op for ``None`` —
+        requests without a session have no tenant to attribute to)."""
+        if tenant is None:
+            return
+        with self._lock:
+            row = self._tenants.get(tenant)
+            if row is None:
+                while len(self._tenants) >= self.max_tenants:
+                    self._tenants.popitem(last=False)
+                row = self._tenants[tenant] = {}
+            row[name] = row.get(name, 0) + int(value)
 
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
@@ -90,6 +137,11 @@ class ServeMetrics:
         with self._lock:
             return dict(self._gauges)
 
+    def tenant_counters(self) -> Dict[str, Dict[str, int]]:
+        """``{tenant: {counter: value}}`` snapshot."""
+        with self._lock:
+            return {t: dict(row) for t, row in self._tenants.items()}
+
     @staticmethod
     def _quantile(sorted_samples, q: float) -> float:
         if not sorted_samples:
@@ -101,10 +153,15 @@ class ServeMetrics:
     def latency_quantiles(self, kinds: Optional[Iterable[str]] = None
                           ) -> Dict[str, float]:
         """``{"latency_<kind>_p50_ms": ..., ...}`` over the recent window
-        (all kinds pooled under ``latency_p*`` as well)."""
+        (all kinds pooled under ``latency_p*`` as well).  The reservoirs
+        are copied under the lock; the O(n log n) sorts run OUTSIDE it so
+        a scrape never stalls ``observe_latency`` on the dispatch
+        worker."""
         with self._lock:
-            samples = {k: sorted(v) for k, v in self._latency.items()
+            samples = {k: list(v) for k, v in self._latency.items()
                        if (kinds is None or k in kinds) and v}
+        for v in samples.values():
+            v.sort()
         out: Dict[str, float] = {}
         pooled = sorted(s for v in samples.values() for s in v)
         for label, data in [("", pooled)] + [
@@ -116,11 +173,63 @@ class ServeMetrics:
 
     def snapshot(self, seq: int = 0) -> MetricRecord:
         """Everything as one :class:`MetricRecord` (``gen`` carries the
-        batch sequence number — the service's notion of time)."""
+        batch sequence number — the service's notion of time; per-tenant
+        counters ride in ``meta["tenants"]``)."""
         gauges = self.gauges()
         gauges.update(self.latency_quantiles())
+        meta: dict = {"source": "serve"}
+        tenants = self.tenant_counters()
+        if tenants:
+            meta["tenants"] = tenants
         return MetricRecord(gen=int(seq), counters=self.counters(),
-                            gauges=gauges, meta={"source": "serve"})
+                            gauges=gauges, meta=meta)
 
     def emit(self, sinks, seq: int = 0) -> None:
         emit_record(sinks, self.snapshot(seq))
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_PROM_PREFIX = "deap_tpu_serve"
+
+
+def _prom_label(value: str) -> str:
+    """Escape a label value per the Prometheus text format."""
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def prometheus_text(record: MetricRecord) -> str:
+    """Render a serve :class:`MetricRecord` in the Prometheus text
+    exposition format (version 0.0.4): counters as
+    ``deap_tpu_serve_<name>_total``, gauges (latency quantiles included)
+    as ``deap_tpu_serve_<name>``, and the per-tenant SLO counters as
+    ``deap_tpu_serve_tenant_<name>_total{tenant="..."}`` labelled
+    series."""
+    lines = []
+    # 0.0.4 text format: a TYPE line must name the SAMPLE's metric
+    # exactly, so the counter families carry their _total suffix in both
+    for name in sorted(record.counters):
+        metric = f"{_PROM_PREFIX}_{name}_total"
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {int(record.counters[name])}")
+    for name in sorted(record.gauges):
+        metric = f"{_PROM_PREFIX}_{name}"
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {float(record.gauges[name]):g}")
+    tenants = record.meta.get("tenants") or {}
+    by_counter: Dict[str, list] = {}
+    for tenant in sorted(tenants):
+        for cname, v in sorted(tenants[tenant].items()):
+            by_counter.setdefault(cname, []).append((tenant, v))
+    for cname in sorted(by_counter):
+        metric = f"{_PROM_PREFIX}_tenant_{cname}_total"
+        lines.append(f"# TYPE {metric} counter")
+        for tenant, v in by_counter[cname]:
+            lines.append(
+                f'{metric}{{tenant="{_prom_label(tenant)}"}} {int(v)}')
+    lines.append(f"# TYPE {_PROM_PREFIX}_batches_seq gauge")
+    lines.append(f"{_PROM_PREFIX}_batches_seq {int(record.gen)}")
+    return "\n".join(lines) + "\n"

@@ -24,13 +24,14 @@ service layer must not cost an HTTP stack, so ``from deap_tpu.serve.net
 import NetServer, RemoteService`` is the entry point.
 """
 
-from .protocol import (encode_frame, decode_frame, remote_exception,  # noqa: F401
+from .protocol import (encode_frame, decode_frame,  # noqa: F401
+                       decode_frame_with_trace, remote_exception,
                        status_of, CONTENT_TYPE, MAGIC)
 from .server import NetServer  # noqa: F401
 from .client import RemoteService, RemoteSession  # noqa: F401
 
 __all__ = [
     "NetServer", "RemoteService", "RemoteSession",
-    "encode_frame", "decode_frame", "remote_exception", "status_of",
-    "CONTENT_TYPE", "MAGIC",
+    "encode_frame", "decode_frame", "decode_frame_with_trace",
+    "remote_exception", "status_of", "CONTENT_TYPE", "MAGIC",
 ]

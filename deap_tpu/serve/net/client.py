@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 
 from ...base import Population, Fitness
+from ...observability.fleettrace import FleetTracer
 from ...observability.sinks import MetricRecord
 from ..dispatcher import ServeError, ServeFuture, ServiceClosed
 from . import protocol
@@ -155,8 +156,8 @@ class _SendFailed(Exception):
 
 
 def _request(conn: http.client.HTTPConnection, method: str, path: str,
-             obj: Any = None) -> Any:
-    body = None if obj is None else protocol.encode_frame(obj)
+             obj: Any = None, trace: Any = None) -> Any:
+    body = None if obj is None else protocol.encode_frame(obj, trace=trace)
     headers = {"Content-Type": protocol.CONTENT_TYPE}
     try:
         conn.request(method, path, body=body, headers=headers)
@@ -184,9 +185,16 @@ class RemoteService:
     instance (see module docstring).  ``address`` is ``"host:port"``,
     ``(host, port)`` or an ``http://`` URL."""
 
-    def __init__(self, address, *, timeout: float = 600.0):
+    def __init__(self, address, *, timeout: float = 600.0,
+                 tracer: Optional[FleetTracer] = None):
         self.host, self.port = _parse_address(address)
         self.timeout = float(timeout)
+        #: client-side span recorder: every ordered (session-mutating)
+        #: request mints a root TraceContext here that rides the DTF1
+        #: frame header, so the server's span tree links back to the
+        #: client hop.  Pass FleetTracer(enabled=False) to opt out.
+        self.tracer = tracer if tracer is not None else FleetTracer(
+            capacity=1024)
         self._worker = _Worker(self.host, self.port, self.timeout)
         self._closed = False
 
@@ -206,9 +214,20 @@ class RemoteService:
                      resolve: Callable[[Any, Optional[BaseException]], None]
                      ) -> None:
         """Queue one request on the ordered worker connection;
-        ``resolve(result, exc)`` runs on the worker thread."""
+        ``resolve(result, exc)`` runs on the worker thread.  With tracing
+        on, the request's root :class:`TraceContext` is minted HERE (at
+        submission) and reused verbatim across the worker's send-phase
+        reconnect retry — a retried request keeps its trace identity."""
+        ctx = self.tracer.context() if self.tracer.enabled else None
+
         def job(conn):
-            return _request(conn, method, path, obj)
+            t0 = self.tracer.clock() if ctx is not None else 0.0
+            out = _request(conn, method, path, obj,
+                           trace=None if ctx is None else ctx.wire())
+            if ctx is not None:
+                self.tracer.record(f"client.{method} {path}", ctx, t0,
+                                   self.tracer.clock())
+            return out
         self._worker.submit(job, resolve)
 
     def _ordered(self, method: str, path: str, obj: Any,
@@ -239,6 +258,17 @@ class RemoteService:
         rec = self._sync("GET", "/v1/metrics")
         return MetricRecord(gen=rec["gen"], counters=rec["counters"],
                             gauges=rec["gauges"], meta=rec.get("meta", {}))
+
+    def trace_tail(self, *, max_spans: int = 256,
+                   trace_id: Optional[str] = None) -> dict:
+        """``GET /v1/trace`` — the server's recent span window
+        (``{"enabled", "dropped", "spans": [...]}``), optionally filtered
+        to one ``trace_id`` (e.g. a span's id from this client's own
+        ``tracer.recent()``)."""
+        path = f"/v1/trace?max={int(max_spans)}"
+        if trace_id is not None:
+            path += f"&trace_id={quote(str(trace_id), safe='')}"
+        return self._sync("GET", path)
 
     def stream_metrics(self, *, max_records: int = 10,
                        timeout: float = 30.0) -> Iterator[MetricRecord]:

@@ -41,7 +41,8 @@ from ..dispatcher import (ServeError, ServiceClosed, ServiceOverloaded,
 from ..buckets import BucketOverflow
 
 __all__ = ["MAGIC", "CONTENT_TYPE", "encode_frame", "decode_frame",
-           "status_of", "error_payload", "remote_exception", "ERROR_STATUS"]
+           "decode_frame_with_trace", "status_of", "error_payload",
+           "remote_exception", "ERROR_STATUS"]
 
 MAGIC = b"DTF1"
 CONTENT_TYPE = "application/x-deap-frame"
@@ -117,14 +118,22 @@ def _dtype_of(token: str) -> np.dtype:
         raise ValueError(f"unknown wire dtype {token!r}")
 
 
-def encode_frame(obj: Any) -> bytes:
-    """Encode a JSON-plus-arrays object tree into one wire frame."""
+def encode_frame(obj: Any, trace: Any = None) -> bytes:
+    """Encode a JSON-plus-arrays object tree into one wire frame.
+
+    ``trace`` (optional) is a small JSON-safe dict — the
+    :meth:`~deap_tpu.observability.fleettrace.TraceContext.wire` form —
+    stored in the frame HEADER under ``"__trace__"``, beside the tensor
+    manifest: request tracing is header metadata, invisible to the body
+    the decoder hands back (a peer that ignores it decodes identically)."""
     tensors: List[np.ndarray] = []
     body = _pack(obj, tensors)
     header = {"body": body,
               "__tensors__": [{"dtype": _dtype_token(a.dtype),
                                "shape": list(a.shape)}
                               for a in tensors]}
+    if trace is not None:
+        header["__trace__"] = trace
     hdr = json.dumps(header, allow_nan=True).encode("utf-8")
     parts = [MAGIC, _HEAD.pack(len(hdr)), hdr]
     for a in tensors:
@@ -143,6 +152,14 @@ def encode_frame(obj: Any) -> bytes:
 def decode_frame(data: bytes) -> Any:
     """Decode :func:`encode_frame` output back into the object tree
     (arrays come back as numpy, bitwise equal to what was encoded)."""
+    return decode_frame_with_trace(data)[0]
+
+
+def decode_frame_with_trace(data: bytes):
+    """Like :func:`decode_frame`, additionally returning the frame
+    header's ``"__trace__"`` dict (``None`` when the sender attached no
+    trace context) — what the server handler adopts request spans
+    from."""
     if len(data) < 8 or data[:4] != MAGIC:
         raise ValueError("not a deap-tpu wire frame (bad magic)")
     (hlen,) = _HEAD.unpack_from(data, 4)
@@ -169,7 +186,9 @@ def decode_frame(data: bytes) -> Any:
         off += nbytes
     if off != len(data):
         raise ValueError(f"{len(data) - off} trailing bytes after tensors")
-    return _unpack(header["body"], tensors)
+    trace = header.get("__trace__")
+    return _unpack(header["body"], tensors), (
+        trace if isinstance(trace, dict) else None)
 
 
 # ---------------------------------------------------------------------------

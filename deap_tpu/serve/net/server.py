@@ -49,8 +49,10 @@ import numpy as np
 import jax.numpy as jnp
 
 from ...base import Population, Fitness
+from ...observability import fleettrace
 from ...observability.sinks import emit_text
 from ..dispatcher import SessionUnknown
+from ..metrics import prometheus_text
 from . import protocol
 
 __all__ = ["NetServer"]
@@ -316,16 +318,33 @@ class _Handler(BaseHTTPRequestHandler):
                       net.sinks)
 
     def _body(self) -> Any:
+        net = self.server_ctx
+        tracer = net.service.tracer if net is not None else None
+        t0 = tracer.clock() if tracer is not None else 0.0
         length = int(self.headers.get("Content-Length", 0) or 0)
         data = self.rfile.read(length) if length else b""
         self._body_consumed = True
-        if self.server_ctx is not None:
-            self.server_ctx.service.metrics.inc("net_bytes_in", len(data))
+        if net is not None:
+            net.service.metrics.inc("net_bytes_in", len(data))
         if not data:
             return {}
         if data[:4] == protocol.MAGIC:
-            return protocol.decode_frame(data)
-        return json.loads(data.decode("utf-8"))
+            obj, trace_in = protocol.decode_frame_with_trace(data)
+        else:
+            obj, trace_in = json.loads(data.decode("utf-8")), None
+        if tracer is not None and trace_in is not None:
+            # adopt the sender's context: this request's server-side span
+            # (a child of the client hop), the wire-decode phase under
+            # it, and the thread-local handoff service._submit picks its
+            # per-request children from
+            ctx = tracer.adopt(trace_in)
+            if ctx is not None:
+                self._trace_ctx = ctx
+                self._trace_t0 = t0
+                tracer.phase("wire_decode", ctx, t0, tracer.clock(),
+                             attrs={"bytes": len(data)})
+                fleettrace.set_current(ctx)
+        return obj
 
     def _drain_body(self) -> None:
         """Consume an unread request body before replying on an error
@@ -349,23 +368,42 @@ class _Handler(BaseHTTPRequestHandler):
         self.server_ctx.service.metrics.inc("net_bytes_out", len(payload))
 
     def _send_obj(self, obj: Any, status: int = 200) -> None:
-        self._send(protocol.encode_frame(obj), status=status)
+        tracer = self.server_ctx.service.tracer
+        ctx = getattr(self, "_trace_ctx", None)
+        if ctx is not None and tracer.enabled:
+            t0 = tracer.clock()
+            payload = protocol.encode_frame(obj)
+            self._send(payload, status=status)
+            tracer.phase("response_encode", ctx, t0, tracer.clock(),
+                         attrs={"bytes": len(payload)})
+        else:
+            self._send(protocol.encode_frame(obj), status=status)
 
     def _send_json(self, obj: Any, status: int = 200) -> None:
         self._send(json.dumps(obj).encode("utf-8"), status=status,
                    content_type="application/json")
 
     def _send_error_obj(self, exc: BaseException) -> None:
-        self.server_ctx.service.metrics.inc("net_errors")
+        net = self.server_ctx
+        net.service.metrics.inc("net_errors")
         self._drain_body()
-        self._send(protocol.error_payload(exc),
-                   status=protocol.status_of(exc),
+        status = protocol.status_of(exc)
+        self._send(protocol.error_payload(exc), status=status,
                    content_type="application/json")
+        if status == 500:
+            # 500 = an UNMAPPED exception — a service bug, not a protocol
+            # outcome (draining/deadline envelopes stay quiet) — dump the
+            # flight recorder for the postmortem (rate-limited inside
+            # dump(), so an error storm costs one dump per window)
+            net.service.tracer.dump(f"error:{type(exc).__name__}",
+                                    net.sinks)
 
     def _route(self, method: str) -> None:
         net = self.server_ctx
         net.service.metrics.inc("net_requests")
         self._body_consumed = False
+        self._trace_ctx = None
+        self._trace_t0 = 0.0
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
         try:
@@ -379,6 +417,8 @@ class _Handler(BaseHTTPRequestHandler):
                     {"toolboxes": sorted(net.toolboxes)})
             if method == "GET" and rest == ["metrics"]:
                 return self._metrics(parse_qs(url.query))
+            if method == "GET" and rest == ["trace"]:
+                return self._trace_tail(parse_qs(url.query))
             if rest[:1] == ["sessions"]:
                 if method == "POST" and len(rest) == 1:
                     return self._send_obj(net.h_create(self._body()))
@@ -411,12 +451,37 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_error_obj(e)
             except BrokenPipeError:
                 pass
+        finally:
+            # close the request span and clear the thread-local handoff —
+            # this handler thread serves many keep-alive requests, and a
+            # stale context would misparent the NEXT request's spans
+            ctx = getattr(self, "_trace_ctx", None)
+            if ctx is not None:
+                fleettrace.set_current(None)
+                tracer = net.service.tracer
+                tracer.record(f"http.{method} {url.path}", ctx,
+                              self._trace_t0, tracer.clock())
 
     # -- metrics stream ------------------------------------------------------
+
+    def _trace_tail(self, query: Dict[str, list]) -> None:
+        """``GET /v1/trace`` — tail the service's span ring (the live
+        window of the flight recorder): optional ``max`` span count and
+        ``trace_id`` filter.  Plain JSON, curl-able beside /v1/metrics."""
+        tracer = self.server_ctx.service.tracer
+        n = int(query.get("max", ["256"])[0])
+        trace_id = query.get("trace_id", [None])[0]
+        self._send_json({"enabled": bool(tracer.enabled),
+                         "dropped": tracer.dropped,
+                         "spans": tracer.recent(n, trace_id=trace_id)})
 
     def _metrics(self, query: Dict[str, list]) -> None:
         net = self.server_ctx
         svc = net.service
+        if query.get("format", [""])[0] == "prometheus":
+            return self._send(
+                prometheus_text(svc.stats()).encode("utf-8"),
+                content_type="text/plain; version=0.0.4; charset=utf-8")
         if query.get("stream", ["0"])[0] not in ("1", "true"):
             return self._send_json(json.loads(svc.stats().to_json()))
         svc.metrics.inc("net_streams")

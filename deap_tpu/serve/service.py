@@ -56,6 +56,8 @@ from jax.sharding import NamedSharding, PartitionSpec as _P
 from ..base import Population, Fitness
 from ..algorithms import ea_step, ea_ask, ea_tell, _norm_eval
 from ..observability import events as _events
+from ..observability import fleettrace
+from ..observability.fleettrace import FleetTracer
 from ..observability.sinks import emit_text
 from .buckets import (BucketPolicy, BucketKey, ShapeHistogram, pad_rows,
                       unpad_rows, pad_population, genome_signature)
@@ -107,6 +109,7 @@ class Session:
         self.name = name
         self.toolbox = toolbox
         self.bucket = bucket
+        self._pop_n: Optional[int] = None   # cached live count (immutable)
         self._state = state          # swapped atomically by the dispatcher
         self._pending = pending      # offspring awaiting tell (phase=asked)
         self.gen = int(gen)
@@ -138,7 +141,11 @@ class Session:
 
     @property
     def pop_size(self) -> int:
-        return int(np.asarray(self._state["live_n"]))
+        # a session's live count never changes; cache the host read so
+        # per-batch policy ticks don't sync a device scalar per session
+        if self._pop_n is None:
+            self._pop_n = int(np.asarray(self._state["live_n"]))
+        return self._pop_n
 
     @property
     def weights(self) -> tuple:
@@ -254,6 +261,18 @@ class EvolutionService:
         Observability: emit a stats :class:`MetricRecord` to ``sinks``
         every N batches (0 = never); compile events also go to the
         in-trace event tap when one is open.
+    tracer:
+        :class:`~deap_tpu.observability.fleettrace.FleetTracer` recording
+        the request span trees (queue wait / pad-bucket / cache lookup /
+        device execute phases).  Default: a fresh enabled tracer on the
+        service clock; pass ``FleetTracer(enabled=False)`` to opt out —
+        the compiled programs and trajectories are identical either way
+        (tracing is pure host bookkeeping, pinned by test).
+    rebucket_policy:
+        Optional :class:`~deap_tpu.serve.rebucket.RebucketPolicy` —
+        evaluated after every dispatched batch; fires
+        :meth:`rebucket` automatically on histogram drift + pad waste
+        (see :meth:`set_rebucket_policy`).
     fault_hook:
         Test seam: called as ``fault_hook(kind, requests)`` before every
         batch execution (raise to inject an evaluation fault).
@@ -278,6 +297,7 @@ class EvolutionService:
                  retry_backoff: float = 0.05, sinks: Sequence = (),
                  stats_every: int = 0, verbose: bool = False,
                  shard_threshold: Optional[int] = None, mesh=None,
+                 tracer: Optional[FleetTracer] = None, rebucket_policy=None,
                  fault_hook=None, clock=time.monotonic):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -293,6 +313,9 @@ class EvolutionService:
         self.metrics = ServeMetrics()
         self.cache = FitnessCache(cache_capacity, metrics=self.metrics)
         self.shapes = ShapeHistogram()
+        self.tracer = (tracer if tracer is not None
+                       else FleetTracer(clock=clock))
+        self._rebucket_policy = None
         self._fault_hook = fault_hook
         self._clock = clock
         self._programs: Dict[tuple, Any] = {}
@@ -313,7 +336,10 @@ class EvolutionService:
         self._dispatcher = BatchDispatcher(
             self._execute, max_pending=max_pending,
             batch_window=batch_window, metrics=self.metrics,
-            retries=eval_retries, backoff=retry_backoff, clock=clock)
+            retries=eval_retries, backoff=retry_backoff, clock=clock,
+            tracer=self.tracer, after_batch=self._after_batch)
+        if rebucket_policy is not None:
+            self.set_rebucket_policy(rebucket_policy)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -341,12 +367,46 @@ class EvolutionService:
     def stats(self):
         """Current :class:`~deap_tpu.observability.sinks.MetricRecord` —
         counters (requests/compiles/cache/...) + gauges (queue depth,
-        occupancy, latency p50/p90/p99)."""
+        occupancy, pad waste, latency p50/p90/p99); per-tenant SLO
+        counters ride in ``meta["tenants"]``."""
+        from .rebucket import pad_waste_of
         self.metrics.set_gauge("sessions", len(self._sessions))
         self.metrics.set_gauge(
             "sharded_sessions",
             sum(1 for s in self.sessions().values() if s.sharded))
+        self.metrics.set_gauge("pad_waste", pad_waste_of(self))
         return self.metrics.snapshot(self._dispatcher.batches)
+
+    def set_rebucket_policy(self, policy) -> None:
+        """Install (or, with ``None``, remove) the auto-rebucket policy.
+        The policy's drift baseline anchors to the current shape
+        histogram; from then on :meth:`RebucketPolicy.tick` runs on the
+        dispatch worker after every batch and may fire
+        :meth:`rebucket` at that quiesce point."""
+        if policy is not None:
+            policy.observe_baseline(self)
+        self._rebucket_policy = policy
+
+    def _after_batch(self) -> None:
+        """Dispatcher worker hook (post-batch, not busy, no locks held):
+        evaluate the auto-rebucket policy.  Policy failures are counted
+        and reported, never propagated — the dispatch worker must
+        survive a control-loop bug."""
+        policy = self._rebucket_policy
+        if policy is None:
+            return
+        try:
+            info = policy.tick(self)
+        except Exception as e:  # noqa: BLE001 — contained by design
+            self.metrics.inc("rebucket_policy_errors")
+            if self.verbose:
+                emit_text(f"[serve] rebucket policy error: {e!r}",
+                          self.sinks)
+            return
+        if info is not None and self.verbose:
+            emit_text(f"[serve] auto-rebucket fired: sizes={info['sizes']} "
+                      f"moved={info['moved']} compiles={info['compiles']}",
+                      self.sinks)
 
     @property
     def draining(self) -> bool:
@@ -390,6 +450,9 @@ class EvolutionService:
             sessions = list(self._sessions.values())
         for s in sessions:
             s.closed = True
+        # postmortem flight record: the last spans before this instance
+        # went away, through the ordinary sink stack (no sinks, no write)
+        self.tracer.dump("drain", self.sinks, force=True)
         return snaps
 
     def mesh(self):
@@ -610,6 +673,15 @@ class EvolutionService:
     def _deadline_at(self, deadline: Optional[float]) -> Optional[float]:
         return None if deadline is None else self._clock() + float(deadline)
 
+    def _trace_ctx(self):
+        """Per-request trace context: a child of the thread's current
+        context (the HTTP handler installs the adopted wire context
+        there) or a fresh root for in-process callers; ``None`` with
+        tracing off."""
+        if not self.tracer.enabled:
+            return None
+        return self.tracer.context(fleettrace.current())
+
     def _submit(self, session: Session, kind: str, payload: dict,
                 deadline: Optional[float] = None, block: bool = False,
                 on_failure=None) -> ServeFuture:
@@ -629,7 +701,8 @@ class EvolutionService:
         req = Request(kind=kind, program_key=program_key,
                       payload=payload, session=session, weight=1,
                       capacity=capacity,
-                      deadline=self._deadline_at(deadline))
+                      deadline=self._deadline_at(deadline),
+                      trace=self._trace_ctx())
         if on_failure is not None:
             req.future._on_failure = on_failure
         return self._dispatcher.submit(req, block=block)
@@ -656,7 +729,8 @@ class EvolutionService:
                       program_key=(id(evaluate), sig, rows, nobj),
                       payload={"genome": genomes, "n": n},
                       session=session, weight=n, capacity=rows,
-                      deadline=self._deadline_at(deadline))
+                      deadline=self._deadline_at(deadline),
+                      trace=self._trace_ctx())
         return self._dispatcher.submit(req)
 
     # -- compiled-program cache ----------------------------------------------
@@ -808,6 +882,7 @@ class EvolutionService:
         weights = s.bucket.weights
         build = lambda: self._build_slot_program(  # noqa: E731
             kind, toolbox, weights, vmapped=False)
+        t_pad0 = self._clock()
         state = self._place_sharded(s._state, rows)
         if kind == "tell":
             if s._pending is None:
@@ -820,7 +895,9 @@ class EvolutionService:
                     self._place_sharded(vals, rows))
         else:
             args = (state,)
+        t_pad1 = self._clock()
         compiled = self._program(kind, program_key, build, args)
+        t_dev0 = self._clock()
         out = compiled(*args)
 
         if kind == "ask":
@@ -835,18 +912,26 @@ class EvolutionService:
                 s.gen += 1
                 self.metrics.inc("steps")
                 self.metrics.inc("steps_sharded")
+                self.metrics.inc_tenant(s.name, "steps")
             elif kind == "tell":
                 with s._phase_lock:
                     s._pending = None
                     s.phase = "idle"
                 s.gen += 1
             results = [{"gen": s.gen, "nevals": int(np.asarray(nevals))}]
+        if req.trace is not None and self.tracer.enabled:
+            t_dev1 = self._clock()
+            self.tracer.phase("pad_bucket", req.trace, t_pad0, t_pad1,
+                              attrs={"rows": rows, "sharded": True})
+            self.tracer.phase("device_execute", req.trace, t_dev0, t_dev1,
+                              attrs={"kind": kind})
         self._maybe_emit_stats()
         return results
 
     def _exec_slots(self, kind: str, program_key: tuple,
                     requests: List[Request]) -> list:
         sessions = [r.session for r in requests]
+        t_pad0 = self._clock()
         tmpl = self._template_state(sessions[0])
         states = [s._state for s in sessions]
         states += [tmpl] * (self.max_batch - len(states))
@@ -872,8 +957,10 @@ class EvolutionService:
             args = (stacked, _stack(pend), jnp.stack(vals))
         else:
             args = (stacked,)
+        t_pad1 = self._clock()
 
         compiled = self._program(kind, program_key, build, args)
+        t_dev0 = self._clock()
         out = compiled(*args)
 
         self.metrics.set_gauge("slot_occupancy",
@@ -894,12 +981,25 @@ class EvolutionService:
                 if kind == "step":
                     s.gen += 1
                     self.metrics.inc("steps")
+                    self.metrics.inc_tenant(s.name, "steps")
                 elif kind == "tell":
                     with s._phase_lock:
                         s._pending = None
                         s.phase = "idle"
                     s.gen += 1
                 results.append({"gen": s.gen, "nevals": int(nevals[i])})
+        if self.tracer.enabled:
+            # the microbatch's phases are shared work: each traced
+            # request gets the same bounds under its own span
+            t_dev1 = self._clock()
+            for r in requests:
+                if r.trace is not None:
+                    self.tracer.phase(
+                        "pad_bucket", r.trace, t_pad0, t_pad1,
+                        attrs={"rows": sessions[0].bucket.rows,
+                               "slots": len(requests)})
+                    self.tracer.phase("device_execute", r.trace,
+                                      t_dev0, t_dev1, attrs={"kind": kind})
         self._maybe_emit_stats()
         return results
 
@@ -921,17 +1021,29 @@ class EvolutionService:
         genomes = [r.payload["genome"] for r in requests]
         counts = [r.payload["n"] for r in requests]
         total = sum(counts)
+        t_pad0 = self._clock()
         merged = jax.tree_util.tree_map(
             lambda *xs: jnp.concatenate(xs, axis=0), *genomes)
         padded = pad_rows(merged, rows)
+        t_pad1 = self._clock()
 
         flat = np.asarray(flatten_rows(merged))
         digests = row_digests(flat)
         namespace = (evaluate_id, sig, nobj)
         hits = self.cache.lookup(namespace, digests)
+        t_cache = self._clock()
         self.metrics.inc("dedup_rows", total - len(set(digests)))
         self.metrics.set_gauge("row_occupancy", total / rows)
+        # per-tenant cache attribution: each request owns a contiguous
+        # row range of the merged batch
+        off = 0
+        for r, n in zip(requests, counts):
+            k = sum(1 for h in hits[off:off + n] if h is not None)
+            self.metrics.inc_tenant(r.tenant, "cache_hits", k)
+            self.metrics.inc_tenant(r.tenant, "cache_misses", n - k)
+            off += n
 
+        t_dev0 = t_dev1 = None
         if all(h is not None for h in hits):
             values = np.stack(hits).astype(np.float32)
         else:
@@ -940,6 +1052,7 @@ class EvolutionService:
                 evaluate, flat_dim)
             compiled = self._program("evaluate", program_key, build,
                                      (padded,))
+            t_dev0 = self._clock()
             # np.array (not asarray): device outputs view as read-only, and
             # cached rows are spliced over this buffer below
             values = np.array(compiled(padded))[:total]
@@ -951,7 +1064,20 @@ class EvolutionService:
             for i, h in enumerate(hits):
                 if h is not None:
                     values[i] = h
+            t_dev1 = self._clock()
         self.metrics.inc("evaluations", total)
+        if self.tracer.enabled:
+            for r in requests:
+                if r.trace is None:
+                    continue
+                self.tracer.phase("pad_bucket", r.trace, t_pad0, t_pad1,
+                                  attrs={"rows": rows, "live": total})
+                self.tracer.phase("cache_lookup", r.trace, t_pad1, t_cache,
+                                  attrs={"rows": total})
+                if t_dev0 is not None:
+                    self.tracer.phase("device_execute", r.trace,
+                                      t_dev0, t_dev1,
+                                      attrs={"kind": "evaluate"})
 
         results, off = [], 0
         for n in counts:

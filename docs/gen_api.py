@@ -69,11 +69,12 @@ PAGES = {
                        "deap_tpu.observability.events",
                        "deap_tpu.observability.telemetry",
                        "deap_tpu.observability.sinks",
-                       "deap_tpu.observability.tracing"]),
+                       "deap_tpu.observability.tracing",
+                       "deap_tpu.observability.fleettrace"]),
     "serve": ("Serving layer (deap_tpu.serve)",
               ["deap_tpu.serve.service", "deap_tpu.serve.dispatcher",
                "deap_tpu.serve.buckets", "deap_tpu.serve.cache",
-               "deap_tpu.serve.metrics"]),
+               "deap_tpu.serve.metrics", "deap_tpu.serve.rebucket"]),
     "serve_net": ("Network frontend (deap_tpu.serve.net)",
                   ["deap_tpu.serve.net", "deap_tpu.serve.net.protocol",
                    "deap_tpu.serve.net.server",

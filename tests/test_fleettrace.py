@@ -1,0 +1,626 @@
+"""Fleet-wide request tracing, per-tenant attribution, and the
+telemetry-driven auto-rebucket policy (ISSUE 9).
+
+The load-bearing assertions:
+
+* **span-tree acceptance** — a loopback ``RemoteSession.step(n)`` yields
+  a complete server-side span tree (wire decode → per-generation queue
+  wait → pad/bucket → device execute → response encode) with monotonic,
+  non-overlapping phase bounds, parented back to the client's root span;
+* **zero-cost-off** — with tracing disabled the service compiles and
+  dispatches the identical program: compile counters and the bitwise
+  trajectory match a traced run on the same seeds;
+* **auto-rebucket drill** — under shifting shape traffic the
+  :class:`RebucketPolicy` fires ``rebucket()`` by itself at a quiesce
+  point, and steady-state traffic afterwards triggers ZERO unplanned
+  recompiles (compile-counter-pinned);
+* **satellites** — latency quantile sorts outside the metrics lock,
+  ``/v1/metrics?stream=1`` under concurrent session churn, and
+  trace-context fidelity across the client's reconnect retry.
+
+Shapes deliberately reuse test_serve/test_serve_net buckets so the
+session-wide persistent compile cache turns reference services into disk
+hits.
+"""
+
+import collections
+import http.client
+import json
+import socket
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deap_tpu import base
+from deap_tpu.observability import fleettrace
+from deap_tpu.observability.fleettrace import FleetTracer, TraceContext
+from deap_tpu.observability.sinks import InMemorySink
+from deap_tpu.ops import crossover, mutation, selection
+from deap_tpu.serve import (EvolutionService, RebucketPolicy, ServeMetrics,
+                            DeadlineExceeded, ServiceOverloaded,
+                            prometheus_text, pad_waste_of)
+from deap_tpu.serve.net import (NetServer, RemoteService, encode_frame,
+                                decode_frame, decode_frame_with_trace)
+
+pytestmark = [pytest.mark.serve]
+
+
+def onemax_toolbox():
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: (jnp.sum(g),))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    return tb
+
+
+def onemax_pop(key, n, nbits):
+    g = jax.random.bernoulli(key, 0.5, (n, nbits)).astype(jnp.float32)
+    return base.Population(genome=g, fitness=base.Fitness.empty(n, (1.0,)))
+
+
+def _final(session):
+    p = session.population()
+    return (np.asarray(p.genome), np.asarray(p.fitness.values),
+            np.asarray(p.fitness.valid))
+
+
+# ---------------------------------------------------------------------------
+# unit level: contexts, frame carriage
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_wire_roundtrip_and_frame_carriage():
+    """Contexts mint unique 128/64-bit ids, survive the wire form, ride
+    the DTF1 frame HEADER (invisible to the body), and malformed trace
+    headers degrade to None instead of failing the request."""
+    tracer = FleetTracer()
+    root = tracer.context()
+    assert len(root.trace_id) == 32 and len(root.span_id) == 16
+    assert root.parent_id is None
+    child = root.child()
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id and child.span_id != root.span_id
+    assert tracer.context().trace_id != root.trace_id   # fresh roots differ
+
+    back = TraceContext.from_wire(root.wire())
+    assert back.trace_id == root.trace_id
+    assert back.span_id == root.span_id
+    for junk in (None, 7, "x", {}, {"trace_id": 1, "span_id": "s"}):
+        assert TraceContext.from_wire(junk) is None
+
+    obj = {"a": np.arange(4, dtype=np.float32), "n": 2}
+    frame = encode_frame(obj, trace=root.wire())
+    body, trace = decode_frame_with_trace(frame)
+    np.testing.assert_array_equal(body["a"], obj["a"])
+    assert trace == root.wire()
+    # trace-less decode surface unchanged, trace invisible to the body
+    assert "__trace__" not in decode_frame(frame)
+    assert decode_frame_with_trace(encode_frame(obj))[1] is None
+
+    # adopt: the server-side context is a CHILD of the sender's span
+    adopted = tracer.adopt(root.wire())
+    assert adopted.trace_id == root.trace_id
+    assert adopted.parent_id == root.span_id
+    assert tracer.adopt({"trace_id": 3}) is None
+    tracer.enabled = False
+    assert tracer.adopt(root.wire()) is None
+
+
+def test_tracer_ring_bounds_and_thread_local_context():
+    """The flight-recorder ring is bounded (drop-oldest, counted), and
+    the thread-local current-context handoff restores correctly."""
+    tracer = FleetTracer(capacity=3)
+    ctx = tracer.context()
+    for i in range(5):
+        tracer.record(f"s{i}", ctx.child(), float(i), float(i + 1))
+    spans = tracer.recent()
+    assert [s["name"] for s in spans] == ["s2", "s3", "s4"]
+    assert tracer.dropped == 2
+    assert tracer.recent(1)[0]["name"] == "s4"
+    assert tracer.recent(0) == []          # not spans[-0:] == everything
+    assert tracer.recent(trace_id="nope") == []
+
+    assert fleettrace.current() is None
+    with fleettrace.use(ctx):
+        assert fleettrace.current() is ctx
+        with tracer.span("inner") as inner:
+            assert inner.parent_id == ctx.span_id
+    assert fleettrace.current() is None
+
+    tracer.enabled = False
+    assert tracer.record("x", ctx, 0.0, 1.0) is None
+    with tracer.span("off") as off_ctx:
+        assert off_ctx is None
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance: loopback step(n) span tree
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.net
+def test_loopback_step_produces_complete_span_tree():
+    """RemoteSession.step(2) over loopback HTTP yields, per generation, a
+    queue-wait → pad/bucket → device-execute chain with monotonic
+    non-overlapping bounds inside its request span; wire decode precedes
+    every phase, response encode follows every phase, and the whole tree
+    shares the trace id minted client-side (server request span parented
+    on the client hop)."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(7)
+    with EvolutionService(max_batch=2) as svc, \
+            NetServer(svc, {"onemax": tb}) as srv, \
+            RemoteService(srv.url, timeout=120) as cli:
+        rs = cli.open_session(key, onemax_pop(key, 20, 10), "onemax",
+                              cxpb=0.6, mutpb=0.3)
+        for f in rs.step(2):
+            f.result(timeout=120)
+
+        client_steps = [s for s in cli.tracer.recent()
+                        if s["name"].endswith("/step")]
+        assert len(client_steps) == 1
+        tid = client_steps[0]["trace_id"]
+        tail = cli.trace_tail(trace_id=tid)
+        assert tail["enabled"] is True
+        spans = tail["spans"]
+        assert spans and all(s["trace_id"] == tid for s in spans)
+
+        # request span: child of the client hop, covers everything
+        [http_span] = [s for s in spans if s["name"].startswith("http.")]
+        assert http_span["parent_id"] == client_steps[0]["span_id"]
+        [wire] = [s for s in spans if s["name"] == "wire_decode"]
+        [resp] = [s for s in spans if s["name"] == "response_encode"]
+        assert wire["parent_id"] == http_span["span_id"]
+        assert resp["parent_id"] == http_span["span_id"]
+
+        gens = [s for s in spans if s["name"] == "serve.step"]
+        assert len(gens) == 2
+        for g in gens:
+            assert g["parent_id"] == http_span["span_id"]
+            kids = {s["name"]: s for s in spans
+                    if s["parent_id"] == g["span_id"]}
+            assert set(kids) == {"queue_wait", "pad_bucket",
+                                 "device_execute"}
+            q, p, d = (kids["queue_wait"], kids["pad_bucket"],
+                       kids["device_execute"])
+            # monotonic, non-overlapping phase bounds inside the request
+            assert g["t0"] <= q["t0"] <= q["t1"] <= p["t0"] <= p["t1"] \
+                <= d["t0"] <= d["t1"] <= g["t1"]
+        # wire decode strictly precedes, response strictly follows
+        assert wire["t1"] <= min(g["t0"] for g in gens)
+        assert resp["t0"] >= max(g["t1"] for g in gens)
+        assert http_span["t0"] <= wire["t0"]
+        assert http_span["t1"] >= resp["t1"]
+
+
+def test_tracing_disabled_identical_program_and_trajectory():
+    """Tracing is host bookkeeping only: a traced service and a
+    tracing-disabled service compile the same number of programs and
+    produce bitwise-identical trajectories on the same seeds."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(7)
+    finals, compiles = [], []
+    for tracer in (None, FleetTracer(enabled=False)):
+        with EvolutionService(max_batch=2, tracer=tracer) as svc:
+            s = svc.open_session(key, onemax_pop(key, 20, 10), tb,
+                                 cxpb=0.6, mutpb=0.3)
+            for f in s.step(3):
+                f.result(timeout=60)
+            finals.append(_final(s))
+            compiles.append(svc.stats().counters["compiles"])
+    assert compiles[0] == compiles[1]
+    for g, w in zip(finals[0], finals[1]):
+        np.testing.assert_array_equal(g, w)
+
+
+# ---------------------------------------------------------------------------
+# per-tenant attribution + Prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+def test_per_tenant_slo_counters_and_prometheus():
+    """Deadline misses, backpressure rejects, steps and cache hit-rates
+    land on the RIGHT tenant's row, ride the snapshot's meta, and render
+    as labelled Prometheus series."""
+    tb = onemax_toolbox()
+    keys = jax.random.split(jax.random.PRNGKey(5), 2)
+    with EvolutionService(max_batch=2) as svc:
+        a = svc.open_session(keys[0], onemax_pop(keys[0], 20, 10), tb,
+                             name="tenant-a", evaluate_initial=False)
+        b = svc.open_session(keys[1], onemax_pop(keys[1], 20, 10), tb,
+                             name="tenant-b", evaluate_initial=False)
+        for f in a.step(2) + b.step(1):
+            f.result(timeout=60)
+
+        # deadline miss for a only: wedge the queue, let the deadline
+        # lapse before dispatch
+        svc._dispatcher.pause()
+        [missed] = a.step(1, deadline=0.0)
+        # backpressure reject for b only: shrink the queue bound with
+        # the dispatcher wedged (the expired request frees its slot via
+        # the corpse-prune; the live fills then hold the queue at the
+        # bound, so b's next submit sheds)
+        svc._dispatcher.max_pending = 2
+        fills = a.step(1) + b.step(1)
+        with pytest.raises(ServiceOverloaded):
+            b.step(1)
+        svc._dispatcher.max_pending = 256
+        svc._dispatcher.resume()
+        with pytest.raises(DeadlineExceeded):
+            missed.result(timeout=60)
+        for f in fills:
+            f.result(timeout=60)
+
+        # cache attribution: same rows evaluated twice -> second pass
+        # all hits, on tenant-a's row
+        genomes = np.ones((4, 10), np.float32)
+        a.evaluate(genomes).result(timeout=60)
+        a.evaluate(genomes).result(timeout=60)
+
+        tenants = svc.metrics.tenant_counters()
+        assert tenants["tenant-a"]["deadline_misses"] == 1
+        assert "deadline_misses" not in tenants["tenant-b"]
+        assert tenants["tenant-b"]["rejected"] == 1
+        assert "rejected" not in tenants["tenant-a"]
+        assert tenants["tenant-a"]["steps"] == 3
+        assert tenants["tenant-b"]["steps"] == 2
+        assert tenants["tenant-a"]["cache_hits"] >= 4
+        assert tenants["tenant-a"]["cache_misses"] >= 1
+
+        rec = svc.stats()
+        assert rec.meta["source"] == "serve"
+        assert rec.meta["tenants"]["tenant-a"]["steps"] == 3
+        prom = prometheus_text(rec)
+        # 0.0.4 format: the TYPE line names the sample's metric exactly
+        assert "# TYPE deap_tpu_serve_steps_total counter" in prom
+        assert "deap_tpu_serve_steps_total 5" in prom
+        assert "deap_tpu_serve_queue_depth " in prom
+        assert ('deap_tpu_serve_tenant_deadline_misses_total'
+                '{tenant="tenant-a"} 1') in prom
+        assert ('deap_tpu_serve_tenant_rejected_total'
+                '{tenant="tenant-b"} 1') in prom
+
+
+def test_tenant_table_bounded_and_label_escaping():
+    m = ServeMetrics(max_tenants=2)
+    for name in ("t0", "t1", "t2"):
+        m.inc_tenant(name, "requests")
+    assert set(m.tenant_counters()) == {"t1", "t2"}   # oldest evicted
+    m.inc_tenant(None, "requests")                    # no-tenant no-op
+    assert set(m.tenant_counters()) == {"t1", "t2"}
+    m2 = ServeMetrics()
+    m2.inc_tenant('we"ird\nname\\x', "steps", 3)
+    prom = prometheus_text(m2.snapshot())
+    assert '{tenant="we\\"ird\\nname\\\\x"} 3' in prom
+
+
+@pytest.mark.net
+def test_prometheus_endpoint_over_http():
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(9)
+    with EvolutionService(max_batch=2) as svc, \
+            NetServer(svc, {"onemax": tb}) as srv, \
+            RemoteService(srv.url, timeout=120) as cli:
+        rs = cli.open_session(key, onemax_pop(key, 20, 10), "onemax",
+                              cxpb=0.6, mutpb=0.3)
+        for f in rs.step(2):
+            f.result(timeout=120)
+        conn = http.client.HTTPConnection(cli.host, cli.port, timeout=30)
+        try:
+            conn.request("GET", "/v1/metrics?format=prometheus")
+            resp = conn.getresponse()
+            text = resp.read().decode("utf-8")
+        finally:
+            conn.close()
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        assert "deap_tpu_serve_steps_total 2" in text
+        assert 'deap_tpu_serve_tenant_steps_total{tenant=' in text
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_dumps_on_drain():
+    """drain() force-dumps the span ring through the service's sinks —
+    the postmortem artifact exists before the instance goes away."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(11)
+    sink = InMemorySink()
+    with EvolutionService(max_batch=2, sinks=[sink]) as svc:
+        s = svc.open_session(key, onemax_pop(key, 20, 10), tb,
+                             evaluate_initial=False)
+        for f in s.step(2):
+            f.result(timeout=60)
+        svc.drain(timeout=30.0)
+    dumps = [t for t in sink.texts if '"flight_recorder"' in t]
+    assert len(dumps) == 1
+    doc = json.loads(dumps[0])
+    assert doc["flight_recorder"] == "drain"
+    assert doc["nspans"] == len(doc["spans"]) > 0
+    assert any(s["name"] == "serve.step" for s in doc["spans"])
+
+
+def test_flight_recorder_dump_rate_limited():
+    clock = {"t": 0.0}
+    tracer = FleetTracer(clock=lambda: clock["t"], dump_min_interval_s=10.0)
+    sink = InMemorySink()
+    tracer.record("x", tracer.context(), 0.0, 1.0)
+    assert tracer.dump("err", [sink]) != []
+    assert tracer.dump("err", [sink]) == []        # inside the window
+    clock["t"] = 11.0
+    assert tracer.dump("err", [sink]) != []        # window elapsed
+    assert tracer.dump("err", [sink], force=True) != []   # force bypasses
+    assert len(sink.texts) == 3
+
+
+# ---------------------------------------------------------------------------
+# auto-rebucket drill: shifting shape traffic, zero unplanned recompiles
+# ---------------------------------------------------------------------------
+
+
+def test_rebucket_policy_drill_zero_unplanned_recompiles():
+    """Traffic the default pow2 grid wastes 30%+ padding on appears; the
+    policy (hysteresis 2, no cooldown) fires rebucket() on its own at a
+    post-batch quiesce point, refits to the observed sizes, and
+    steady-state stepping afterwards triggers ZERO further compiles; the
+    policy does not re-fire once drift is re-anchored and waste is
+    gone."""
+    tb = onemax_toolbox()
+    keys = jax.random.split(jax.random.PRNGKey(31), 2)
+    with EvolutionService(max_batch=4) as svc:
+        policy = RebucketPolicy(pad_waste_threshold=0.2,
+                                drift_threshold=0.5, hold=2,
+                                cooldown_s=0.0, max_buckets=2)
+        svc.set_rebucket_policy(policy)     # baseline: empty histogram
+        a = svc.open_session(keys[0], onemax_pop(keys[0], 40, 8), tb,
+                             name="a", evaluate_initial=False)
+        b = svc.open_session(keys[1], onemax_pop(keys[1], 48, 8), tb,
+                             name="b", evaluate_initial=False)
+        assert a.bucket.rows == 64 and b.bucket.rows == 64
+        assert pad_waste_of(svc) == pytest.approx(1 - 88 / 128)
+        for f in a.step(3) + b.step(3):
+            f.result(timeout=60)
+        c = svc.stats().counters
+        assert c["rebuckets"] == 1 and c["rebuckets_auto"] == 1
+        assert c["rebucket_policy_errors"] == 0
+        assert a.bucket.rows == 40 and b.bucket.rows == 48
+        assert svc.policy.sizes == (40, 48)
+        assert policy.last_fire_info["moved"] and \
+            sorted(policy.last_fire_info["moved"]) == ["a", "b"]
+
+        settled = c["compiles"]
+        for f in a.step(3) + b.step(3):
+            f.result(timeout=60)
+        c2 = svc.stats().counters
+        assert c2["compiles"] == settled, "unplanned recompile after " \
+            "auto-rebucket"
+        assert c2["rebuckets"] == 1                  # no re-fire
+        assert svc.stats().gauges["pad_waste"] == 0.0
+        for s in (a, b):
+            assert np.isfinite(np.asarray(
+                s.population().fitness.values)).all()
+
+
+def test_rebucket_policy_hysteresis_and_cooldown():
+    """Unit-level: one qualifying tick is noise (hold=2), the cooldown
+    suppresses back-to-back fires, and a no-op grid re-anchors instead
+    of firing."""
+    clock = {"t": 0.0}
+
+    class FakeShapes:
+        def __init__(self, counts):
+            self._c = counts
+
+        def counts(self):
+            return dict(self._c)
+
+        def derive_policy(self, **kw):
+            from deap_tpu.serve import BucketPolicy
+            return BucketPolicy(sizes=tuple(sorted(self._c)),
+                                grow_beyond=True)
+
+    class FakeSession:
+        def __init__(self, n, rows):
+            self.pop_size, self.sharded = n, False
+            self.bucket = type("B", (), {"rows": rows})()
+
+    class FakeService:
+        def __init__(self):
+            from deap_tpu.serve import BucketPolicy
+            self.shapes = FakeShapes({40: 5})
+            self.policy = BucketPolicy()           # pow2 grid
+            self.metrics = ServeMetrics()
+            self._sessions = {"s": FakeSession(40, 64)}
+            self.fired = 0
+
+        def sessions(self):
+            return dict(self._sessions)
+
+        def rebucket(self, **kw):
+            self.fired += 1
+            self.policy = self.shapes.derive_policy()
+            self._sessions["s"].bucket.rows = 40
+            return {"sizes": self.policy.sizes, "moved": ["s"],
+                    "compiles": 1, "old_sizes": ()}
+
+    svc = FakeService()
+    pol = RebucketPolicy(pad_waste_threshold=0.2, drift_threshold=0.5,
+                         hold=2, cooldown_s=30.0,
+                         clock=lambda: clock["t"])
+    assert pol.tick(svc) is None and svc.fired == 0     # hysteresis
+    assert pol.tick(svc) is not None and svc.fired == 1
+    assert svc.metrics.counter("rebuckets_auto") == 1
+    # after the fire: waste gone, drift re-anchored -> quiet
+    assert pol.tick(svc) is None and svc.fired == 1
+    # new drifted wasteful traffic inside the cooldown stays suppressed
+    svc.shapes = FakeShapes({100: 50})
+    svc._sessions["s"] = FakeSession(100, 160)
+    clock["t"] = 10.0
+    assert pol.tick(svc) is None and pol.tick(svc) is None
+    clock["t"] = 40.0                                   # cooldown over
+    assert pol.tick(svc) is None                        # hold rebuilds
+    assert pol.tick(svc) is not None and svc.fired == 2
+
+
+# ---------------------------------------------------------------------------
+# satellite: latency quantile sorts must run OUTSIDE the metrics lock
+# ---------------------------------------------------------------------------
+
+
+def test_latency_quantiles_sort_outside_lock():
+    """Regression for the scrape-stalls-dispatch contention bug: the
+    reservoir sort must not run while holding the metrics lock.  Floats
+    whose comparisons probe the lock prove it: with the old
+    sort-under-lock implementation every comparison would find the lock
+    held."""
+    m = ServeMetrics()
+
+    class LockProbe(float):
+        def __lt__(self, other):
+            assert m._lock.acquire(blocking=False), \
+                "reservoir sorted while holding the metrics lock"
+            m._lock.release()
+            return float.__lt__(self, other)
+
+    m._latency["step"] = collections.deque(
+        (LockProbe(x) for x in (0.5, 0.1, 0.9, 0.3, 0.7)), maxlen=16)
+    q = m.latency_quantiles()
+    assert q["latency_step_p50_ms"] == pytest.approx(500.0)
+    assert q["latency_p99_ms"] == pytest.approx(900.0)
+
+
+# ---------------------------------------------------------------------------
+# satellite: metrics stream under concurrent session churn
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.net
+def test_metrics_stream_survives_session_churn():
+    """/v1/metrics?stream=1 keeps yielding valid records while sessions
+    are created, stepped and closed mid-stream (the stats snapshot walks
+    the live session table concurrently)."""
+    tb = onemax_toolbox()
+    keys = jax.random.split(jax.random.PRNGKey(21), 4)
+    with EvolutionService(max_batch=2) as svc, \
+            NetServer(svc, {"onemax": tb}) as srv, \
+            RemoteService(srv.url, timeout=120) as cli:
+        records, errors = [], []
+
+        def tail():
+            try:
+                for rec in cli.stream_metrics(max_records=4, timeout=20):
+                    records.append(rec)
+            except Exception as e:  # noqa: BLE001 — the assertion target
+                errors.append(e)
+
+        t = threading.Thread(target=tail, daemon=True)
+        t.start()
+        # churn: create / step / close while the stream tails activity
+        for i, k in enumerate(keys):
+            s = cli.open_session(k, onemax_pop(k, 20, 10), "onemax",
+                                 cxpb=0.6, mutpb=0.3, name=f"churn-{i}",
+                                 evaluate_initial=False)
+            for f in s.step(2):
+                f.result(timeout=120)
+            s.close()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert not errors
+        assert records, "stream yielded nothing during live churn"
+        for rec in records:
+            assert rec.meta["source"] == "serve"
+            assert rec.counters["steps"] >= 0
+        assert svc.stats().counters["net_streams"] == 1
+
+
+# ---------------------------------------------------------------------------
+# satellite: trace context survives the client reconnect retry
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.net
+def test_trace_context_survives_client_reconnect():
+    """A send-phase transport failure makes the ordered worker retry on
+    a fresh connection (PR 7 semantics); the retried request must carry
+    the SAME trace context, so the server-side span tree still links to
+    the client hop that the caller observed."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(13)
+    # a port with nothing listening: connect must fail fast
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+
+    with EvolutionService(max_batch=2) as svc, \
+            NetServer(svc, {"onemax": tb}) as srv, \
+            RemoteService(srv.url, timeout=120) as cli:
+        rs = cli.open_session(key, onemax_pop(key, 20, 10), "onemax",
+                              cxpb=0.6, mutpb=0.3)
+        rs.step(1)[0].result(timeout=120)
+
+        worker = cli._worker
+        real_connection = worker._connection
+        state = {"failed": 0}
+
+        def flaky():
+            if state["failed"] == 0:
+                state["failed"] = 1
+                return http.client.HTTPConnection(
+                    "127.0.0.1", dead_port, timeout=2)
+            return real_connection()
+
+        worker._connection = flaky
+        try:
+            rs.step(1)[0].result(timeout=120)      # survives the retry
+        finally:
+            worker._connection = real_connection
+        assert state["failed"] == 1, "the flaky connection was never hit"
+        assert rs.gen == 2
+
+        steps = [s for s in cli.tracer.recent()
+                 if s["name"].endswith("/step")]
+        # one client span per SUCCESSFUL request — the failed send
+        # recorded nothing, the retry reused the same context
+        assert len(steps) == 2
+        retried = steps[-1]
+        tail = cli.trace_tail(trace_id=retried["trace_id"])
+        [http_span] = [s for s in tail["spans"]
+                       if s["name"].startswith("http.")]
+        assert http_span["parent_id"] == retried["span_id"]
+        assert any(s["name"] == "serve.step" for s in tail["spans"])
+
+
+# ---------------------------------------------------------------------------
+# satellite: deap-tpu-serve --per-kind stats line
+# ---------------------------------------------------------------------------
+
+
+def test_cli_stat_line_per_kind_quantiles():
+    """The CLI stats line keeps its pooled p50/p99 by default and, with
+    --per-kind, surfaces the per-request-kind quantiles ServeMetrics
+    already computes (previously computed and dropped)."""
+    from deap_tpu.serve.cli import _stat_line, _per_kind_quantiles
+    m = ServeMetrics()
+    for name in ("requests", "completed", "batches"):
+        m.inc(name)
+    m.observe_latency("step", 0.010)
+    m.observe_latency("step", 0.030)
+    m.observe_latency("evaluate", 0.200)
+    rec = m.snapshot(seq=3)
+    kinds = _per_kind_quantiles(rec.gauges)
+    assert set(kinds) == {"step", "evaluate"}
+    assert kinds["evaluate"][0] == pytest.approx(200.0)
+
+    pooled = _stat_line(rec)
+    assert "p50=" in pooled and "step[" not in pooled
+    per_kind = _stat_line(rec, per_kind=True)
+    assert "step[p50=" in per_kind and "evaluate[p50=200.0ms" in per_kind
+    assert "p99=" in per_kind

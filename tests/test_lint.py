@@ -531,8 +531,8 @@ def test_parse_error_is_reported_not_crashing(tmp_path):
 def test_rule_registry_and_defaults():
     names = {r.name for r in iter_rules()}
     assert {"no-bare-print", "no-blocking-sleep", "lock-discipline",
-            "trace-impurity", "rng-key-reuse", "tracer-leak",
-            "bench-json", "collective-budget"} <= names
+            "metric-discipline", "trace-impurity", "rng-key-reuse",
+            "tracer-leak", "bench-json", "collective-budget"} <= names
     assert get_rule("collective-budget").default is False, \
         "the HLO-lowering pass must stay opt-in (it needs jax)"
     with pytest.raises(KeyError):
@@ -565,3 +565,87 @@ def test_cli_select_unknown_rule_is_usage_error():
         capture_output=True, text=True, cwd=REPO)
     assert out.returncode == 2
     assert "unknown lint rule" in out.stderr
+
+
+# ---------------------------------------------------------------------------
+# metric-discipline (ISSUE 9 satellite)
+
+
+def test_metric_discipline_fires(tmp_path):
+    """Can-fail fixture: a non-snake_case constant, a registry typo, and
+    an unsanctioned dynamic f-string name must each be flagged; registry
+    names, sanctioned prefixes, inc_tenant's name position, dynamic Name
+    args (out of scope) and non-metrics receivers must not."""
+    _write(tmp_path, "deap_tpu/serve/metrics.py", """\
+        SERVE_COUNTERS = ("steps", "compiles")
+        NET_COUNTERS = ("net_requests",)
+        SERVE_GAUGES = ("queue_depth",)
+        TENANT_COUNTERS = ("steps",)
+        """)
+    _write(tmp_path, "deap_tpu/serve/mod.py", """\
+        class S:
+            def f(self, kind, name):
+                self.metrics.inc("BadName")
+                self._metrics.inc("step_typo")
+                self.metrics.inc(f"custom_{kind}")
+                self.metrics.set_gauge("queue_depth", 1.0)
+                self.metrics.inc(f"compiles_{kind}")
+                self.metrics.inc_tenant("tenant x", "steps")
+                self.metrics.inc(name)
+                other.inc("NotAMetric")
+        """)
+    r = _findings(tmp_path, "metric-discipline")
+    by_line = {f.line: f.message for f in r.findings}
+    assert len(r.findings) == 3, r.findings
+    assert "not snake_case" in by_line[3]
+    assert "not in the committed registry" in by_line[4]
+    assert "dynamic f-string metric name" in by_line[5]
+
+
+def test_metric_discipline_registry_pin(tmp_path):
+    """A whole-repo run over a real package whose metrics registry went
+    missing must fail loudly (the diff lost its reference list), while a
+    fixture repo without a package init just skips the registry check."""
+    _write(tmp_path, "deap_tpu/__init__.py", "")
+    _write(tmp_path, "deap_tpu/serve/mod.py",
+           'class S:\n    def f(self):\n        self.metrics.inc("x")\n')
+    r = _findings(tmp_path, "metric-discipline")
+    assert len(r.findings) == 1
+    assert "lost its committed name list" in r.findings[0].message
+
+    fixture = tmp_path / "fixture"
+    _write(fixture, "deap_tpu/serve/mod.py",
+           'class S:\n    def f(self):\n        self.metrics.inc("x")\n')
+    r = _findings(fixture, "metric-discipline")
+    assert r.findings == []        # no package init: no registry to lose
+
+
+def test_metric_discipline_repo_is_clean():
+    r = run_lint(repo=REPO, select=["metric-discipline"])
+    assert r.findings == [], render_text(r)
+
+
+# ---------------------------------------------------------------------------
+# bench-json: BENCH_TRACE.json schema (ISSUE 9 satellite)
+
+
+def test_bench_json_trace_schema(tmp_path):
+    """BENCH_TRACE.json gets the stricter tracing-overhead schema: both
+    latency legs with finite p50s are required, and a leg smuggled out
+    or a NaN overhead fails; the well-formed shape passes."""
+    good = ('{"metric": "serve_net_trace_overhead_pct", "value": 1.2, '
+            '"unit": "%", '
+            '"traced": {"roundtrip_p50_ms": 11.1}, '
+            '"untraced": {"roundtrip_p50_ms": 11.0}}')
+    (tmp_path / "BENCH_TRACE.json").write_text(good)
+    r = _findings(tmp_path, "bench-json")
+    assert r.findings == [], r.findings
+
+    (tmp_path / "BENCH_TRACE.json").write_text(
+        '{"metric": "m", "value": 1.0, "unit": "%", '
+        '"traced": {"roundtrip_p50_ms": "NaN"}}')
+    r = _findings(tmp_path, "bench-json")
+    msgs = " ".join(f.message for f in r.findings)
+    assert "'untraced' must be an object" in msgs
+    assert "roundtrip_p50_ms' must be a finite number" in msgs
+    assert "non-finite number must not be committed as a string" in msgs

@@ -20,10 +20,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def test_lint_gate():
     """THE static-analysis gate: every default pass (no-bare-print,
-    no-blocking-sleep, lock-discipline, trace-impurity, rng-key-reuse,
-    tracer-leak, bench-json) over the whole repo must be clean —
-    zero non-baselined findings — and fast (the framework parses each
-    file once and never imports jax; budget < 10s)."""
+    no-blocking-sleep, lock-discipline, metric-discipline,
+    trace-impurity, rng-key-reuse, tracer-leak, bench-json) over the
+    whole repo must be clean — zero non-baselined findings — and fast
+    (the framework parses each file once and never imports jax;
+    budget < 10s)."""
     t0 = time.monotonic()
     out = subprocess.run(
         [sys.executable, "-m", "deap_tpu.lint.cli", "--format", "json"],
@@ -33,8 +34,8 @@ def test_lint_gate():
     report = json.loads(out.stdout)
     assert report["summary"]["findings"] == 0
     assert {"no-bare-print", "no-blocking-sleep", "lock-discipline",
-            "trace-impurity", "rng-key-reuse", "tracer-leak",
-            "bench-json"} <= set(report["summary"]["rules_run"])
+            "metric-discipline", "trace-impurity", "rng-key-reuse",
+            "tracer-leak", "bench-json"} <= set(report["summary"]["rules_run"])
     assert "collective-budget" not in report["summary"]["rules_run"], \
         "the heavy lowering pass must not run in the default gate"
     assert wall < 10.0, f"lint gate took {wall:.1f}s (budget 10s)"

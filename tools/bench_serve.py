@@ -30,6 +30,13 @@ round-trip p50/p99, aggregate pipelined throughput, and the wire
 overhead vs an in-process pass run in the same invocation — plus the
 same bitwise cross-check (net results vs in-process results on the same
 seeds).
+
+``--net --trace`` measures the COST OF TRACING itself: the same loopback
+single-step round trips with the fleet tracers toggled on/off in
+interleaved blocks (so machine drift hits both legs equally), reporting
+the p50 delta as ``trace_overhead_pct`` — the committed
+``BENCH_TRACE.json`` artifact, schema-gated by the ``bench-json`` lint
+pass and accepted at <= 5%.
 """
 
 from __future__ import annotations
@@ -251,6 +258,74 @@ def run_net_bench(sessions: int, pops, dims, ngen: int, max_batch: int,
     }
 
 
+def run_trace_bench(sessions: int, pops, dims, max_batch: int, seed: int,
+                    probes: int = 40, rounds: int = 3) -> dict:
+    """Tracing-overhead benchmark: loopback single-step round trips with
+    the server+client FleetTracers enabled vs disabled, interleaved per
+    round so clock drift and cache warmth hit both legs equally.  The
+    committed metric is the p50 delta (percent)."""
+    from deap_tpu.serve import EvolutionService
+    from deap_tpu.serve.net import NetServer, RemoteService
+
+    tb = _toolbox()
+    specs = _fleet_specs(sessions, pops, dims, seed)
+    lat = {True: [], False: []}
+
+    with EvolutionService(max_batch=max_batch) as svc, \
+            NetServer(svc, {"bench": tb}) as srv, \
+            RemoteService(srv.url, timeout=600) as cli:
+        fleet = [cli.open_session(k, _population(k, n, d), "bench",
+                                  cxpb=0.7, mutpb=0.3)
+                 for k, n, d in specs]
+        for s in fleet:
+            s.step()[0].result(timeout=600)          # warmup / AOT
+        for r in range(rounds):
+            for enabled in (True, False) if r % 2 == 0 else (False, True):
+                svc.tracer.enabled = enabled
+                cli.tracer.enabled = enabled
+                for i in range(probes):
+                    t0 = time.perf_counter()
+                    fleet[i % len(fleet)].step(1)[0].result(timeout=600)
+                    lat[enabled].append(time.perf_counter() - t0)
+
+    def leg(samples):
+        ms = sorted(x * 1e3 for x in samples)
+
+        def pct(q):
+            if not ms:
+                return None      # --latency-probes 0 / --trace-rounds 0
+            return round(ms[min(len(ms) - 1,
+                                int(round(q * (len(ms) - 1))))], 3)
+        return {"roundtrip_p50_ms": pct(0.50),
+                "roundtrip_p90_ms": pct(0.90),
+                "roundtrip_p99_ms": pct(0.99),
+                "samples": len(ms)}
+
+    traced, untraced = leg(lat[True]), leg(lat[False])
+    if traced["roundtrip_p50_ms"] is None \
+            or untraced["roundtrip_p50_ms"] is None:
+        overhead = None
+    else:
+        overhead = round(
+            100.0 * (traced["roundtrip_p50_ms"]
+                     - untraced["roundtrip_p50_ms"])
+            / max(untraced["roundtrip_p50_ms"], 1e-9), 3)
+    return {
+        "metric": "serve_net_trace_overhead_pct",
+        "value": overhead,
+        "unit": "% p50 single-step round-trip delta, tracing on vs off "
+                "(loopback --net)",
+        "config": {"sessions": sessions, "pops": pops, "dims": dims,
+                   "max_batch": max_batch, "probes_per_block": probes,
+                   "rounds": rounds,
+                   "note": "blocks interleaved on/off per round; warmup "
+                           "step per session excluded"},
+        "traced": traced,
+        "untraced": untraced,
+        "trace_overhead_pct": overhead,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="bench_serve",
@@ -269,12 +344,26 @@ def main(argv=None) -> int:
     ap.add_argument("--latency-probes", type=int, default=40,
                     help="--net: sequential single-step round trips for "
                          "the latency percentiles")
+    ap.add_argument("--trace", action="store_true",
+                    help="with --net: measure the tracing overhead "
+                         "instead (p50 round-trip delta, FleetTracer "
+                         "on vs off in interleaved blocks) -- the "
+                         "BENCH_TRACE.json artifact")
+    ap.add_argument("--trace-rounds", type=int, default=3,
+                    help="--trace: interleaved on/off block pairs")
     ap.add_argument("--out", default=None,
                     help="also write the JSON report to this path")
     args = ap.parse_args(argv)
 
     import jax
-    if args.net:
+    if args.net and args.trace:
+        report = run_trace_bench(args.sessions,
+                                 [int(p) for p in args.pops.split(",")],
+                                 [int(d) for d in args.dims.split(",")],
+                                 args.max_batch, args.seed,
+                                 probes=args.latency_probes,
+                                 rounds=args.trace_rounds)
+    elif args.net:
         report = run_net_bench(args.sessions,
                                [int(p) for p in args.pops.split(",")],
                                [int(d) for d in args.dims.split(",")],
@@ -291,7 +380,7 @@ def main(argv=None) -> int:
     if args.out:
         Path(args.out).write_text(text + "\n")
     print(text)
-    return 0 if report["bitwise_identical"] else 1
+    return 0 if report.get("bitwise_identical", True) else 1
 
 
 if __name__ == "__main__":
