@@ -163,19 +163,37 @@ def run_tpu():
         pop = jax.tree_util.tree_map(
             lambda x: jax.device_put(x, sh) if x.ndim else x, pop)
 
+    def fresh_args():
+        """Per-dispatch copies of (key, pop): the whole-run scan donates
+        its inputs, so each execution consumes its argument buffers —
+        re-dispatching the originals would raise on deleted arrays.  The
+        copies happen OUTSIDE the timed region."""
+        return (jnp.copy(key),
+                jax.tree_util.tree_map(jnp.copy, pop))
+
     def timed(ngen):
         """Explicit AOT pipeline (jit -> lower -> compile -> execute) so
         the warmup doubles as a phase-split measurement — the
         trace/lower/compile/execute breakdown hand-rolled perf_counter
         around a jitted call cannot see.  The timed quantity is unchanged:
-        the SECOND execution of the compiled program, forced to host."""
+        the SECOND execution of the compiled program, forced to host.
+
+        The run is compiled with **explicit buffer donation** across the
+        generation scan (ROADMAP raw-speed item): (key, pop) are donated,
+        so XLA aliases the initial carry into the loop state instead of
+        holding both live — peak footprint drops by the population size
+        and the entry copy disappears (measured in BENCH_DONATION.json;
+        the donation contract is gated by deap_tpu.analysis's
+        donation-leak pass on the ``ga_generation_scan`` inventory
+        entry)."""
         from deap_tpu.observability.tracing import aot_phase_times
-        run = make_run(ngen)
+        run = jax.jit(make_run(ngen), donate_argnums=(0, 1))
         # warmup = the AOT pipeline itself (blocked on completion)
-        _, phases, compiled = aot_phase_times(run, key, pop,
+        _, phases, compiled = aot_phase_times(run, *fresh_args(),
                                               return_compiled=True)
+        k2, p2 = fresh_args()
         t0 = time.perf_counter()
-        _, best = compiled(key, pop)
+        _, best = compiled(k2, p2)
         best_host = np.asarray(best)      # device->host: forces completion
         return time.perf_counter() - t0, float(best_host[-1]), phases
 

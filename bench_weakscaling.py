@@ -51,7 +51,6 @@ BENCH_WEAK_REPEATS (default 3), BENCH_WEAK_MO_POP (default 8192).
 
 import json
 import os
-import re
 import sys
 import time
 
@@ -64,8 +63,19 @@ REPEATS = int(os.environ.get("BENCH_WEAK_REPEATS", 3))
 MO_POP = int(os.environ.get("BENCH_WEAK_MO_POP", 8192))
 DIM = 100
 
-COLLECTIVES = ("collective-permute", "all-gather", "all-reduce",
-               "all-to-all", "reduce-scatter")
+# The ONE counting rule for collective instruction definitions is
+# canonical in deap_tpu.analysis.hlo (the program-contract analyzer's
+# jax-free text layer) and RE-EXPORTED here so every historical import
+# site — this bench, the weak-scaling budget gate, the HLO-pin tests
+# (tests/test_parallel.py), the per-scope profiler
+# (tools/profile_nsga2_stages.py) — keeps working; independent
+# spellings of the rule WILL drift (the profiler's first draft anchored
+# on a `\S+` shape token that async ops' tuple shapes break).  An
+# opcode occurrence is the opcode name directly followed by its operand
+# list (sync ``name(`` or async ``name-start(``); operand references
+# ``%name.42`` and ``name-done(`` never produce either).
+from deap_tpu.analysis.hlo import (COLLECTIVES, collective_op_on_line,  # noqa: E402
+                                   collective_ops as _collective_ops)
 
 
 def _collective_counts(txt: str) -> dict:
@@ -73,36 +83,6 @@ def _collective_counts(txt: str) -> dict:
     every operand *reference* to a collective's result re-matches the
     name.  Kept so r05↔r06 rows stay comparable."""
     return {name: txt.count(name) for name in COLLECTIVES if txt.count(name)}
-
-
-# The ONE counting rule for collective instruction definitions, shared
-# by the budget gate, the HLO-pin tests (tests/test_parallel.py), and
-# the per-scope profiler (tools/profile_nsga2_stages.py) — three
-# independent spellings of this rule WILL drift (the profiler's first
-# draft anchored on a `\S+` shape token that async ops' tuple shapes
-# break).  An opcode occurrence is the opcode name directly followed by
-# its operand list (sync ``name(`` or async ``name-start(``); operand
-# references ``%name.42`` and ``name-done(`` never produce either).
-_COLLECTIVE_OP_RE = re.compile(
-    r"\b(" + "|".join(COLLECTIVES) + r")(?:-start)?\(")
-
-
-def collective_op_on_line(line: str) -> str | None:
-    """Base opcode of the collective instruction defined on this HLO
-    text line, or None (HLO prints one instruction per line)."""
-    m = _COLLECTIVE_OP_RE.search(line)
-    return m.group(1) if m else None
-
-
-def _collective_ops(txt: str) -> dict:
-    """HLO collective *instruction definitions* — the count the
-    collective budget gates."""
-    out = {}
-    for line in txt.splitlines():
-        name = collective_op_on_line(line)
-        if name:
-            out[name] = out.get(name, 0) + 1
-    return out
 
 
 def build(layout: str, n_dev: int, pop_per_dev: int = None,

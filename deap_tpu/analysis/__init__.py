@@ -1,0 +1,72 @@
+"""``deap_tpu.analysis`` — the program-contract analyzer: jaxpr/HLO-
+level checks over the repo's canonical compiled programs.
+
+The AST tier (:mod:`deap_tpu.lint`) polices source text and must stay
+jax-free; this package is its deliberate complement — the **heavy
+tier** that loads jax, lowers the named program inventory at small
+canonical shapes, and checks the contracts that only exist after
+lowering:
+
+* **donation-leak** — input buffers structurally aliasable to outputs
+  but not donated (the ROADMAP's "explicit buffer donation across the
+  generation scan"), plus declared donations that never lowered to an
+  alias;
+* **recompile-hazard** — weak-typed operands and values baked as
+  literals where operands belong (the silent-recompile class EvoJAX and
+  evosax both document: nothing fails, the service just compiles one
+  executable per distinct value);
+* **callback-in-sharded-program** — host-callback custom-calls inside
+  mesh-partitioned programs, the XLA sharding-propagation crash class
+  PR 2 re-discovered at runtime, caught here at lowering time;
+* **program-budget** — HLO collective instruction counts per inventory
+  entry gated against the committed ``tools/program_budget.json``
+  (generalizing the three hardcoded weak-scaling layouts of
+  ``tools/check_collective_budget.py`` to budgets keyed by program).
+
+Findings are ordinary :class:`deap_tpu.lint.core.Finding` records, so
+they flow through the existing reporters/suppression/baseline machinery
+— and ``deap-tpu-lint --select program-contract`` runs this analyzer in
+a subprocess, keeping the lint process itself jax-free.
+
+Like the parent package, the init is lazy (PEP 562): importing
+``deap_tpu.analysis.hlo`` (pure text analyzers — the canonical
+collective-counting rule lives there) never pulls in jax; the inventory
+and passes import it on first access.
+"""
+
+import importlib
+
+_LAZY = {
+    "hlo": ".hlo",
+    "inventory": ".inventory",
+    "passes": ".passes",
+    "cli": ".cli",
+}
+_PASSES_EXPORTS = ("run_analysis", "AnalysisResult", "PASS_NAMES",
+                   "compare_budget", "update_program_budget",
+                   "PROGRAM_BUDGET_PATH")
+_INVENTORY_EXPORTS = ("INVENTORY", "ProgramEntry", "entries", "get_entry",
+                      "lower_entry")
+
+__all__ = list(_LAZY) + list(_PASSES_EXPORTS) + list(_INVENTORY_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        module = importlib.import_module(_LAZY[name], __name__)
+        globals()[name] = module
+        return module
+    if name in _PASSES_EXPORTS:
+        value = getattr(importlib.import_module(".passes", __name__), name)
+        globals()[name] = value
+        return value
+    if name in _INVENTORY_EXPORTS:
+        value = getattr(importlib.import_module(".inventory", __name__),
+                        name)
+        globals()[name] = value
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -1,0 +1,126 @@
+"""``deap-tpu-analyze`` — console entry of the program-contract
+analyzer (the heavy, jax-loading tier of the repo's static analysis;
+the AST tier is ``deap-tpu-lint``).
+
+::
+
+    deap-tpu-analyze                      # whole inventory, every pass
+    deap-tpu-analyze ga_generation_scan   # restrict to named programs
+    deap-tpu-analyze --select donation-leak,program-budget
+    deap-tpu-analyze --format json        # machine output on stdout
+    deap-tpu-analyze --update-budget      # refresh tools/program_budget.json
+    deap-tpu-analyze --list               # inventory catalog
+
+Exit codes: 0 clean, 1 live findings, 2 usage/internal error.  The
+sharded entries need an 8-device mesh: this entry point sets
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` and forces the
+CPU platform **before** jax initializes, so it runs identically on a
+laptop and in CI (lowering structure — what every pass checks — does
+not depend on the platform executing it).
+
+This module is a sanctioned ``print`` site (its stdout is its
+interface, same contract as ``lint/cli.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _init_devices() -> None:
+    """8 virtual CPU devices, set up BEFORE jax initializes (same dance
+    as tools/check_collective_budget.py — the backend cannot be
+    re-configured once used)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="deap-tpu-analyze",
+        description="Program-contract analyzer: lower the canonical "
+                    "compiled-program inventory and check donation, "
+                    "recompile hazards, callback/sharding safety, and "
+                    "per-program collective budgets.")
+    ap.add_argument("programs", nargs="*",
+                    help="inventory entries to analyze (default: all)")
+    ap.add_argument("--select", default=None, metavar="PASS[,PASS...]",
+                    help="run only these passes (donation-leak, "
+                         "recompile-hazard, callback-in-sharded-program, "
+                         "program-budget)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--update-budget", action="store_true",
+                    help="rewrite tools/program_budget.json from the "
+                         "current inventory, then exit 0")
+    ap.add_argument("--budget-file", default=None,
+                    help="alternate budget path (default: "
+                         "tools/program_budget.json)")
+    ap.add_argument("--list", action="store_true", dest="list_programs",
+                    help="print the inventory catalog and exit")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    _init_devices()
+    from pathlib import Path
+    from .inventory import entries
+    from .passes import (PROGRAM_BUDGET_PATH, run_analysis,
+                         update_program_budget)
+
+    if args.list_programs:
+        for e in entries():
+            tags = "".join(t for t, on in (
+                (" [mesh]", e.mesh), (" [budget]", e.budget),
+                (" [donates]", bool(e.donate)),
+                (" [waived]", bool(e.donate_waiver))) if on)
+            print(f"{e.name:28s} {e.anchor:36s}{tags}")
+            print(f"{'':28s} {e.doc}")
+        return 0
+
+    budget_path = (Path(args.budget_file) if args.budget_file
+                   else PROGRAM_BUDGET_PATH)
+    if args.update_budget:
+        if args.programs or args.select:
+            # a partial measurement would silently rewrite the WHOLE
+            # committed budget from a subset — same contract as
+            # deap-tpu-lint --update-baseline
+            print("deap-tpu-analyze: --update-budget requires a full "
+                  "run (no program names / --select)", file=sys.stderr)
+            return 2
+        doc = update_program_budget(budget_path)
+        print(json.dumps({"updated": str(budget_path),
+                          "budget": doc["budget"]}))
+        return 0
+
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    try:
+        result = run_analysis(names=args.programs or None, select=select,
+                              budget_path=budget_path)
+    except KeyError as e:
+        print(f"deap-tpu-analyze: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps(result.as_dict(), indent=2, sort_keys=True))
+        return result.exit_code
+    for f in result.findings:
+        print(f"{f.path}: [{f.rule}] {f.severity}: {f.message}")
+    waived = (f"; {len(result.waived)} donation waiver(s) honored"
+              if result.waived else "")
+    print(f"{len(result.findings)} finding(s) across "
+          f"{len(result.programs)} lowered programs "
+          f"({len(result.passes_run)} passes{waived})")
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

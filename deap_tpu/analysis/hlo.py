@@ -1,0 +1,129 @@
+"""Text-level analyzers for lowered (StableHLO) and compiled (HLO)
+program artifacts — the shared parsing layer of the program-contract
+passes.
+
+This module is deliberately **jax-free** (pure ``re``/string work over
+program text), so light consumers — ``bench_weakscaling.py``'s metric
+reporting, the HLO-pin tests, the per-scope profiler — can import the
+ONE canonical counting rule without paying the array-stack import.
+
+The collective counting rule lived in ``bench_weakscaling.py`` through
+r06; it is canonical **here** now and the bench re-exports it, so the
+budget gates (three weak-scaling layouts in
+``tools/collective_budget.json`` AND the per-program inventory budgets
+in ``tools/program_budget.json``), the pin tests, and the profiler can
+never drift apart: an opcode occurrence is the opcode name directly
+followed by its operand list (sync ``name(`` or async ``name-start(``);
+operand references ``%name.42`` and ``name-done(`` never produce
+either.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Set
+
+__all__ = ["COLLECTIVES", "collective_op_on_line", "collective_ops",
+           "custom_call_targets", "callback_targets", "aliased_parameters",
+           "parameter_count", "normalize_stablehlo"]
+
+#: the HLO collective opcodes every budget gates
+COLLECTIVES = ("collective-permute", "all-gather", "all-reduce",
+               "all-to-all", "reduce-scatter")
+
+_COLLECTIVE_OP_RE = re.compile(
+    r"\b(" + "|".join(COLLECTIVES) + r")(?:-start)?\(")
+
+
+def collective_op_on_line(line: str) -> Optional[str]:
+    """Base opcode of the collective instruction defined on this HLO
+    text line, or None (HLO prints one instruction per line)."""
+    m = _COLLECTIVE_OP_RE.search(line)
+    return m.group(1) if m else None
+
+
+def collective_ops(txt: str) -> Dict[str, int]:
+    """HLO collective *instruction definitions* per opcode — the count
+    the collective budgets gate."""
+    out: Dict[str, int] = {}
+    for line in txt.splitlines():
+        name = collective_op_on_line(line)
+        if name:
+            out[name] = out.get(name, 0) + 1
+    return out
+
+
+# -- StableHLO (lowered, pre-compile) ----------------------------------------
+
+_CUSTOM_CALL_RE = re.compile(
+    r"stablehlo\.custom_call\s+@([A-Za-z_][\w.]*)")
+
+#: substrings that mark a custom-call target as a host callback entry
+#: (io_callback / pure_callback / jax.debug.callback all lower to
+#: ``xla_python_*callback`` / ``xla_ffi_*callback`` custom calls)
+_CALLBACK_MARKERS = ("callback",)
+
+
+def custom_call_targets(txt: str) -> List[str]:
+    """Every ``stablehlo.custom_call @target`` in a lowered module, in
+    order (duplicates kept — each is one call site)."""
+    return _CUSTOM_CALL_RE.findall(txt)
+
+
+def callback_targets(txt: str) -> List[str]:
+    """The custom-call targets that are host callbacks — the class of
+    op that crashes XLA's sharding propagation when it appears inside a
+    mesh-partitioned program (the PR 2 islands crash, re-discovered at
+    runtime; this detects it at lowering time)."""
+    return [t for t in custom_call_targets(txt)
+            if any(m in t.lower() for m in _CALLBACK_MARKERS)]
+
+
+_ALIAS_RE = re.compile(r"%arg(\d+):[^,)]*?\{[^}]*tf\.aliasing_output")
+_PARAM_RE = re.compile(r"%arg(\d+):")
+
+
+def aliased_parameters(txt: str) -> Set[int]:
+    """Flat parameter indices of the lowered module's ``@main`` that
+    carry a donation marker (``tf.aliasing_output``) — i.e. the inputs
+    jax actually lowered as donated.  A declared ``donate_argnums`` that
+    produces no marker here never took effect."""
+    main = _main_signature(txt)
+    return {int(i) for i in _ALIAS_RE.findall(main)}
+
+
+def parameter_count(txt: str) -> int:
+    """Number of flat parameters of the lowered module's ``@main``."""
+    main = _main_signature(txt)
+    ids = [int(i) for i in _PARAM_RE.findall(main)]
+    return (max(ids) + 1) if ids else 0
+
+
+def _main_signature(txt: str) -> str:
+    """The parameter list of the lowered module's ``@main`` (the region
+    between ``@main(`` and the ``->`` result arrow) — where per-parameter
+    attributes like ``tf.aliasing_output`` live."""
+    idx = txt.find("@main(")
+    if idx < 0:
+        return ""
+    end = txt.find("->", idx)
+    if end < 0:
+        end = txt.find("{", idx)
+    return txt[idx:end] if end > idx else txt[idx:]
+
+
+_BACKEND_CONFIG_RE = re.compile(r'backend_config\s*=\s*"[^"]*"')
+_LOCATION_RE = re.compile(r"\s+loc\(.*?\)$", re.MULTILINE)
+
+
+def normalize_stablehlo(txt: str) -> str:
+    """Strip the per-process noise from a lowered module's text so two
+    lowerings of the *same* program compare byte-equal: callback
+    ``backend_config`` blobs embed host object addresses, and ``loc``
+    metadata embeds source paths.  Everything semantically meaningful
+    (ops, shapes, constants, shardings) survives — which is exactly what
+    the recompile-hazard diff needs: a Python value baked as a literal
+    shows up as a differing ``stablehlo.constant``."""
+    txt = _BACKEND_CONFIG_RE.sub('backend_config = "<elided>"', txt)
+    txt = _LOCATION_RE.sub("", txt)
+    return txt

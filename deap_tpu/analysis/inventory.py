@@ -1,0 +1,480 @@
+"""The program inventory: the repo's canonical compiled programs, named
+and buildable at small canonical shapes.
+
+The AST lint tier (:mod:`deap_tpu.lint`) sees source text; everything
+the toolbox ``map`` boundary gates behind ``jit``/``scan`` is invisible
+to it.  This registry is the complement's foundation: each
+:class:`ProgramEntry` knows how to construct one production program
+shape-faithfully at a size small enough to lower in a test budget —
+the flagship GA generation scan, the serving layer's step executables
+(slot-packed, and pop-sharded over the mesh), the sharded NSGA-II
+selection variants, the GP interpreter, and the CMA/DE/PSO update
+steps.  The :mod:`deap_tpu.analysis.passes` pipeline lowers every entry
+and checks program-level contracts (donation, recompile hazards,
+callback/sharding safety, collective budgets) that only exist *after*
+lowering.
+
+Shapes are deliberately tiny: lowering cost is what the tier-1 gate
+pays, and none of the checked properties — aliasing structure, baked
+constants, callback custom-calls, collective instruction counts —
+depends on array sizes (the same reasoning as
+``tools/check_collective_budget.py``; the committed budgets record the
+shapes they were taken at).
+
+Every ``build(variant=...)`` accepts a variant index and varies ONLY
+runtime values (key seeds, probability knobs, payload contents), never
+shapes or dtypes: two variants of one entry must lower to the identical
+program, and a difference is a recompile hazard (a Python value baked
+as a literal where an operand belongs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ProgramEntry", "Lowered", "INVENTORY", "entries", "get_entry",
+           "lower_entry", "require_mesh", "build_ga_scan", "N_DEV"]
+
+#: mesh width every sharded entry lowers at (tests/conftest.py and the
+#: analyze CLI both stand up this many virtual CPU devices)
+N_DEV = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramEntry:
+    """One canonical compiled program.
+
+    ``build(variant=0)`` returns ``(fn, args)`` — a traceable callable
+    and committed example arguments at the canonical small shape.
+    ``donate`` is the argnums the production call site donates (the
+    donation-leak pass verifies they lower to aliases AND that nothing
+    donatable is left over); ``donate_waiver`` documents why a program
+    intentionally donates nothing (e.g. the serve dispatcher re-executes
+    failed batches with the same buffers — donation would invalidate
+    session state on retry).  ``budget=True`` compiles the entry and
+    gates its HLO collective counts against
+    ``tools/program_budget.json``."""
+
+    name: str
+    anchor: str                       # repo-relative module of the program
+    build: Callable[..., Tuple[Callable, tuple]]
+    doc: str = ""
+    mesh: bool = False
+    budget: bool = False
+    donate: Tuple[int, ...] = ()
+    donate_waiver: str = ""
+    callback_ok: bool = False
+    static_argnums: Tuple[int, ...] = ()
+
+
+@dataclasses.dataclass
+class Lowered:
+    """One lowered entry: the jax ``Lowered`` stage plus its StableHLO
+    text (compiled HLO is produced lazily — only the budget pass pays
+    for XLA compilation, and only on ``budget=True`` entries)."""
+
+    entry: ProgramEntry
+    fn: Callable
+    args: tuple
+    lowered: Any
+    text: str
+    _compiled_text: Optional[str] = None
+
+    def compiled_text(self) -> str:
+        if self._compiled_text is None:
+            self._compiled_text = self.lowered.compile().as_text()
+        return self._compiled_text
+
+
+def require_mesh() -> Mesh:
+    """The analysis mesh (``N_DEV`` devices on one axis).  Raises with
+    the setup recipe when the process was started without enough virtual
+    devices — the backend cannot be re-initialized after first use."""
+    devs = jax.devices()
+    if len(devs) < N_DEV:
+        raise RuntimeError(
+            f"program inventory needs {N_DEV} devices, have {len(devs)}: "
+            "set XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{N_DEV} before jax initializes (the deap-tpu-analyze CLI "
+            "and tests/conftest.py both do)")
+    return Mesh(np.array(devs[:N_DEV]), ("pop",))
+
+
+# ---------------------------------------------------------------------------
+# shared builders
+# ---------------------------------------------------------------------------
+
+#: canonical small shapes (committed alongside the budgets: the checked
+#: properties are size-independent, the record is for reproducibility)
+POP, DIM = 64, 8
+ROWS_SHARDED = 64            # 8 rows/device on the N_DEV mesh
+MO_POP, MO_NOBJ = 128, 3
+GP_POP, GP_CAP, GP_POINTS = 32, 16, 8
+
+
+def _ga_toolbox():
+    """The flagship GA toolbox (bench.py's operator set at gate dims)."""
+    from .. import base, benchmarks
+    from ..ops import crossover, mutation, selection
+    tb = base.Toolbox()
+    tb.register("evaluate", benchmarks.rastrigin)
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=0.3,
+                indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3,
+                tie_break="rank")
+    return tb
+
+
+def _mo_toolbox():
+    """A two-objective toolbox whose select is the sharded NSGA-II (the
+    shadow toolbox a pop-sharded serve session steps with)."""
+    from .. import base
+    from ..ops import crossover, mutation
+    from ..parallel.emo_sharded import sel_nsga2_sharded
+    tb = base.Toolbox()
+    tb.register("evaluate",
+                lambda g: (jnp.sum(g * g), jnp.sum((g - 1.0) ** 2)))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=0.3,
+                indpb=0.05)
+    tb.register("select", sel_nsga2_sharded, mesh=require_mesh(),
+                front_chunk=32)
+    return tb
+
+
+def _session_state(variant: int, rows: int, dim: int, nobj: int = 1,
+                   live_n: Optional[int] = None) -> Dict[str, jax.Array]:
+    """A serve session state dict at a bucket shape (the operand pytree
+    of every slot/sharded program; see ``EvolutionService._make_state``).
+    ``variant`` perturbs only values: the key stream and the cxpb/mutpb
+    knobs — which the program must carry as operands, never bake."""
+    key = jax.random.PRNGKey(7 + variant)
+    genome = jax.random.uniform(jax.random.fold_in(key, 1),
+                                (rows, dim), jnp.float32, -1.0, 1.0)
+    n = rows - 2 if live_n is None else live_n
+    return {"key": jax.random.key_data(key) if jax.dtypes.issubdtype(
+                key.dtype, jax.dtypes.prng_key) else key.astype(jnp.uint32),
+            "genome": genome,
+            "values": jnp.zeros((rows, nobj), jnp.float32),
+            "valid": jnp.zeros((rows,), bool),
+            "live_n": jnp.asarray(n, jnp.int32),
+            "cxpb": jnp.asarray(0.6 + 0.1 * variant, jnp.float32),
+            "mutpb": jnp.asarray(0.3 - 0.1 * variant, jnp.float32)}
+
+
+def _place_sharded(tree, rows: int, mesh: Mesh):
+    """Pop-axis placement of a session state (the serving layer's
+    ``_place_sharded`` contract: rows-long leading axes shard, the rest
+    replicate)."""
+    row_sh = NamedSharding(mesh, P("pop"))
+    rep_sh = NamedSharding(mesh, P())
+
+    def put(x):
+        x = jnp.asarray(x)
+        sh = row_sh if (x.ndim and x.shape[0] == rows) else rep_sh
+        return jax.device_put(x, sh)
+    return jax.tree_util.tree_map(put, tree)
+
+
+# -- entry builders ----------------------------------------------------------
+
+
+def build_ga_scan(pop: int = POP, dim: int = DIM, ngen: int = 2,
+                  variant: int = 0):
+    """The hot GA path: bench.py's whole-run generation scan (select →
+    vary → evaluate under ``lax.scan``) — the program the ROADMAP's
+    raw-speed item donates buffers across.  Public and parameterized so
+    the donation measurement (``tools/bench_donation.py``) and the
+    inventory entry build the SAME program at their respective shapes
+    (a third spelling of this body would silently drift from the one
+    the gate enforces)."""
+    from .. import base, benchmarks
+    from ..algorithms import vary_genome
+    tb = _ga_toolbox()
+
+    def generation(carry, _):
+        key, g, fv = carry
+        key, k_sel, k_var = jax.random.split(key, 3)
+        fit = base.Fitness(values=fv, valid=jnp.ones(pop, bool),
+                           weights=(-1.0,))
+        idx = tb.select(k_sel, fit, pop)
+        g = g[idx]
+        g, _ = vary_genome(k_var, g, tb, 0.9, 0.5, pairing="halves")
+        fv = jax.vmap(lambda x: benchmarks.rastrigin(x)[0])(g)[:, None]
+        return (key, g, fv), jnp.min(fv)
+
+    def run(key, genome, values):
+        return lax.scan(generation, (key, genome, values), None,
+                        length=ngen)
+
+    key = jax.random.PRNGKey(variant)
+    genome = jax.random.uniform(jax.random.fold_in(key, 1), (pop, dim),
+                                jnp.float32, -5.12, 5.12)
+    values = jax.vmap(lambda x: benchmarks.rastrigin(x)[0])(genome)[:, None]
+    return run, (jax.random.key_data(key) if jax.dtypes.issubdtype(
+        key.dtype, jax.dtypes.prng_key) else key, genome, values)
+
+
+def _build_session_step(variant: int = 0):
+    """One serve session's step program, un-vmapped (the per-state form
+    every slot/sharded executable wraps)."""
+    from ..serve.service import build_slot_program
+    fn = build_slot_program("step", _ga_toolbox(), (-1.0,), vmapped=False)
+    return fn, (_session_state(variant, 16, DIM),)
+
+
+def _build_serve_step_slots(variant: int = 0):
+    """The slot-packed step executable: 2 sessions advancing under one
+    vmap dispatch (``EvolutionService._exec_slots``)."""
+    from ..serve.service import build_slot_program
+    fn = build_slot_program("step", _ga_toolbox(), (-1.0,), vmapped=True)
+    states = [_session_state(variant, 16, DIM, live_n=14),
+              _session_state(variant + 2, 16, DIM, live_n=9)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+    return fn, (stacked,)
+
+
+def _build_serve_step_sharded(variant: int = 0):
+    """A pop-sharded session's step executable: the un-vmapped program
+    over mesh-sharded state (``EvolutionService._exec_sharded``)."""
+    from ..serve.service import build_slot_program
+    mesh = require_mesh()
+    fn = build_slot_program("step", _ga_toolbox(), (-1.0,), vmapped=False)
+    state = _place_sharded(
+        _session_state(variant, ROWS_SHARDED, DIM, live_n=ROWS_SHARDED - 4),
+        ROWS_SHARDED, mesh)
+    return fn, (state,)
+
+
+def _build_serve_nsga2_sharded(variant: int = 0):
+    """A pop-sharded multi-objective session: the step executable whose
+    select is :func:`~deap_tpu.parallel.emo_sharded.sel_nsga2_sharded`
+    (the shadow-toolbox swap ``EvolutionService._sharded_toolbox``
+    performs for NSGA-II tenants at or above the shard threshold)."""
+    from ..serve.service import build_slot_program
+    mesh = require_mesh()
+    fn = build_slot_program("step", _mo_toolbox(), (-1.0, -1.0),
+                            vmapped=False)
+    state = _place_sharded(
+        _session_state(variant, ROWS_SHARDED, DIM, nobj=2,
+                       live_n=ROWS_SHARDED),
+        ROWS_SHARDED, mesh)
+    return fn, (state,)
+
+
+def _build_nsga2_sharded(exchange: str, variant: int = 0):
+    """Standalone sharded NSGA-II selection (``exchange="indices"`` is
+    the r06 collective-lean default; ``"rows"`` the legacy protocol)."""
+    from ..parallel.emo_sharded import sel_nsga2_sharded
+    mesh = require_mesh()
+    key = jax.random.PRNGKey(11 + variant)
+    x = jax.random.uniform(key, (MO_POP, MO_NOBJ))
+    w = -jnp.stack([x[:, 0], x[:, 1] * (1.5 - x[:, 0]),
+                    x[:, 2] * (1.5 - x[:, 0])], axis=1)
+    w = jax.device_put(w, NamedSharding(mesh, P("pop", None)))
+
+    def sel(w_):
+        return sel_nsga2_sharded(None, w_, MO_POP // 2, mesh, axis="pop",
+                                 front_chunk=32, exchange=exchange)
+    return sel, (w,)
+
+
+def _build_gp_interp(variant: int = 0):
+    """The vectorized GP tree interpreter (XLA stack machine) over a
+    small population."""
+    from ..gp import pset as gp_pset
+    from ..gp.interp import make_population_evaluator
+    ps = gp_pset.PrimitiveSet("MAIN", 1)
+    ps.add_primitive(jnp.add, 2, name="add")
+    ps.add_primitive(jnp.multiply, 2, name="mul")
+    ps.add_primitive(jnp.negative, 1, name="neg")
+    ev = make_population_evaluator(ps, GP_CAP, backend="xla")
+    key = jax.random.PRNGKey(3 + variant)
+    f = gp_pset.freeze_pset(ps)
+    codes = jax.random.randint(key, (GP_POP, GP_CAP), 0, f.n_nodes,
+                               jnp.int32)
+    consts = jax.random.uniform(jax.random.fold_in(key, 1),
+                                (GP_POP, GP_CAP), jnp.float32)
+    lengths = jnp.full((GP_POP,), 1, jnp.int32)
+    X = jax.random.uniform(jax.random.fold_in(key, 2),
+                           (1, GP_POINTS), jnp.float32)
+    return ev, (codes, consts, lengths, X)
+
+
+def _build_cma_update(variant: int = 0):
+    """One CMA-ES generate → evaluate → update step (the
+    ``ea_generate_update`` scan body for the CMA strategy head)."""
+    from .. import cma as cma_mod
+    from ..base import Population, Fitness
+    strategy = cma_mod.Strategy(centroid=np.zeros(DIM), sigma=0.5,
+                                lambda_=8)
+
+    def step(state, key):
+        g = strategy.generate(state, key)
+        values = jax.vmap(lambda x: jnp.sum(x * x))(g)[:, None]
+        pop = Population(g, Fitness(values=values,
+                                    valid=jnp.ones(g.shape[0], bool),
+                                    weights=(-1.0,)))
+        return strategy.update(state, pop)
+
+    key = jax.random.PRNGKey(5 + variant)
+    return step, (strategy.init(), jax.random.key_data(key)
+                  if jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key)
+                  else key)
+
+
+def _build_de_step(variant: int = 0):
+    """One differential-evolution generation."""
+    from .. import de as de_mod
+    from ..base import Population, Fitness
+
+    def evaluate(x):
+        return (jnp.sum(x * x),)
+
+    def step(key, pop):
+        return de_mod.de_step(key, pop, evaluate, cr=0.25, f=1.0)
+
+    key = jax.random.PRNGKey(13 + variant)
+    genome = jax.random.uniform(jax.random.fold_in(key, 1), (POP, DIM),
+                                jnp.float32, -1.0, 1.0)
+    values = jax.vmap(lambda x: jnp.sum(x * x))(genome)[:, None]
+    pop = Population(genome, Fitness(values=values,
+                                     valid=jnp.ones(POP, bool),
+                                     weights=(-1.0,)))
+    return step, (jax.random.key_data(key) if jax.dtypes.issubdtype(
+        key.dtype, jax.dtypes.prng_key) else key, pop)
+
+
+def _build_pso_step(variant: int = 0):
+    """One synchronous PSO generation."""
+    from .. import pso as pso_mod
+
+    def evaluate(x):
+        return (jnp.sum(x * x),)
+
+    def step(key, state):
+        return pso_mod.pso_step(key, state, evaluate, weights=(-1.0,),
+                                smin=-0.5, smax=0.5)
+
+    key = jax.random.PRNGKey(17 + variant)
+    state = pso_mod.pso_init(jax.random.fold_in(key, 1), POP, DIM,
+                             -1.0, 1.0, -0.5, 0.5)
+    return step, (jax.random.key_data(key) if jax.dtypes.issubdtype(
+        key.dtype, jax.dtypes.prng_key) else key, state)
+
+
+#: the serve dispatcher's donation waiver, shared by every serve-layer
+#: entry: ``BatchDispatcher`` wraps execution in ``with_retries`` and a
+#: retried batch re-dispatches the SAME session-state buffers — donating
+#: them would hand XLA permission to overwrite the only copy before the
+#: retry runs.  (Per-request state copies would cost more than donation
+#: saves at bucket sizes; revisit if sharded sessions grow past HBM/2.)
+_SERVE_WAIVER = ("serve dispatch retries re-execute with the same state "
+                 "buffers (resilience.with_retries); donation would "
+                 "invalidate the retry's inputs")
+
+INVENTORY: Tuple[ProgramEntry, ...] = (
+    ProgramEntry(
+        name="ga_generation_scan", anchor="bench.py",
+        build=build_ga_scan, donate=(0, 1, 2),
+        doc="flagship GA whole-run scan (select/vary/evaluate per gen); "
+            "the ROADMAP raw-speed item donates key+genome+fitness "
+            "across it"),
+    ProgramEntry(
+        name="ea_step_session", anchor="deap_tpu/algorithms.py",
+        build=_build_session_step, donate_waiver=_SERVE_WAIVER,
+        doc="one serve session's ea_step generation (live-masked, "
+            "un-vmapped)"),
+    ProgramEntry(
+        name="serve_step_slots", anchor="deap_tpu/serve/service.py",
+        build=_build_serve_step_slots, donate_waiver=_SERVE_WAIVER,
+        doc="slot-packed step executable (2 sessions under one vmap)"),
+    ProgramEntry(
+        name="serve_step_sharded", anchor="deap_tpu/serve/service.py",
+        build=_build_serve_step_sharded, mesh=True, budget=True,
+        donate_waiver=_SERVE_WAIVER,
+        doc="pop-sharded session step executable over the service mesh"),
+    ProgramEntry(
+        name="serve_nsga2_sharded_session",
+        anchor="deap_tpu/serve/service.py",
+        build=_build_serve_nsga2_sharded, mesh=True, budget=True,
+        donate_waiver=_SERVE_WAIVER,
+        doc="pop-sharded multi-objective session step (shadow-toolbox "
+            "sel_nsga2_sharded select)"),
+    ProgramEntry(
+        name="nsga2_sharded_indices",
+        anchor="deap_tpu/parallel/emo_sharded.py",
+        build=partial(_build_nsga2_sharded, "indices"), mesh=True,
+        budget=True,
+        donate_waiver="pure selection: returns indices, no state to "
+                      "donate into",
+        doc="sharded NSGA-II selection, r06 collective-lean index-"
+            "payload peel"),
+    ProgramEntry(
+        name="nsga2_sharded_rows",
+        anchor="deap_tpu/parallel/emo_sharded.py",
+        build=partial(_build_nsga2_sharded, "rows"), mesh=True,
+        budget=True,
+        donate_waiver="pure selection: returns indices, no state to "
+                      "donate into",
+        doc="sharded NSGA-II selection, legacy row-gather protocol"),
+    ProgramEntry(
+        name="gp_interp", anchor="deap_tpu/gp/interp.py",
+        build=_build_gp_interp,
+        donate_waiver="pure evaluation: inputs (population tokens) are "
+                      "re-read by the caller after fitness lands",
+        doc="vectorized GP stack-machine interpreter over a population"),
+    ProgramEntry(
+        name="cma_update", anchor="deap_tpu/cma.py",
+        build=_build_cma_update, donate=(0,),
+        doc="CMA-ES generate/evaluate/update step (ea_generate_update "
+            "scan body)"),
+    ProgramEntry(
+        name="de_step", anchor="deap_tpu/de.py",
+        build=_build_de_step, donate=(1,),
+        doc="one DE generation (donor build + binomial crossover + "
+            "greedy replace)"),
+    ProgramEntry(
+        name="pso_step", anchor="deap_tpu/pso.py",
+        build=_build_pso_step, donate=(1,),
+        doc="one synchronous PSO generation"),
+)
+
+
+def entries(names: Optional[List[str]] = None) -> List[ProgramEntry]:
+    """The inventory (optionally restricted to ``names``; unknown names
+    raise with the available set)."""
+    if not names:
+        return list(INVENTORY)
+    by_name = {e.name: e for e in INVENTORY}
+    out = []
+    for n in names:
+        if n not in by_name:
+            raise KeyError(f"unknown inventory program {n!r} "
+                           f"(have: {', '.join(sorted(by_name))})")
+        out.append(by_name[n])
+    return out
+
+
+def get_entry(name: str) -> ProgramEntry:
+    return entries([name])[0]
+
+
+def lower_entry(entry: ProgramEntry, variant: int = 0) -> Lowered:
+    """Build and lower one entry (with its declared donation, so the
+    lowered text carries the aliasing the production call site gets)."""
+    fn, args = entry.build(variant=variant)
+    jitted = jax.jit(fn, donate_argnums=entry.donate or (),
+                     static_argnums=entry.static_argnums or ())
+    lowered = jitted.lower(*args)
+    return Lowered(entry=entry, fn=fn, args=args, lowered=lowered,
+                   text=lowered.as_text())

@@ -1,0 +1,419 @@
+"""The program-contract passes: checks that only exist *after* lowering.
+
+Each pass consumes :class:`~deap_tpu.analysis.inventory.Lowered`
+artifacts and yields the same :class:`~deap_tpu.lint.core.Finding`
+records the AST tier produces, so findings flow through the existing
+text/JSON/SARIF reporters, the suppression counters, and (via the
+``program-contract`` opt-in lint rule) the committed-baseline machinery
+unchanged.
+
+=============================== =============================================
+``donation-leak``               input buffers structurally aliasable to an
+                                output but not donated (and declared
+                                donations that never lowered to an alias)
+``recompile-hazard``            weak-typed operands, value-variant lowering
+                                differences (a Python value baked as a
+                                literal where an operand belongs),
+                                non-hashable static args
+``callback-in-sharded-program`` host-callback custom-calls inside a
+                                mesh-partitioned program — the XLA
+                                sharding-propagation crash class PR 2 hit
+                                at runtime, detected at lowering time
+``program-budget``              HLO collective instruction counts per
+                                inventory entry vs the committed
+                                ``tools/program_budget.json``
+=============================== =============================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from collections import Counter
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+
+from ..lint.core import REPO, Finding
+from . import hlo
+from .inventory import Lowered, N_DEV, entries, lower_entry
+
+__all__ = ["PASS_NAMES", "AnalysisResult", "run_analysis",
+           "donation_findings", "recompile_findings", "callback_findings",
+           "budget_findings", "compare_budget", "measure_budget_counts",
+           "update_program_budget", "PROGRAM_BUDGET_PATH",
+           "DONATION_MIN_BYTES"]
+
+PASS_NAMES = ("donation-leak", "recompile-hazard",
+              "callback-in-sharded-program", "program-budget")
+
+PROGRAM_BUDGET_PATH = REPO / "tools" / "program_budget.json"
+
+#: buffers below this size are never donation findings: donating a key
+#: or a scalar knob saves nothing and the noise would drown the genome-
+#: sized leaks the pass exists for
+DONATION_MIN_BYTES = 1024
+
+
+# ---------------------------------------------------------------------------
+# donation-leak
+# ---------------------------------------------------------------------------
+
+
+def _flat_leaves(tree) -> List:
+    return jax.tree_util.tree_leaves(tree)
+
+
+def _leaf_key(x) -> Tuple:
+    return (tuple(x.shape), str(x.dtype))
+
+
+def _leaf_bytes(x) -> int:
+    import numpy as np
+    return int(np.dtype(str(x.dtype)).itemsize * max(1, int(np.prod(x.shape))))
+
+
+def donation_findings(low: Lowered) -> Iterable[Finding]:
+    """Structural aliasing audit of one lowered entry.
+
+    An input leaf whose ``(shape, dtype)`` matches an output leaf can be
+    donated (``donate_argnums``) and the generation's old buffer reused
+    for the new one — on the scan-carry programs this inventory names,
+    skipping the donation doubles the population's peak footprint and
+    adds a copy.  The pass bipartite-matches non-donated input leaves
+    against the outputs *left over* after the declared donations claim
+    theirs, and flags every unmatched-but-matchable input at or above
+    :data:`DONATION_MIN_BYTES` with the ``donate_argnums`` fix.
+
+    Entries with a ``donate_waiver`` are skipped — the waiver string is
+    the reviewed reason donation is intentionally absent (e.g. the serve
+    dispatcher's retry-with-same-buffers contract).
+
+    The declared side is audited too: a donated argnum whose leaves
+    produced no ``tf.aliasing_output`` marker in the lowered module
+    never took effect (typo'd argnum, or shapes stopped matching after a
+    refactor) and is reported — jax only warns at compile time, on the
+    production box, where nobody is watching."""
+    entry = low.entry
+    if entry.donate_waiver:
+        return
+    out_shapes = jax.eval_shape(low.fn, *low.args)
+    out_counts: Counter = Counter(
+        _leaf_key(x) for x in _flat_leaves(out_shapes))
+
+    # walk the args in flat-parameter order (jit lowers the flattened
+    # leaves positionally, so flat index == %argN of the lowered @main):
+    # donated leaves claim their matching outputs, and every LARGE
+    # donated leaf's flat index must carry an alias marker
+    donated_leaves = 0
+    must_alias: List[int] = []          # flat indices that have to alias
+    flat = 0
+    for i, arg in enumerate(low.args):
+        for leaf in _flat_leaves(arg):
+            if i in entry.donate:
+                donated_leaves += 1
+                if _leaf_bytes(leaf) >= DONATION_MIN_BYTES:
+                    must_alias.append(flat)
+                k = _leaf_key(leaf)
+                if out_counts[k] > 0:
+                    out_counts[k] -= 1
+            flat += 1
+
+    # effectiveness audit: jax silently skips donated buffers it cannot
+    # alias (it only warns at compile time, on the production box).
+    # Every *large* donated leaf must alias — per leaf, not in
+    # aggregate, so a big donation that stopped taking effect cannot
+    # hide behind a small sibling that still does; tiny scalars (a step
+    # counter, sigma) are legitimately skipped by the runtime and carry
+    # no footprint anyway.  A declared donation with NO effect at all
+    # (typo'd argnum) is flagged even when every leaf is small.
+    aliased = hlo.aliased_parameters(low.text)
+    dead = [j for j in must_alias if j not in aliased]
+    if dead or (donated_leaves and not aliased):
+        yield Finding(
+            rule="donation-leak", path=entry.anchor, line=1,
+            message=(f"program '{entry.name}': declared donation "
+                     f"(donate_argnums={entry.donate}) does not take "
+                     "effect for "
+                     + (f"flat parameter(s) {dead}" if dead
+                        else "any leaf")
+                     + " -- no input-output alias lowered; the doubled "
+                     "footprint silently persists (check the argnums "
+                     "and that input/output shapes still match)"))
+
+    for i, arg in enumerate(low.args):
+        if i in entry.donate:
+            continue
+        for leaf in _flat_leaves(arg):
+            k = _leaf_key(leaf)
+            if _leaf_bytes(leaf) < DONATION_MIN_BYTES:
+                continue
+            if out_counts[k] > 0:
+                out_counts[k] -= 1
+                shape, dtype = k
+                yield Finding(
+                    rule="donation-leak", path=entry.anchor, line=1,
+                    message=(f"program '{entry.name}': argument {i} leaf "
+                             f"{dtype}{list(shape)} "
+                             f"({_leaf_bytes(leaf)} bytes) is structurally "
+                             "aliasable to an output but not donated -- "
+                             f"add donate_argnums=({i},) at the call site "
+                             "(or record a donate_waiver on the inventory "
+                             "entry if the buffer is re-read after "
+                             "dispatch)"))
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard
+# ---------------------------------------------------------------------------
+
+
+def recompile_findings(low: Lowered,
+                       variant: Optional[Lowered] = None
+                       ) -> Iterable[Finding]:
+    """Constant-specialization hazards of one lowered entry.
+
+    *Weak types*: an operand traced from a bare Python scalar carries
+    ``weak_type=True``; the first strongly-typed value at the same call
+    site retraces the program — a silent compile fork per dtype flavor.
+
+    *Baked values*: ``variant`` is the same entry lowered from
+    ``build(variant=1)`` — identical shapes/dtypes, different runtime
+    values (key seeds, probability knobs).  The two lowerings must be
+    byte-identical after :func:`~deap_tpu.analysis.hlo.normalize_stablehlo`;
+    a difference means some value the program must carry as an operand
+    was baked in as a literal, i.e. the production path compiles one
+    program per distinct value (the EvoJAX/evosax silent-recompile
+    class).
+
+    *Static args*: a non-hashable value at a ``static_argnums`` position
+    fails at dispatch time with jax's generic unhashable error — flagged
+    here with the entry named."""
+    entry = low.entry
+    try:
+        jaxpr = jax.make_jaxpr(low.fn, static_argnums=entry.static_argnums
+                               or ())(*low.args)
+    except Exception:   # noqa: BLE001 — jaxpr is advisory; lowering worked
+        jaxpr = None
+    if jaxpr is not None:
+        weak = [i for i, v in enumerate(jaxpr.jaxpr.invars)
+                if getattr(v.aval, "weak_type", False)]
+        if weak:
+            yield Finding(
+                rule="recompile-hazard", path=entry.anchor, line=1,
+                message=(f"program '{entry.name}': flat operand(s) {weak} "
+                         "are weak-typed (a bare Python scalar reached "
+                         "the trace) -- the first strongly-typed caller "
+                         "forks a recompile; pass "
+                         "jnp.asarray(x, explicit_dtype)"))
+
+    for i in entry.static_argnums:
+        try:
+            hash(low.args[i])
+        except TypeError:
+            yield Finding(
+                rule="recompile-hazard", path=entry.anchor, line=1,
+                message=(f"program '{entry.name}': static argument {i} is "
+                         "not hashable -- jit cannot key its compile "
+                         "cache on it; make it a hashable config object "
+                         "or pass it as an operand"))
+
+    if variant is not None:
+        a = hlo.normalize_stablehlo(low.text)
+        b = hlo.normalize_stablehlo(variant.text)
+        if a != b:
+            diff_line = next(
+                (la for la, lb in zip(a.splitlines(), b.splitlines())
+                 if la != lb), "<length mismatch>")
+            yield Finding(
+                rule="recompile-hazard", path=entry.anchor, line=1,
+                message=(f"program '{entry.name}': lowering differs "
+                         "between value variants of the same shapes -- a "
+                         "runtime value (key, probability, count) is "
+                         "baked into the program as a literal and every "
+                         "distinct value will compile its own executable"
+                         f"; first differing line: {diff_line.strip()[:160]}"))
+
+
+# ---------------------------------------------------------------------------
+# callback-in-sharded-program
+# ---------------------------------------------------------------------------
+
+
+def callback_findings(low: Lowered) -> Iterable[Finding]:
+    """Host-callback custom-calls inside mesh-partitioned programs.
+
+    PR 2 found this class at runtime: an ``io_callback`` inside a
+    mesh-sharded islands program drove XLA's sharding propagation into a
+    CHECK crash, and the fix was discovered by probing.  The lowered
+    module already names every callback custom-call
+    (``stablehlo.custom_call @xla_python_cpu_callback`` and kin), so the
+    hazard is detectable before XLA ever partitions — this pass walks
+    the mesh entries' lowered text and flags any callback target unless
+    the entry opts in (``callback_ok=True``: single-device programs, or
+    paths with an end-of-run drain fallback)."""
+    entry = low.entry
+    if not entry.mesh or entry.callback_ok:
+        return
+    for target in sorted(set(hlo.callback_targets(low.text))):
+        yield Finding(
+            rule="callback-in-sharded-program", path=entry.anchor, line=1,
+            message=(f"program '{entry.name}': host-callback custom-call "
+                     f"'{target}' inside a mesh-partitioned program -- "
+                     "XLA sharding propagation crashes on this class "
+                     "(PR 2, islands telemetry); drain on the host "
+                     "between dispatches instead, or mark the entry "
+                     "callback_ok with a reviewed reason"))
+
+
+# ---------------------------------------------------------------------------
+# program-budget
+# ---------------------------------------------------------------------------
+
+
+def measure_budget_counts(lows: Sequence[Lowered]) -> Dict[str, Dict[str, int]]:
+    """{entry name: {collective: instruction count}} for the budget
+    entries among ``lows`` (compiles them — the one expensive step)."""
+    return {low.entry.name: hlo.collective_ops(low.compiled_text())
+            for low in lows if low.entry.budget}
+
+
+def load_program_budget(path: Path = PROGRAM_BUDGET_PATH) -> Dict:
+    with open(path) as f:
+        return json.load(f)["budget"]
+
+
+def compare_budget(counts: Dict[str, Dict[str, int]],
+                   budget: Dict[str, Dict[str, int]]) -> List[str]:
+    """Pure comparison (unit-tested without any lowering): one violation
+    string per (program, collective) whose measured count exceeds the
+    budgeted count.  Programs/collectives absent from the budget are
+    budgeted 0; counts BELOW budget pass (improvements don't fail the
+    gate — refresh the budget to lock them in).  Same contract as
+    ``tools/check_collective_budget.compare``, keyed by inventory entry
+    instead of weak-scaling layout."""
+    violations = []
+    for name, ops in sorted(counts.items()):
+        allowed = budget.get(name, {})
+        for op, got in sorted(ops.items()):
+            cap = int(allowed.get(op, 0))
+            if got > cap:
+                violations.append(
+                    f"{name}: {op} x{got} exceeds budget {cap}")
+    return violations
+
+
+def update_program_budget(path: Path = PROGRAM_BUDGET_PATH,
+                          lows: Optional[Sequence[Lowered]] = None) -> dict:
+    """Measure the budget entries and rewrite the committed budget to
+    exactly the measured inventory (the explicit-diff refresh workflow,
+    as ``check_collective_budget --update-budget``)."""
+    if lows is None:
+        lows = [lower_entry(e) for e in entries() if e.budget]
+    counts = measure_budget_counts(lows)
+    doc = {
+        "_note": ("HLO collective instruction budget per inventory "
+                  "program (deap_tpu/analysis/inventory.py), gated "
+                  "tier-1 through deap_tpu.analysis; regenerate with "
+                  "deap-tpu-analyze --update-budget and commit the diff "
+                  "when an inventory change is intentional"),
+        "n_devices": N_DEV,
+        "method": "instruction definitions: 'opcode(' + 'opcode-start('",
+        "shapes": "inventory canonical shapes "
+                  "(deap_tpu/analysis/inventory.py)",
+        "budget": counts,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def budget_findings(lows: Sequence[Lowered],
+                    path: Path = PROGRAM_BUDGET_PATH) -> Iterable[Finding]:
+    budget_lows = [low for low in lows if low.entry.budget]
+    if not budget_lows:
+        return
+    try:
+        budget = load_program_budget(path)
+    except (OSError, KeyError, ValueError) as e:
+        yield Finding(
+            rule="program-budget", path="tools/program_budget.json", line=1,
+            message=f"cannot read committed program budget: {e}")
+        return
+    counts = measure_budget_counts(budget_lows)
+    anchors = {low.entry.name: low.entry.anchor for low in budget_lows}
+    for v in compare_budget(counts, budget):
+        name = v.split(":", 1)[0]
+        yield Finding(
+            rule="program-budget",
+            path=anchors.get(name, "tools/program_budget.json"), line=1,
+            message=(f"collective budget exceeded -- {v} (an intentional "
+                     "inventory change is committed via "
+                     "deap-tpu-analyze --update-budget)"))
+
+
+# ---------------------------------------------------------------------------
+# the runner
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    """One analyzer run: live findings (the gate fails on any), the
+    programs lowered, and the donation waivers honored (reported, so a
+    waiver can never silently hide)."""
+
+    findings: List[Finding]
+    programs: List[str]
+    waived: Dict[str, str]
+    passes_run: List[str]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+    def as_dict(self) -> dict:
+        return {"findings": [f.as_dict() for f in self.findings],
+                "programs": self.programs,
+                "waived": self.waived,
+                "summary": {"passes_run": self.passes_run,
+                            "programs_lowered": len(self.programs),
+                            "findings": len(self.findings),
+                            "exit_code": self.exit_code}}
+
+
+def run_analysis(*, names: Optional[List[str]] = None,
+                 select: Optional[Sequence[str]] = None,
+                 budget_path: Path = PROGRAM_BUDGET_PATH) -> AnalysisResult:
+    """Lower the inventory (all of it, or ``names``) and run the
+    selected passes (default: every pass).  The variant lowering for the
+    recompile diff is only built when that pass runs."""
+    passes = list(select) if select else list(PASS_NAMES)
+    unknown = [p for p in passes if p not in PASS_NAMES]
+    if unknown:
+        raise KeyError(f"unknown analysis pass(es) {unknown!r} "
+                       f"(have: {', '.join(PASS_NAMES)})")
+    todo = entries(names)
+    findings: List[Finding] = []
+    lows: List[Lowered] = []
+    waived: Dict[str, str] = {}
+    for entry in todo:
+        low = lower_entry(entry)
+        lows.append(low)
+        if entry.donate_waiver:
+            waived[entry.name] = entry.donate_waiver
+        if "donation-leak" in passes:
+            findings.extend(donation_findings(low))
+        if "recompile-hazard" in passes:
+            variant = lower_entry(entry, variant=1)
+            findings.extend(recompile_findings(low, variant))
+        if "callback-in-sharded-program" in passes:
+            findings.extend(callback_findings(low))
+    if "program-budget" in passes:
+        findings.extend(budget_findings(lows, path=budget_path))
+    findings.sort(key=lambda f: (f.path, f.rule, f.message))
+    return AnalysisResult(findings=findings,
+                          programs=[e.name for e in todo],
+                          waived=waived, passes_run=passes)

@@ -36,7 +36,11 @@ Rule catalog (see ``docs/static_analysis.md`` for bad/good examples):
 ``rng-key-reuse``    a PRNG key consumed twice without split/fold_in
 ``tracer-leak``      ``int()``/``bool()``/``if`` on traced values
 ``bench-json``       committed BENCH/MULTICHIP/budget JSONs match schema
+``metric-discipline`` serve metric names snake_case + in the registry
 ``collective-budget`` HLO collective counts within budget (heavy, opt-in)
+``program-contract`` compiled-program contracts: donation, recompile
+                     hazards, callbacks under a mesh, per-program
+                     budgets via ``deap-tpu-analyze`` (heavy, opt-in)
 ================== ========================================================
 """
 

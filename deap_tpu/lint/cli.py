@@ -62,10 +62,48 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline to grandfather exactly the "
                          "current findings, then exit 0")
+    ap.add_argument("--changed", action="store_true",
+                    help="scan only git-touched .py files (diff vs HEAD, "
+                         "staged, and untracked) -- the sub-second "
+                         "pre-commit loop; exits 0 immediately when "
+                         "nothing changed")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="text format: also list baselined findings")
     return ap
+
+
+def changed_py_files(repo: Path):
+    """Repo-relative ``.py`` paths git considers touched: working-tree +
+    staged changes vs HEAD, plus untracked files.  Deleted files are
+    excluded (nothing to lint).  Raises ``RuntimeError`` when git is
+    unavailable or ``repo`` is not a work tree — the caller surfaces
+    that as a usage error rather than silently linting nothing."""
+    import subprocess
+    cmds = (["git", "diff", "--name-only", "HEAD", "--"],
+            ["git", "ls-files", "--others", "--exclude-standard"])
+    names = []
+    for cmd in cmds:
+        try:
+            proc = subprocess.run(cmd, cwd=repo, capture_output=True,
+                                  text=True)
+        except OSError as e:
+            raise RuntimeError(f"{' '.join(cmd)} failed: {e}") from None
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(cmd)} failed: "
+                f"{proc.stderr.strip() or 'not a git work tree?'}")
+        names.extend(proc.stdout.splitlines())
+    out = []
+    seen = set()
+    for name in names:
+        if not name.endswith(".py") or name in seen:
+            continue
+        seen.add(name)
+        path = Path(repo) / name
+        if path.is_file():
+            out.append(path)
+    return sorted(out)
 
 
 def main(argv=None) -> int:
@@ -90,13 +128,44 @@ def _main(argv=None) -> int:
             print(f"{r.name:20s} {r.severity:7s} {r.doc}{tag}")
         return 0
 
-    if args.update_baseline and (args.select or args.ignore or args.paths):
+    if args.update_baseline and (args.select or args.ignore or args.paths
+                                 or args.changed):
         # a partial run sees a subset of findings; rewriting the whole
         # baseline from it would silently drop every other rule's/path's
         # grandfathered entries
         print("deap-tpu-lint: --update-baseline requires a full run "
-              "(no --select/--ignore/paths)", file=sys.stderr)
+              "(no --select/--ignore/--changed/paths)", file=sys.stderr)
         return 2
+
+    if args.changed:
+        if args.paths:
+            print("deap-tpu-lint: --changed and explicit paths are "
+                  "mutually exclusive", file=sys.stderr)
+            return 2
+        try:
+            changed = changed_py_files(Path(args.repo))
+        except RuntimeError as e:
+            print(f"deap-tpu-lint: --changed: {e}", file=sys.stderr)
+            return 2
+        if not changed:
+            # nothing to scan: emit a format-faithful empty report (a
+            # JSON/SARIF consumer must still receive its document)
+            from .core import LintResult
+            empty = LintResult(findings=[], suppressed=[], baselined=[],
+                               expired=[], rules_run=[], files_scanned=0)
+            if args.format == "json":
+                print(json.dumps(render_json(empty), indent=2,
+                                 sort_keys=True))
+            elif args.format == "sarif":
+                print(json.dumps(render_sarif(empty), indent=2,
+                                 sort_keys=True))
+            else:
+                print("0 finding(s) in 0 files "
+                      "(no git-touched .py files)")
+            return 0
+        # a path-restricted run: whole-repo coverage pins don't apply,
+        # which is exactly right for a per-commit loop
+        args.paths = changed
 
     baseline_path = args.baseline
     if baseline_path is None:

@@ -292,6 +292,13 @@ def run_lint(*, repo: Path = REPO, paths: Optional[Sequence[Path]] = None,
     if baseline:
         from .baseline import apply_baseline
         findings, baselined, expired = apply_baseline(findings, baseline)
+        if ctx.path_restricted:
+            # a partial scan (explicit paths / --changed) cannot tell
+            # whether an entry in an UNSCANNED file still fires — only
+            # entries whose file was actually scanned may be reported
+            # expired, or every pre-commit run would nag to
+            # --update-baseline over files it never looked at
+            expired = [e for e in expired if e.get("path") in ctx.by_rel]
 
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return LintResult(findings=findings, suppressed=suppressed,

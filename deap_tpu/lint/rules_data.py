@@ -5,12 +5,14 @@
 — against a small schema, so a malformed benchmark commit fails tier-1
 instead of silently breaking the trajectory tooling that diffs them.
 
-``collective-budget`` is the framework registration of the HLO
-collective-inventory gate: it is **default-off** (select it explicitly)
-because it lowers three weak-scaling programs on an 8-virtual-device
-mesh — the one pass that needs JAX.  It shells out to
-``tools/check_collective_budget.py`` in a subprocess, so even selecting
-it never imports jax into the linting process.
+``collective-budget`` and ``program-contract`` are the framework
+registrations of the two HLO-level gates: both are **default-off**
+(select explicitly) because they lower real programs on an
+8-virtual-device mesh — the passes that need JAX.  Both shell out in a
+subprocess (``tools/check_collective_budget.py`` for the three
+weak-scaling layouts; ``deap-tpu-analyze`` for the program-contract
+inventory of :mod:`deap_tpu.analysis`), so even selecting them never
+imports jax into the linting process.
 """
 
 from __future__ import annotations
@@ -181,6 +183,38 @@ def bench_json_findings(repo: Path) -> List[Finding]:
       "(no NaN/Infinity constants) and match their record schema")
 def _check_bench_json(ctx: LintContext) -> Iterable[Finding]:
     return bench_json_findings(ctx.repo)
+
+
+@rule("program-contract",
+      "program-level contracts of the compiled inventory (donation "
+      "leaks, recompile hazards, callbacks under a mesh, per-program "
+      "collective budgets) via deap-tpu-analyze (heavy: lowers the "
+      "inventory on an 8-device virtual mesh; select explicitly)",
+      default=False)
+def _check_program_contract(ctx: LintContext) -> Iterable[Finding]:
+    """Framework registration of :mod:`deap_tpu.analysis` — like
+    ``collective-budget``, it shells out so that even selecting it never
+    imports jax into the linting process.  The subprocess's JSON
+    findings re-surface here with their sub-rule folded into the
+    message, so they ride the same reporters/baseline machinery as
+    every AST finding."""
+    out = subprocess.run(
+        [sys.executable, "-m", "deap_tpu.analysis.cli", "--format",
+         "json"],
+        capture_output=True, text=True, timeout=600, cwd=str(ctx.repo))
+    try:
+        report = json.loads(out.stdout)
+    except ValueError:
+        tail = (out.stderr or out.stdout).strip().splitlines()[-3:]
+        yield Finding(rule="program-contract",
+                      path="deap_tpu/analysis", line=1,
+                      message=("program-contract analyzer failed (rc="
+                               f"{out.returncode}): " + "; ".join(tail)))
+        return
+    for f in report.get("findings", []):
+        yield Finding(rule="program-contract", path=f["path"],
+                      line=int(f.get("line", 1)),
+                      message=f"[{f['rule']}] {f['message']}")
 
 
 @rule("collective-budget",

@@ -712,8 +712,12 @@ def rng_key_reuse_findings(pf: PyFile) -> List[Finding]:
     bits, silently correlating whatever they feed.  ``fold_in`` and key
     constructors don't consume.  Branches are analyzed independently
     (an if/else that each consume the key once is fine); a consumption
-    inside a loop whose key is never rebound in the loop body fires the
-    every-iteration form of the bug."""
+    inside a loop whose key is never rebound per iteration fires the
+    every-iteration form of the bug.  Rebinding is recognized both in
+    the loop body (the ``key, sub = jax.random.split(key)`` tuple-unpack
+    idiom consumes and retires the key in one statement) and in the loop
+    statement's own targets (``for k in jax.random.split(key, n):``
+    rebinds ``k`` every iteration)."""
     findings: List[Finding] = []
     jn = jax_names(pf)
     if not (jn.jax or jn.jax_random or jn.jax_random_funcs):
@@ -728,7 +732,7 @@ def rng_key_reuse_findings(pf: PyFile) -> List[Finding]:
         return sorted(calls, key=lambda c: (c.lineno, c.col_offset))
 
     def consume(call: ast.Call, consumed: Dict[str, str], scope: str,
-                loop_ctx: Optional[Sequence[ast.stmt]]) -> None:
+                loop_ctx: Optional[ast.stmt]) -> None:
         fname = _jax_random_func_of(call.func, jn)
         if fname is None or fname in _NONCONSUMING:
             return
@@ -745,7 +749,14 @@ def rng_key_reuse_findings(pf: PyFile) -> List[Finding]:
                          "key replays the same bits (split or fold_in "
                          "first)")))
         elif loop_ctx is not None:
-            rebound = _rebound_in(loop_ctx)
+            # a per-iteration rebinding retires the key: anything bound
+            # in the loop BODY (the `key, sub = jax.random.split(key)`
+            # tuple-unpack rebind idiom included) — and the loop
+            # statement's OWN targets, which rebind on every iteration
+            # too (`for k in jax.random.split(key, n): use(k)` is the
+            # canonical iterate-over-subkeys idiom, not a reuse)
+            rebound = (_rebound_in(loop_ctx.body)
+                       | _assigned_names(loop_ctx))
             if keyname not in rebound:
                 findings.append(Finding(
                     rule="rng-key-reuse", path=pf.rel, line=call.lineno,
@@ -759,7 +770,7 @@ def rng_key_reuse_findings(pf: PyFile) -> List[Finding]:
         consumed[keyname] = fname
 
     def walk(stmts: Sequence[ast.stmt], consumed: Dict[str, str],
-             scope: str, loop_ctx: Optional[Sequence[ast.stmt]]) -> bool:
+             scope: str, loop_ctx: Optional[ast.stmt]) -> bool:
         """Analyze ``stmts`` in order, mutating ``consumed``.  Returns
         True when control cannot fall off the end (return/raise/break/
         continue) — a terminated branch's consumption never merges into
@@ -802,7 +813,7 @@ def rng_key_reuse_findings(pf: PyFile) -> List[Finding]:
                 inner = dict(consumed)
                 for t in _assigned_names(stmt):
                     inner.pop(t, None)
-                walk(stmt.body, inner, scope, stmt.body)
+                walk(stmt.body, inner, scope, stmt)
                 walk(stmt.orelse, consumed, scope, loop_ctx)
                 consumed.update(inner)
                 continue

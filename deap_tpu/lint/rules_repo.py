@@ -40,6 +40,7 @@ SANCTIONED_PRINT_MODULES = {
     "resilience/faultdrill.py",
     "native/build.py",
     "lint/cli.py",
+    "analysis/cli.py",
 }
 
 
@@ -320,10 +321,31 @@ def _written_guarded_attrs(stmt: ast.stmt, guarded: Set[str]
     return hits
 
 
+def _lock_aliases(meth: ast.AST, decl: Dict[str, Set[str]]) -> Dict[str, str]:
+    """Local names bound to a registered lock inside ``meth``:
+    ``cv = self._cv`` makes ``with cv:`` hold ``_cv``.  The alias map is
+    a per-method prescan (statement order is not tracked: aliasing a
+    lock and then rebinding the name to something else in the same
+    method is pathological, and treating the name as the lock errs on
+    the quiet side only for that pathology)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(meth):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        lock = _self_attr(node.value)
+        if lock in decl:
+            aliases[node.targets[0].id] = lock
+    return aliases
+
+
 def lock_discipline_findings(tree: ast.AST, path: str) -> List[Finding]:
     """Enforce every ``_GUARDED_BY`` declaration in ``tree``: a write to
     a registered attribute outside a ``with self.<its lock>:`` block is
-    a finding.  Exemptions, by convention:
+    a finding.  ``with`` context expressions are resolved through lock
+    aliasing — ``cv = self._cv`` followed by ``with cv:`` holds ``_cv``
+    (the dispatcher-style local-alias idiom).  Exemptions, by
+    convention:
 
     * ``__init__`` — construction precedes publication to other threads;
     * methods whose name ends ``_locked`` — the caller holds the lock
@@ -341,6 +363,7 @@ def lock_discipline_findings(tree: ast.AST, path: str) -> List[Finding]:
                 continue
             if meth.name == "__init__" or meth.name.endswith("_locked"):
                 continue
+            aliases = _lock_aliases(meth, decl)
 
             def walk(stmts: List[ast.stmt], held: Set[str]) -> None:
                 for stmt in stmts:
@@ -362,6 +385,9 @@ def lock_discipline_findings(tree: ast.AST, path: str) -> List[Finding]:
                     if isinstance(stmt, (ast.With, ast.AsyncWith)):
                         for item in stmt.items:
                             a = _self_attr(item.context_expr)
+                            if a is None and isinstance(item.context_expr,
+                                                        ast.Name):
+                                a = aliases.get(item.context_expr.id)
                             if a in decl:
                                 now = now | {a}
                         walk(stmt.body, now)

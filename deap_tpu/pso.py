@@ -69,8 +69,13 @@ def pso_init(key, n: int, dim: int, pmin: float, pmax: float,
     pos = jax.random.uniform(kp, (n, dim), minval=pmin, maxval=pmax)
     spd = jax.random.uniform(ks, (n, dim), minval=smin, maxval=smax)
     return PSOState(position=pos, speed=spd, pbest=pos,
-                    pbest_w=jnp.full((n,), -jnp.inf),
-                    gbest=pos[0], gbest_w=jnp.array(-jnp.inf))
+                    # explicit dtype: a bare float fill traces weak-typed,
+                    # and the first strong-f32 value fed back here (e.g. a
+                    # checkpoint restore) would fork a recompile — pinned
+                    # by the program-contract recompile-hazard pass
+                    pbest_w=jnp.full((n,), -jnp.inf, jnp.float32),
+                    gbest=pos[0],
+                    gbest_w=jnp.asarray(-jnp.inf, jnp.float32))
 
 
 def pso_step(key, state: PSOState, evaluate: Callable, weights=(-1.0,),
@@ -192,8 +197,9 @@ def multiswarm_init(key, nswarm: int, nparticle: int, dim: int,
     act = jnp.arange(nswarm) < (nswarm if active is None else active)
     return MultiswarmState(
         position=pos, speed=spd, pbest=pos,
-        pbest_w=jnp.full((nswarm, nparticle), -jnp.inf),
-        sbest=pos[:, 0], sbest_w=jnp.full((nswarm,), -jnp.inf),
+        pbest_w=jnp.full((nswarm, nparticle), -jnp.inf, jnp.float32),
+        sbest=pos[:, 0],
+        sbest_w=jnp.full((nswarm,), -jnp.inf, jnp.float32),
         active=act)
 
 

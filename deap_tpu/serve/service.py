@@ -66,7 +66,7 @@ from .dispatcher import (BatchDispatcher, Request, ServeFuture, ServeError,
                          ServiceClosed, ServiceDraining)
 from .metrics import ServeMetrics
 
-__all__ = ["EvolutionService", "Session"]
+__all__ = ["EvolutionService", "Session", "build_slot_program"]
 
 
 def _stack(trees):
@@ -90,6 +90,69 @@ def _as_raw_key(key) -> jax.Array:
     if jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key):
         return jax.random.key_data(key)
     return key.astype(jnp.uint32)
+
+
+def build_slot_program(kind: str, toolbox, weights: tuple,
+                       vmapped: bool = True):
+    """Request-kind program over one session state dict (the operand
+    pytree ``EvolutionService._make_state`` builds: ``key``/``genome``/
+    ``values``/``valid``/``live_n``/``cxpb``/``mutpb``).  ``vmapped``
+    (default) wraps it over the slot axis for microbatching;
+    ``vmapped=False`` is the pop-sharded form — the same per-session
+    computation dispatched alone so GSPMD partitions its pop axis over
+    the mesh instead of a slot axis over sessions.
+
+    Module-level (not a service method) so the program-contract analyzer
+    (:mod:`deap_tpu.analysis`) lowers the *same* executables the service
+    dispatches — an inventory copy of this builder would silently drift.
+    Note the trajectory knobs (``cxpb``/``mutpb``) and the key ride in
+    the state as **operands**: baking either as a Python constant would
+    fork one compile per distinct value across sessions, which the
+    analyzer's recompile-hazard variant diff pins."""
+    maybe_vmap = jax.vmap if vmapped else (lambda f: f)
+
+    def as_population(state):
+        return Population(state["genome"],
+                          Fitness(values=state["values"],
+                                  valid=state["valid"], weights=weights))
+
+    def live_of(state):
+        return jnp.arange(state["valid"].shape[0]) < state["live_n"]
+
+    def pack(state, pop):
+        return {**state, "genome": pop.genome,
+                "values": pop.fitness.values, "valid": pop.fitness.valid}
+
+    if kind == "step":
+        def one(state):
+            key, pop, nevals = ea_step(
+                state["key"], as_population(state), toolbox,
+                state["cxpb"], state["mutpb"], live=live_of(state))
+            return {**pack(state, pop), "key": key}, nevals
+        return maybe_vmap(one)
+    if kind == "init":
+        def one(state):
+            pop, nevals = ea_tell(toolbox, as_population(state),
+                                  live=live_of(state))
+            return pack(state, pop), nevals
+        return maybe_vmap(one)
+    if kind == "ask":
+        def one(state):
+            key, off = ea_ask(state["key"], as_population(state),
+                              toolbox, state["cxpb"], state["mutpb"],
+                              live=live_of(state))
+            return ({**state, "key": key}, off.genome,
+                    off.fitness.values, off.fitness.valid)
+        return maybe_vmap(one)
+    if kind == "tell":
+        def one(state, pending, values):
+            pg, pv, pvalid = pending
+            pop, nevals = ea_tell(
+                toolbox, Population(pg, Fitness(pv, pvalid, weights)),
+                values, live=live_of(state))
+            return pack(state, pop), nevals
+        return maybe_vmap(one)
+    raise ValueError(f"unknown slot program kind {kind!r}")
 
 
 class Session:
@@ -758,55 +821,7 @@ class EvolutionService:
 
     def _build_slot_program(self, kind: str, toolbox, weights: tuple,
                             vmapped: bool = True):
-        """Request-kind program over one session state.  ``vmapped``
-        (default) wraps it over the slot axis for microbatching;
-        ``vmapped=False`` is the pop-sharded form — the same per-session
-        computation dispatched alone so GSPMD partitions its pop axis over
-        the mesh instead of a slot axis over sessions."""
-        maybe_vmap = jax.vmap if vmapped else (lambda f: f)
-
-        def as_population(state):
-            return Population(state["genome"],
-                              Fitness(values=state["values"],
-                                      valid=state["valid"], weights=weights))
-
-        def live_of(state):
-            return jnp.arange(state["valid"].shape[0]) < state["live_n"]
-
-        def pack(state, pop):
-            return {**state, "genome": pop.genome,
-                    "values": pop.fitness.values, "valid": pop.fitness.valid}
-
-        if kind == "step":
-            def one(state):
-                key, pop, nevals = ea_step(
-                    state["key"], as_population(state), toolbox,
-                    state["cxpb"], state["mutpb"], live=live_of(state))
-                return {**pack(state, pop), "key": key}, nevals
-            return maybe_vmap(one)
-        if kind == "init":
-            def one(state):
-                pop, nevals = ea_tell(toolbox, as_population(state),
-                                      live=live_of(state))
-                return pack(state, pop), nevals
-            return maybe_vmap(one)
-        if kind == "ask":
-            def one(state):
-                key, off = ea_ask(state["key"], as_population(state),
-                                  toolbox, state["cxpb"], state["mutpb"],
-                                  live=live_of(state))
-                return ({**state, "key": key}, off.genome,
-                        off.fitness.values, off.fitness.valid)
-            return maybe_vmap(one)
-        if kind == "tell":
-            def one(state, pending, values):
-                pg, pv, pvalid = pending
-                pop, nevals = ea_tell(
-                    toolbox, Population(pg, Fitness(pv, pvalid, weights)),
-                    values, live=live_of(state))
-                return pack(state, pop), nevals
-            return maybe_vmap(one)
-        raise ValueError(f"unknown slot program kind {kind!r}")
+        return build_slot_program(kind, toolbox, weights, vmapped=vmapped)
 
     def _build_evaluate_program(self, evaluate, flat_dim: int):
         dedup = flat_dim <= self.dedup_max_flat_dim

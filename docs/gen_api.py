@@ -94,6 +94,9 @@ PAGES = {
               "deap_tpu.lint.reporters", "deap_tpu.lint.rules_repo",
               "deap_tpu.lint.rules_jax", "deap_tpu.lint.rules_data",
               "deap_tpu.lint.cli"]),
+    "analysis": ("Program-contract analyzer (deap_tpu.analysis)",
+                 ["deap_tpu.analysis.hlo", "deap_tpu.analysis.inventory",
+                  "deap_tpu.analysis.passes", "deap_tpu.analysis.cli"]),
 }
 
 

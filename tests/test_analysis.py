@@ -1,0 +1,295 @@
+"""Program-contract analyzer (``deap_tpu.analysis``) — the tier-1 gate
+over the compiled-program inventory plus a *can-fail* fixture per pass
+(a checker that can't fail is not a gate).
+
+The gate lowers every inventory entry in-process (jax is already up on
+the suite's 8-virtual-device CPU mesh) and must come back clean: any
+donation leak, recompile hazard, callback-under-mesh, or collective
+budget excess on a canonical program fails tier-1.
+"""
+
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deap_tpu.analysis import hlo
+from deap_tpu.analysis.inventory import (INVENTORY, Lowered, ProgramEntry,
+                                         entries, lower_entry)
+from deap_tpu.analysis.passes import (DONATION_MIN_BYTES, PASS_NAMES,
+                                      budget_findings, callback_findings,
+                                      compare_budget, donation_findings,
+                                      measure_budget_counts,
+                                      recompile_findings, run_analysis,
+                                      update_program_budget)
+
+
+# ---------------------------------------------------------------------------
+# THE gate
+# ---------------------------------------------------------------------------
+
+
+def test_program_contract_gate():
+    """Lower the whole inventory and run every pass: the canonical
+    programs must satisfy every contract — no donation leaks, no
+    recompile hazards, no callbacks under a mesh, collective counts
+    within tools/program_budget.json."""
+    result = run_analysis()
+    assert len(result.programs) >= 8, \
+        f"inventory shrank to {result.programs}"
+    assert sorted(result.passes_run) == sorted(PASS_NAMES)
+    assert result.findings == [], "\n".join(
+        f"{f.rule}: {f.message}" for f in result.findings)
+    # the serve executables' donation waiver is honored *visibly*
+    assert "serve_step_sharded" in result.waived
+
+
+def test_inventory_covers_the_named_surfaces():
+    """The acceptance surface: the hot GA scan, serve sharded-session
+    executables, both sharded NSGA-II variants, the GP interpreter, and
+    the strategy heads are all named programs."""
+    names = {e.name for e in INVENTORY}
+    assert {"ga_generation_scan", "serve_step_slots", "serve_step_sharded",
+            "serve_nsga2_sharded_session", "nsga2_sharded_indices",
+            "nsga2_sharded_rows", "gp_interp", "cma_update", "de_step",
+            "pso_step"} <= names
+
+
+def test_ga_scan_actually_donates():
+    """The ROADMAP raw-speed contract, pinned at the artifact level: the
+    flagship generation scan's lowered module aliases every declared
+    donated input (key, genome, fitness) to an output."""
+    low = lower_entry(entries(["ga_generation_scan"])[0])
+    assert hlo.aliased_parameters(low.text) == {0, 1, 2}
+
+
+# ---------------------------------------------------------------------------
+# donation-leak (can-fail)
+# ---------------------------------------------------------------------------
+
+
+def _entry(build, name="fixture", **kw) -> ProgramEntry:
+    return ProgramEntry(name=name, anchor="tests/fixture.py",
+                        build=build, **kw)
+
+
+def _carry_fixture(variant: int = 0):
+    def fn(x):
+        return x * 2.0 + 1.0
+    return fn, (jnp.zeros((64, 8), jnp.float32) + variant,)
+
+
+def test_donation_leak_fires_and_fix_clears_it():
+    leak = _entry(_carry_fixture)
+    f = list(donation_findings(lower_entry(leak)))
+    assert len(f) == 1 and "donate_argnums=(0,)" in f[0].message
+    fixed = _entry(_carry_fixture, donate=(0,))
+    assert list(donation_findings(lower_entry(fixed))) == []
+    waived = _entry(_carry_fixture, donate_waiver="caller re-reads x")
+    assert list(donation_findings(lower_entry(waived))) == []
+
+
+def test_donation_below_threshold_not_flagged():
+    def build(variant: int = 0):
+        def fn(x):
+            return x + 1.0
+        return fn, (jnp.zeros((4,), jnp.float32),)   # 16 bytes
+    assert 16 < DONATION_MIN_BYTES
+    assert list(donation_findings(lower_entry(_entry(build)))) == []
+
+
+def test_declared_donation_that_never_takes_is_flagged():
+    """donate_argnums pointing at an input no output can alias: jax only
+    warns at compile time on the production box — the pass fails the
+    gate instead."""
+    def build(variant: int = 0):
+        def fn(x):
+            return jnp.sum(x)                        # (64,8) -> scalar
+        return fn, (jnp.zeros((64, 8), jnp.float32),)
+    with pytest.warns(UserWarning, match="donated buffers"):
+        low = lower_entry(_entry(build, donate=(0,)))
+    f = list(donation_findings(low))
+    assert len(f) == 1 and "does not take effect" in f[0].message
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard (can-fail)
+# ---------------------------------------------------------------------------
+
+
+def test_dead_big_donation_not_hidden_by_small_alias():
+    """A LARGE donated leaf whose alias stopped lowering must be flagged
+    even when a small donated sibling still aliases — the audit is per
+    leaf, not an aggregate marker count."""
+    def build(variant: int = 0):
+        def fn(d):
+            # counter round-trips (aliases); genome collapses to a
+            # scalar (its donation cannot take effect)
+            return {"c": d["c"] + 1, "s": jnp.sum(d["g"])}
+        return fn, ({"c": jnp.zeros((4,), jnp.int32),
+                     "g": jnp.zeros((64, 8), jnp.float32)},)
+    with pytest.warns(UserWarning, match="donated buffers"):
+        low = lower_entry(_entry(build, donate=(0,)))
+    f = list(donation_findings(low))
+    assert len(f) == 1 and "does not take effect" in f[0].message
+    assert "[1]" in f[0].message    # the genome's flat parameter index
+
+
+def test_weak_type_operand_flagged():
+    def build(variant: int = 0):
+        def fn(x, s):
+            return x * s
+        return fn, (jnp.zeros((8,), jnp.float32), 2.0)   # bare scalar
+    f = list(recompile_findings(lower_entry(_entry(build))))
+    assert len(f) == 1 and "weak-typed" in f[0].message
+
+
+def test_baked_literal_flagged_and_operand_form_clean():
+    def baked(variant: int = 0):
+        scale = 0.5 + 0.25 * variant          # python value baked in
+        def fn(x):
+            return x * scale
+        return fn, (jnp.zeros((8,), jnp.float32),)
+
+    def operand(variant: int = 0):
+        def fn(x, scale):
+            return x * scale
+        return fn, (jnp.zeros((8,), jnp.float32),
+                    jnp.asarray(0.5 + 0.25 * variant, jnp.float32))
+
+    e = _entry(baked)
+    f = list(recompile_findings(lower_entry(e), lower_entry(e, variant=1)))
+    assert len(f) == 1 and "baked into the program" in f[0].message
+    e2 = _entry(operand)
+    assert list(recompile_findings(lower_entry(e2),
+                                   lower_entry(e2, variant=1))) == []
+
+
+def test_nonhashable_static_arg_flagged():
+    def fn(x, cfg):
+        return x
+    entry = _entry(lambda variant=0: (fn, (jnp.zeros((4,)), [1, 2])),
+                   static_argnums=(1,))
+    low = Lowered(entry=entry, fn=fn, args=(jnp.zeros((4,)), [1, 2]),
+                  lowered=None, text="")
+    f = list(recompile_findings(low))
+    assert len(f) == 1 and "not hashable" in f[0].message
+
+
+# ---------------------------------------------------------------------------
+# callback-in-sharded-program (can-fail)
+# ---------------------------------------------------------------------------
+
+
+def _callback_fixture(variant: int = 0):
+    from jax.experimental import io_callback
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()[:8]), ("d",))
+
+    def fn(x):
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P("d")))
+        io_callback(lambda v: None, None, jnp.sum(x), ordered=True)
+        return x * 2
+    return fn, (jnp.zeros((16,), jnp.float32),)
+
+
+def test_callback_under_mesh_flagged():
+    f = list(callback_findings(lower_entry(
+        _entry(_callback_fixture, mesh=True,
+               donate_waiver="fixture"))))
+    assert len(f) == 1 and "callback" in f[0].message
+    # opt-in entries and single-device programs are not flagged
+    ok = _entry(_callback_fixture, mesh=True, callback_ok=True,
+                donate_waiver="fixture")
+    assert list(callback_findings(lower_entry(ok))) == []
+    single = _entry(_callback_fixture, donate_waiver="fixture")
+    assert list(callback_findings(lower_entry(single))) == []
+
+
+# ---------------------------------------------------------------------------
+# program-budget (can-fail, pure comparison + roundtrip)
+# ---------------------------------------------------------------------------
+
+
+def test_budget_compare_semantics():
+    budget = {"prog": {"all-gather": 4}}
+    bad = compare_budget({"prog": {"all-gather": 4, "all-reduce": 2}},
+                         budget)
+    assert len(bad) == 1 and "all-reduce" in bad[0]
+    assert compare_budget({"prog": {"all-gather": 3}}, budget) == []
+    assert compare_budget({"new_prog": {"all-gather": 1}}, {}) \
+        == ["new_prog: all-gather x1 exceeds budget 0"]
+
+
+def _fake_budget_low(name: str, compiled: str) -> Lowered:
+    entry = ProgramEntry(name=name, anchor="tests/fixture.py",
+                        build=lambda variant=0: (None, ()), budget=True)
+    return Lowered(entry=entry, fn=None, args=(), lowered=None, text="",
+                   _compiled_text=compiled)
+
+
+def test_budget_findings_and_update_roundtrip(tmp_path):
+    low = _fake_budget_low(
+        "prog", '  %ag = all-gather(%x)\n  %ar = all-reduce-start(%y)\n')
+    assert measure_budget_counts([low]) == \
+        {"prog": {"all-gather": 1, "all-reduce": 1}}
+    path = tmp_path / "program_budget.json"
+    update_program_budget(path, lows=[low])
+    doc = json.loads(path.read_text())
+    assert doc["budget"] == {"prog": {"all-gather": 1, "all-reduce": 1}}
+    assert list(budget_findings([low], path=path)) == []
+    # a regression (an extra collective) fails against the committed file
+    worse = _fake_budget_low(
+        "prog", "all-gather(\nall-gather(\nall-reduce-start(\n")
+    f = list(budget_findings([worse], path=path))
+    assert len(f) == 1 and "all-gather x2 exceeds budget 1" in f[0].message
+    # an unreadable budget is a finding, not a crash
+    f = list(budget_findings([low], path=tmp_path / "missing.json"))
+    assert len(f) == 1 and "cannot read" in f[0].message
+
+
+# ---------------------------------------------------------------------------
+# hlo text analyzers
+# ---------------------------------------------------------------------------
+
+
+def test_collective_counting_rule():
+    txt = ("%a = all-gather(%x)\n"
+           "%b = all-reduce-start(%y)\n"
+           "%c = all-reduce-done(%b)\n"          # not a definition
+           "%d = add(%a, %all-gather.3)\n")      # operand ref, not a def
+    assert hlo.collective_ops(txt) == {"all-gather": 1, "all-reduce": 1}
+
+
+def test_aliased_parameter_parsing():
+    txt = ('func.func public @main(%arg0: tensor<2xui32> '
+           '{tf.aliasing_output = 0 : i32}, %arg1: tensor<4xf32>, '
+           '%arg2: tensor<4xf32> {tf.aliasing_output = 2 : i32}) '
+           '-> (tensor<2xui32>) {')
+    assert hlo.aliased_parameters(txt) == {0, 2}
+    assert hlo.parameter_count(txt) == 3
+
+
+def test_normalize_strips_process_noise():
+    a = 'stablehlo.custom_call @cb(%x) {backend_config = "9415852739"}'
+    b = 'stablehlo.custom_call @cb(%x) {backend_config = "812340577"}'
+    assert hlo.normalize_stablehlo(a) == hlo.normalize_stablehlo(b)
+
+
+def test_unknown_entry_and_pass_raise():
+    with pytest.raises(KeyError):
+        entries(["not_a_program"])
+    with pytest.raises(KeyError):
+        run_analysis(select=["not-a-pass"])
+
+
+def test_update_budget_refuses_partial_runs(capsys):
+    """A partial measurement must not rewrite the whole committed
+    budget (same contract as deap-tpu-lint --update-baseline)."""
+    from deap_tpu.analysis.cli import main
+    assert main(["serve_step_sharded", "--update-budget"]) == 2
+    assert main(["--select", "program-budget", "--update-budget"]) == 2
+    assert "full run" in capsys.readouterr().err

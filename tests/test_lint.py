@@ -649,3 +649,273 @@ def test_bench_json_trace_schema(tmp_path):
     assert "'untraced' must be an object" in msgs
     assert "roundtrip_p50_ms' must be a finite number" in msgs
     assert "non-finite number must not be committed as a string" in msgs
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10 satellites: lock aliasing, loop-target key rebinds, reporter
+# snapshots, --changed mode, program-contract registration
+
+
+def test_lock_discipline_honors_lock_alias(tmp_path):
+    """The dispatcher-style local alias: ``cv = self._cv`` followed by
+    ``with cv:`` holds the registered lock — guarded writes under the
+    alias must not flag, while writes under an unrelated name still do."""
+    _write(tmp_path, "deap_tpu/serve/aliasy.py", """\
+        import threading
+
+        class Dispatcher:
+            _GUARDED_BY = {"_cv": ("_pending", "_closed")}
+
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._pending = []
+                self._closed = False
+
+            def drain(self):
+                cv = self._cv
+                with cv:
+                    self._pending.clear()
+                    self._closed = True
+
+            def bad(self):
+                other = self._unrelated
+                with other:
+                    self._pending.append(1)
+        """)
+    r = _findings(tmp_path, "lock-discipline")
+    assert [(f.line,) for f in r.findings] == [(20,)], \
+        render_text(r)
+    assert "_pending" in r.findings[0].message
+
+
+def test_rng_key_reuse_loop_target_rebind_is_clean(tmp_path):
+    """The iterate-over-subkeys idioms: the loop statement's own target
+    rebinds the key every iteration — ``for k in jax.random.split(key,
+    n):`` (incl. the shadowing and zip/enumerate spellings) and the
+    ``key, sub = jax.random.split(key)`` tuple-unpack rebind must stay
+    clean, while a genuinely unrebound loop key still fires."""
+    _write(tmp_path, "deap_tpu/keys.py", """\
+        import jax
+
+        def iter_subkeys(key):
+            for k in jax.random.split(key, 4):
+                jax.random.uniform(k)
+
+        def iter_shadow(key):
+            for key in jax.random.split(key, 4):
+                jax.random.uniform(key)
+
+        def zip_subkeys(key, xs):
+            for x, k in zip(xs, jax.random.split(key, 4)):
+                jax.random.normal(k, (2,))
+
+        def unpack_rebind(key):
+            for i in range(4):
+                key, sub = jax.random.split(key)
+                jax.random.uniform(sub)
+        """)
+    r = _findings(tmp_path, "rng-key-reuse")
+    assert r.findings == [], render_text(r)
+    _write(tmp_path, "deap_tpu/badkeys.py", """\
+        import jax
+
+        def loop_no_rebind(key):
+            for i in range(4):
+                jax.random.uniform(key)
+        """)
+    r = _findings(tmp_path, "rng-key-reuse")
+    assert [(f.path, f.line) for f in r.findings] == \
+        [("deap_tpu/badkeys.py", 5)]
+
+
+def _multi_rule_fixture(tmp_path):
+    """A fixture repo firing three different rules at known lines."""
+    _write(tmp_path, "deap_tpu/serve/net/__init__.py", "")
+    _write(tmp_path, "deap_tpu/multi.py", """\
+        import jax
+        print("hello")
+        a = jax.random.normal(jax.random.PRNGKey(0), (3,))
+        key = jax.random.PRNGKey(1)
+        b = jax.random.normal(key, (3,))
+        c = jax.random.normal(key, (3,))
+        """)
+    _write(tmp_path, "deap_tpu/serve/sleepy.py",
+           "import time\ndef f():\n    time.sleep(1)\n")
+
+
+def test_reporter_snapshot_multi_rule(tmp_path):
+    """Snapshot of all three reporters over a multi-rule fixture: the
+    same findings render consistently as text lines, JSON records, and
+    SARIF results (rule metadata included for every fired rule)."""
+    _multi_rule_fixture(tmp_path)
+    r = run_lint(repo=tmp_path)
+    fired = {f.rule for f in r.findings}
+    assert {"no-bare-print", "rng-key-reuse", "no-blocking-sleep"} <= fired
+
+    text = render_text(r)
+    assert "deap_tpu/multi.py:2: [no-bare-print] error:" in text
+    assert "deap_tpu/multi.py:6: [rng-key-reuse] error:" in text
+    assert "deap_tpu/serve/sleepy.py:3: [no-blocking-sleep] error:" in text
+    assert f"{len(r.findings)} finding(s)" in text
+
+    doc = render_json(r)
+    assert doc["summary"]["findings"] == len(r.findings)
+    by_rule = {}
+    for f in doc["findings"]:
+        by_rule.setdefault(f["rule"], []).append(f)
+    assert by_rule["no-bare-print"][0]["line"] == 2
+
+    sarif = render_sarif(r)
+    results = sarif["runs"][0]["results"]
+    assert len(results) == len(r.findings)
+    rules = sarif["runs"][0]["tool"]["driver"]["rules"]
+    for res in results:
+        assert rules[res["ruleIndex"]]["id"] == res["ruleId"]
+        assert res["fingerprints"]["deapTpuLint/v1"]
+    json.dumps(sarif)
+
+
+def test_sarif_level_maps_severity():
+    """SARIF ``level`` follows finding severity (error/warning), with
+    unknown severities conservatively mapped to error."""
+    from deap_tpu.lint.core import LintResult
+    findings = [Finding(rule="no-bare-print", path="a.py", line=1,
+                        message="m", severity="error"),
+                Finding(rule="no-bare-print", path="a.py", line=2,
+                        message="w", severity="warning"),
+                Finding(rule="no-bare-print", path="a.py", line=3,
+                        message="x", severity="odd")]
+    r = LintResult(findings=findings, suppressed=[], baselined=[],
+                   expired=[], rules_run=["no-bare-print"],
+                   files_scanned=1)
+    levels = [res["level"] for res in render_sarif(r)["runs"][0]["results"]]
+    assert levels == ["error", "warning", "error"]
+
+
+def test_fingerprints_stable_across_line_shift(tmp_path):
+    """The baseline contract at reporter level: shifting a finding down
+    the file (a neighbor edit) changes its line but not its fingerprint,
+    in both JSON and SARIF output."""
+    _multi_rule_fixture(tmp_path)
+    before = {(f["rule"], f["fingerprint"])
+              for f in render_json(run_lint(repo=tmp_path))["findings"]}
+    path = tmp_path / "deap_tpu" / "multi.py"
+    path.write_text("# shifted\n# shifted again\n" + path.read_text())
+    after_doc = render_json(run_lint(repo=tmp_path))
+    after = {(f["rule"], f["fingerprint"]) for f in after_doc["findings"]}
+    assert before == after
+    assert any(f["path"] == "deap_tpu/multi.py" and f["line"] == 4
+               for f in after_doc["findings"])   # lines DID move
+
+
+def test_changed_mode_lists_git_touched_files(tmp_path):
+    """``--changed`` restricts the scan to git-touched .py files: one
+    modified tracked file + one untracked file, with deletions and
+    clean files excluded."""
+    import subprocess as sp
+    from deap_tpu.lint.cli import changed_py_files
+
+    def git(*args):
+        sp.run(["git", *args], cwd=tmp_path, check=True,
+               capture_output=True)
+
+    git("init", "-q")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    _write(tmp_path, "clean.py", "x = 1\n")
+    _write(tmp_path, "touched.py", "y = 1\n")
+    _write(tmp_path, "doomed.py", "z = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    (tmp_path / "touched.py").write_text("y = 2\n")
+    (tmp_path / "doomed.py").unlink()
+    _write(tmp_path, "fresh.py", "w = 1\n")
+    _write(tmp_path, "notes.txt", "not python\n")
+    rels = [p.name for p in changed_py_files(tmp_path)]
+    assert rels == ["fresh.py", "touched.py"]
+    # outside a work tree the helper raises (the CLI maps it to rc=2)
+    with pytest.raises(RuntimeError):
+        changed_py_files(tmp_path / "nowhere")
+
+
+def test_changed_mode_cli_and_guards(tmp_path):
+    """--changed end-to-end against a HERMETIC fixture repo (never the
+    developer's live working tree): a touched violation fails, a clean
+    tree exits 0 — emitting a format-faithful empty JSON document, not a
+    text line — and combining --changed with explicit paths is a usage
+    error."""
+    import subprocess as sp
+
+    def git(*args):
+        sp.run(["git", *args], cwd=tmp_path, check=True,
+               capture_output=True)
+
+    git("init", "-q")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    _write(tmp_path, "deap_tpu/clean.py", "x = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+
+    def cli(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "deap_tpu.lint.cli", "--changed",
+             "--repo", str(tmp_path), *extra],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+
+    # clean tree: rc 0; --format json still emits a JSON document
+    out = cli()
+    assert out.returncode == 0 and "no git-touched" in out.stdout
+    out = cli("--format", "json")
+    assert out.returncode == 0
+    assert json.loads(out.stdout)["summary"]["findings"] == 0
+
+    # a touched violation fails
+    _write(tmp_path, "deap_tpu/dirty.py", 'print("oops")\n')
+    out = cli()
+    assert out.returncode == 1 and "no-bare-print" in out.stdout
+
+    out = cli("deap_tpu")
+    assert out.returncode == 2 and "mutually exclusive" in out.stderr
+
+
+def test_program_contract_rule_registered_opt_in():
+    """The program-contract analyzer rides the lint framework as its
+    second heavy opt-in pass: registered, default-off, and its doc names
+    deap-tpu-analyze (running it needs jax, via subprocess)."""
+    rule = get_rule("program-contract")
+    assert rule.default is False
+    assert "deap-tpu-analyze" in rule.doc
+
+
+def test_path_restricted_run_does_not_expire_unscanned_baseline(tmp_path):
+    """A partial scan (--changed / explicit paths) cannot tell whether a
+    baseline entry in an UNSCANNED file still fires: it must not report
+    it expired (a pre-commit loop would otherwise nag --update-baseline
+    over files it never looked at).  A full run still expires entries
+    for real, including those whose file was deleted."""
+    _write(tmp_path, "deap_tpu/old.py", 'print("grandfathered")\n')
+    _write(tmp_path, "deap_tpu/fresh.py", "x = 1\n")
+    full = run_lint(repo=tmp_path, select=["no-bare-print"])
+    write_baseline(full.findings, tmp_path / "baseline.json")
+    from deap_tpu.lint import load_baseline
+    bl = load_baseline(tmp_path / "baseline.json")
+
+    partial = run_lint(repo=tmp_path, select=["no-bare-print"],
+                       paths=[tmp_path / "deap_tpu" / "fresh.py"],
+                       baseline=bl)
+    assert partial.findings == [] and partial.expired == [], \
+        "unscanned file's baseline entry reported expired"
+
+    # scanned-and-fixed still expires on a partial run of THAT file
+    (tmp_path / "deap_tpu" / "old.py").write_text("x = 2\n")
+    partial2 = run_lint(repo=tmp_path, select=["no-bare-print"],
+                        paths=[tmp_path / "deap_tpu" / "old.py"],
+                        baseline=bl)
+    assert len(partial2.expired) == 1
+
+    # full run over a deleted file also expires (the filter must not
+    # suppress whole-repo expiry)
+    (tmp_path / "deap_tpu" / "old.py").unlink()
+    whole = run_lint(repo=tmp_path, select=["no-bare-print"], baseline=bl)
+    assert len(whole.expired) == 1

@@ -38,6 +38,8 @@ def test_lint_gate():
             "tracer-leak", "bench-json"} <= set(report["summary"]["rules_run"])
     assert "collective-budget" not in report["summary"]["rules_run"], \
         "the heavy lowering pass must not run in the default gate"
+    assert "program-contract" not in report["summary"]["rules_run"], \
+        "the program-contract analyzer must not run in the default gate"
     assert wall < 10.0, f"lint gate took {wall:.1f}s (budget 10s)"
 
 
@@ -181,6 +183,34 @@ def test_lint_entry_and_baseline_wired():
     assert os.path.exists(DEFAULT_BASELINE), \
         "tools/lint_baseline.json must be committed (empty is fine)"
     assert isinstance(load_baseline(), dict)
+
+
+def test_analyze_entry_and_budget_wired():
+    """pyproject must expose the deap-tpu-analyze console entry
+    (pointing at an importable callable — importing the CLI module must
+    NOT pull in jax; the heavy imports happen inside main), and the
+    committed per-program collective budget must exist with the shape
+    the gate compares against.  (Textual pyproject checks: tomllib
+    needs python >= 3.11 and this gate runs on 3.10.)"""
+    with open(os.path.join(REPO, "pyproject.toml")) as f:
+        text = f.read()
+    assert 'deap-tpu-analyze = "deap_tpu.analysis.cli:main"' in text, \
+        "deap-tpu-analyze console entry missing"
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; import deap_tpu.analysis.cli as c; "
+         "assert callable(c.main); "
+         "assert 'jax' not in sys.modules, 'jax imported at CLI import'; "
+         "print('ok')"],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert out.returncode == 0, out.stderr
+    with open(os.path.join(REPO, "tools", "program_budget.json")) as f:
+        doc = json.load(f)
+    assert isinstance(doc["budget"], dict) and doc["budget"], \
+        "tools/program_budget.json must carry per-program budgets"
+    for name in ("serve_step_sharded", "nsga2_sharded_indices",
+                 "nsga2_sharded_rows"):
+        assert name in doc["budget"], f"budget lost entry {name}"
 
 
 def test_serve_entry_and_extra_wired():
