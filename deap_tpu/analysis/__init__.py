@@ -21,7 +21,18 @@ lowering:
 * **program-budget** — HLO collective instruction counts per inventory
   entry gated against the committed ``tools/program_budget.json``
   (generalizing the three hardcoded weak-scaling layouts of
-  ``tools/check_collective_budget.py`` to budgets keyed by program).
+  ``tools/check_collective_budget.py`` to budgets keyed by program);
+* **memory-budget** — peak/argument/output/temp bytes per entry from
+  XLA's ``memory_analysis`` gated against the committed
+  ``tools/memory_budget.json`` (info-degrading, never crashing, on
+  backends without the API);
+* **fusion-materialization** — the megakernel scoreboard from optimized
+  HLO: fusion kernels, non-fused elementwise roots, and pop-sized
+  materialized intermediates between the operator stages, count-gated
+  by the same memory budget;
+* **dtype-traffic** — silent width inflation: f64 anywhere in a lowered
+  module, weak-type widening survivors on outputs, and wide floating
+  leaves on entries with a declared narrow ``storage_dtype``.
 
 Findings are ordinary :class:`deap_tpu.lint.core.Finding` records, so
 they flow through the existing reporters/suppression/baseline machinery
@@ -44,7 +55,9 @@ _LAZY = {
 }
 _PASSES_EXPORTS = ("run_analysis", "AnalysisResult", "PASS_NAMES",
                    "compare_budget", "update_program_budget",
-                   "PROGRAM_BUDGET_PATH")
+                   "PROGRAM_BUDGET_PATH",
+                   "compare_memory_budget", "update_memory_budget",
+                   "MEMORY_BUDGET_PATH", "MEMORY_SLACK_FRAC")
 _INVENTORY_EXPORTS = ("INVENTORY", "ProgramEntry", "entries", "get_entry",
                       "lower_entry")
 

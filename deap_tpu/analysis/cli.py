@@ -6,10 +6,16 @@ the AST tier is ``deap-tpu-lint``).
 
     deap-tpu-analyze                      # whole inventory, every pass
     deap-tpu-analyze ga_generation_scan   # restrict to named programs
-    deap-tpu-analyze --select donation-leak,program-budget
+    deap-tpu-analyze --select donation-leak,memory-budget
     deap-tpu-analyze --format json        # machine output on stdout
     deap-tpu-analyze --update-budget      # refresh tools/program_budget.json
+                                          # AND tools/memory_budget.json
     deap-tpu-analyze --list               # inventory catalog
+
+The text summary ends with a per-pass wall-time attribution line
+(``pass wall: lower 16.4s, memory-budget 13.2s, ...``) — the gate
+budget is per-run, and a slow new pass must be findable from the
+output, not rediscovered with a profiler.
 
 Exit codes: 0 clean, 1 live findings, 2 usage/internal error.  The
 sharded entries need an 8-device mesh: this entry point sets
@@ -54,14 +60,19 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--select", default=None, metavar="PASS[,PASS...]",
                     help="run only these passes (donation-leak, "
                          "recompile-hazard, callback-in-sharded-program, "
-                         "program-budget)")
+                         "program-budget, memory-budget, "
+                         "fusion-materialization, dtype-traffic)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--update-budget", action="store_true",
-                    help="rewrite tools/program_budget.json from the "
-                         "current inventory, then exit 0")
+                    help="rewrite tools/program_budget.json AND "
+                         "tools/memory_budget.json from the current "
+                         "inventory, then exit 0")
     ap.add_argument("--budget-file", default=None,
-                    help="alternate budget path (default: "
+                    help="alternate collective-budget path (default: "
                          "tools/program_budget.json)")
+    ap.add_argument("--memory-budget-file", default=None,
+                    help="alternate memory/fusion-budget path (default: "
+                         "tools/memory_budget.json)")
     ap.add_argument("--list", action="store_true", dest="list_programs",
                     help="print the inventory catalog and exit")
     return ap
@@ -71,8 +82,9 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     _init_devices()
     from pathlib import Path
-    from .inventory import entries
-    from .passes import (PROGRAM_BUDGET_PATH, run_analysis,
+    from .inventory import entries, lower_entry
+    from .passes import (MEMORY_BUDGET_PATH, PROGRAM_BUDGET_PATH,
+                         run_analysis, update_memory_budget,
                          update_program_budget)
 
     if args.list_programs:
@@ -87,6 +99,9 @@ def main(argv=None) -> int:
 
     budget_path = (Path(args.budget_file) if args.budget_file
                    else PROGRAM_BUDGET_PATH)
+    memory_budget_path = (Path(args.memory_budget_file)
+                          if args.memory_budget_file
+                          else MEMORY_BUDGET_PATH)
     if args.update_budget:
         if args.programs or args.select:
             # a partial measurement would silently rewrite the WHOLE
@@ -95,16 +110,24 @@ def main(argv=None) -> int:
             print("deap-tpu-analyze: --update-budget requires a full "
                   "run (no program names / --select)", file=sys.stderr)
             return 2
-        doc = update_program_budget(budget_path)
-        print(json.dumps({"updated": str(budget_path),
-                          "budget": doc["budget"]}))
+        # both budgets come off the SAME lowered inventory, so one
+        # refresh can never commit two inconsistent snapshots
+        lows = [lower_entry(e) for e in entries()]
+        doc = update_program_budget(
+            budget_path, lows=[low for low in lows if low.entry.budget])
+        mem_doc = update_memory_budget(memory_budget_path, lows=lows)
+        print(json.dumps({"updated": [str(budget_path),
+                                      str(memory_budget_path)],
+                          "budget": doc["budget"],
+                          "memory_budget": mem_doc["budget"]}))
         return 0
 
     select = ([s.strip() for s in args.select.split(",") if s.strip()]
               if args.select else None)
     try:
         result = run_analysis(names=args.programs or None, select=select,
-                              budget_path=budget_path)
+                              budget_path=budget_path,
+                              memory_budget_path=memory_budget_path)
     except KeyError as e:
         print(f"deap-tpu-analyze: {e.args[0]}", file=sys.stderr)
         return 2
@@ -119,6 +142,11 @@ def main(argv=None) -> int:
     print(f"{len(result.findings)} finding(s) across "
           f"{len(result.programs)} lowered programs "
           f"({len(result.passes_run)} passes{waived})")
+    # the gate budget is per-run; a slow new pass must be attributable
+    print("pass wall: " + ", ".join(
+        f"{name} {result.timings[name]:.2f}s"
+        for name in sorted(result.timings,
+                           key=result.timings.get, reverse=True)))
     return result.exit_code
 
 

@@ -25,7 +25,11 @@ from typing import Dict, List, Optional, Set
 
 __all__ = ["COLLECTIVES", "collective_op_on_line", "collective_ops",
            "custom_call_targets", "callback_targets", "aliased_parameters",
-           "parameter_count", "normalize_stablehlo"]
+           "parameter_count", "normalize_stablehlo",
+           "DTYPE_BYTES", "ELEMENTWISE_OPS", "NON_MATERIALIZING_OPS",
+           "ELEMENTWISE_MIN_BYTES", "shape_bytes", "instruction_shape_op",
+           "fused_computation_names", "fusion_metrics",
+           "f64_tensor_count"]
 
 #: the HLO collective opcodes every budget gates
 COLLECTIVES = ("collective-permute", "all-gather", "all-reduce",
@@ -114,6 +118,170 @@ def _main_signature(txt: str) -> str:
 
 _BACKEND_CONFIG_RE = re.compile(r'backend_config\s*=\s*"[^"]*"')
 _LOCATION_RE = re.compile(r"\s+loc\(.*?\)$", re.MULTILINE)
+
+
+# -- compiled HLO (post-optimization) fusion/materialization metrics ---------
+
+#: bytes per element of every HLO primitive type the parser prices
+#: (``pred`` is one byte in XLA's buffer assignment; 4-bit types round
+#: up — they are packed in real buffers, but overpricing errs toward
+#: flagging, never toward hiding a large intermediate)
+DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+    "f16": 2, "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "tf32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1,
+    "f8e5m2fnuz": 1,
+}
+
+_SHAPE_TOK_RE = re.compile(
+    r"\b(" + "|".join(DTYPE_BYTES) + r")\[([0-9,]*)\]")
+
+#: HLO opcodes whose "result" is a view/alias/control construct, not a
+#: freshly materialized buffer — never counted as an intermediate
+NON_MATERIALIZING_OPS = frozenset({
+    "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+    "while", "conditional", "call", "after-all", "add-dependency",
+    "optimization-barrier",
+})
+
+#: elementwise HLO opcodes: one of these OUTSIDE a fused computation is
+#: a materialization XLA's fuser left on the table (the megakernel
+#: scoreboard's "non-fused elementwise root" metric)
+ELEMENTWISE_OPS = frozenset({
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "select", "compare", "and", "or", "xor", "not", "negate", "abs",
+    "exponential", "exponential-minus-one", "log", "log-plus-one",
+    "cosine", "sine", "tangent", "tanh", "sqrt", "rsqrt", "cbrt",
+    "power", "convert", "floor", "ceil", "round-nearest-afz",
+    "round-nearest-even", "clamp", "sign", "shift-left",
+    "shift-right-logical", "shift-right-arithmetic", "remainder",
+    "atan2", "is-finite", "popcnt", "clz", "erf", "logistic",
+})
+
+#: result-size floor for the elementwise-root count: scalar loop
+#: counters and key arithmetic in while bodies are not traffic
+ELEMENTWISE_MIN_BYTES = 1024
+
+
+def shape_bytes(shape: str) -> int:
+    """Total bytes of an HLO result-shape string — ``f32[64,8]{1,0}``,
+    a scalar ``u32[]``, or a tuple ``(s32[], u32[3]{0}, ...)`` (summed).
+    Unknown/opaque types (``token``) price as 0."""
+    total = 0
+    for dtype, dims in _SHAPE_TOK_RE.findall(shape):
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += DTYPE_BYTES[dtype] * n
+    return total
+
+
+def instruction_shape_op(line: str):
+    """``(result shape text, opcode)`` of one HLO instruction line, or
+    ``None`` for non-instruction lines.  Handles scalar, array, and
+    tuple result shapes (``%w = (s32[], u32[3]{0}) while(...)``)."""
+    s = line.strip()
+    if not s.startswith("%") and not s.startswith("ROOT %"):
+        return None
+    if s.startswith("ROOT "):
+        s = s[5:]
+    eq = s.find(" = ")
+    if eq < 0:
+        return None
+    rest = s[eq + 3:]
+    if rest.startswith("("):
+        depth = 0
+        end = -1
+        for i, ch in enumerate(rest):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    end = i
+                    break
+        if end < 0:
+            return None
+        shape, tail = rest[:end + 1], rest[end + 1:].lstrip()
+    else:
+        sp = rest.find(" ")
+        if sp < 0:
+            return None
+        shape, tail = rest[:sp], rest[sp + 1:]
+    op = tail.split("(", 1)[0].strip()
+    if not op or any(c not in "abcdefghijklmnopqrstuvwxyz-0123456789"
+                     for c in op):
+        return None
+    return shape, op
+
+
+_CALLS_RE = re.compile(r"\bcalls=%([\w.\-]+)")
+_COMPUTATION_RE = re.compile(r"^(?:ENTRY\s+)?%([\w.\-]+)\s*\(")
+
+
+def fused_computation_names(txt: str) -> Set[str]:
+    """Names of the computations that are fusion *bodies* (referenced by
+    a ``calls=`` attribute) — instructions inside them live in
+    registers, not buffers, and are excluded from materialization
+    counts."""
+    return set(_CALLS_RE.findall(txt))
+
+
+def fusion_metrics(txt: str, large_bytes: int,
+                   elementwise_min_bytes: int = ELEMENTWISE_MIN_BYTES
+                   ) -> Dict[str, int]:
+    """The fusion/materialization scoreboard of one compiled (post-
+    optimization) HLO module:
+
+    * ``fusions`` — fusion instruction definitions (each is one fused
+      kernel XLA emits);
+    * ``elementwise_roots`` — elementwise instruction definitions
+      OUTSIDE fused computations with results at or above
+      ``elementwise_min_bytes`` (each is a loop over a materialized
+      buffer the fuser failed to merge);
+    * ``large_intermediates`` — materialized instruction results
+      (outside fused computations, excluding views/control ops) at or
+      above ``large_bytes`` — on the GA generation scan these are
+      exactly the per-operator population buffers between select, mate,
+      and mutate that the planned Pallas megakernel exists to
+      eliminate.
+
+    Pure text analysis; HLO prints one instruction per line and
+    computations start at column 0."""
+    fused = fused_computation_names(txt)
+    current = None
+    out = {"fusions": 0, "elementwise_roots": 0, "large_intermediates": 0}
+    for line in txt.splitlines():
+        if line and not line[0].isspace():
+            m = _COMPUTATION_RE.match(line)
+            if m:
+                current = m.group(1)
+            continue
+        parsed = instruction_shape_op(line)
+        if parsed is None or current in fused:
+            continue
+        shape, op = parsed
+        if op == "fusion":
+            out["fusions"] += 1
+        nbytes = shape_bytes(shape)
+        if op in ELEMENTWISE_OPS and nbytes >= elementwise_min_bytes:
+            out["elementwise_roots"] += 1
+        if op not in NON_MATERIALIZING_OPS and nbytes >= large_bytes:
+            out["large_intermediates"] += 1
+    return out
+
+
+_F64_TENSOR_RE = re.compile(r"tensor<(?:[0-9?]+x)*f64>")
+
+
+def f64_tensor_count(txt: str) -> int:
+    """Occurrences of an ``f64`` tensor type in a lowered (StableHLO)
+    module — double-width traffic on an EC path is never intentional in
+    this codebase (genomes/fitness are f32 today, headed narrower), so
+    any appearance is silent width inflation."""
+    return len(_F64_TENSOR_RE.findall(txt))
 
 
 def normalize_stablehlo(txt: str) -> str:

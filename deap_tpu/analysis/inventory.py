@@ -73,13 +73,21 @@ class ProgramEntry:
     donate_waiver: str = ""
     callback_ok: bool = False
     static_argnums: Tuple[int, ...] = ()
+    #: declared narrow on-device storage dtype (e.g. "bfloat16" once
+    #: mixed-precision genomes land): the dtype-traffic pass flags any
+    #: wider floating leaf at/above the donation floor as inflation
+    storage_dtype: str = ""
+    #: reviewed reason a dtype-traffic finding is intentionally absent
+    dtype_waiver: str = ""
 
 
 @dataclasses.dataclass
 class Lowered:
     """One lowered entry: the jax ``Lowered`` stage plus its StableHLO
-    text (compiled HLO is produced lazily — only the budget pass pays
-    for XLA compilation, and only on ``budget=True`` entries)."""
+    text.  The compiled executable (and its HLO text) is produced
+    lazily and cached, so the passes that need XLA compilation — the
+    collective budget on ``budget=True`` entries, and the memory/fusion
+    contract tier on every entry — share one compile per entry."""
 
     entry: ProgramEntry
     fn: Callable
@@ -87,10 +95,28 @@ class Lowered:
     lowered: Any
     text: str
     _compiled_text: Optional[str] = None
+    _compiled: Any = None
+    _out_shapes: Any = None
+
+    def out_shapes(self):
+        """``jax.eval_shape(fn, *args)`` — cached, because three passes
+        (donation, dtype-traffic, the traffic figure) all need the
+        output avals and an abstract re-trace per pass is the analyzer
+        run's own wall time."""
+        if self._out_shapes is None:
+            self._out_shapes = jax.eval_shape(self.fn, *self.args)
+        return self._out_shapes
+
+    def compiled(self):
+        """The compiled executable (cached — every pass that needs XLA
+        compilation shares the one compile per entry)."""
+        if self._compiled is None:
+            self._compiled = self.lowered.compile()
+        return self._compiled
 
     def compiled_text(self) -> str:
         if self._compiled_text is None:
-            self._compiled_text = self.lowered.compile().as_text()
+            self._compiled_text = self.compiled().as_text()
         return self._compiled_text
 
 

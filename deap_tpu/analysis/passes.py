@@ -22,6 +22,21 @@ unchanged.
 ``program-budget``              HLO collective instruction counts per
                                 inventory entry vs the committed
                                 ``tools/program_budget.json``
+``memory-budget``               peak/argument/output/temp bytes per entry
+                                from XLA ``memory_analysis`` vs the
+                                committed ``tools/memory_budget.json``
+                                (info-degrades when a backend lacks the
+                                API — never a crash, never silence)
+``fusion-materialization``      fusion kernels, non-fused elementwise
+                                roots, and pop-sized materialized
+                                intermediates in the optimized HLO — the
+                                megakernel scoreboard, count-gated by the
+                                same ``tools/memory_budget.json``
+``dtype-traffic``               silent width inflation: f64 anywhere in a
+                                lowered module, weak-type widening
+                                survivors on outputs, wide floating
+                                leaves on entries with a declared
+                                ``storage_dtype``
 =============================== =============================================
 """
 
@@ -29,6 +44,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import time
 from collections import Counter
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
@@ -43,12 +59,32 @@ __all__ = ["PASS_NAMES", "AnalysisResult", "run_analysis",
            "donation_findings", "recompile_findings", "callback_findings",
            "budget_findings", "compare_budget", "measure_budget_counts",
            "update_program_budget", "PROGRAM_BUDGET_PATH",
-           "DONATION_MIN_BYTES"]
+           "DONATION_MIN_BYTES",
+           "memory_findings", "fusion_findings", "dtype_findings",
+           "compare_memory_budget", "measure_memory_stats",
+           "measure_fusion_metrics", "traffic_bytes", "large_bytes_for",
+           "update_memory_budget", "MEMORY_BUDGET_PATH",
+           "MEMORY_SLACK_FRAC", "GATED_BYTE_KEYS", "GATED_COUNT_KEYS"]
 
 PASS_NAMES = ("donation-leak", "recompile-hazard",
-              "callback-in-sharded-program", "program-budget")
+              "callback-in-sharded-program", "program-budget",
+              "memory-budget", "fusion-materialization", "dtype-traffic")
 
 PROGRAM_BUDGET_PATH = REPO / "tools" / "program_budget.json"
+MEMORY_BUDGET_PATH = REPO / "tools" / "memory_budget.json"
+
+#: headroom over the committed byte budgets (XLA buffer assignment is
+#: deterministic for one jaxlib, but byte-exact pins would churn on
+#: every toolchain bump; a quarter's slack still fails a doubled
+#: footprint cold).  Committed in the budget file so the gate and the
+#: file can never disagree about the margin; this is the default the
+#: update workflow writes.
+MEMORY_SLACK_FRAC = 0.25
+
+#: budget keys gated with slack (bytes) vs exactly (counts).  Counts
+#: below budget pass — improvements are locked in by refreshing.
+GATED_BYTE_KEYS = ("peak_bytes",)
+GATED_COUNT_KEYS = ("large_intermediates", "elementwise_roots")
 
 #: buffers below this size are never donation findings: donating a key
 #: or a scalar knob saves nothing and the noise would drown the genome-
@@ -98,7 +134,7 @@ def donation_findings(low: Lowered) -> Iterable[Finding]:
     entry = low.entry
     if entry.donate_waiver:
         return
-    out_shapes = jax.eval_shape(low.fn, *low.args)
+    out_shapes = low.out_shapes()
     out_counts: Counter = Counter(
         _leaf_key(x) for x in _flat_leaves(out_shapes))
 
@@ -355,24 +391,391 @@ def budget_findings(lows: Sequence[Lowered],
 
 
 # ---------------------------------------------------------------------------
+# memory-budget / fusion-materialization / dtype-traffic
+# ---------------------------------------------------------------------------
+
+
+_MEM_STAT_KEYS = {"argument_size_in_bytes": "argument_bytes",
+                  "output_size_in_bytes": "output_bytes",
+                  "temp_size_in_bytes": "temp_bytes",
+                  "alias_size_in_bytes": "alias_bytes"}
+
+
+def measure_memory_stats(low: Lowered) -> Optional[Dict[str, int]]:
+    """One entry's footprint row from XLA's ``memory_analysis`` —
+    ``argument/output/temp/alias_bytes`` plus the derived ``peak_bytes``
+    (args + outputs + temps − aliased, the same live-at-once upper
+    bound ``tools/bench_donation.py`` commits).  Returns ``None`` when
+    the executable does not expose the API (some plugin backends) — the
+    memory-budget pass degrades to an informational finding then,
+    never a crash and never silent success."""
+    try:
+        stats = low.compiled().memory_analysis()
+    except Exception:   # noqa: BLE001 — absence of the API, not a bug here
+        return None
+    if stats is None:
+        return None
+    row: Dict[str, int] = {}
+    for attr, key in _MEM_STAT_KEYS.items():
+        v = getattr(stats, attr, None)
+        if v is not None:
+            row[key] = int(v)
+    if "argument_bytes" not in row and "temp_bytes" not in row:
+        return None
+    row["peak_bytes"] = (row.get("argument_bytes", 0)
+                         + row.get("output_bytes", 0)
+                         + row.get("temp_bytes", 0)
+                         - row.get("alias_bytes", 0))
+    return row
+
+
+def large_bytes_for(low: Lowered) -> int:
+    """The entry's "pop-sized" threshold: the largest argument leaf's
+    bytes (the population/genome buffer), per device on mesh entries
+    (the compiled module's shapes are the partitioned locals).  Floored
+    at :data:`DONATION_MIN_BYTES` so degenerate tiny fixtures don't
+    count every scalar."""
+    leaves = [_leaf_bytes(x) for arg in low.args
+              for x in _flat_leaves(arg)]
+    top = max(leaves, default=0)
+    if low.entry.mesh:
+        top //= N_DEV
+    return max(DONATION_MIN_BYTES, top)
+
+
+def measure_fusion_metrics(low: Lowered) -> Optional[Dict[str, int]]:
+    """The fusion/materialization scoreboard of one compiled entry (see
+    :func:`deap_tpu.analysis.hlo.fusion_metrics`), plus the threshold it
+    was counted at.  ``None`` when the backend cannot produce compiled
+    HLO text."""
+    try:
+        txt = low.compiled_text()
+    except Exception:   # noqa: BLE001 — same degradation contract as memory
+        return None
+    thr = large_bytes_for(low)
+    row = hlo.fusion_metrics(txt, thr)
+    row["large_bytes_threshold"] = thr
+    return row
+
+
+def traffic_bytes(low: Lowered) -> Optional[Dict[str, int]]:
+    """Per-program bytes moved across the dispatch boundary (argument
+    leaves in + output leaves out, from the avals — backend-free).  The
+    figure that will quantify the bf16/int8-genome win the day narrow
+    storage lands: half the genome width is half this number."""
+    try:
+        out_shapes = low.out_shapes()
+    except Exception:   # noqa: BLE001 — advisory metric
+        return None
+    args_b = sum(_leaf_bytes(x) for arg in low.args
+                 for x in _flat_leaves(arg))
+    out_b = sum(_leaf_bytes(x) for x in _flat_leaves(out_shapes))
+    return {"argument_leaf_bytes": args_b, "output_leaf_bytes": out_b,
+            "bytes_moved": args_b + out_b}
+
+
+def memory_rows(lows: Sequence[Lowered]) -> Dict[str, Dict[str, int]]:
+    """{entry name: full measured row} — footprint stats, fusion
+    scoreboard, and traffic figure merged (what ``--update-budget``
+    commits per entry)."""
+    rows: Dict[str, Dict[str, int]] = {}
+    for low in lows:
+        row: Dict[str, int] = {}
+        for part in (measure_memory_stats(low),
+                     measure_fusion_metrics(low), traffic_bytes(low)):
+            if part:
+                row.update(part)
+        rows[low.entry.name] = row
+    return rows
+
+
+def load_memory_budget(path: Path = MEMORY_BUDGET_PATH) -> Tuple[Dict, float]:
+    with open(path) as f:
+        doc = json.load(f)
+    return doc["budget"], float(doc.get("slack_frac", MEMORY_SLACK_FRAC))
+
+
+def _usable_int(v) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool)
+
+
+def compare_memory_budget(rows: Dict[str, Dict[str, int]],
+                          budget: Dict[str, Dict[str, int]],
+                          slack_frac: float = MEMORY_SLACK_FRAC,
+                          *, byte_keys: Sequence[str] = GATED_BYTE_KEYS,
+                          count_keys: Sequence[str] = GATED_COUNT_KEYS,
+                          report_missing: bool = True) -> List[str]:
+    """Pure comparison (unit-tested without lowering anything): one
+    violation string per gated metric over budget.  Byte metrics allow
+    ``slack_frac`` headroom (toolchain bumps shift buffer assignment by
+    a few percent; a regression doubles it); count metrics are exact,
+    like the collective budget.  An entry with no committed row is a
+    violation when ``report_missing`` (every inventory program must
+    carry a budget; the memory-budget pass owns that check so the one
+    defect is not double-reported by the fusion pass).  A committed cap
+    that is not an integer is ALSO a violation — a hand-edited float or
+    string cap must never silently disable its gate."""
+    violations: List[str] = []
+    for name, row in sorted(rows.items()):
+        allowed = budget.get(name)
+        if allowed is None:
+            if report_missing:
+                violations.append(
+                    f"{name}: no committed memory budget row")
+            continue
+        for k in tuple(byte_keys) + tuple(count_keys):
+            cap = allowed.get(k)
+            if cap is not None and not _usable_int(cap):
+                violations.append(
+                    f"{name}: committed budget value for {k} is not an "
+                    f"integer ({cap!r}) -- the gate cannot compare "
+                    "against it; fix the committed file")
+        for k in byte_keys:
+            got, cap = row.get(k), allowed.get(k)
+            if not _usable_int(got) or not _usable_int(cap):
+                continue
+            ceil = int(cap * (1.0 + slack_frac))
+            if got > ceil:
+                violations.append(
+                    f"{name}: {k} {got} exceeds budget {cap} "
+                    f"(+{int(slack_frac * 100)}% slack = {ceil})")
+        for k in count_keys:
+            got, cap = row.get(k), allowed.get(k)
+            if not _usable_int(got) or not _usable_int(cap):
+                continue
+            if got > cap:
+                violations.append(
+                    f"{name}: {k} x{got} exceeds budget {cap}")
+    return violations
+
+
+def update_memory_budget(path: Path = MEMORY_BUDGET_PATH,
+                         lows: Optional[Sequence[Lowered]] = None) -> dict:
+    """Measure EVERY inventory entry and rewrite the committed memory &
+    fusion budget to exactly the measured rows (the explicit-diff
+    refresh workflow shared with the collective budget)."""
+    if lows is None:
+        lows = [lower_entry(e) for e in entries()]
+    rows = memory_rows(lows)
+    doc = {
+        "_note": ("memory & fusion contract budget per inventory program "
+                  "(deap_tpu/analysis/inventory.py): peak/argument/"
+                  "output/temp bytes from XLA memory_analysis, fusion "
+                  "kernel count, non-fused elementwise roots, pop-sized "
+                  "materialized intermediates, and dispatch-boundary "
+                  "bytes moved; gated tier-1 through deap_tpu.analysis "
+                  "(peak_bytes with slack_frac headroom; intermediate/"
+                  "elementwise counts exact).  Regenerate with "
+                  "deap-tpu-analyze --update-budget and commit the diff "
+                  "when an inventory change is intentional"),
+        "n_devices": N_DEV,
+        "slack_frac": MEMORY_SLACK_FRAC,
+        "method": ("peak_bytes = argument+output+temp-alias bytes "
+                   "(memory_analysis); fusion metrics from optimized "
+                   "HLO text, large = max argument leaf bytes "
+                   "(per-device on mesh entries)"),
+        "shapes": "inventory canonical shapes "
+                  "(deap_tpu/analysis/inventory.py)",
+        "budget": rows,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def memory_findings(lows: Sequence[Lowered],
+                    path: Path = MEMORY_BUDGET_PATH) -> Iterable[Finding]:
+    """The MEMORY-BUDGET pass: every entry's footprint row vs the
+    committed budget.  A backend whose executables lack
+    ``memory_analysis`` yields ONE informational finding per entry
+    (severity ``info`` — reported, never gate-failing) instead of a
+    crash or silent success."""
+    if not lows:
+        return
+    try:
+        budget, slack = load_memory_budget(path)
+    except (OSError, KeyError, ValueError) as e:
+        yield Finding(
+            rule="memory-budget", path="tools/memory_budget.json", line=1,
+            message=f"cannot read committed memory budget: {e}")
+        return
+    rows: Dict[str, Dict[str, int]] = {}
+    anchors = {}
+    for low in lows:
+        anchors[low.entry.name] = low.entry.anchor
+        mem = measure_memory_stats(low)
+        if mem is None:
+            yield Finding(
+                rule="memory-budget", path=low.entry.anchor, line=1,
+                severity="info",
+                message=(f"program '{low.entry.name}': backend does not "
+                         "expose memory_analysis on the compiled "
+                         "executable -- footprint budget not checkable "
+                         "on this platform (gate passes informationally;"
+                         " run on a backend with CompiledMemoryStats "
+                         "to enforce)"))
+            continue
+        rows[low.entry.name] = mem
+    for v in compare_memory_budget(rows, budget, slack,
+                                   count_keys=()):
+        name = v.split(":", 1)[0]
+        kind = ("memory budget missing"
+                if "no committed memory budget row" in v
+                else "memory budget exceeded")
+        yield Finding(
+            rule="memory-budget",
+            path=anchors.get(name, "tools/memory_budget.json"), line=1,
+            message=(f"{kind} -- {v} (an intentional "
+                     "footprint change is committed via "
+                     "deap-tpu-analyze --update-budget)"))
+
+
+def fusion_findings(lows: Sequence[Lowered],
+                    path: Path = MEMORY_BUDGET_PATH) -> Iterable[Finding]:
+    """The FUSION/MATERIALIZATION pass: the optimized-HLO scoreboard
+    (fusion kernels, non-fused elementwise roots, pop-sized materialized
+    intermediates) count-gated against the same committed budget — the
+    direct measure of what the planned select→mate→mutate Pallas
+    megakernel buys, enforced per entry from day one."""
+    if not lows:
+        return
+    try:
+        budget, slack = load_memory_budget(path)
+    except (OSError, KeyError, ValueError) as e:
+        yield Finding(
+            rule="fusion-materialization", path="tools/memory_budget.json",
+            line=1,
+            message=f"cannot read committed memory budget: {e}")
+        return
+    rows: Dict[str, Dict[str, int]] = {}
+    anchors = {}
+    for low in lows:
+        anchors[low.entry.name] = low.entry.anchor
+        fus = measure_fusion_metrics(low)
+        if fus is None:
+            yield Finding(
+                rule="fusion-materialization", path=low.entry.anchor,
+                line=1, severity="info",
+                message=(f"program '{low.entry.name}': backend cannot "
+                         "produce compiled HLO text -- fusion/"
+                         "materialization contract not checkable on "
+                         "this platform"))
+            continue
+        rows[low.entry.name] = fus
+    # missing rows are the memory-budget pass's finding (one defect,
+    # one report); this pass gates only the materialization counts
+    for v in compare_memory_budget(rows, budget, slack, byte_keys=(),
+                                   report_missing=False):
+        name = v.split(":", 1)[0]
+        yield Finding(
+            rule="fusion-materialization",
+            path=anchors.get(name, "tools/memory_budget.json"), line=1,
+            message=(f"materialization budget exceeded -- {v} (every "
+                     "count above budget is a population-sized buffer "
+                     "XLA re-materialized between operator stages; an "
+                     "intentional change is committed via "
+                     "deap-tpu-analyze --update-budget)"))
+
+
+#: floating dtypes ordered by width, for the storage-dtype audit
+_FLOAT_WIDTH = {"bfloat16": 2, "float16": 2, "float8_e4m3fn": 1,
+                "float8_e5m2": 1, "float32": 4, "float64": 8}
+
+
+def dtype_findings(low: Lowered) -> Iterable[Finding]:
+    """The DTYPE-TRAFFIC audit of one lowered entry: silent width
+    inflation that multiplies HBM traffic without changing results.
+
+    * **f64 anywhere** in the lowered module — double-width EC traffic
+      is never intentional here (genomes are f32 today, headed
+      narrower); one stray ``np.float64`` scalar widens whole
+      broadcasts.
+    * **weak-type widening survivors** — an *output* leaf still weak-
+      typed after lowering: a bare Python scalar flowed through to the
+      result, so the first strongly-typed consumer widens (and the
+      recompile fork of the input-side check has an output-side twin).
+    * **declared storage dtype** — entries that commit to a narrow
+      on-device genome dtype (``storage_dtype=\"bfloat16\"`` once
+      mixed-precision lands) must not carry wider floating leaves at or
+      above the donation floor; each is the bf16/int8 win silently
+      given back.
+
+    A reviewed exception records a ``dtype_waiver`` on the entry."""
+    entry = low.entry
+    if entry.dtype_waiver:
+        return
+    if hlo.f64_tensor_count(low.text):
+        yield Finding(
+            rule="dtype-traffic", path=entry.anchor, line=1,
+            message=(f"program '{entry.name}': f64 tensor type(s) in the "
+                     "lowered module -- double-width traffic on an EC "
+                     "path (a Python float or np.float64 widened the "
+                     "trace); pin the dtype at the leaf, or record a "
+                     "dtype_waiver with the reviewed reason"))
+    try:
+        out_shapes = low.out_shapes()
+    except Exception:   # noqa: BLE001 — shape eval is advisory
+        out_shapes = None
+    if out_shapes is not None:
+        weak = [i for i, x in enumerate(_flat_leaves(out_shapes))
+                if getattr(x, "weak_type", False)]
+        if weak:
+            yield Finding(
+                rule="dtype-traffic", path=entry.anchor, line=1,
+                message=(f"program '{entry.name}': output leaf(s) {weak} "
+                         "are weak-typed -- a bare Python scalar "
+                         "survived to the result and the first strongly-"
+                         "typed consumer widens it (and forks a "
+                         "recompile); pin with jnp.asarray(x, dtype)"))
+    if entry.storage_dtype:
+        declared_w = _FLOAT_WIDTH.get(entry.storage_dtype)
+        wide: List[int] = []
+        flat = 0
+        for arg in low.args:
+            for leaf in _flat_leaves(arg):
+                name = str(leaf.dtype)
+                w = _FLOAT_WIDTH.get(name)
+                if (w is not None and declared_w is not None
+                        and w > declared_w
+                        and _leaf_bytes(leaf) >= DONATION_MIN_BYTES):
+                    wide.append(flat)
+                flat += 1
+        if wide:
+            yield Finding(
+                rule="dtype-traffic", path=entry.anchor, line=1,
+                message=(f"program '{entry.name}': flat argument "
+                         f"leaf(s) {wide} are wider than the declared "
+                         f"storage dtype {entry.storage_dtype} -- the "
+                         "narrow-genome traffic win is silently given "
+                         "back; store narrow and widen inside the "
+                         "program (f32 accumulate), or update the "
+                         "declaration"))
+
+
+# ---------------------------------------------------------------------------
 # the runner
 # ---------------------------------------------------------------------------
 
 
 @dataclasses.dataclass
 class AnalysisResult:
-    """One analyzer run: live findings (the gate fails on any), the
-    programs lowered, and the donation waivers honored (reported, so a
-    waiver can never silently hide)."""
+    """One analyzer run: live findings (the gate fails on any
+    ``error``-severity finding; ``info`` findings — e.g. a backend that
+    cannot report memory stats — are surfaced but never fail), the
+    programs lowered, the donation waivers honored (reported, so a
+    waiver can never silently hide), and per-pass wall time (the run's
+    gate budget is attributable to the pass that spent it)."""
 
     findings: List[Finding]
     programs: List[str]
     waived: Dict[str, str]
     passes_run: List[str]
+    timings: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     @property
     def exit_code(self) -> int:
-        return 1 if self.findings else 0
+        return 1 if any(f.severity == "error" for f in self.findings) else 0
 
     def as_dict(self) -> dict:
         return {"findings": [f.as_dict() for f in self.findings],
@@ -381,15 +784,23 @@ class AnalysisResult:
                 "summary": {"passes_run": self.passes_run,
                             "programs_lowered": len(self.programs),
                             "findings": len(self.findings),
+                            "pass_wall_s": {k: round(v, 3) for k, v
+                                            in self.timings.items()},
                             "exit_code": self.exit_code}}
 
 
 def run_analysis(*, names: Optional[List[str]] = None,
                  select: Optional[Sequence[str]] = None,
-                 budget_path: Path = PROGRAM_BUDGET_PATH) -> AnalysisResult:
+                 budget_path: Path = PROGRAM_BUDGET_PATH,
+                 memory_budget_path: Path = MEMORY_BUDGET_PATH
+                 ) -> AnalysisResult:
     """Lower the inventory (all of it, or ``names``) and run the
     selected passes (default: every pass).  The variant lowering for the
-    recompile diff is only built when that pass runs."""
+    recompile diff is only built when that pass runs.  Wall time is
+    accumulated per pass (plus ``lower`` for the shared lowering step);
+    XLA compilation is paid once per entry and attributed to the first
+    compiled-artifact pass that runs (``program-budget``, else
+    ``memory-budget``, else ``fusion-materialization``)."""
     passes = list(select) if select else list(PASS_NAMES)
     unknown = [p for p in passes if p not in PASS_NAMES]
     if unknown:
@@ -399,21 +810,48 @@ def run_analysis(*, names: Optional[List[str]] = None,
     findings: List[Finding] = []
     lows: List[Lowered] = []
     waived: Dict[str, str] = {}
+    timings: Dict[str, float] = {"lower": 0.0}
+    timings.update({p: 0.0 for p in passes})
+
+    def timed(name: str, fn) -> list:
+        t0 = time.perf_counter()
+        try:
+            return list(fn())
+        finally:
+            timings[name] += time.perf_counter() - t0
+
     for entry in todo:
+        t0 = time.perf_counter()
         low = lower_entry(entry)
+        timings["lower"] += time.perf_counter() - t0
         lows.append(low)
         if entry.donate_waiver:
             waived[entry.name] = entry.donate_waiver
         if "donation-leak" in passes:
-            findings.extend(donation_findings(low))
+            findings += timed("donation-leak",
+                              lambda: donation_findings(low))
         if "recompile-hazard" in passes:
-            variant = lower_entry(entry, variant=1)
-            findings.extend(recompile_findings(low, variant))
+            def _recompile(low=low, entry=entry):
+                return recompile_findings(low, lower_entry(entry, variant=1))
+            findings += timed("recompile-hazard", _recompile)
         if "callback-in-sharded-program" in passes:
-            findings.extend(callback_findings(low))
+            findings += timed("callback-in-sharded-program",
+                              lambda: callback_findings(low))
+        if "dtype-traffic" in passes:
+            findings += timed("dtype-traffic", lambda: dtype_findings(low))
     if "program-budget" in passes:
-        findings.extend(budget_findings(lows, path=budget_path))
+        findings += timed("program-budget",
+                          lambda: budget_findings(lows, path=budget_path))
+    if "memory-budget" in passes:
+        findings += timed("memory-budget",
+                          lambda: memory_findings(
+                              lows, path=memory_budget_path))
+    if "fusion-materialization" in passes:
+        findings += timed("fusion-materialization",
+                          lambda: fusion_findings(
+                              lows, path=memory_budget_path))
     findings.sort(key=lambda f: (f.path, f.rule, f.message))
     return AnalysisResult(findings=findings,
                           programs=[e.name for e in todo],
-                          waived=waived, passes_run=passes)
+                          waived=waived, passes_run=passes,
+                          timings=timings)

@@ -39,8 +39,9 @@ def bench_json_targets(repo: Path) -> List[Tuple[str, Path]]:
     ``tools/bench_serve.py --net --trace``) gets its own stricter
     schema."""
     out: List[Tuple[str, Path]] = []
+    _SPECIAL = {"BENCH_TRACE.json": "trace", "BENCH_MEMORY.json": "memory"}
     for p in sorted(repo.glob("BENCH_*.json")):
-        out.append(("trace" if p.name == "BENCH_TRACE.json" else "bench", p))
+        out.append((_SPECIAL.get(p.name, "bench"), p))
     for p in sorted(repo.glob("MULTICHIP_*.json")):
         out.append(("multichip", p))
     budget = repo / "tools" / "collective_budget.json"
@@ -124,6 +125,36 @@ def _schema_errors(kind: str, doc) -> List[str]:
                     or not math.isfinite(float(p50)):
                 errors.append(f"key '{leg}.roundtrip_p50_ms' must be a "
                               "finite number")
+    elif kind == "memory":
+        # BENCH_MEMORY.json: the footprint-trajectory record from
+        # tools/bench_memory.py — runner status (int rc / bool ok) plus
+        # entry-keyed rows of finite non-negative byte counts, so a
+        # malformed commit fails tier-1 before the trajectory tooling
+        # (or the memory-budget gate's cross-check) chokes on it
+        if not isinstance(doc.get("rc"), int) or isinstance(doc.get("rc"),
+                                                            bool):
+            errors.append("key 'rc' must be an integer")
+        if not isinstance(doc.get("ok"), bool):
+            errors.append("key 'ok' must be a boolean")
+        rows = doc.get("entries")
+        if not isinstance(rows, dict) or not rows:
+            errors.append("key 'entries' must be a non-empty object "
+                          "{program name: {metric: bytes}}")
+        else:
+            for name, row in rows.items():
+                if not isinstance(row, dict):
+                    errors.append(f"entries[{name!r}] must be an object")
+                    continue
+                for k, v in row.items():
+                    if k.endswith("_bytes"):
+                        if isinstance(v, bool) or not isinstance(v, int) \
+                                or v < 0:
+                            errors.append(
+                                f"entries[{name!r}][{k!r}] must be a "
+                                "non-negative integer byte count")
+                    elif isinstance(v, float) and not math.isfinite(v):
+                        errors.append(
+                            f"entries[{name!r}][{k!r}] must be finite")
     elif kind == "multichip":
         if not isinstance(doc.get("rc"), int) or isinstance(doc.get("rc"),
                                                             bool):

@@ -1,0 +1,218 @@
+"""``lock-order`` — static deadlock lint over the serve fleet's locks.
+
+The ``lock-discipline`` pass (:mod:`deap_tpu.lint.rules_repo`) proves
+each guarded *write* holds its lock; it says nothing about the ORDER
+locks nest in.  With several lock-bearing objects on one request path —
+the dispatcher's ``_cv``, the service's ``_lock``, a session's
+``_phase_lock``, the tracer's ``_lock`` — an inverted nesting is a real
+deadlock no type checker sees: ``serve/service.py`` documents exactly
+this hazard ("NEVER held across a submit — the dispatcher takes its own
+lock first on some failure paths, and the reverse order would
+deadlock").
+
+This pass builds the static acquisition graph per class:
+
+* **nodes** are the class's lock attributes — ``_GUARDED_BY`` keys plus
+  every ``self.<attr> = threading.Lock()/RLock()/Condition()`` binding;
+* **edges** ``A → B`` whenever ``with self.B:`` is entered while ``A``
+  is held — directly nested, through a local alias (``cv = self._cv``,
+  the dispatcher idiom, resolved by the same prescan lock-discipline
+  uses), or via a ``self.<method>()`` call whose body (transitively,
+  through further self-calls) acquires ``B``.
+
+A **cycle** in the graph is two code paths that can interleave into a
+deadlock and fails the gate.  Re-entrant self-edges (``with self._lock``
+inside a ``*_locked`` helper called under the same lock) are excluded —
+re-entry is an RLock legality question, not an ordering one, and the
+repo's ``*_locked`` convention already marks those helpers.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Set, Tuple
+
+from .core import Finding, LintContext, rule
+from .rules_repo import _lock_aliases, _own_expressions, _self_attr
+
+__all__ = ["lock_attributes", "acquisition_graph", "graph_cycles",
+           "lock_order_findings"]
+
+#: constructor names whose result bound to ``self.<attr>`` makes the
+#: attribute a lock node (``threading.Lock()`` / bare ``Lock()`` after a
+#: from-import both count)
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+
+def lock_attributes(cls: ast.ClassDef) -> Set[str]:
+    """The class's lock nodes: ``_GUARDED_BY`` keys (string-literal
+    dict, same contract as lock-discipline) plus every attribute
+    assigned a ``Lock()``/``RLock()``/``Condition()`` anywhere in the
+    class body."""
+    locks: Set[str] = set()
+    for stmt in cls.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "_GUARDED_BY"
+                and isinstance(stmt.value, ast.Dict)):
+            for k in stmt.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    locks.add(k.value)
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        attr = _self_attr(node.targets[0])
+        if attr is None or not isinstance(node.value, ast.Call):
+            continue
+        f = node.value.func
+        name = (f.attr if isinstance(f, ast.Attribute)
+                else f.id if isinstance(f, ast.Name) else None)
+        if name in _LOCK_FACTORIES:
+            locks.add(attr)
+    return locks
+
+
+def _method_scan(meth, locks: Set[str]
+                 ) -> Tuple[Set[Tuple[str, str]], Set[str],
+                            List[Tuple[FrozenSet[str], str]]]:
+    """One method's direct evidence: nesting ``edges``, the set of locks
+    it ``acquires`` directly, and its self-method ``calls`` with the
+    lock set held at each call site."""
+    aliases = _lock_aliases(meth, dict.fromkeys(locks))
+    edges: Set[Tuple[str, str]] = set()
+    acquires: Set[str] = set()
+    calls: List[Tuple[FrozenSet[str], str]] = []
+
+    def resolve(expr) -> str:
+        a = _self_attr(expr)
+        if a is None and isinstance(expr, ast.Name):
+            a = aliases.get(expr.id)
+        return a if a in locks else None
+
+    def scan_calls(root, held: Set[str]) -> None:
+        for node in ast.walk(root):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and _self_attr(node.func) is not None):
+                calls.append((frozenset(held), node.func.attr))
+
+    def walk(stmts, held: Set[str]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue        # a nested def's body runs later, unlocked
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                now = set(held)
+                for item in stmt.items:
+                    scan_calls(item.context_expr, now)
+                    lk = resolve(item.context_expr)
+                    if lk is not None:
+                        acquires.add(lk)
+                        edges.update((h, lk) for h in now if h != lk)
+                        now.add(lk)
+                walk(stmt.body, now)
+                continue
+            for expr in _own_expressions(stmt):
+                scan_calls(expr, held)
+            for body in (getattr(stmt, "body", None),
+                         getattr(stmt, "orelse", None),
+                         getattr(stmt, "finalbody", None)):
+                if body:
+                    walk(body, held)
+            for h in getattr(stmt, "handlers", []):
+                walk(h.body, held)
+
+    walk(meth.body, set())
+    return edges, acquires, calls
+
+
+def acquisition_graph(cls: ast.ClassDef) -> Set[Tuple[str, str]]:
+    """The class's lock acquisition edges: direct ``with`` nesting plus
+    one-class interprocedural propagation — a ``self.m()`` call under a
+    held lock contributes an edge to every lock ``m`` may (transitively,
+    through further self-calls) acquire."""
+    locks = lock_attributes(cls)
+    if len(locks) < 2:
+        return set()
+    methods = [m for m in cls.body
+               if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    edges: Set[Tuple[str, str]] = set()
+    direct: Dict[str, Set[str]] = {}
+    calls: Dict[str, List[Tuple[FrozenSet[str], str]]] = {}
+    for meth in methods:
+        e, acq, c = _method_scan(meth, locks)
+        edges |= e
+        direct[meth.name] = acq
+        calls[meth.name] = c
+    # transitive may-acquire closure over the class-local call graph
+    may: Dict[str, Set[str]] = {m: set(a) for m, a in direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for m, sites in calls.items():
+            for _held, callee in sites:
+                gain = may.get(callee, set()) - may[m]
+                if gain:
+                    may[m] |= gain
+                    changed = True
+    for m, sites in calls.items():
+        for held, callee in sites:
+            for lk in may.get(callee, ()):
+                edges.update((h, lk) for h in held if h != lk)
+    return edges
+
+
+def graph_cycles(edges: Set[Tuple[str, str]]) -> List[List[str]]:
+    """Elementary cycles of the (small) acquisition graph, each
+    normalized to start at its lexicographically smallest node and
+    deduplicated — stable output for stable finding messages."""
+    adj: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+    seen: Set[Tuple[str, ...]] = set()
+    cycles: List[List[str]] = []
+
+    def dfs(start: str, node: str, path: List[str]) -> None:
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start:
+                rot = min(range(len(path)), key=lambda i: path[i])
+                key = tuple(path[rot:] + path[:rot])
+                if key not in seen:
+                    seen.add(key)
+                    cycles.append(list(key))
+            elif nxt not in path and nxt > start:
+                # only visit nodes above the start so each cycle is
+                # discovered exactly once, from its smallest node
+                dfs(start, nxt, path + [nxt])
+
+    for start in sorted(adj):
+        dfs(start, start, [start])
+    return cycles
+
+
+def lock_order_findings(tree: ast.AST, path: str) -> List[Finding]:
+    """Every acquisition-order cycle in every class of ``tree``."""
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for cyc in graph_cycles(acquisition_graph(node)):
+            order = " -> ".join(cyc + [cyc[0]])
+            findings.append(Finding(
+                rule="lock-order", path=path, line=node.lineno,
+                message=(f"{node.name}: lock acquisition cycle {order} "
+                         "-- two threads taking these locks in opposite "
+                         "orders deadlock; pick ONE order and hold it on "
+                         "every path (or collapse to a single lock)")))
+    return findings
+
+
+@rule("lock-order",
+      "nested 'with self.<lock>:' acquisitions (direct, aliased, or via "
+      "self-method calls) must form a consistent acyclic order per class "
+      "-- the static deadlock lint for the serve fleet's lock trio")
+def _check_lock_order(ctx: LintContext) -> Iterable[Finding]:
+    for pf in ctx.py_files:
+        if pf.tree is None:
+            continue
+        yield from lock_order_findings(pf.tree, pf.rel)

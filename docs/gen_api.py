@@ -93,7 +93,7 @@ PAGES = {
              ["deap_tpu.lint.core", "deap_tpu.lint.baseline",
               "deap_tpu.lint.reporters", "deap_tpu.lint.rules_repo",
               "deap_tpu.lint.rules_jax", "deap_tpu.lint.rules_data",
-              "deap_tpu.lint.cli"]),
+              "deap_tpu.lint.rules_locks", "deap_tpu.lint.cli"]),
     "analysis": ("Program-contract analyzer (deap_tpu.analysis)",
                  ["deap_tpu.analysis.hlo", "deap_tpu.analysis.inventory",
                   "deap_tpu.analysis.passes", "deap_tpu.analysis.cli"]),

@@ -37,3 +37,19 @@ def _suite_compile_cache(tmp_path_factory):
     from deap_tpu.utils.compilecache import enable_compile_cache
     enable_compile_cache(tmp_path_factory.getbasetemp() / "xla-cache",
                          min_compile_time_secs=0.25)
+
+
+@pytest.fixture(scope="session")
+def program_contract_run():
+    """ONE full program-contract analyzer run (every inventory entry,
+    every pass), shared between the cleanliness gate
+    (tests/test_analysis.py) and the wall-time pin
+    (tests/test_tooling.py).  The run lowers AND compiles all 11
+    canonical programs — the single most expensive analysis step in
+    tier-1 — so the suite must never pay for it twice just to assert
+    two properties of the same result."""
+    import time as _time
+    from deap_tpu.analysis.passes import run_analysis
+    t0 = _time.monotonic()
+    result = run_analysis()
+    return result, _time.monotonic() - t0

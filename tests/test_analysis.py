@@ -19,10 +19,17 @@ from deap_tpu.analysis import hlo
 from deap_tpu.analysis.inventory import (INVENTORY, Lowered, ProgramEntry,
                                          entries, lower_entry)
 from deap_tpu.analysis.passes import (DONATION_MIN_BYTES, PASS_NAMES,
-                                      budget_findings, callback_findings,
-                                      compare_budget, donation_findings,
+                                      AnalysisResult, budget_findings,
+                                      callback_findings, compare_budget,
+                                      compare_memory_budget,
+                                      donation_findings, dtype_findings,
+                                      fusion_findings,
                                       measure_budget_counts,
-                                      recompile_findings, run_analysis,
+                                      measure_fusion_metrics,
+                                      measure_memory_stats,
+                                      memory_findings, recompile_findings,
+                                      run_analysis, traffic_bytes,
+                                      update_memory_budget,
                                       update_program_budget)
 
 
@@ -31,19 +38,27 @@ from deap_tpu.analysis.passes import (DONATION_MIN_BYTES, PASS_NAMES,
 # ---------------------------------------------------------------------------
 
 
-def test_program_contract_gate():
+def test_program_contract_gate(program_contract_run):
     """Lower the whole inventory and run every pass: the canonical
     programs must satisfy every contract — no donation leaks, no
     recompile hazards, no callbacks under a mesh, collective counts
-    within tools/program_budget.json."""
-    result = run_analysis()
-    assert len(result.programs) >= 8, \
+    within tools/program_budget.json, footprint/fusion inventories
+    within tools/memory_budget.json, no silent dtype widening.  (The
+    run itself is the shared session fixture; tests/test_tooling.py
+    pins its wall time against the gate budget.)"""
+    result, _wall = program_contract_run
+    assert len(result.programs) >= 11, \
         f"inventory shrank to {result.programs}"
     assert sorted(result.passes_run) == sorted(PASS_NAMES)
     assert result.findings == [], "\n".join(
         f"{f.rule}: {f.message}" for f in result.findings)
     # the serve executables' donation waiver is honored *visibly*
     assert "serve_step_sharded" in result.waived
+    # all 11 entries carry a committed memory/fusion budget row
+    from deap_tpu.analysis.passes import load_memory_budget
+    budget, slack = load_memory_budget()
+    assert set(budget) >= set(result.programs)
+    assert 0.0 <= slack <= 1.0
 
 
 def test_inventory_covers_the_named_surfaces():
@@ -252,8 +267,239 @@ def test_budget_findings_and_update_roundtrip(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# memory-budget / fusion-materialization (can-fail)
+# ---------------------------------------------------------------------------
+
+
+def _clean_mem_build(variant: int = 0):
+    """A program with one fused elementwise body over a 64 KiB input."""
+    def fn(x):
+        return x * 2.0 + 1.0
+    return fn, (jnp.zeros((256, 64), jnp.float32) + variant,)
+
+
+def _bloated_mem_build(variant: int = 0):
+    """The same interface with an injected oversized intermediate: the
+    (256,256) product is a materialized buffer 4x the input — the
+    regression class the committed budget must catch."""
+    def fn(x):
+        y = jnp.dot(x, x.T)                      # 256 KiB intermediate
+        return x * 2.0 + jnp.sum(y)
+    return fn, (jnp.zeros((256, 64), jnp.float32) + variant,)
+
+
+def test_memory_stats_and_budget_roundtrip(tmp_path):
+    low = lower_entry(_entry(_clean_mem_build, name="fixture_prog",
+                             donate_waiver="fixture"))
+    mem = measure_memory_stats(low)
+    assert mem is not None and mem["peak_bytes"] > 0
+    assert mem["argument_bytes"] == 256 * 64 * 4
+    fus = measure_fusion_metrics(low)
+    assert fus is not None and fus["large_bytes_threshold"] == 256 * 64 * 4
+    tr = traffic_bytes(low)
+    assert tr["bytes_moved"] == 2 * 256 * 64 * 4
+    path = tmp_path / "memory_budget.json"
+    update_memory_budget(path, lows=[low])
+    doc = json.loads(path.read_text())
+    assert doc["budget"]["fixture_prog"]["peak_bytes"] == mem["peak_bytes"]
+    assert list(memory_findings([low], path=path)) == []
+    assert list(fusion_findings([low], path=path)) == []
+
+
+def test_injected_oversized_intermediate_fails_the_gate(tmp_path):
+    """THE can-fail acceptance fixture: commit the budget from the clean
+    program, then analyze a build with an injected pop-sized
+    intermediate — the fusion-materialization count gate (and the peak
+    gate, past its slack) must fail with exit code 1."""
+    clean = lower_entry(_entry(_clean_mem_build, name="fixture_prog",
+                               donate_waiver="fixture"))
+    path = tmp_path / "memory_budget.json"
+    update_memory_budget(path, lows=[clean])
+    bloated = lower_entry(_entry(_bloated_mem_build, name="fixture_prog",
+                                 donate_waiver="fixture"))
+    f = list(fusion_findings([bloated], path=path))
+    assert f and any("large_intermediates" in x.message for x in f)
+    f_mem = list(memory_findings([bloated], path=path))
+    assert f_mem and any("peak_bytes" in x.message for x in f_mem)
+    result = AnalysisResult(findings=f + f_mem, programs=["fixture_prog"],
+                            waived={}, passes_run=["memory-budget",
+                                                   "fusion-materialization"])
+    assert result.exit_code == 1    # what deap-tpu-analyze returns
+
+
+def test_compare_memory_budget_semantics():
+    budget = {"prog": {"peak_bytes": 1000, "large_intermediates": 2,
+                       "elementwise_roots": 0}}
+    # byte gates carry slack; count gates are exact
+    assert compare_memory_budget(
+        {"prog": {"peak_bytes": 1200}}, budget, slack_frac=0.25) == []
+    bad = compare_memory_budget(
+        {"prog": {"peak_bytes": 1300}}, budget, slack_frac=0.25)
+    assert len(bad) == 1 and "peak_bytes 1300 exceeds budget 1000" in bad[0]
+    bad = compare_memory_budget(
+        {"prog": {"large_intermediates": 3}}, budget)
+    assert len(bad) == 1 and "large_intermediates x3" in bad[0]
+    assert compare_memory_budget(
+        {"prog": {"large_intermediates": 1}}, budget) == []
+    # an entry with no committed row is itself a violation — reported
+    # once (the fusion pass opts out so one defect is one finding)
+    assert compare_memory_budget({"new_prog": {"peak_bytes": 1}}, budget) \
+        == ["new_prog: no committed memory budget row"]
+    assert compare_memory_budget({"new_prog": {"peak_bytes": 1}}, budget,
+                                 report_missing=False) == []
+    # a hand-edited non-integer cap must not silently disable its gate
+    bad = compare_memory_budget(
+        {"prog": {"peak_bytes": 1}},
+        {"prog": {"peak_bytes": 1.5e8}}, slack_frac=0.25)
+    assert len(bad) == 1 and "not an integer" in bad[0]
+    bad = compare_memory_budget(
+        {"prog": {"large_intermediates": 1}},
+        {"prog": {"large_intermediates": True}})
+    assert len(bad) == 1 and "not an integer" in bad[0]
+
+
+def test_memory_pass_degrades_without_memory_analysis(tmp_path):
+    """Satellite acceptance: a backend whose executable lacks the
+    memory_analysis API produces a single INFORMATIONAL finding — not a
+    crash, not silent success — and does not fail the gate."""
+    class _NoMemExecutable:
+        pass                         # no memory_analysis, no as_text
+
+    entry = ProgramEntry(name="fake_backend_prog",
+                         anchor="tests/fixture.py",
+                         build=lambda variant=0: (None, ()))
+    low = Lowered(entry=entry, fn=None, args=(), lowered=None, text="",
+                  _compiled=_NoMemExecutable())
+    path = tmp_path / "memory_budget.json"
+    path.write_text(json.dumps(
+        {"slack_frac": 0.25, "budget": {"fake_backend_prog": {}}}))
+    f = list(memory_findings([low], path=path))
+    assert len(f) == 1
+    assert f[0].severity == "info"
+    assert "memory_analysis" in f[0].message
+    result = AnalysisResult(findings=f, programs=[entry.name], waived={},
+                            passes_run=["memory-budget"])
+    assert result.exit_code == 0     # informational: never gate-failing
+    # an unreadable budget stays a hard finding, not a crash
+    f = list(memory_findings([low], path=tmp_path / "missing.json"))
+    assert len(f) == 1 and "cannot read" in f[0].message
+    assert f[0].severity == "error"
+
+
+# ---------------------------------------------------------------------------
+# dtype-traffic (can-fail)
+# ---------------------------------------------------------------------------
+
+
+def test_dtype_traffic_flags_f64_text():
+    entry = ProgramEntry(name="wide", anchor="tests/fixture.py",
+                         build=lambda variant=0: (None, ()))
+    low = Lowered(entry=entry, fn=None, args=(), lowered=None,
+                  text="%0 = stablehlo.add %a, %b : tensor<8xf64>")
+    f = list(dtype_findings(low))
+    assert len(f) == 1 and "f64" in f[0].message
+    waived = ProgramEntry(name="wide", anchor="tests/fixture.py",
+                          build=lambda variant=0: (None, ()),
+                          dtype_waiver="legacy f64 benchmark surface")
+    low = Lowered(entry=waived, fn=None, args=(), lowered=None,
+                  text="%0 = stablehlo.add %a, %b : tensor<8xf64>")
+    assert list(dtype_findings(low)) == []
+
+
+def test_dtype_traffic_flags_weak_output():
+    def build(variant: int = 0):
+        def fn(x):
+            return 2.0                    # bare Python scalar survives
+        return fn, (jnp.zeros((8,), jnp.float32),)
+    f = list(dtype_findings(lower_entry(_entry(build))))
+    assert len(f) == 1 and "weak-typed" in f[0].message
+
+
+def test_dtype_traffic_enforces_declared_storage_dtype():
+    def build(variant: int = 0):
+        def fn(x):
+            return x.astype(jnp.float32).sum()
+        return fn, (jnp.zeros((64, 8), jnp.float32),)   # wide leaf
+    wide = _entry(build, storage_dtype="bfloat16")
+    f = list(dtype_findings(lower_entry(wide)))
+    assert len(f) == 1 and "storage dtype bfloat16" in f[0].message
+
+    def narrow_build(variant: int = 0):
+        def fn(x):
+            return x.astype(jnp.float32).sum()
+        return fn, (jnp.zeros((64, 8), jnp.bfloat16),)
+    ok = _entry(narrow_build, storage_dtype="bfloat16")
+    assert list(dtype_findings(lower_entry(ok))) == []
+
+
+def test_run_analysis_reports_per_pass_wall_time():
+    """The gate budget is per-run; every pass's share must be
+    attributable (satellite of the memory-contract PR)."""
+    result = run_analysis(names=["cma_update"],
+                          select=["donation-leak", "dtype-traffic"])
+    assert set(result.timings) == {"lower", "donation-leak",
+                                   "dtype-traffic"}
+    assert all(t >= 0.0 for t in result.timings.values())
+    summary = result.as_dict()["summary"]
+    assert set(summary["pass_wall_s"]) == set(result.timings)
+
+
+# ---------------------------------------------------------------------------
 # hlo text analyzers
 # ---------------------------------------------------------------------------
+
+
+def test_shape_bytes_and_instruction_parsing():
+    assert hlo.shape_bytes("f32[64,8]{1,0}") == 2048
+    assert hlo.shape_bytes("u32[]") == 4
+    assert hlo.shape_bytes("(s32[], u32[3]{0}, f32[2,2]{1,0})") == 32
+    assert hlo.shape_bytes("token[]") == 0
+    assert hlo.instruction_shape_op(
+        "  %multiply.1 = f32[64,8]{1,0} multiply(f32[64,8]{1,0} %a, "
+        "f32[64,8]{1,0} %b)") == ("f32[64,8]{1,0}", "multiply")
+    assert hlo.instruction_shape_op(
+        "  ROOT %w = (s32[], u32[3]{0}) while((s32[], u32[3]{0}) %t), "
+        "condition=%c, body=%b") == ("(s32[], u32[3]{0})", "while")
+    assert hlo.instruction_shape_op("ENTRY %main (x: f32[4]) -> f32[4] {") \
+        is None
+
+
+def test_fusion_metrics_counts_only_unfused_materializations():
+    txt = "\n".join([
+        "HloModule m",
+        "",
+        "%fused_computation (p: f32[1024]) -> f32[1024] {",
+        "  %p = f32[1024]{0} parameter(0)",
+        # inside a fusion body: lives in registers, never counted
+        "  ROOT %add.9 = f32[1024]{0} add(f32[1024]{0} %p, "
+        "f32[1024]{0} %p)",
+        "}",
+        "",
+        "ENTRY %main (x: f32[1024]) -> f32[1024] {",
+        "  %x = f32[1024]{0} parameter(0)",
+        "  %fu = f32[1024]{0} fusion(f32[1024]{0} %x), kind=kLoop, "
+        "calls=%fused_computation",
+        # a non-fused elementwise root over a large buffer: flagged twice
+        # (elementwise + materialized intermediate)
+        "  %mul.1 = f32[1024]{0} multiply(f32[1024]{0} %fu, "
+        "f32[1024]{0} %x)",
+        # small elementwise (scalar loop counter class): not counted
+        "  %cnt = s32[] add(s32[] %c0, s32[] %c1)",
+        # a view op: never a materialization",
+        "  %gte = f32[1024]{0} get-tuple-element((f32[1024]{0}) %tup), "
+        "index=0",
+        "  ROOT %copy.1 = f32[1024]{0} copy(f32[1024]{0} %mul.1)",
+        "}",
+    ])
+    m = hlo.fusion_metrics(txt, large_bytes=4096)
+    assert m == {"fusions": 1, "elementwise_roots": 1,
+                 "large_intermediates": 3}   # fusion out, mul, copy
+
+
+def test_f64_tensor_count():
+    assert hlo.f64_tensor_count("tensor<64x8xf64>") == 1
+    assert hlo.f64_tensor_count("tensor<f64>") == 1
+    assert hlo.f64_tensor_count("tensor<64x8xf32> tensor<8xf16>") == 0
 
 
 def test_collective_counting_rule():
@@ -284,6 +530,27 @@ def test_unknown_entry_and_pass_raise():
         entries(["not_a_program"])
     with pytest.raises(KeyError):
         run_analysis(select=["not-a-pass"])
+
+
+def test_analyze_cli_rc1_on_memory_budget_excess(tmp_path, capsys):
+    """End-to-end acceptance: deap-tpu-analyze exits 1 when an entry's
+    peak bytes (or materialization count) exceeds its committed budget —
+    here a doctored budget file whose caps sit below reality."""
+    from deap_tpu.analysis.cli import main
+    path = tmp_path / "memory_budget.json"
+    path.write_text(json.dumps({
+        "slack_frac": 0.25,
+        "budget": {"cma_update": {"peak_bytes": 1,
+                                  "large_intermediates": 0,
+                                  "elementwise_roots": 0}}}))
+    rc = main(["cma_update",
+               "--select", "memory-budget,fusion-materialization",
+               "--memory-budget-file", str(path), "--format", "json"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    report = json.loads(out)
+    assert any("peak_bytes" in f["message"]
+               for f in report["findings"])
 
 
 def test_update_budget_refuses_partial_runs(capsys):

@@ -349,6 +349,158 @@ def test_bench_json_accepts_committed_shapes():
     assert r.findings == [], render_text(r)
 
 
+def test_bench_json_memory_schema(tmp_path):
+    """BENCH_MEMORY.json schema (can-fail): int rc / bool ok, entry-
+    keyed rows of finite non-negative byte counts."""
+    (tmp_path / "BENCH_MEMORY.json").write_text(json.dumps({
+        "rc": True,                       # bool where int belongs
+        "ok": "yes",                      # string where bool belongs
+        "entries": {
+            "ga_generation_scan": {"peak_bytes": -5,
+                                   "argument_bytes": 26476552.5,
+                                   "fusions": 114},
+            "broken_row": 7,
+        }}))
+    r = _findings(tmp_path, "bench-json")
+    msgs = [f.message for f in r.findings
+            if f.path == "BENCH_MEMORY.json"]
+    assert any("'rc' must be an integer" in m for m in msgs)
+    assert any("'ok' must be a boolean" in m for m in msgs)
+    assert any("'peak_bytes'" in m and "non-negative" in m for m in msgs)
+    assert any("'argument_bytes'" in m for m in msgs)
+    assert any("must be an object" in m for m in msgs)
+    # a well-formed record (the committed artifact's shape) is clean
+    (tmp_path / "BENCH_MEMORY.json").write_text(json.dumps({
+        "rc": 0, "ok": True,
+        "entries": {"ga_generation_scan": {
+            "peak_bytes": 105907592, "argument_bytes": 26476552,
+            "fusions": 114, "large_intermediates": 3}}}))
+    r = _findings(tmp_path, "bench-json")
+    assert [f for f in r.findings if f.path == "BENCH_MEMORY.json"] == []
+
+
+# ---------------------------------------------------------------------------
+# lock-order (static deadlock lint)
+
+
+def test_lock_order_cycle_fires_on_inverted_acquisition(tmp_path):
+    """THE can-fail fixture: two methods taking the same two locks in
+    opposite orders is the textbook interleaving deadlock."""
+    _write(tmp_path, "deap_tpu/serve/deadlocky.py", """\
+        import threading
+
+        class Inverted:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._lock = threading.Lock()
+
+            def submit(self):
+                with self._cv:
+                    with self._lock:
+                        pass
+
+            def fail_path(self):
+                with self._lock:
+                    with self._cv:
+                        pass
+        """)
+    r = _findings(tmp_path, "lock-order")
+    assert len(r.findings) == 1, render_text(r)
+    f = r.findings[0]
+    assert f.rule == "lock-order"
+    assert "_cv -> _lock -> _cv" in f.message
+    assert "deadlock" in f.message
+
+
+def test_lock_order_consistent_nesting_is_clean(tmp_path):
+    _write(tmp_path, "deap_tpu/serve/orderly.py", """\
+        import threading
+
+        class Consistent:
+            _GUARDED_BY = {"_cv": ("_pending",), "_lock": ("_table",)}
+
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._lock = threading.Lock()
+
+            def submit(self):
+                with self._cv:
+                    with self._lock:
+                        pass
+
+            def other_path(self):
+                with self._cv:
+                    with self._lock:
+                        pass
+
+            def single(self):
+                with self._lock:
+                    pass
+        """)
+    r = _findings(tmp_path, "lock-order")
+    assert r.findings == [], render_text(r)
+
+
+def test_lock_order_resolves_aliases_and_self_calls(tmp_path):
+    """The two resolution layers the serve code actually uses: a local
+    lock alias (``cv = self._cv``) and a self-method call that acquires
+    the second lock — the inversion is only visible interprocedurally."""
+    _write(tmp_path, "deap_tpu/serve/indirect.py", """\
+        import threading
+
+        class Indirect:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._lock = threading.Lock()
+
+            def _take_lock(self):
+                with self._lock:
+                    pass
+
+            def submit(self):
+                cv = self._cv
+                with cv:
+                    self._take_lock()
+
+            def fail_path(self):
+                with self._lock:
+                    with self._cv:
+                        pass
+        """)
+    r = _findings(tmp_path, "lock-order")
+    assert len(r.findings) == 1, render_text(r)
+    assert "_cv -> _lock -> _cv" in r.findings[0].message
+
+
+def test_lock_order_reentrant_helper_not_flagged(tmp_path):
+    """Re-entry (a *_locked helper acquiring the lock its caller holds)
+    is an RLock legality question, not an ordering cycle."""
+    _write(tmp_path, "deap_tpu/serve/reentrant.py", """\
+        import threading
+
+        class Reentrant:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._cv = threading.Condition()
+
+            def _poke_locked(self):
+                with self._lock:
+                    pass
+
+            def submit(self):
+                with self._lock:
+                    self._poke_locked()
+        """)
+    r = _findings(tmp_path, "lock-order")
+    assert r.findings == [], render_text(r)
+
+
+def test_lock_order_registered_default_on():
+    rule = get_rule("lock-order")
+    assert rule.default, "lock-order must run in the tier-1 gate"
+    assert "deadlock" in rule.doc
+
+
 # ---------------------------------------------------------------------------
 # framework behaviors
 

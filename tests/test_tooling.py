@@ -34,8 +34,9 @@ def test_lint_gate():
     report = json.loads(out.stdout)
     assert report["summary"]["findings"] == 0
     assert {"no-bare-print", "no-blocking-sleep", "lock-discipline",
-            "metric-discipline", "trace-impurity", "rng-key-reuse",
-            "tracer-leak", "bench-json"} <= set(report["summary"]["rules_run"])
+            "lock-order", "metric-discipline", "trace-impurity",
+            "rng-key-reuse", "tracer-leak",
+            "bench-json"} <= set(report["summary"]["rules_run"])
     assert "collective-budget" not in report["summary"]["rules_run"], \
         "the heavy lowering pass must not run in the default gate"
     assert "program-contract" not in report["summary"]["rules_run"], \
@@ -211,6 +212,45 @@ def test_analyze_entry_and_budget_wired():
     for name in ("serve_step_sharded", "nsga2_sharded_indices",
                  "nsga2_sharded_rows"):
         assert name in doc["budget"], f"budget lost entry {name}"
+    # the memory & fusion contract tier: every inventory entry must have
+    # a committed footprint/materialization row with its gated metrics
+    with open(os.path.join(REPO, "tools", "memory_budget.json")) as f:
+        mem = json.load(f)
+    assert isinstance(mem["budget"], dict) and len(mem["budget"]) >= 11, \
+        "tools/memory_budget.json must cover the whole inventory"
+    assert 0.0 <= float(mem["slack_frac"]) <= 1.0
+    for name, row in mem["budget"].items():
+        for key in ("peak_bytes", "large_intermediates",
+                    "elementwise_roots", "fusions", "bytes_moved"):
+            assert key in row, f"memory budget row {name} lost {key}"
+
+
+def test_analyze_per_pass_wall_time_and_gate_bound(program_contract_run):
+    """The analyzer must attribute its wall time per pass (a slow new
+    pass is findable from the summary, not just the run total), and the
+    whole in-gate analysis run must stay under the 600s bound the
+    program-contract lint rule already allots its subprocess."""
+    result, wall = program_contract_run
+    from deap_tpu.analysis.passes import PASS_NAMES
+    assert set(result.timings) == set(PASS_NAMES) | {"lower"}
+    assert all(t >= 0.0 for t in result.timings.values())
+    assert sum(result.timings.values()) <= wall + 1.0
+    summary = result.as_dict()["summary"]
+    assert set(summary["pass_wall_s"]) == set(result.timings)
+    assert wall < 600.0, \
+        f"in-gate analysis run took {wall:.0f}s (bound 600s)"
+
+
+def test_analyze_cli_prints_pass_wall_summary(capsys):
+    """The text summary's attribution line (cheap restricted run — the
+    full-run timing rides the shared session fixture above)."""
+    from deap_tpu.analysis.cli import main
+    rc = main(["cma_update", "--select", "donation-leak,dtype-traffic"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "pass wall:" in out
+    assert "donation-leak" in out and "dtype-traffic" in out
+    assert "lower" in out
 
 
 def test_serve_entry_and_extra_wired():

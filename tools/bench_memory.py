@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Memory-footprint trajectory anchor for the flagship GA generation
+scan (the ROADMAP raw-speed item's scoreboard, enforced per-program by
+the ``memory-budget``/``fusion-materialization`` passes of
+``deap_tpu.analysis``).
+
+Measures the DONATED whole-run GA scan at the bench_donation shapes
+(the same program, built by the same
+``deap_tpu.analysis.inventory.build_ga_scan``, that
+``tools/bench_donation.py`` times and the ``ga_generation_scan``
+inventory entry gates — three call sites, ONE builder, zero drift) and
+records:
+
+* peak / argument / output / temp / alias bytes from XLA's
+  ``memory_analysis`` (the compiler's own buffer assignment — no timer
+  noise);
+* the fusion/materialization scoreboard of the optimized HLO (fusion
+  kernels, non-fused elementwise roots, pop-sized materialized
+  intermediates) — the numbers the future select→mate→mutate Pallas
+  megakernel must drive down at the measurement shape, not just at the
+  gate's canonical shape;
+* a consistency cross-check against the committed BENCH_DONATION.json:
+  the donated peak measured here must match that artifact's donated
+  ``peak_bytes_upper_bound``, and must confirm the −20%-of-undonated
+  result.
+
+Prints ONE JSON object (committed as BENCH_MEMORY.json), schema-gated
+tier-1 by the ``bench-json`` lint pass ("memory" record: integer
+``rc``, boolean ``ok``, entry-keyed rows of non-negative integer byte
+counts).
+
+Env: BENCH_MEM_POP (default 65536), BENCH_MEM_DIM (100),
+BENCH_MEM_NGEN (8).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+POP = int(os.environ.get("BENCH_MEM_POP", 65536))
+DIM = int(os.environ.get("BENCH_MEM_DIM", 100))
+NGEN = int(os.environ.get("BENCH_MEM_NGEN", 8))
+
+#: byte-level agreement demanded with BENCH_DONATION.json's donated leg
+#: (same program, same shapes — only a toolchain bump moves it)
+CONSISTENCY_TOL = 0.05
+
+
+def main() -> int:
+    import jax
+
+    from deap_tpu.analysis import hlo
+    from deap_tpu.analysis.inventory import build_ga_scan
+    from deap_tpu.analysis.passes import DONATION_MIN_BYTES
+
+    run, args = build_ga_scan(pop=POP, dim=DIM, ngen=NGEN)
+    compiled = jax.jit(run, donate_argnums=(0, 1, 2)).lower(*args).compile()
+
+    # same degradation contract as the memory-budget pass: a backend
+    # without the API yields a valid (rc=1, ok=false) record, never a
+    # traceback with no JSON for the schema gate to see
+    row = {}
+    try:
+        m = compiled.memory_analysis()
+        for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "alias_size_in_bytes"):
+            v = getattr(m, k, None)
+            if v is not None:
+                row[k.replace("_size_in_bytes", "_bytes")] = int(v)
+    except Exception:   # noqa: BLE001 — absence of the API
+        row = {}
+    if row:
+        row["peak_bytes"] = (row.get("argument_bytes", 0)
+                             + row.get("output_bytes", 0)
+                             + row.get("temp_bytes", 0)
+                             - row.get("alias_bytes", 0))
+
+    genome_bytes = POP * DIM * 4
+    fusion = {}
+    try:
+        fusion = hlo.fusion_metrics(compiled.as_text(),
+                                    max(DONATION_MIN_BYTES, genome_bytes))
+        fusion["large_bytes_threshold"] = max(DONATION_MIN_BYTES,
+                                              genome_bytes)
+    except Exception:   # noqa: BLE001 — no compiled text on this backend
+        fusion = {}
+
+    result = {
+        "cmd": "python tools/bench_memory.py",
+        "rc": 0, "ok": True,
+        "pop": POP, "dim": DIM, "ngen": NGEN,
+        "platform": jax.devices()[0].platform,
+        "entries": {"ga_generation_scan": {**row, **fusion}},
+    }
+    if not row:
+        result["ok"] = False
+        result["rc"] = 1
+        result["degraded"] = ("backend does not expose memory_analysis "
+                              "on the compiled executable")
+
+    don_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_DONATION.json")
+    try:
+        with open(don_path) as f:
+            don = json.load(f)["result"]
+    except (OSError, KeyError, ValueError):
+        don = None
+    if row and don and don.get("pop") == POP and don.get("dim") == DIM:
+        donated = don["donated"]["memory"]["peak_bytes_upper_bound"]
+        undonated = don["undonated"]["memory"]["peak_bytes_upper_bound"]
+        delta = abs(row["peak_bytes"] - donated) / max(1, donated)
+        saved_frac = (undonated - row["peak_bytes"]) / max(1, undonated)
+        consistent = bool(delta <= CONSISTENCY_TOL and saved_frac >= 0.15)
+        result["donation_consistency"] = {
+            "bench_donation_donated_peak_bytes": int(donated),
+            "bench_donation_undonated_peak_bytes": int(undonated),
+            "relative_delta": round(delta, 4),
+            "peak_saved_fraction_vs_undonated": round(saved_frac, 4),
+            "ok": consistent,
+        }
+        if not consistent:
+            result["ok"] = False
+            result["rc"] = 1
+    result["note"] = (
+        "donated whole-run GA generation scan at the bench_donation "
+        "shapes, same build_ga_scan builder as the gate's "
+        "ga_generation_scan entry; peak_bytes = args+outputs+temps-"
+        "aliased from XLA memory_analysis; fusion metrics counted by "
+        "deap_tpu.analysis.hlo.fusion_metrics at a genome-sized "
+        "threshold; cross-checked against BENCH_DONATION.json's "
+        "donated/undonated legs (the -20% peak result).  Per-program "
+        "budgets at canonical shapes are gated by tools/"
+        "memory_budget.json through deap-tpu-analyze")
+    print(json.dumps(result))
+    return result["rc"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())
