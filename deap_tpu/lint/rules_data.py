@@ -39,7 +39,8 @@ def bench_json_targets(repo: Path) -> List[Tuple[str, Path]]:
     ``tools/bench_serve.py --net --trace``) gets its own stricter
     schema."""
     out: List[Tuple[str, Path]] = []
-    _SPECIAL = {"BENCH_TRACE.json": "trace", "BENCH_MEMORY.json": "memory"}
+    _SPECIAL = {"BENCH_TRACE.json": "trace", "BENCH_MEMORY.json": "memory",
+                "BENCH_FLEET.json": "fleet"}
     for p in sorted(repo.glob("BENCH_*.json")):
         out.append((_SPECIAL.get(p.name, "bench"), p))
     for p in sorted(repo.glob("MULTICHIP_*.json")):
@@ -155,6 +156,39 @@ def _schema_errors(kind: str, doc) -> List[str]:
                     elif isinstance(v, float) and not math.isfinite(v):
                         errors.append(
                             f"entries[{name!r}][{k!r}] must be finite")
+    elif kind == "fleet":
+        # BENCH_FLEET.json: the router-tier scale proof from
+        # tools/bench_fleet.py — runner status plus the three fleet
+        # metrics the drill claims (per-instance throughput, failover
+        # recovery, tenant fairness), each pinned finite so a malformed
+        # commit fails tier-1 before the trajectory tooling reads it
+        if not isinstance(doc.get("rc"), int) or isinstance(doc.get("rc"),
+                                                            bool):
+            errors.append("key 'rc' must be an integer")
+        if not isinstance(doc.get("ok"), bool):
+            errors.append("key 'ok' must be a boolean")
+        sessions = doc.get("sessions")
+        if isinstance(sessions, bool) or not isinstance(sessions, int) \
+                or sessions < 1:
+            errors.append("key 'sessions' must be a positive integer "
+                          "(the remote-session count the loadgen drove)")
+        per = doc.get("per_instance_throughput")
+        if not isinstance(per, dict) or not per:
+            errors.append("key 'per_instance_throughput' must be a "
+                          "non-empty object {instance: steps_per_s}")
+        else:
+            for inst, v in per.items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                        or not math.isfinite(float(v)) or v < 0:
+                    errors.append(
+                        f"per_instance_throughput[{inst!r}] must be a "
+                        "finite non-negative number")
+        for key in ("failover_recovery_s", "tenant_fairness_ratio"):
+            v = doc.get(key)
+            if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                    or not math.isfinite(float(v)) or v < 0:
+                errors.append(f"key '{key}' must be a finite non-negative "
+                              "number")
     elif kind == "multichip":
         if not isinstance(doc.get("rc"), int) or isinstance(doc.get("rc"),
                                                             bool):

@@ -36,6 +36,7 @@ SANCTIONED_PRINT_MODULES = {
     "observability/sinks.py",
     "observability/cli.py",
     "serve/cli.py",
+    "serve/router/cli.py",
     "selftest.py",
     "resilience/faultdrill.py",
     "native/build.py",
@@ -76,7 +77,7 @@ def _check_bare_print(ctx: LintContext) -> Iterable[Finding]:
 
 #: subpackages of deap_tpu/serve/ the walk MUST find modules under — a
 #: rename/move fails the gate instead of silently shrinking its scope
-REQUIRED_SLEEP_SUBPACKAGES = ("net",)
+REQUIRED_SLEEP_SUBPACKAGES = ("net", "router")
 
 
 def _time_sleep_spellings(tree: ast.AST) -> Tuple[Set[str], Set[str]]:
@@ -428,7 +429,9 @@ METRIC_REGISTRY_MODULE = "deap_tpu/serve/metrics.py"
 METRIC_REGISTRY_TUPLES = {
     "SERVE_COUNTERS": ("inc",),
     "NET_COUNTERS": ("inc",),
+    "ROUTER_COUNTERS": ("inc",),
     "SERVE_GAUGES": ("set_gauge",),
+    "ROUTER_GAUGES": ("set_gauge",),
     "TENANT_COUNTERS": ("inc_tenant",),
 }
 

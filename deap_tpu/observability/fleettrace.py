@@ -46,7 +46,8 @@ from typing import Any, Dict, Iterator, List, Optional
 from .sinks import emit_text
 
 __all__ = ["TraceContext", "SpanRecord", "FleetTracer", "TRACE_KEY",
-           "new_trace_id", "new_span_id", "current", "set_current", "use"]
+           "new_trace_id", "new_span_id", "current", "set_current", "use",
+           "join_spans", "span_tree"]
 
 #: key the wire protocol stores a trace context under in the DTF1 frame's
 #: JSON header (beside ``"body"`` and ``"__tensors__"``)
@@ -167,6 +168,48 @@ def use(ctx: Optional[TraceContext]) -> Iterator[Optional[TraceContext]]:
 # ---------------------------------------------------------------------------
 # the recorder
 # ---------------------------------------------------------------------------
+
+
+def join_spans(by_source: Dict[str, List[dict]]) -> List[dict]:
+    """Merge span-dict lists from several processes (the router's own
+    ring plus each backend's ``GET /v1/trace`` window) into one flat
+    list, each span annotated with ``attrs["source"]`` naming the
+    process it came from.  The shared ``trace_id`` is what joins a
+    request's spans across the fleet — this is the router health loop's
+    raw material (and the postmortem view of a cross-instance request)."""
+    merged: List[dict] = []
+    for source, spans in by_source.items():
+        for s in spans:
+            s = dict(s)
+            attrs = dict(s.get("attrs") or {})
+            attrs.setdefault("source", source)
+            s["attrs"] = attrs
+            merged.append(s)
+    merged.sort(key=lambda s: (s.get("trace_id", ""), s.get("t0", 0.0)))
+    return merged
+
+
+def span_tree(spans: List[dict]) -> List[dict]:
+    """Nest a flat span-dict list into parent→children trees (each node
+    gains a ``"children"`` list; roots are spans whose parent is absent
+    from the set — including spans whose parent hop lives on ANOTHER
+    process that contributed no ring, the normal case for a router
+    joining backend windows).  Children sort by ``t0``.  Used by the
+    router's health loop to walk one request's cross-instance story and
+    by ``deap-tpu-trace``-style postmortems."""
+    nodes = {s["span_id"]: dict(s, children=[]) for s in spans
+             if s.get("span_id")}
+    roots: List[dict] = []
+    for node in nodes.values():
+        parent = node.get("parent_id")
+        if parent and parent in nodes and parent != node["span_id"]:
+            nodes[parent]["children"].append(node)
+        else:
+            roots.append(node)
+    for node in nodes.values():
+        node["children"].sort(key=lambda s: s.get("t0", 0.0))
+    roots.sort(key=lambda s: s.get("t0", 0.0))
+    return roots
 
 
 class FleetTracer:

@@ -43,7 +43,8 @@ from ..resilience.retry import with_retries, RetriesExhausted
 
 __all__ = ["ServeFuture", "Request", "BatchDispatcher", "ServeError",
            "ServiceClosed", "ServiceOverloaded", "DeadlineExceeded",
-           "RequestCancelled", "ServiceDraining", "SessionUnknown"]
+           "RequestCancelled", "ServiceDraining", "SessionUnknown",
+           "TenantQuotaExceeded"]
 
 
 class ServeError(RuntimeError):
@@ -65,6 +66,15 @@ class SessionUnknown(ServeError):
 
 class ServiceOverloaded(ServeError):
     """The bounded request queue is full — shed load or retry later."""
+
+
+class TenantQuotaExceeded(ServeError):
+    """The request's tenant is over an admission quota (session count or
+    queued-request backlog) at the fleet router — a per-tenant admission
+    decision, distinct from :class:`ServiceOverloaded` (whole-service
+    backpressure).  Raised by
+    :mod:`deap_tpu.serve.router.tenants` and rebuilt typed on the client
+    from the wire error envelope."""
 
 
 class DeadlineExceeded(ServeError):
@@ -263,7 +273,24 @@ class BatchDispatcher:
                timeout: Optional[float] = None) -> ServeFuture:
         """Enqueue; on a full queue either raise :class:`ServiceOverloaded`
         (default) or block up to ``timeout`` for space."""
-        request.submitted = self._clock()
+        return self.submit_many([request], block=block,
+                                timeout=timeout)[0]
+
+    def submit_many(self, requests: List[Request], *, block: bool = False,
+                    timeout: Optional[float] = None) -> List[ServeFuture]:
+        """Enqueue several requests **atomically**: either every request
+        is queued or none is.  This is how ``Session.step(n)`` pipelines
+        its n generations — a drain (or close, or full queue) racing the
+        submission must never split the pipeline, queueing a prefix that
+        executes while the caller is told the call failed.  The failover
+        retry story depends on it: a ``ServiceDraining`` rejection
+        PROVES nothing of the call ran, so re-sending the whole call to
+        the restored instance cannot double-apply a generation."""
+        if not requests:
+            return []
+        now = self._clock()
+        for request in requests:
+            request.submitted = now
         with self._cv:
             if self._closed:
                 raise ServiceClosed("service is closed")
@@ -273,32 +300,58 @@ class BatchDispatcher:
                 # drain wait — the failover snapshot sits at a boundary
                 # every client observed
                 raise ServiceDraining("service is draining for failover")
-            if len(self._pending) >= self.max_pending:
+            if len(requests) > self.max_pending:
+                # an atomic batch bigger than the queue can EVER hold
+                # would wait on a predicate no completion satisfies —
+                # fail fast instead of hanging (or spin-rejecting) the
+                # caller forever
+                if self._metrics is not None:
+                    self._metrics.inc("rejected", len(requests))
+                    for r in requests:
+                        self._metrics.inc_tenant(r.tenant, "rejected")
+                raise ServiceOverloaded(
+                    f"an atomic batch of {len(requests)} requests can "
+                    f"never fit the queue (max_pending="
+                    f"{self.max_pending}); split the call or raise "
+                    "max_pending")
+            if len(self._pending) + len(requests) > self.max_pending:
                 # cancelled/expired entries still hold queue slots until
                 # the worker reaches them — resolve them here instead of
                 # shedding live work while the queue is full of corpses
                 self._pending = collections.deque(
                     r for r in self._pending if not self._prune_locked(r))
-            if len(self._pending) >= self.max_pending:
+            if len(self._pending) + len(requests) > self.max_pending:
                 if not block or not self._cv.wait_for(
-                        lambda: self._closed
-                        or len(self._pending) < self.max_pending,
+                        lambda: self._closed or self._draining
+                        or (len(self._pending) + len(requests)
+                            <= self.max_pending),
                         timeout=timeout):
                     if self._metrics is not None:
-                        self._metrics.inc("rejected")
-                        self._metrics.inc_tenant(request.tenant, "rejected")
+                        self._metrics.inc("rejected", len(requests))
+                        for r in requests:
+                            self._metrics.inc_tenant(r.tenant, "rejected")
                     raise ServiceOverloaded(
                         f"{len(self._pending)} requests pending "
                         f"(max_pending={self.max_pending})")
                 if self._closed:
                     raise ServiceClosed("service is closed")
-            self._pending.append(request)
+                if self._draining:
+                    # a drain that landed while this submission was
+                    # blocked on queue space: enqueueing now would slip
+                    # work behind the drain wait, after set_draining()
+                    # promised the pending queue can only shrink
+                    raise ServiceDraining(
+                        "service is draining for failover")
+            self._pending.extend(requests)
             if self._metrics is not None:
-                self._metrics.inc("requests")
-                self._metrics.inc_tenant(request.tenant, "requests")
+                self._metrics.inc("requests", len(requests))
+                # per-request tenant rows: a batch is not required to be
+                # single-session, so requests[0] must not absorb them all
+                for r in requests:
+                    self._metrics.inc_tenant(r.tenant, "requests")
                 self._metrics.set_gauge("queue_depth", len(self._pending))
             self._cv.notify_all()
-        return request.future
+        return [r.future for r in requests]
 
     def set_draining(self, value: bool = True) -> None:
         """Reject (``ServiceDraining``) every submission from now on —

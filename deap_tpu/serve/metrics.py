@@ -36,7 +36,8 @@ from typing import Dict, Iterable, Optional
 from ..observability.sinks import MetricRecord, emit_record
 
 __all__ = ["ServeMetrics", "SERVE_COUNTERS", "SERVE_GAUGES", "NET_COUNTERS",
-           "TENANT_COUNTERS", "prometheus_text"]
+           "ROUTER_COUNTERS", "ROUTER_GAUGES", "TENANT_COUNTERS",
+           "prometheus_text"]
 
 #: Counters the service maintains (cumulative over the service lifetime).
 SERVE_COUNTERS = (
@@ -53,7 +54,28 @@ SERVE_COUNTERS = (
 #: covers both the HTTP edge and the device control plane.
 NET_COUNTERS = (
     "net_requests", "net_errors", "net_streams",
-    "net_bytes_in", "net_bytes_out",
+    "net_bytes_in", "net_bytes_out", "net_bytes_saved",
+    "net_frames_compressed",
+)
+
+#: Counters of the fleet router (deap_tpu.serve.router) — the control
+#: plane ABOVE one instance.  Kept in this module so the
+#: ``metric-discipline`` lint's committed-registry diff covers router
+#: inc-sites exactly like service ones; the router's ServeMetrics store
+#: is constructed with ``extra_counters=ROUTER_COUNTERS``.
+ROUTER_COUNTERS = (
+    "router_requests", "router_errors", "router_forwards",
+    "router_forward_retries", "router_sessions_placed",
+    "router_sessions_closed", "router_placements_warm",
+    "router_quota_rejections", "router_health_probes",
+    "router_backends_sick", "router_failovers", "router_failover_sessions",
+    "router_orphans_replaced", "router_sessions_lost",
+)
+
+#: Gauges of the fleet router (last-value).
+ROUTER_GAUGES = (
+    "router_backends_alive", "router_sessions_routed",
+    "router_inflight", "router_failover_recovery_s",
 )
 
 #: Gauges (last-value).
@@ -78,13 +100,22 @@ class ServeMetrics:
     ``max_tenants`` bounds the per-tenant table: when a fresh tenant
     would exceed it, the oldest tenant's row is evicted (the table is a
     live attribution view, not an accounting ledger — long-lived fleets
-    must not leak a row per dead session forever)."""
+    must not leak a row per dead session forever).
 
-    def __init__(self, latency_window: int = 2048, max_tenants: int = 4096):
+    ``extra_counters`` / ``extra_gauges`` pre-register additional name
+    families in the snapshot (the router passes
+    :data:`ROUTER_COUNTERS`/:data:`ROUTER_GAUGES`) — backend snapshots
+    stay free of zero-valued router series."""
+
+    def __init__(self, latency_window: int = 2048, max_tenants: int = 4096,
+                 extra_counters: Iterable[str] = (),
+                 extra_gauges: Iterable[str] = ()):
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {
-            k: 0 for k in SERVE_COUNTERS + NET_COUNTERS}
-        self._gauges: Dict[str, float] = {k: 0.0 for k in SERVE_GAUGES}
+            k: 0 for k in SERVE_COUNTERS + NET_COUNTERS
+            + tuple(extra_counters)}
+        self._gauges: Dict[str, float] = {
+            k: 0.0 for k in SERVE_GAUGES + tuple(extra_gauges)}
         self._latency: Dict[str, collections.deque] = {}
         self._window = int(latency_window)
         self._tenants: "collections.OrderedDict[str, Dict[str, int]]" = \

@@ -42,7 +42,8 @@ import jax.numpy as jnp
 from ...base import Population, Fitness
 from ...observability.fleettrace import FleetTracer
 from ...observability.sinks import MetricRecord
-from ..dispatcher import ServeError, ServeFuture, ServiceClosed
+from ..dispatcher import (DeadlineExceeded, ServeError, ServeFuture,
+                          ServiceClosed)
 from . import protocol
 
 __all__ = ["RemoteService", "RemoteSession"]
@@ -67,14 +68,34 @@ class _Worker:
     thread.  Jobs run strictly in submission order; a job's ``resolve``
     callback receives ``(result, exception)``."""
 
-    def __init__(self, host: str, port: int, timeout: float):
+    def __init__(self, host: str, port: int, timeout: float,
+                 request_timeout: Optional[float] = None):
         self._host, self._port, self._timeout = host, port, timeout
+        #: per-request response deadline (socket timeout on the ordered
+        #: connection): a hung backend fails the ONE waiting future with
+        #: typed DeadlineExceeded instead of blocking this worker thread
+        #: forever; None falls back to the connection timeout
+        self._request_timeout = request_timeout
         self._conn: Optional[http.client.HTTPConnection] = None
         self._jobs: "queue.Queue" = queue.Queue()
         self._closed = False
+        # retargets land here from ANY thread (a _sync caller following
+        # a redirect) and are applied by the worker thread itself at its
+        # next _connection() — the worker owns the live connection, and
+        # closing it cross-thread would kill a response mid-read
+        self._target_lock = threading.Lock()
+        self._pending_target: Optional[Tuple[str, int]] = None
         self._thread = threading.Thread(target=self._run,
                                         name="deap-tpu-remote", daemon=True)
         self._thread.start()
+
+    def retarget(self, host: str, port: int) -> None:
+        """Point the ordered connection at a new instance (failover
+        redirect).  Thread-safe: the new address is latched and the
+        worker thread applies it — dropping its own connection — before
+        its next request."""
+        with self._target_lock:
+            self._pending_target = (host, int(port))
 
     def submit(self, job: Callable, resolve: Callable) -> None:
         if self._closed:
@@ -91,9 +112,16 @@ class _Worker:
             self._conn = None
 
     def _connection(self) -> http.client.HTTPConnection:
+        with self._target_lock:
+            target, self._pending_target = self._pending_target, None
+        if target is not None and target != (self._host, self._port):
+            self._host, self._port = target
+            self._drop_connection()
         if self._conn is None:
+            t = (self._request_timeout if self._request_timeout is not None
+                 else self._timeout)
             self._conn = http.client.HTTPConnection(
-                self._host, self._port, timeout=self._timeout)
+                self._host, self._port, timeout=t)
         return self._conn
 
     def _run(self) -> None:
@@ -119,11 +147,32 @@ class _Worker:
                     self._drop_connection()
                     resolve(None, e2.cause)
                     continue
+                except TimeoutError as e2:
+                    self._drop_connection()
+                    resolve(None, DeadlineExceeded(
+                        "no response from "
+                        f"{self._host}:{self._port} within "
+                        f"{self._request_timeout or self._timeout}s "
+                        f"({e2 or 'socket timeout'})"))
+                    continue
                 except Exception as e2:  # noqa: BLE001
                     self._drop_connection()
                     resolve(None, e2)
                     continue
                 resolve(result, None)
+                continue
+            except TimeoutError as e:
+                # the per-request deadline passed with no response: the
+                # typed failure the serving stack already speaks.  The
+                # connection is poisoned (a late response would answer
+                # the WRONG request) — drop it; the worker moves on to
+                # the next job instead of blocking forever
+                self._drop_connection()
+                resolve(None, DeadlineExceeded(
+                    "no response from "
+                    f"{self._host}:{self._port} within "
+                    f"{self._request_timeout or self._timeout}s "
+                    f"({e or 'socket timeout'})"))
                 continue
             except (http.client.HTTPException, OSError) as e:
                 # response-phase failure: the server MAY have executed the
@@ -156,9 +205,17 @@ class _SendFailed(Exception):
 
 
 def _request(conn: http.client.HTTPConnection, method: str, path: str,
-             obj: Any = None, trace: Any = None) -> Any:
-    body = None if obj is None else protocol.encode_frame(obj, trace=trace)
+             obj: Any = None, trace: Any = None,
+             compress: Optional[str] = None,
+             accept: Tuple[str, ...] = ("zlib",)) -> Any:
+    body = (None if obj is None
+            else protocol.encode_frame(obj, trace=trace, compress=compress,
+                                       accept=accept))
     headers = {"Content-Type": protocol.CONTENT_TYPE}
+    if accept:
+        # bodyless requests (population GETs — the responses most worth
+        # compressing) advertise through the HTTP header channel
+        headers[protocol.ACCEPT_HEADER] = ",".join(accept)
     try:
         conn.request(method, path, body=body, headers=headers)
     except (http.client.HTTPException, OSError) as e:
@@ -171,8 +228,15 @@ def _request(conn: http.client.HTTPConnection, method: str, path: str,
             err = json.loads(data.decode("utf-8"))
         except (ValueError, UnicodeDecodeError):
             raise ServeError(f"HTTP {resp.status}: {data[:200]!r}")
-        raise protocol.remote_exception(err.get("error", "ServeError"),
+        exc = protocol.remote_exception(err.get("error", "ServeError"),
                                         err.get("message", ""))
+        # a drained instance's envelope may carry the replacement's URL;
+        # the caller (RemoteService) follows it — the rejected request
+        # never executed, so a re-send cannot double-apply
+        loc = err.get("location")
+        if isinstance(loc, str) and loc:
+            exc.remote_location = loc
+        raise exc
     if not data:
         return None
     if data[:4] == protocol.MAGIC:
@@ -183,32 +247,81 @@ def _request(conn: http.client.HTTPConnection, method: str, path: str,
 class RemoteService:
     """Client handle on one :class:`~deap_tpu.serve.net.server.NetServer`
     instance (see module docstring).  ``address`` is ``"host:port"``,
-    ``(host, port)`` or an ``http://`` URL."""
+    ``(host, port)`` or an ``http://`` URL.
+
+    ``request_timeout`` bounds each ordered request's wait for a
+    response: a hung backend fails that ONE future with typed
+    :class:`~deap_tpu.serve.dispatcher.DeadlineExceeded` (and the worker
+    reconnects for the next job) instead of wedging the ordered pipeline
+    forever.  ``compress="zlib"`` deflates outgoing tensor payloads (big
+    tells/evaluates); the client always advertises what it can inflate,
+    so servers compress responses regardless.  ``follow_redirects``
+    (default on) makes the client transparently re-target when a drained
+    instance's error envelope names the replacement — the failover moves
+    without the caller seeing an exception."""
 
     def __init__(self, address, *, timeout: float = 600.0,
+                 request_timeout: Optional[float] = None,
+                 compress: Optional[str] = None,
+                 follow_redirects: bool = True,
                  tracer: Optional[FleetTracer] = None):
         self.host, self.port = _parse_address(address)
         self.timeout = float(timeout)
+        self.request_timeout = (None if request_timeout is None
+                                else float(request_timeout))
+        if compress is not None and compress not in protocol.WIRE_CODECS:
+            raise ValueError(f"unknown wire codec {compress!r} "
+                             f"(have {sorted(protocol.WIRE_CODECS)})")
+        self.compress = compress
+        self.follow_redirects = bool(follow_redirects)
         #: client-side span recorder: every ordered (session-mutating)
         #: request mints a root TraceContext here that rides the DTF1
         #: frame header, so the server's span tree links back to the
         #: client hop.  Pass FleetTracer(enabled=False) to opt out.
         self.tracer = tracer if tracer is not None else FleetTracer(
             capacity=1024)
-        self._worker = _Worker(self.host, self.port, self.timeout)
+        self._worker = _Worker(self.host, self.port, self.timeout,
+                               request_timeout=self.request_timeout)
         self._closed = False
 
     # -- plumbing ------------------------------------------------------------
 
+    def _redirect_target(self, exc: BaseException) -> Optional[Tuple[str,
+                                                                     int]]:
+        """(host, port) of the replacement instance a typed error names,
+        when redirect-following applies."""
+        loc = getattr(exc, "remote_location", None)
+        if not self.follow_redirects or not loc:
+            return None
+        try:
+            return _parse_address(loc)
+        except ValueError:
+            return None
+
+    def _retarget(self, host: str, port: int) -> None:
+        """Re-point this client at a replacement instance.  Called on
+        the ordered worker thread (which owns the ordered connection) or
+        from a _sync caller — either way the rejected request is about
+        to be re-sent to the new address."""
+        self.host, self.port = host, int(port)
+        self._worker.retarget(host, port)
+
     def _sync(self, method: str, path: str, obj: Any = None) -> Any:
         """Out-of-band request on a fresh connection (never queues behind
-        the ordered worker)."""
-        conn = http.client.HTTPConnection(self.host, self.port,
-                                          timeout=self.timeout)
-        try:
-            return _request(conn, method, path, obj)
-        finally:
-            conn.close()
+        the ordered worker); follows at most one failover redirect."""
+        for _hop in range(2):
+            conn = http.client.HTTPConnection(self.host, self.port,
+                                              timeout=self.timeout)
+            try:
+                return _request(conn, method, path, obj,
+                                compress=self.compress)
+            except ServeError as e:
+                target = self._redirect_target(e)
+                if target is None or _hop:
+                    raise
+                self._retarget(*target)
+            finally:
+                conn.close()
 
     def _ordered_raw(self, method: str, path: str, obj: Any,
                      resolve: Callable[[Any, Optional[BaseException]], None]
@@ -222,8 +335,21 @@ class RemoteService:
 
         def job(conn):
             t0 = self.tracer.clock() if ctx is not None else 0.0
-            out = _request(conn, method, path, obj,
-                           trace=None if ctx is None else ctx.wire())
+            wire_ctx = None if ctx is None else ctx.wire()
+            try:
+                out = _request(conn, method, path, obj, trace=wire_ctx,
+                               compress=self.compress)
+            except ServeError as e:
+                # transparent redirect-on-failover: the drained instance
+                # rejected this request (never executed) and named its
+                # replacement — re-send there, keeping trace identity
+                target = self._redirect_target(e)
+                if target is None:
+                    raise
+                self._retarget(*target)
+                out = _request(self._worker._connection(), method, path,
+                               obj, trace=wire_ctx,
+                               compress=self.compress)
             if ctx is not None:
                 self.tracer.record(f"client.{method} {path}", ctx, t0,
                                    self.tracer.clock())
@@ -300,10 +426,13 @@ class RemoteService:
     def open_session(self, key, population: Population, toolbox: str, *,
                      cxpb: float = 0.5, mutpb: float = 0.2,
                      name: Optional[str] = None,
+                     tenant: Optional[str] = None,
                      evaluate_initial: bool = True) -> "RemoteSession":
         """Mirror of :meth:`EvolutionService.open_session`, with
         ``toolbox`` a *name* in the server's registry (functions don't
-        travel)."""
+        travel).  ``tenant`` names the paying tenant for fleet-router
+        admission (quotas + weighted-fair scheduling); a plain NetServer
+        ignores it."""
         fit = population.fitness
         body = {"toolbox": str(toolbox),
                 "key": _raw_key(key),
@@ -316,6 +445,8 @@ class RemoteService:
             body["valid"] = np.asarray(fit.valid)
         if name is not None:
             body["name"] = str(name)
+        if tenant is not None:
+            body["tenant"] = str(tenant)
         out = self._sync("POST", "/v1/sessions", body)
         return RemoteSession(self, out["name"], gen=int(out["gen"]),
                              weights=tuple(fit.weights),

@@ -19,11 +19,25 @@ No pickle anywhere on the wire: a frame can describe only JSON scalars,
 containers and typed arrays, so a malicious peer can at worst send wrong
 numbers, not code.
 
+**Payload compression** (negotiated, optional): a sender may zlib the
+concatenated tensor payload section — at pop=10⁶/dim=100 a single tell
+is ~400 MB raw — marking the frame header with ``"__zip__": "zlib"``;
+the decoder inflates before slicing, so arrays round-trip **bit-exact**
+(zlib is lossless — NaN payloads and signed zeros included, pinned by
+test).  Negotiation rides the header too: a request that advertises
+``"__accept__": ["zlib"]`` invites the responder to compress its reply;
+a peer that never advertises never receives a compressed frame, and a
+legacy decoder that ignores both keys still decodes every UNcompressed
+frame identically.  The router forwards frames verbatim (payload bytes
+untouched), so end-to-end compression survives the extra hop.
+
 Error mapping: service-layer exceptions travel as
 ``{"error": <class name>, "message": ...}`` JSON with a matching HTTP
 status (:data:`ERROR_STATUS`); :func:`remote_exception` rebuilds the
 typed exception on the client so ``RemoteSession`` raises exactly what
-the in-process ``Session`` would.
+the in-process ``Session`` would.  A draining instance that knows where
+its sessions went may add ``"location"`` to the envelope — the typed
+redirect the client follows transparently on failover.
 """
 
 from __future__ import annotations
@@ -31,18 +45,52 @@ from __future__ import annotations
 import base64
 import json
 import struct
-from typing import Any, Dict, List
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..dispatcher import (ServeError, ServiceClosed, ServiceOverloaded,
                           DeadlineExceeded, RequestCancelled,
-                          ServiceDraining, SessionUnknown)
+                          ServiceDraining, SessionUnknown,
+                          TenantQuotaExceeded)
 from ..buckets import BucketOverflow
 
-__all__ = ["MAGIC", "CONTENT_TYPE", "encode_frame", "decode_frame",
-           "decode_frame_with_trace", "status_of", "error_payload",
-           "remote_exception", "ERROR_STATUS"]
+__all__ = ["MAGIC", "CONTENT_TYPE", "ACCEPT_HEADER", "encode_frame",
+           "encode_frame_ex", "decode_frame", "decode_frame_with_trace",
+           "decode_frame_with_meta", "rewrite_trace", "status_of",
+           "error_payload", "remote_exception", "ERROR_STATUS",
+           "WIRE_CODECS"]
+
+#: payload codecs this build can negotiate (name -> (deflate, inflate))
+WIRE_CODECS = {"zlib": (zlib.compress, zlib.decompress)}
+
+
+def _inflate_zlib_bounded(data: bytes, max_bytes: int) -> bytes:
+    """Inflate at most ``max_bytes`` (+1 sentinel byte) of output — the
+    decompression-bomb guard: a frame's payload may never expand past
+    what its own tensor manifest accounts for, so a few-MB frame cannot
+    allocate gigabytes before the manifest size check runs."""
+    d = zlib.decompressobj()
+    out = d.decompress(data, max_bytes + 1)
+    if len(out) > max_bytes:
+        raise ValueError(
+            f"compressed payload inflates past the {max_bytes} bytes its "
+            "tensor manifest declares (rejecting decompression bomb)")
+    return out
+
+
+#: decode-side inflate per codec, bounded by the manifest's declared
+#: byte total (the compress side stays the plain function in
+#: :data:`WIRE_CODECS`)
+_INFLATE_BOUNDED = {"zlib": _inflate_zlib_bounded}
+
+#: HTTP request header carrying the sender's acceptable payload codecs —
+#: the negotiation channel for BODYLESS requests (a GET of a session's
+#: full population is exactly the response most worth compressing, and
+#: has no frame to advertise in).  Comma-separated codec names; the
+#: frame-header ``__accept__`` list and this header are unioned.
+ACCEPT_HEADER = "X-DTF-Accept"
 
 MAGIC = b"DTF1"
 CONTENT_TYPE = "application/x-deap-frame"
@@ -118,14 +166,22 @@ def _dtype_of(token: str) -> np.dtype:
         raise ValueError(f"unknown wire dtype {token!r}")
 
 
-def encode_frame(obj: Any, trace: Any = None) -> bytes:
-    """Encode a JSON-plus-arrays object tree into one wire frame.
+def encode_frame_ex(obj: Any, trace: Any = None, *,
+                    compress: Optional[str] = None,
+                    accept: Tuple[str, ...] = (),
+                    min_compress_bytes: int = 4096
+                    ) -> Tuple[bytes, Dict[str, int]]:
+    """Encode a frame and report its payload accounting.
 
-    ``trace`` (optional) is a small JSON-safe dict — the
-    :meth:`~deap_tpu.observability.fleettrace.TraceContext.wire` form —
-    stored in the frame HEADER under ``"__trace__"``, beside the tensor
-    manifest: request tracing is header metadata, invisible to the body
-    the decoder hands back (a peer that ignores it decodes identically)."""
+    Returns ``(frame_bytes, stats)`` with ``stats["payload_bytes"]`` the
+    raw tensor-payload size and ``stats["wire_payload_bytes"]`` what
+    actually hit the wire — their difference feeds the server's
+    ``net_bytes_saved`` counter.  ``compress`` names a
+    :data:`WIRE_CODECS` codec to deflate the payload section with
+    (applied only when the raw payload reaches ``min_compress_bytes`` —
+    deflating a 100-byte ask header costs more than it saves); ``accept``
+    advertises the codecs THIS peer can inflate, inviting the responder
+    to compress its reply."""
     tensors: List[np.ndarray] = []
     body = _pack(obj, tensors)
     header = {"body": body,
@@ -134,25 +190,66 @@ def encode_frame(obj: Any, trace: Any = None) -> bytes:
                               for a in tensors]}
     if trace is not None:
         header["__trace__"] = trace
-    hdr = json.dumps(header, allow_nan=True).encode("utf-8")
-    parts = [MAGIC, _HEAD.pack(len(hdr)), hdr]
+    if accept:
+        header["__accept__"] = [c for c in accept if c in WIRE_CODECS]
+    payload_parts = []
     for a in tensors:
         if a.dtype.kind == "V":
             # extension dtypes (bfloat16 & friends) carry their raw bits;
             # single-byte-lane or little-endian hosts only — every
             # supported platform (x86/ARM/TPU hosts) is little-endian
-            parts.append(a.tobytes())
+            payload_parts.append(a.tobytes())
         else:
             # canonical little-endian payload, whatever the host order
-            parts.append(a.astype(a.dtype.newbyteorder("<"), copy=False)
-                          .tobytes())
-    return b"".join(parts)
+            payload_parts.append(
+                a.astype(a.dtype.newbyteorder("<"), copy=False).tobytes())
+    payload = b"".join(payload_parts)
+    raw_bytes = len(payload)
+    if (compress is not None and compress in WIRE_CODECS
+            and raw_bytes >= int(min_compress_bytes)):
+        deflated = WIRE_CODECS[compress][0](payload)
+        if len(deflated) < raw_bytes:   # incompressible data ships raw
+            header["__zip__"] = compress
+            payload = deflated
+    hdr = json.dumps(header, allow_nan=True).encode("utf-8")
+    frame = b"".join([MAGIC, _HEAD.pack(len(hdr)), hdr, payload])
+    return frame, {"payload_bytes": raw_bytes,
+                   "wire_payload_bytes": len(payload)}
+
+
+def encode_frame(obj: Any, trace: Any = None, *,
+                 compress: Optional[str] = None,
+                 accept: Tuple[str, ...] = (),
+                 min_compress_bytes: int = 4096) -> bytes:
+    """Encode a JSON-plus-arrays object tree into one wire frame.
+
+    ``trace`` (optional) is a small JSON-safe dict — the
+    :meth:`~deap_tpu.observability.fleettrace.TraceContext.wire` form —
+    stored in the frame HEADER under ``"__trace__"``, beside the tensor
+    manifest: request tracing is header metadata, invisible to the body
+    the decoder hands back (a peer that ignores it decodes identically).
+    ``compress``/``accept`` are the payload-compression negotiation
+    (see :func:`encode_frame_ex`, which also reports bytes saved)."""
+    return encode_frame_ex(obj, trace, compress=compress, accept=accept,
+                           min_compress_bytes=min_compress_bytes)[0]
+
+
+def _split_header(data: bytes) -> Tuple[dict, int]:
+    """Parse and validate the frame prefix; returns ``(header dict,
+    payload offset)``."""
+    if len(data) < 8 or data[:4] != MAGIC:
+        raise ValueError("not a deap-tpu wire frame (bad magic)")
+    (hlen,) = _HEAD.unpack_from(data, 4)
+    hdr_end = 8 + hlen
+    if len(data) < hdr_end:
+        raise ValueError("truncated frame header")
+    return json.loads(data[8:hdr_end].decode("utf-8")), hdr_end
 
 
 def decode_frame(data: bytes) -> Any:
     """Decode :func:`encode_frame` output back into the object tree
     (arrays come back as numpy, bitwise equal to what was encoded)."""
-    return decode_frame_with_trace(data)[0]
+    return decode_frame_with_meta(data)[0]
 
 
 def decode_frame_with_trace(data: bytes):
@@ -160,22 +257,44 @@ def decode_frame_with_trace(data: bytes):
     header's ``"__trace__"`` dict (``None`` when the sender attached no
     trace context) — what the server handler adopts request spans
     from."""
-    if len(data) < 8 or data[:4] != MAGIC:
-        raise ValueError("not a deap-tpu wire frame (bad magic)")
-    (hlen,) = _HEAD.unpack_from(data, 4)
-    hdr_end = 8 + hlen
-    if len(data) < hdr_end:
-        raise ValueError("truncated frame header")
-    header = json.loads(data[8:hdr_end].decode("utf-8"))
-    tensors: List[np.ndarray] = []
-    off = hdr_end
+    obj, meta = decode_frame_with_meta(data)
+    return obj, meta["trace"]
+
+
+def decode_frame_with_meta(data: bytes) -> Tuple[Any, Dict[str, Any]]:
+    """Full decode: ``(object tree, meta)`` where ``meta`` carries the
+    header's negotiation state — ``trace`` (adopted by the server
+    handler), ``accept`` (codecs the sender can inflate, so the responder
+    knows whether it may compress its reply), ``compressed`` (codec name
+    or ``None``), and the ``payload_bytes``/``wire_payload_bytes`` pair
+    the byte-savings counters are computed from."""
+    header, off = _split_header(data)
+    codec = header.get("__zip__")
+    wire_payload = len(data) - off
+    # manifest first: its declared byte total bounds the inflate below
+    specs: List[tuple] = []
+    declared = 0
     for spec in header.get("__tensors__", ()):
         dt = _dtype_of(spec["dtype"])
         shape = tuple(int(s) for s in spec["shape"])
         nbytes = dt.itemsize * int(np.prod(shape, dtype=np.int64))
-        if off + nbytes > len(data):
+        if nbytes < 0:
+            raise ValueError("negative tensor extent in manifest")
+        specs.append((dt, shape, nbytes))
+        declared += nbytes
+    if codec is not None:
+        if codec not in WIRE_CODECS:
+            raise ValueError(f"unknown payload codec {codec!r}")
+        payload = _INFLATE_BOUNDED[codec](data[off:], declared)
+        off = 0
+    else:
+        payload = data
+    start = off
+    tensors: List[np.ndarray] = []
+    for dt, shape, nbytes in specs:
+        if off + nbytes > len(payload):
             raise ValueError("truncated tensor payload")
-        a = np.frombuffer(data, dtype=dt, count=nbytes // dt.itemsize,
+        a = np.frombuffer(payload, dtype=dt, count=nbytes // dt.itemsize,
                           offset=off)
         a = a.reshape(shape)
         if dt.kind != "V":
@@ -184,11 +303,34 @@ def decode_frame_with_trace(data: bytes):
             a = a.copy()
         tensors.append(a)
         off += nbytes
-    if off != len(data):
-        raise ValueError(f"{len(data) - off} trailing bytes after tensors")
+    if off != len(payload):
+        raise ValueError(f"{len(payload) - off} trailing bytes after "
+                         "tensors")
     trace = header.get("__trace__")
-    return _unpack(header["body"], tensors), (
-        trace if isinstance(trace, dict) else None)
+    accept = tuple(c for c in header.get("__accept__", ())
+                   if isinstance(c, str))
+    return _unpack(header["body"], tensors), {
+        "trace": trace if isinstance(trace, dict) else None,
+        "accept": accept,
+        "compressed": codec,
+        "payload_bytes": off - start,
+        "wire_payload_bytes": wire_payload,
+    }
+
+
+def rewrite_trace(data: bytes, trace: Any) -> bytes:
+    """Replace (or insert/remove) a frame's ``"__trace__"`` header IN
+    PLACE of the old one, leaving the tensor payload bytes untouched —
+    how the router inserts its hop into the span tree while forwarding
+    a possibly-huge (possibly-compressed) frame without ever decoding
+    the tensors.  ``trace=None`` strips the header."""
+    header, off = _split_header(data)
+    if trace is None:
+        header.pop("__trace__", None)
+    else:
+        header["__trace__"] = trace
+    hdr = json.dumps(header, allow_nan=True).encode("utf-8")
+    return b"".join([MAGIC, _HEAD.pack(len(hdr)), hdr, data[off:]])
 
 
 # ---------------------------------------------------------------------------
@@ -199,6 +341,7 @@ def decode_frame_with_trace(data: bytes):
 ERROR_STATUS: Dict[type, int] = {
     SessionUnknown: 404,
     BucketOverflow: 413,
+    TenantQuotaExceeded: 429,
     ServiceOverloaded: 429,
     RequestCancelled: 409,
     DeadlineExceeded: 504,
@@ -220,9 +363,17 @@ def status_of(exc: BaseException) -> int:
     return 500
 
 
-def error_payload(exc: BaseException) -> bytes:
-    return json.dumps({"error": type(exc).__name__,
-                       "message": str(exc)}).encode("utf-8")
+def error_payload(exc: BaseException,
+                  location: Optional[str] = None) -> bytes:
+    """The JSON error envelope.  ``location`` (optional) is the typed
+    redirect a drained instance attaches once it knows where its
+    sessions were restored — :class:`RemoteService` re-targets and
+    retries transparently (safe: the erroring instance rejected the
+    request before executing it)."""
+    doc = {"error": type(exc).__name__, "message": str(exc)}
+    if location:
+        doc["location"] = str(location)
+    return json.dumps(doc).encode("utf-8")
 
 
 def remote_exception(name: str, message: str) -> BaseException:

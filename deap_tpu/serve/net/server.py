@@ -29,6 +29,10 @@ noted)::
     POST   /v1/admin/restore                failover step 2: adopt a
                                             drained snapshot
     POST   /v1/admin/rebucket               adaptive bucket-grid refit
+    POST   /v1/admin/redirect               failover step 3: record where
+                                            drained sessions now live (a
+                                            typed redirect for stale
+                                            clients)
 
 Cross-instance failover is drain → ship the frame → restore: the snapshot
 carries each session's toolbox *name*, bucket rows and raw PRNG key, so
@@ -51,7 +55,7 @@ import jax.numpy as jnp
 from ...base import Population, Fitness
 from ...observability import fleettrace
 from ...observability.sinks import emit_text
-from ..dispatcher import SessionUnknown
+from ..dispatcher import ServiceDraining, SessionUnknown
 from ..metrics import prometheus_text
 from . import protocol
 
@@ -80,19 +84,30 @@ class NetServer:
 
     #: lock-guarded shared state (``lock-discipline`` lint pass): the
     #: session→toolbox name map is written by concurrent HTTP handler
-    #: threads (create/close/restore) — writes only under ``self._lock``
-    _GUARDED_BY = {"_lock": ("_session_toolbox",)}
+    #: threads (create/close/restore), and the failover redirect target
+    #: by the admin endpoint — writes only under ``self._lock``
+    _GUARDED_BY = {"_lock": ("_session_toolbox", "_redirect")}
 
     def __init__(self, service, toolboxes: Dict[str, Any], *,
                  host: str = "127.0.0.1", port: int = 0,
                  result_timeout: float = 600.0, sinks: Sequence = (),
-                 verbose: bool = False):
+                 compress_min_bytes: int = 4096, verbose: bool = False):
         self.service = service
         self.toolboxes = dict(toolboxes)
         self.result_timeout = float(result_timeout)
         self.sinks = list(sinks)
+        #: raw tensor-payload size below which a response is never
+        #: compressed even for a zlib-advertising peer (deflating a tiny
+        #: ask result costs more CPU than the bytes it saves)
+        self.compress_min_bytes = int(compress_min_bytes)
         self.verbose = bool(verbose)
         self._session_toolbox: Dict[str, str] = {}
+        #: where this instance's sessions went after a drain (set by
+        #: POST /v1/admin/redirect, typically by the fleet router once
+        #: restore succeeded elsewhere): attached to ServiceDraining /
+        #: SessionUnknown error envelopes so direct clients follow the
+        #: failover transparently
+        self._redirect: Optional[str] = None
         self._lock = threading.Lock()
         net = self
 
@@ -288,6 +303,20 @@ class NetServer:
             max_buckets=int(body.get("max_buckets", 8)),
             warm=tuple(body.get("warm", ("step",))))
 
+    def h_redirect(self, body: dict) -> dict:
+        """Failover step 3 (optional): record where the drained sessions
+        now live, so clients still pointed HERE get a typed redirect in
+        the error envelope instead of a dead end.  ``{"url": null}``
+        clears it."""
+        url = body.get("url")
+        with self._lock:
+            self._redirect = None if url is None else str(url)
+        return {"location": url}
+
+    @property
+    def redirect_location(self) -> Optional[str]:
+        return self._redirect
+
 
 def _as_device(tree):
     """Decoded wire genome (numpy arrays in plain containers) → device
@@ -329,7 +358,19 @@ class _Handler(BaseHTTPRequestHandler):
         if not data:
             return {}
         if data[:4] == protocol.MAGIC:
-            obj, trace_in = protocol.decode_frame_with_trace(data)
+            obj, meta = protocol.decode_frame_with_meta(data)
+            trace_in = meta["trace"]
+            # payload-compression negotiation: remember what the PEER
+            # can inflate (response-side), and account an inbound
+            # compressed frame's savings
+            self._accept = tuple(dict.fromkeys(
+                tuple(getattr(self, "_accept", ())) + tuple(meta["accept"])))
+            if meta["compressed"]:
+                net.service.metrics.inc("net_frames_compressed")
+                net.service.metrics.inc(
+                    "net_bytes_saved",
+                    max(0, meta["payload_bytes"]
+                        - meta["wire_payload_bytes"]))
         else:
             obj, trace_in = json.loads(data.decode("utf-8")), None
         if tracer is not None and trace_in is not None:
@@ -367,17 +408,33 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(payload)
         self.server_ctx.service.metrics.inc("net_bytes_out", len(payload))
 
+    def _encode_response(self, obj: Any) -> bytes:
+        """Encode a response frame, compressing the tensor payload when
+        the request advertised a codec this build holds and the payload
+        clears the server's size floor; savings feed ``net_bytes_saved``."""
+        net = self.server_ctx
+        codec = next((c for c in getattr(self, "_accept", ())
+                      if c in protocol.WIRE_CODECS), None)
+        payload, stats = protocol.encode_frame_ex(
+            obj, compress=codec,
+            min_compress_bytes=net.compress_min_bytes)
+        saved = stats["payload_bytes"] - stats["wire_payload_bytes"]
+        if saved > 0:
+            net.service.metrics.inc("net_frames_compressed")
+            net.service.metrics.inc("net_bytes_saved", saved)
+        return payload
+
     def _send_obj(self, obj: Any, status: int = 200) -> None:
         tracer = self.server_ctx.service.tracer
         ctx = getattr(self, "_trace_ctx", None)
         if ctx is not None and tracer.enabled:
             t0 = tracer.clock()
-            payload = protocol.encode_frame(obj)
+            payload = self._encode_response(obj)
             self._send(payload, status=status)
             tracer.phase("response_encode", ctx, t0, tracer.clock(),
                          attrs={"bytes": len(payload)})
         else:
-            self._send(protocol.encode_frame(obj), status=status)
+            self._send(self._encode_response(obj), status=status)
 
     def _send_json(self, obj: Any, status: int = 200) -> None:
         self._send(json.dumps(obj).encode("utf-8"), status=status,
@@ -388,8 +445,14 @@ class _Handler(BaseHTTPRequestHandler):
         net.service.metrics.inc("net_errors")
         self._drain_body()
         status = protocol.status_of(exc)
-        self._send(protocol.error_payload(exc), status=status,
-                   content_type="application/json")
+        # a drained instance that knows its replacement attaches the
+        # typed redirect (draining rejections AND post-drain lookup
+        # misses — the two shapes a stale client sees after failover)
+        location = (net.redirect_location
+                    if isinstance(exc, (ServiceDraining, SessionUnknown))
+                    else None)
+        self._send(protocol.error_payload(exc, location=location),
+                   status=status, content_type="application/json")
         if status == 500:
             # 500 = an UNMAPPED exception — a service bug, not a protocol
             # outcome (draining/deadline envelopes stay quiet) — dump the
@@ -404,6 +467,15 @@ class _Handler(BaseHTTPRequestHandler):
         self._body_consumed = False
         self._trace_ctx = None
         self._trace_t0 = 0.0
+        # per-request negotiation state: a keep-alive connection serves
+        # many requests, and a stale accept list would compress a reply
+        # for a peer that did not advertise on THIS request.  The HTTP
+        # header channel covers bodyless GETs (the full-population read
+        # is the response most worth compressing); a frame body's
+        # __accept__ list unions in via _body()
+        hdr = self.headers.get(protocol.ACCEPT_HEADER, "")
+        self._accept = tuple(c.strip() for c in hdr.split(",")
+                             if c.strip())
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
         try:
@@ -440,7 +512,8 @@ class _Handler(BaseHTTPRequestHandler):
                         return self._send_obj(fn(name, self._body()))
             if method == "POST" and rest[:1] == ["admin"] and len(rest) == 2:
                 fn = {"drain": net.h_drain, "restore": net.h_restore,
-                      "rebucket": net.h_rebucket}.get(rest[1])
+                      "rebucket": net.h_rebucket,
+                      "redirect": net.h_redirect}.get(rest[1])
                 if fn is not None:
                     return self._send_obj(fn(self._body()))
             raise SessionUnknown(f"unknown path {url.path!r}")

@@ -1,0 +1,49 @@
+"""Fleet control plane: the ``deap-tpu-router`` tier above N serving
+instances.
+
+One :class:`~deap_tpu.serve.net.server.NetServer` is a single point of
+capacity AND of failure; the reference framework's distribution story —
+swapping ``toolbox.map`` for a SCOOP pool (doc/tutorials/basic/part4.rst)
+— never grows past one pool of workers.  This package is the layer the
+ROADMAP's "millions of users" goal needs, built purely by *composing*
+primitives the fleet already wire-exposes (drain/restore, ``/v1/metrics``,
+``/v1/trace``, tenant counters):
+
+* :mod:`~deap_tpu.serve.router.backend` — :class:`Backend`, the raw-frame
+  forwarding + control-plane handle on one instance;
+* :mod:`~deap_tpu.serve.router.placement` — bucket-histogram-aware
+  placement (:class:`PlacementPolicy`): sibling shapes co-locate on
+  instances with warm compiled programs;
+* :mod:`~deap_tpu.serve.router.health` — :class:`HealthMonitor`: polls
+  ``/v1/metrics``, joins ``/v1/trace`` spans, latches sick instances and
+  fires automatic drain→restore failover;
+* :mod:`~deap_tpu.serve.router.tenants` — quota enforcement + weighted-
+  fair forwarding (:class:`WeightedFairScheduler`), the typed
+  :class:`TenantQuotaExceeded` admission decision;
+* :mod:`~deap_tpu.serve.router.core` — :class:`FleetRouter`, the routing
+  table and failover driver;
+* :mod:`~deap_tpu.serve.router.server` — :class:`RouterServer`, the DTF1
+  HTTP frontend clients reach through an unchanged
+  :class:`~deap_tpu.serve.net.client.RemoteService`.
+
+``tools/bench_fleet.py`` is the scale proof (10³+ remote sessions across
+≥3 instances, committed as ``BENCH_FLEET.json``); the in-gate drill lives
+in ``tests/test_serve_router.py``.
+"""
+
+from .backend import Backend, BackendDown  # noqa: F401
+from .core import FleetRouter  # noqa: F401
+from .health import HealthMonitor, HealthPolicy, HealthSample  # noqa: F401
+from .placement import (BackendPlan, PlacementPolicy,  # noqa: F401
+                        fleet_sizes)
+from .server import RouterServer  # noqa: F401
+from .tenants import (TenantQuota, TenantQuotaExceeded,  # noqa: F401
+                      WeightedFairScheduler)
+
+__all__ = [
+    "Backend", "BackendDown",
+    "FleetRouter", "RouterServer",
+    "HealthMonitor", "HealthPolicy", "HealthSample",
+    "BackendPlan", "PlacementPolicy", "fleet_sizes",
+    "TenantQuota", "TenantQuotaExceeded", "WeightedFairScheduler",
+]

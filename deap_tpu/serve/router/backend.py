@@ -1,0 +1,188 @@
+""":class:`Backend` — the router's handle on one
+:class:`~deap_tpu.serve.net.server.NetServer` instance.
+
+Two traffic classes, deliberately separated:
+
+* **forwarding** (:meth:`forward`) — raw DTF1 frames relayed
+  byte-for-byte (payloads untouched, so compression negotiated between
+  client and instance survives the hop).  Each router handler thread
+  keeps its own keep-alive connection to the backend (thread-local
+  pool), mirroring the stdlib frontend's one-handler-per-connection
+  model; a send-phase failure retries once on a fresh connection (the
+  request never hit the wire), a response-phase failure propagates — the
+  instance may have executed a non-idempotent step;
+* **control** (:meth:`healthz` / :meth:`metrics` / :meth:`trace_tail` /
+  :meth:`drain` / :meth:`restore` / :meth:`set_redirect` /
+  :meth:`toolboxes`) — per-call connections with their own (short)
+  timeout so a wedged instance can never stall the health loop or a
+  failover behind a long forward.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..dispatcher import ServeError
+from ..net import protocol
+from ..net.client import _parse_address
+
+__all__ = ["Backend", "BackendDown"]
+
+
+class BackendDown(ServeError):
+    """The backend did not answer (connect/send/read failure) — the
+    transport-level 'sick' signal, distinct from a typed service error
+    the instance itself raised.  ``sent`` records whether the request
+    reached the wire: ``False`` means the instance provably never saw it
+    (a re-send cannot double-execute anything), ``True`` means it died
+    mid-response and MAY have executed — the router never retries
+    those."""
+
+    def __init__(self, message: str, *, sent: bool = False):
+        super().__init__(message)
+        self.sent = bool(sent)
+
+
+class Backend:
+    """One routable serving instance (see module docstring)."""
+
+    def __init__(self, name: str, address, *, timeout: float = 600.0,
+                 control_timeout: float = 10.0):
+        self.name = str(name)
+        self.host, self.port = _parse_address(address)
+        self.timeout = float(timeout)
+        self.control_timeout = float(control_timeout)
+        self._tls = threading.local()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def __repr__(self) -> str:
+        return f"Backend({self.name!r}, {self.url})"
+
+    # -- forwarding ----------------------------------------------------------
+
+    def _fwd_conn(self, fresh: bool = False) -> http.client.HTTPConnection:
+        conn = getattr(self._tls, "conn", None)
+        if fresh and conn is not None:
+            conn.close()
+            conn = None
+        if conn is None:
+            conn = http.client.HTTPConnection(self.host, self.port,
+                                              timeout=self.timeout)
+            self._tls.conn = conn
+        return conn
+
+    def forward(self, method: str, path: str, body: Optional[bytes],
+                content_type: str = protocol.CONTENT_TYPE,
+                accept: Optional[str] = None) -> Tuple[int, bytes]:
+        """Relay one raw request; returns ``(status, response bytes)``.
+        ``accept`` relays the client's ``X-DTF-Accept`` compression
+        advertisement (the only negotiation channel a bodyless GET has).
+        Raises :class:`BackendDown` when the instance cannot be reached
+        (send retried once on a fresh connection — safe, the request
+        never arrived) or stops answering mid-response."""
+        headers = {"Content-Type": content_type}
+        if accept:
+            headers[protocol.ACCEPT_HEADER] = accept
+        for attempt in (0, 1):
+            conn = self._fwd_conn(fresh=attempt > 0)
+            try:
+                conn.request(method, path, body=body, headers=headers)
+            except (http.client.HTTPException, OSError) as e:
+                if attempt:
+                    self.drop_connections()
+                    raise BackendDown(
+                        f"backend {self.name} unreachable at {self.url}: "
+                        f"{e}", sent=False) from e
+                continue            # stale keep-alive: one fresh retry
+            try:
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            except (http.client.HTTPException, OSError) as e:
+                # response-phase: the instance may have executed the
+                # request — no silent re-send, surface the failure
+                self.drop_connections()
+                raise BackendDown(
+                    f"backend {self.name} died mid-response on "
+                    f"{method} {path}: {e}", sent=True) from e
+        raise AssertionError("unreachable")
+
+    def drop_connections(self) -> None:
+        """Drop THIS thread's pooled forwarding connection (other
+        threads' pools drop lazily on their next send failure)."""
+        conn = getattr(self._tls, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._tls.conn = None
+
+    # -- control plane -------------------------------------------------------
+
+    def _control(self, method: str, path: str, obj: Any = None,
+                 timeout: Optional[float] = None) -> Any:
+        conn = http.client.HTTPConnection(
+            self.host, self.port,
+            timeout=self.control_timeout if timeout is None else timeout)
+        try:
+            body = None if obj is None else protocol.encode_frame(obj)
+            try:
+                conn.request(method, path, body=body,
+                             headers={"Content-Type":
+                                      protocol.CONTENT_TYPE})
+                resp = conn.getresponse()
+                data = resp.read()
+            except (http.client.HTTPException, OSError) as e:
+                raise BackendDown(
+                    f"backend {self.name} control call {method} {path} "
+                    f"failed: {e}") from e
+            if resp.status >= 400:
+                try:
+                    err = json.loads(data.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    raise ServeError(
+                        f"backend {self.name}: HTTP {resp.status}: "
+                        f"{data[:200]!r}")
+                raise protocol.remote_exception(
+                    err.get("error", "ServeError"), err.get("message", ""))
+            if not data:
+                return None
+            if data[:4] == protocol.MAGIC:
+                return protocol.decode_frame(data)
+            return json.loads(data.decode("utf-8"))
+        finally:
+            conn.close()
+
+    def healthz(self) -> dict:
+        return self._control("GET", "/v1/healthz")
+
+    def metrics(self) -> dict:
+        return self._control("GET", "/v1/metrics")
+
+    def trace_tail(self, max_spans: int = 256) -> dict:
+        return self._control("GET", f"/v1/trace?max={int(max_spans)}")
+
+    def toolboxes(self) -> List[str]:
+        return list(self._control("GET", "/v1/toolboxes")["toolboxes"])
+
+    def drain(self, timeout: float = 60.0) -> Dict[str, dict]:
+        """Quiesce + snapshot (control call with the DRAIN timeout, not
+        the short health one — a loaded instance needs time to flush)."""
+        out = self._control("POST", "/v1/admin/drain",
+                            {"timeout": float(timeout)},
+                            timeout=timeout + self.control_timeout)
+        return out["sessions"]
+
+    def restore(self, snapshot: Dict[str, dict],
+                timeout: float = 120.0) -> dict:
+        """Adopt a snapshot; returns the full ``{"restored", "skipped"}``
+        response — the router re-places skipped orphans elsewhere."""
+        return self._control("POST", "/v1/admin/restore",
+                             {"sessions": snapshot},
+                             timeout=timeout)
+
+    def set_redirect(self, url: Optional[str]) -> None:
+        self._control("POST", "/v1/admin/redirect", {"url": url})

@@ -1,0 +1,443 @@
+""":class:`RouterServer` — the fleet's DTF1 HTTP frontend.
+
+Clients speak to the router exactly as they speak to one
+:class:`~deap_tpu.serve.net.server.NetServer` — the same paths, the same
+frame codec, the same typed error envelopes — so the existing
+:class:`~deap_tpu.serve.net.client.RemoteService` works unchanged with a
+router URL.  Per request the router:
+
+1. **admits** — session creates pass tenant quotas and affinity
+   placement (:meth:`FleetRouter.admit_session`); session-mutating ops
+   take a weighted-fair forwarding slot first, so one tenant's burst
+   cannot monopolize the fleet's dispatch parallelism;
+2. **traces** — the client's ``__trace__`` header is adopted and
+   REWRITTEN to the router's own hop
+   (:func:`~deap_tpu.serve.net.protocol.rewrite_trace` — header-only,
+   tensor payloads untouched), so the backend's span tree hangs off a
+   ``router.forward`` span that hangs off the client hop;
+3. **forwards** — raw frames relayed to the routed backend over pooled
+   keep-alive connections.  Compression negotiated end-to-end survives
+   the hop because payload bytes are never touched;
+4. **retries safely** — a forward the backend never received
+   (:class:`~deap_tpu.serve.router.backend.BackendDown` with
+   ``sent=False``) or typed-rejected (``ServiceDraining``) re-routes
+   after waiting for the failover to move the session, then retries —
+   both cases provably never executed.  A mid-response death is NOT
+   retried (the step may have applied); the client resyncs, exactly as
+   it would against a bare instance.
+
+Router-only surface (on top of the NetServer paths)::
+
+    GET  /v1/admin/fleet            topology: backends, health, routes
+    POST /v1/admin/fleet/failover   {"backend": name} — manual drill
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence, Tuple
+from urllib.parse import parse_qs, quote, unquote, urlparse
+
+from ...observability.sinks import emit_text
+from ..dispatcher import (ServeError, ServiceOverloaded, SessionUnknown,
+                          TenantQuotaExceeded)
+from ..metrics import prometheus_text
+from ..net import protocol
+from .backend import Backend, BackendDown
+from .core import FleetRouter
+
+__all__ = ["RouterServer"]
+
+#: session-op names whose forwards take a weighted-fair slot
+_FAIR_OPS = ("step", "ask", "tell", "evaluate")
+
+
+class RouterServer:
+    """Serve a :class:`FleetRouter` over HTTP (see module docstring).
+
+    ``failover_wait`` bounds how long a safely-retryable forward waits
+    for the routing table to move its session before giving up;
+    ``acquire_timeout`` bounds the weighted-fair slot wait (a saturated
+    fleet then sheds typed :class:`ServiceOverloaded`, mirroring the
+    instance-level queue bound)."""
+
+    def __init__(self, router: FleetRouter, *, host: str = "127.0.0.1",
+                 port: int = 0, failover_wait: float = 30.0,
+                 acquire_timeout: float = 60.0, sinks: Sequence = (),
+                 verbose: bool = False):
+        self.router = router
+        self.failover_wait = float(failover_wait)
+        self.acquire_timeout = float(acquire_timeout)
+        self.sinks = list(sinks) or list(router.sinks)
+        self.verbose = bool(verbose)
+        ctx = self
+
+        class Handler(_RouterHandler):
+            server_ctx = ctx
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "RouterServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="deap-tpu-router-http", daemon=True)
+            self._thread.start()
+            if self.verbose:
+                emit_text(f"[router] listening on {self.url} fronting "
+                          f"{sorted(self.router.backends)}", self.sinks)
+        return self
+
+    def close(self) -> None:
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self._httpd.server_close()
+        self.router.close()
+
+    def __enter__(self) -> "RouterServer":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    @property
+    def address(self) -> tuple:
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    """One connection's requests, routed into the :class:`RouterServer`
+    context.  Mirrors the instance handler's keep-alive + explicit
+    Content-Length framing."""
+
+    protocol_version = "HTTP/1.1"
+    server_ctx: RouterServer = None     # bound by RouterServer
+
+    # -- plumbing ------------------------------------------------------------
+
+    def log_message(self, fmt, *args):
+        ctx = self.server_ctx
+        if ctx is not None and ctx.verbose:
+            emit_text(f"[router] {self.address_string()} {fmt % args}",
+                      ctx.sinks)
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        data = self.rfile.read(length) if length else b""
+        self._body_consumed = True
+        self.server_ctx.router.metrics.inc("net_bytes_in", len(data))
+        return data
+
+    def _drain_body(self) -> None:
+        if getattr(self, "_body_consumed", False):
+            return
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        if length:
+            self.rfile.read(length)
+        self._body_consumed = True
+
+    def _send(self, payload: bytes, status: int = 200,
+              content_type: str = protocol.CONTENT_TYPE) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+        self.server_ctx.router.metrics.inc("net_bytes_out", len(payload))
+
+    def _send_json(self, obj, status: int = 200) -> None:
+        self._send(json.dumps(obj).encode("utf-8"), status=status,
+                   content_type="application/json")
+
+    def _send_error_obj(self, exc: BaseException) -> None:
+        self.server_ctx.router.metrics.inc("router_errors")
+        if isinstance(exc, TenantQuotaExceeded):
+            # both rejection shapes — session quota at create, backlog
+            # quota at the fair scheduler — count as admission decisions
+            self.server_ctx.router.metrics.inc("router_quota_rejections")
+        self._drain_body()
+        self._send(protocol.error_payload(exc),
+                   status=protocol.status_of(exc),
+                   content_type="application/json")
+
+    def _respond_raw(self, status: int, data: bytes) -> None:
+        """Relay a backend's response bytes (frame or error envelope —
+        the client's decoder handles both).  Error envelopes are
+        sanitized first: a backend's failover ``location`` must never
+        reach a router client, or its redirect-following would re-point
+        it AT the backend and bypass quotas/scheduling for good."""
+        if status >= 400 and data[:4] != protocol.MAGIC:
+            data = _strip_redirect(data)
+        ctype = (protocol.CONTENT_TYPE if data[:4] == protocol.MAGIC
+                 else "application/json")
+        self._send(data, status=status, content_type=ctype)
+
+    # -- routing -------------------------------------------------------------
+
+    def _route(self, method: str) -> None:
+        ctx = self.server_ctx
+        router = ctx.router
+        router.metrics.inc("router_requests")
+        self._body_consumed = False
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        try:
+            if parts[:1] != ["v1"]:
+                raise SessionUnknown(f"unknown path {url.path!r}")
+            rest = parts[1:]
+            if method == "GET" and rest == ["healthz"]:
+                return self._healthz()
+            if method == "GET" and rest == ["toolboxes"]:
+                return self._send_json(
+                    {"toolboxes": router.toolbox_union()})
+            if method == "GET" and rest == ["metrics"]:
+                return self._metrics(parse_qs(url.query))
+            if method == "GET" and rest == ["trace"]:
+                return self._trace_tail(parse_qs(url.query))
+            if method == "GET" and rest == ["admin", "fleet"]:
+                return self._send_json(router.topology())
+            if (method == "POST" and rest == ["admin", "fleet",
+                                              "failover"]):
+                return self._manual_failover()
+            if rest[:1] == ["sessions"]:
+                if method == "POST" and len(rest) == 1:
+                    return self._create()
+                if len(rest) == 2 and method in ("GET", "DELETE"):
+                    return self._session_op(method, unquote(rest[1]), None)
+                if method == "POST" and len(rest) == 3 \
+                        and rest[2] in _FAIR_OPS:
+                    return self._session_op(method, unquote(rest[1]),
+                                            rest[2])
+            raise SessionUnknown(f"unknown path {url.path!r}")
+        except BrokenPipeError:
+            raise
+        except Exception as e:  # noqa: BLE001 — typed over the wire
+            try:
+                self._send_error_obj(e)
+            except BrokenPipeError:
+                pass
+
+    # -- router-local endpoints ----------------------------------------------
+
+    def _healthz(self) -> None:
+        router = self.server_ctx.router
+        sick = router.health.sick()
+        self._send_json({
+            "status": "ok" if len(sick) < len(router.backends) else "sick",
+            "role": "router",
+            "backends": {n: ("sick" if n in sick else "ok")
+                         for n in router.backends},
+            "sessions": router.stats().gauges["router_sessions_routed"]})
+
+    def _metrics(self, query) -> None:
+        rec = self.server_ctx.router.stats()
+        if query.get("format", [""])[0] == "prometheus":
+            return self._send(
+                prometheus_text(rec).encode("utf-8"),
+                content_type="text/plain; version=0.0.4; charset=utf-8")
+        self._send_json(json.loads(rec.to_json()))
+
+    def _trace_tail(self, query) -> None:
+        tracer = self.server_ctx.router.tracer
+        n = int(query.get("max", ["256"])[0])
+        trace_id = query.get("trace_id", [None])[0]
+        self._send_json({"enabled": bool(tracer.enabled),
+                         "dropped": tracer.dropped,
+                         "spans": tracer.recent(n, trace_id=trace_id)})
+
+    def _manual_failover(self) -> None:
+        router = self.server_ctx.router
+        raw = self._read_body()
+        if raw[:4] == protocol.MAGIC:
+            body = protocol.decode_frame(raw)
+        else:
+            body = json.loads(raw.decode("utf-8")) if raw else {}
+        name = body.get("backend")
+        backend = router.backends.get(name)
+        if backend is None:
+            raise SessionUnknown(f"no backend named {name!r}")
+        router.health.force_sick(name, "manual failover")
+        self._send_json(
+            {"backend": name, "sick": router.health.is_sick(name)})
+
+    # -- the create path (decode once: placement needs the shape) ------------
+
+    def _create(self) -> None:
+        ctx = self.server_ctx
+        router = ctx.router
+        raw = self._read_body()
+        if raw[:4] != protocol.MAGIC:
+            raise ValueError("session create requires a DTF1 frame body")
+        body, meta = protocol.decode_frame_with_meta(raw)
+        trace_ctx = router.tracer.adopt(meta["trace"])
+        backend, tenant, name, n, sig = router.admit_session(body)
+        body["name"] = name
+        # re-encode (the one path the router must decode, for placement)
+        # with the sender's own codec — the initial population is the
+        # protocol's largest payload, and decoding must not strip its
+        # compression for the router→backend leg
+        frame = protocol.encode_frame(
+            body, trace=None if trace_ctx is None else trace_ctx.wire(),
+            accept=meta["accept"], compress=meta["compressed"])
+        t0 = router.tracer.clock()
+        try:
+            status, data = backend.forward(
+                "POST", "/v1/sessions", frame,
+                accept=self.headers.get(protocol.ACCEPT_HEADER))
+        except BackendDown as e:
+            router.abort_session(name, tenant)
+            router.note_forward_failure(backend, e)
+            raise ServeError(f"create failed: {e}") from e
+        router.metrics.inc("router_forwards")
+        if status >= 400:
+            router.abort_session(name, tenant)
+        else:
+            router.commit_session(name, backend, n, sig, tenant)
+        if trace_ctx is not None:
+            router.tracer.record(
+                "router.forward POST /v1/sessions", trace_ctx, t0,
+                router.tracer.clock(),
+                attrs={"backend": backend.name, "status": status,
+                       "session": name, "tenant": tenant})
+        self._respond_raw(status, data)
+
+    # -- forwarded session ops -----------------------------------------------
+
+    def _session_op(self, method: str, name: str,
+                    op: Optional[str]) -> None:
+        ctx = self.server_ctx
+        router = ctx.router
+        raw = self._read_body() if method == "POST" else b""
+        tenant = router.tenant_of(name)
+        quoted = quote(name, safe="")
+        path = (f"/v1/sessions/{quoted}/{op}" if op
+                else f"/v1/sessions/{quoted}")
+        # router hop in the span tree: adopt the client context from the
+        # frame header and swap in this hop's identity — payloads stay
+        # untouched (rewrite_trace is header-only)
+        trace_ctx = None
+        body = raw
+        if raw[:4] == protocol.MAGIC:
+            _hdr, _off = protocol._split_header(raw)
+            trace_ctx = router.tracer.adopt(_hdr.get("__trace__"))
+            if trace_ctx is not None:
+                body = protocol.rewrite_trace(raw, trace_ctx.wire())
+        fair = op in _FAIR_OPS
+        if fair:
+            try:
+                router.scheduler.acquire(tenant,
+                                         timeout=ctx.acquire_timeout)
+            except TimeoutError as e:
+                raise ServiceOverloaded(
+                    f"router forwarding saturated: {e}") from e
+        t0 = router.tracer.clock()
+        try:
+            status, data, backend = self._forward_routed(
+                method, name, path, body,
+                accept=self.headers.get(protocol.ACCEPT_HEADER))
+        finally:
+            if fair:
+                router.scheduler.release(tenant)
+        if method == "DELETE" and status < 400:
+            router.forget_session(name)
+        if trace_ctx is not None:
+            router.tracer.record(
+                f"router.forward {method} {path}", trace_ctx, t0,
+                router.tracer.clock(),
+                attrs={"backend": backend.name, "status": status,
+                       "session": name, "tenant": tenant})
+        self._respond_raw(status, data)
+
+    def _forward_routed(self, method: str, name: str, path: str,
+                        body: bytes, accept: Optional[str] = None
+                        ) -> Tuple[int, bytes, Backend]:
+        """Forward to the session's routed backend; re-route and retry
+        ONLY failures that provably never executed (unreachable before
+        send, or typed ServiceDraining rejections) — a failover in
+        flight moves the session, and the retry lands on its new home."""
+        ctx = self.server_ctx
+        router = ctx.router
+        last_exc: Optional[Exception] = None
+        for attempt in range(3):
+            backend = router.route_of(name)     # SessionUnknown when lost
+            if attempt:
+                router.metrics.inc("router_forward_retries")
+            try:
+                status, data = backend.forward(method, path, body or None,
+                                               accept=accept)
+            except BackendDown as e:
+                router.note_forward_failure(backend, e)
+                if e.sent:
+                    # the instance may have executed this op — never
+                    # silently re-send a step/tell
+                    raise ServeError(
+                        f"backend {backend.name} died mid-request; resync "
+                        f"the session state ({e})") from e
+                last_exc = e
+                router.wait_rerouted(name, backend.name,
+                                     timeout=ctx.failover_wait)
+                continue
+            router.metrics.inc("router_forwards")
+            if status < 400 or not _is_draining_envelope(data):
+                return status, data, backend
+            # typed draining rejection: the op never executed; wait for
+            # the failover to move the session, then retry
+            last_exc = None
+            if not router.wait_rerouted(name, backend.name,
+                                        timeout=ctx.failover_wait):
+                return status, data, backend
+        if last_exc is not None:
+            raise ServeError(
+                f"session {name!r} unreachable after retries: "
+                f"{last_exc}") from last_exc
+        return status, data, backend
+
+    # -- verbs ---------------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        self._route("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._route("POST")
+
+    def do_DELETE(self):  # noqa: N802
+        self._route("DELETE")
+
+
+def _strip_redirect(data: bytes) -> bytes:
+    """Drop ``location`` from a relayed JSON error envelope; anything
+    unparsable is returned untouched."""
+    try:
+        doc = json.loads(data.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return data
+    if not isinstance(doc, dict) or "location" not in doc:
+        return data
+    doc.pop("location")
+    return json.dumps(doc).encode("utf-8")
+
+
+def _is_draining_envelope(data: bytes) -> bool:
+    if data[:4] == protocol.MAGIC or not data:
+        return False
+    try:
+        doc = json.loads(data.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return False
+    return doc.get("error") == "ServiceDraining"
+
+

@@ -236,8 +236,8 @@ class Session:
             if self.phase != "idle":
                 raise ServeError(f"session {self.name!r} has an "
                                  "outstanding ask(); tell() first")
-        return [self._service._submit(self, "step", {}, deadline, block)
-                for _ in range(int(n))]
+        return self._service._submit_pipeline(self, "step", int(n),
+                                              deadline, block)
 
     def ask(self, deadline: Optional[float] = None) -> ServeFuture:
         """Produce the next offspring batch (selection + variation, no
@@ -745,9 +745,9 @@ class EvolutionService:
             return None
         return self.tracer.context(fleettrace.current())
 
-    def _submit(self, session: Session, kind: str, payload: dict,
-                deadline: Optional[float] = None, block: bool = False,
-                on_failure=None) -> ServeFuture:
+    def _build_request(self, session: Session, kind: str, payload: dict,
+                       deadline: Optional[float] = None,
+                       on_failure=None) -> Request:
         if self._draining:
             raise ServiceDraining("service is draining for failover")
         if session.closed:
@@ -768,7 +768,25 @@ class EvolutionService:
                       trace=self._trace_ctx())
         if on_failure is not None:
             req.future._on_failure = on_failure
+        return req
+
+    def _submit(self, session: Session, kind: str, payload: dict,
+                deadline: Optional[float] = None, block: bool = False,
+                on_failure=None) -> ServeFuture:
+        req = self._build_request(session, kind, payload, deadline,
+                                  on_failure)
         return self._dispatcher.submit(req, block=block)
+
+    def _submit_pipeline(self, session: Session, kind: str, n: int,
+                         deadline: Optional[float] = None,
+                         block: bool = False) -> List[ServeFuture]:
+        """Queue ``n`` identical requests ATOMICALLY (all or none) —
+        ``step(n)`` must never race a drain into queueing a prefix that
+        executes while the call reports failure; see
+        :meth:`BatchDispatcher.submit_many`."""
+        reqs = [self._build_request(session, kind, {}, deadline)
+                for _ in range(int(n))]
+        return self._dispatcher.submit_many(reqs, block=block)
 
     def _submit_evaluate(self, session: Session, genomes,
                          deadline: Optional[float] = None) -> ServeFuture:

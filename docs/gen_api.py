@@ -79,6 +79,15 @@ PAGES = {
                   ["deap_tpu.serve.net", "deap_tpu.serve.net.protocol",
                    "deap_tpu.serve.net.server",
                    "deap_tpu.serve.net.client"]),
+    "serve_router": ("Fleet control plane (deap_tpu.serve.router)",
+                     ["deap_tpu.serve.router",
+                      "deap_tpu.serve.router.backend",
+                      "deap_tpu.serve.router.placement",
+                      "deap_tpu.serve.router.health",
+                      "deap_tpu.serve.router.tenants",
+                      "deap_tpu.serve.router.core",
+                      "deap_tpu.serve.router.server",
+                      "deap_tpu.serve.router.cli"]),
     "support": ("Observability & persistence (deap_tpu.utils)",
                 ["deap_tpu.utils.support", "deap_tpu.utils.checkpoint",
                  "deap_tpu.utils.compilecache"]),

@@ -85,14 +85,15 @@ def test_no_blocking_sleep_flags_asyncio_polling_loop(tmp_path):
 
 def test_no_blocking_sleep_coverage_pin(tmp_path):
     """On a whole-repo run over a real package (deap_tpu/__init__.py
-    present), serve/net/ missing -> the pass reports lost coverage
-    instead of silently shrinking its scope; a path-restricted run of
-    the same tree is exempt (there is no coverage to lose)."""
+    present), serve/net/ and serve/router/ missing -> the pass reports
+    lost coverage per subpackage instead of silently shrinking its
+    scope; a path-restricted run of the same tree is exempt (there is no
+    coverage to lose)."""
     _write(tmp_path, "deap_tpu/__init__.py", "")
     _write(tmp_path, "deap_tpu/serve/mod.py", "x = 1\n")
     r = _findings(tmp_path, "no-blocking-sleep")
-    assert len(r.findings) == 1
-    assert "lost coverage" in r.findings[0].message
+    assert len(r.findings) == 2           # net/ and router/ both lost
+    assert all("lost coverage" in f.message for f in r.findings)
     r2 = run_lint(repo=tmp_path, select=["no-blocking-sleep"],
                   paths=[tmp_path / "deap_tpu" / "serve"])
     assert r2.findings == []
@@ -104,7 +105,7 @@ def test_no_blocking_sleep_coverage_pin_whole_tree_gone(tmp_path):
     _write(tmp_path, "deap_tpu/__init__.py", "")
     _write(tmp_path, "deap_tpu/serving/mod.py", "x = 1\n")   # renamed
     r = _findings(tmp_path, "no-blocking-sleep")
-    assert len(r.findings) == 2   # serve/ and serve/net/ both lost
+    assert len(r.findings) == 3   # serve/, serve/net/, serve/router/
     assert all("lost coverage" in f.message for f in r.findings)
 
 

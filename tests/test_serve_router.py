@@ -1,0 +1,827 @@
+"""Fleet-router tests: placement affinity, health-driven failover,
+tenant enforcement, wire compression, per-request timeouts, redirects.
+
+The load-bearing assertions (ISSUE 12 acceptance criteria):
+
+* **fleet drill** — ≥3 in-process ``NetServer`` instances behind one
+  ``RouterServer``; sessions placed by bucket-histogram affinity; one
+  instance latched sick mid-traffic by the HEALTH LOOP (error spans in
+  its ``/v1/trace`` window, not an operator call) → automatic
+  drain→restore → the surviving trajectories are **bitwise equal** to an
+  undisturbed single-instance reference; a tenant over quota receives
+  typed ``TenantQuotaExceeded`` while other tenants keep stepping;
+* **drain-during-restore races** — a restore target that dies
+  mid-restore (or whose registry skips the orphans) leaves the router
+  able to re-place the sessions on a third instance;
+* **wire compression** — zlib payload frames round-trip bit-exact (NaN
+  payloads included), are only sent to peers that advertised the codec,
+  and feed the ``net_bytes_saved`` counter;
+* **per-request timeout** — a hung backend fails ONE future with typed
+  ``DeadlineExceeded`` instead of wedging the ordered client worker.
+
+Shapes deliberately mirror ``test_serve_net.py`` (40/48×8 onemax at
+``max_batch=4`` → bucket 64) so the session-wide persistent compile
+cache turns every service's programs into disk hits.
+"""
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deap_tpu import base
+from deap_tpu.observability.fleettrace import join_spans, span_tree
+from deap_tpu.ops import crossover, mutation, selection
+from deap_tpu.serve import DeadlineExceeded, EvolutionService
+from deap_tpu.serve.buckets import genome_signature
+from deap_tpu.serve.net import (NetServer, RemoteService, decode_frame,
+                                encode_frame)
+from deap_tpu.serve.net import protocol
+from deap_tpu.serve.router import (Backend, FleetRouter, HealthPolicy,
+                                   PlacementPolicy, BackendPlan,
+                                   RouterServer, TenantQuota,
+                                   TenantQuotaExceeded,
+                                   WeightedFairScheduler, fleet_sizes)
+
+pytestmark = [pytest.mark.serve, pytest.mark.net]
+
+
+def onemax_toolbox():
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: (jnp.sum(g),))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    return tb
+
+
+def onemax_pop(key, n, nbits):
+    g = jax.random.bernoulli(key, 0.5, (n, nbits)).astype(jnp.float32)
+    return base.Population(genome=g, fitness=base.Fitness.empty(n, (1.0,)))
+
+
+def _final(session):
+    p = session.population()
+    return (np.asarray(p.genome), np.asarray(p.fitness.values),
+            np.asarray(p.fitness.valid))
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair scheduling + quotas (host-only, deterministic)
+# ---------------------------------------------------------------------------
+
+
+def test_weighted_fair_scheduler_order_and_quotas():
+    """Virtual-time tags grant in weighted order; backlog and session
+    quotas raise the typed TenantQuotaExceeded."""
+    sched = WeightedFairScheduler(
+        max_inflight=1,
+        quotas={"gold": TenantQuota(weight=3.0),
+                "silver": TenantQuota(weight=1.0, max_pending=2),
+                "capped": TenantQuota(max_sessions=2)})
+    # occupy the single slot so every later acquire queues
+    sched.acquire("gold")
+    order = []
+    threads = []
+
+    def waiter(tenant, tag):
+        sched.acquire(tenant, timeout=30)
+        order.append(tag)
+        sched.release(tenant)
+
+    # enqueue serially (each waiter registered before the next starts)
+    for tenant, tag in [("gold", "g1"), ("silver", "s1"), ("gold", "g2"),
+                        ("gold", "g3")]:
+        t = threading.Thread(target=waiter, args=(tenant, tag))
+        t.start()
+        threads.append(t)
+        deadline = time.monotonic() + 10
+        want = len(threads)
+        while time.monotonic() < deadline:
+            with sched._cv:
+                if len(sched._waiting) + len(sched._granted) >= want:
+                    break
+    sched.release("gold")               # free the slot: grants cascade
+    for t in threads:
+        t.join(timeout=30)
+    # tags: g1=1/3, g2=2/3, g3=1, s1=1 — silver's tie beats g3 on seq
+    assert order == ["g1", "g2", "s1", "g3"]
+
+    # backlog quota: silver may queue at most 2 — fill the slot first
+    sched.acquire("gold")
+    holders = []
+    for _ in range(2):
+        t = threading.Thread(target=lambda: (sched.acquire("silver", 30),
+                                             holders.append(1),
+                                             sched.release("silver")))
+        t.start()
+        threads.append(t)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        with sched._cv:
+            if sched._pending.get("silver", 0) == 2:
+                break
+    with pytest.raises(TenantQuotaExceeded):
+        sched.acquire("silver", timeout=1)
+    sched.release("gold")
+    for t in threads:
+        t.join(timeout=30)
+
+    # session quota: third concurrent session is a typed rejection
+    sched.session_opened("capped")
+    sched.session_opened("capped")
+    with pytest.raises(TenantQuotaExceeded):
+        sched.session_opened("capped")
+    sched.session_closed("capped")
+    sched.session_opened("capped")      # freed slot re-admits
+    sched.close()
+
+
+def test_placement_affinity_warm_and_spread():
+    """Warm (rows, signature) classes win placement until the spread
+    guard trips; fleet_sizes folds histograms through derive_sizes."""
+    sig = genome_signature(np.zeros((1, 8), np.float32))
+    policy = PlacementPolicy(spread=2)
+    a, b = BackendPlan(), BackendPlan()
+    rows = policy.bucket_rows(40)       # 64 on the default pow-2 grid
+    assert rows == 64
+    a.observe_placement(40, rows, sig)
+    # sibling shape (48 pads into the same 64-bucket): co-locates warm
+    chosen, warm = policy.choose([("A", a), ("B", b)], 48, sig)
+    assert chosen == "A" and warm
+    # load spread: once A leads by > spread, the cold backend wins
+    a.observe_placement(40, rows, sig)
+    a.observe_placement(40, rows, sig)
+    chosen, warm = policy.choose([("A", a), ("B", b)], 48, sig)
+    assert chosen == "B" and not warm
+    # different genome signature is never "warm"
+    sig16 = genome_signature(np.zeros((1, 16), np.float32))
+    chosen, warm = policy.choose([("A", a), ("B", b)], 40, sig16)
+    assert not warm
+    # fleet-wide grid: merged histograms through derive_sizes
+    sizes = fleet_sizes([a, b], max_buckets=4)
+    assert sizes == (40,)
+    assert fleet_sizes([BackendPlan()]) is None
+
+
+# ---------------------------------------------------------------------------
+# wire compression (protocol + negotiated loopback)
+# ---------------------------------------------------------------------------
+
+
+def test_frame_compression_bitwise_and_negotiated():
+    """zlib payload frames round-trip bit-exact (NaN/Inf/-0.0 included);
+    only advertising peers receive compressed replies; incompressible
+    payloads ship raw."""
+    weird = np.tile(np.asarray([np.nan, np.inf, -0.0, 1.5], np.float32),
+                    4096)
+    frame, stats = protocol.encode_frame_ex(
+        {"w": weird, "t": (1.0, -1.0)}, compress="zlib",
+        min_compress_bytes=1)
+    assert stats["wire_payload_bytes"] < stats["payload_bytes"]
+    obj, meta = protocol.decode_frame_with_meta(frame)
+    assert meta["compressed"] == "zlib"
+    assert (obj["w"].view(np.uint32) == weird.view(np.uint32)).all()
+    assert obj["t"] == (1.0, -1.0)
+    # below the size floor: raw frame, decodes identically
+    small = protocol.encode_frame({"x": np.arange(4)}, compress="zlib")
+    obj2, meta2 = protocol.decode_frame_with_meta(small)
+    assert meta2["compressed"] is None
+    np.testing.assert_array_equal(obj2["x"], np.arange(4))
+    # plain decode_frame accepts compressed frames transparently
+    np.testing.assert_array_equal(
+        decode_frame(frame)["w"].view(np.uint32), weird.view(np.uint32))
+    # rewrite_trace (the router hop) never touches compressed payloads
+    rt = protocol.rewrite_trace(frame, {"trace_id": "t", "span_id": "s"})
+    obj3, meta3 = protocol.decode_frame_with_meta(rt)
+    assert meta3["trace"] == {"trace_id": "t", "span_id": "s"}
+    assert (obj3["w"].view(np.uint32) == weird.view(np.uint32)).all()
+
+
+def test_decompression_bomb_rejected():
+    """A compressed payload may never inflate past what the frame's own
+    tensor manifest declares: a few-KB frame that would expand to tens
+    of MB is rejected before the allocation, not after."""
+    import zlib
+    legit = protocol.encode_frame({"x": np.zeros(4096, np.float32)},
+                                  compress="zlib", min_compress_bytes=1)
+    _hdr, off = protocol._split_header(legit)
+    bombed = legit[:off] + zlib.compress(b"\x00" * (32 << 20))
+    with pytest.raises(ValueError, match="inflates past"):
+        protocol.decode_frame(bombed)
+    # the untampered frame still round-trips (exact-size inflate path)
+    obj = protocol.decode_frame(legit)
+    np.testing.assert_array_equal(obj["x"], np.zeros(4096, np.float32))
+
+
+def test_pipeline_larger_than_queue_fails_fast():
+    """step(n) with n > max_pending can never be queued atomically —
+    typed ServiceOverloaded immediately, never an unbounded block=True
+    wait on a predicate no completion can satisfy."""
+    from deap_tpu.serve import ServiceOverloaded
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(33)
+    with EvolutionService(max_batch=4, max_pending=8) as svc:
+        s = svc.open_session(key, onemax_pop(key, 40, 8), tb,
+                             name="wide", evaluate_initial=False)
+        t0 = time.monotonic()
+        with pytest.raises(ServiceOverloaded, match="never fit"):
+            s.step(9, block=True)
+        assert time.monotonic() - t0 < 5.0      # failed fast, no hang
+        for f in s.step(3):                     # session still usable
+            assert f.exception(timeout=120) is None
+
+
+def test_blocking_submit_rejected_when_drain_lands_mid_wait():
+    """A submit blocked on queue SPACE must honor a drain that lands
+    while it waits: waking and enqueueing anyway would slip work behind
+    the drain wait, after set_draining() promised the pending queue can
+    only shrink (the failover snapshot boundary)."""
+    from deap_tpu.serve.dispatcher import (BatchDispatcher, Request,
+                                           ServiceDraining)
+    hold = threading.Event()
+
+    def execute(kind, program_key, requests):
+        hold.wait(30)
+        return [None] * len(requests)
+
+    def req():
+        return Request(kind="noop", program_key=("k",), payload={})
+
+    d = BatchDispatcher(execute, max_pending=1)
+    try:
+        d.submit(req())                     # worker picks this up
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:  # wait until it's in-flight
+            with d._cv:
+                if d._busy and not d._pending:
+                    break
+        d.submit(req())                     # queue now holds one (full)
+        outcome = []
+
+        def blocked_submit():
+            try:
+                d.submit(req(), block=True, timeout=30)
+                outcome.append("queued")
+            except ServiceDraining:
+                outcome.append("draining")
+
+        t = threading.Thread(target=blocked_submit)
+        t.start()
+        deadline = time.monotonic() + 10    # wait until it blocks on space
+        while time.monotonic() < deadline and not outcome:
+            with d._cv:
+                full = len(d._pending) >= d.max_pending
+            if full and t.is_alive():
+                break
+        d.set_draining(True)
+        t.join(timeout=30)
+        assert outcome == ["draining"]      # typed reject, nothing queued
+        with d._cv:
+            assert len(d._pending) <= 1     # the blocked request never slipped in
+    finally:
+        hold.set()
+        d.set_draining(False)
+        d.close()
+
+
+def test_router_strips_failover_location_from_relayed_envelopes():
+    """A backend's draining envelope carries a ``location`` redirect so
+    DIRECT clients re-target; relayed through the router it must be
+    stripped, or a router client's redirect-following would re-point it
+    at the backend and bypass quotas/scheduling for good."""
+    import json
+    from deap_tpu.serve import ServiceDraining
+    from deap_tpu.serve.router.server import _strip_redirect
+
+    env = protocol.error_payload(ServiceDraining("moving"),
+                                 location="host9:1234")
+    assert b"location" in env               # the direct-client shape
+    doc = json.loads(_strip_redirect(env).decode("utf-8"))
+    assert "location" not in doc
+    assert doc["error"] == "ServiceDraining"    # typed rebuild survives
+    # envelopes without a redirect, and non-JSON bytes, pass untouched
+    plain = protocol.error_payload(ValueError("x"))
+    assert _strip_redirect(plain) == plain
+    assert _strip_redirect(b"\x93not json") == b"\x93not json"
+
+
+def test_health_probe_latches_queue_progress_stall():
+    """Queued requests with a flat ``completed`` counter past stall_s is
+    a wedged dispatch pipeline — trace spans can't see it (queue_wait is
+    recorded at dispatch), so the probe must; resumed completions reopen
+    the window instead of staying latched."""
+    from deap_tpu.serve.router.health import HealthMonitor, HealthPolicy
+
+    class _WedgedBackend:
+        name = "b0"
+        completed = 5
+        depth = 3.0
+
+        def healthz(self):
+            return {"ok": True, "draining": False}
+
+        def metrics(self):
+            return {"counters": {"completed": self.completed, "failed": 0},
+                    "gauges": {"queue_depth": self.depth}}
+
+        def trace_tail(self, n):
+            return {"spans": []}
+
+    now = [0.0]
+    be = _WedgedBackend()
+    mon = HealthMonitor([be], on_sick=lambda b, r: None,
+                        policy=HealthPolicy(stall_s=5.0),
+                        clock=lambda: now[0])
+    assert mon.probe(be).ok                 # first poll: baseline only
+    assert mon.probe(be).ok                 # flat, but window just opened
+    now[0] = 6.0
+    sample = mon.probe(be)                  # flat past stall_s -> sick
+    assert not sample.ok and "wedged" in sample.reason
+    be.completed += 1                       # progress resumes
+    now[0] = 12.0
+    assert mon.probe(be).ok                 # delta > 0 resets the window
+    now[0] = 16.0
+    assert mon.probe(be).ok                 # flat again but only 4s < stall_s
+    now[0] = 22.0
+    assert not mon.probe(be).ok             # re-wedged past the NEW window
+    be.depth = 0.0                          # empty queue is idle, not wedged
+    now[0] = 40.0
+    assert mon.probe(be).ok
+
+
+def test_scheduler_timeout_backs_out_and_grant_path_leaves_no_residue():
+    """A timed-out waiter must back fully out (its latched slot or heap
+    entry passes to the next tag, not leaks), and the granted fast path
+    (entry already heappopped by the grant loop) must leave zero stale
+    bookkeeping behind."""
+    sched = WeightedFairScheduler(max_inflight=1)
+    sched.acquire("a")                      # hold the only slot
+    with pytest.raises(TimeoutError):
+        sched.acquire("b", timeout=0.05)    # expires while the slot is held
+    with sched._cv:                         # waiter backed fully out
+        assert not sched._waiting and not sched._granted
+        assert "b" not in sched._pending
+    got = []
+    t = threading.Thread(target=lambda: (sched.acquire("c", timeout=30),
+                                         got.append("c")))
+    t.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:      # wait until c is queued
+        with sched._cv:
+            if sched._waiting or sched._granted:
+                break
+    sched.release("a")                      # slot passes to c
+    t.join(timeout=30)
+    assert got == ["c"]
+    sched.release("c")
+    with sched._cv:                         # grant fast path: no residue
+        assert not sched._waiting and not sched._granted
+        assert not sched._pending and sched._inflight == 0
+    sched.close()
+
+
+def test_router_relays_accept_header_for_bodyless_gets():
+    """Compression negotiated end-to-end survives the router hop for
+    bodyless GETs too: the client's X-DTF-Accept advertisement is
+    relayed, so the backend compresses the population read — the
+    response most worth compressing."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(34)
+    with EvolutionService(max_batch=4) as svc, \
+            NetServer(svc, {"onemax": tb}, compress_min_bytes=64) as srv, \
+            FleetRouter([("a", srv.address)], start_health=False) as router, \
+            RouterServer(router) as rs, \
+            RemoteService(rs.url, timeout=120, compress="zlib") as cli:
+        s = cli.open_session(key, onemax_pop(key, 40, 8), "onemax",
+                             name="zr", evaluate_initial=False)
+        for f in s.step(2):
+            assert f.exception(timeout=120) is None
+        pop = s.population()                    # GET through the router
+        assert pop.genome.shape == (40, 8)
+        rec = decode_frame(srv and protocol.encode_frame({})) \
+            if False else None  # placeholder removed below
+        backend_stats = router.backends["a"].metrics()
+        assert backend_stats["counters"]["net_frames_compressed"] >= 1
+        assert backend_stats["counters"]["net_bytes_saved"] > 0
+        s.close()
+
+
+def test_compression_negotiation_loopback_counts_saved_bytes():
+    """A zlib-advertising client gets compressed responses (bitwise
+    equal populations) and the server counts net_bytes_saved; a peer
+    that does not advertise gets raw frames."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(21)
+    with EvolutionService(max_batch=4) as svc, \
+            NetServer(svc, {"onemax": tb}, compress_min_bytes=64) as srv, \
+            RemoteService(srv.url, timeout=120, compress="zlib") as cli:
+        rs = cli.open_session(key, onemax_pop(key, 40, 8), "onemax",
+                              name="z", evaluate_initial=False)
+        pop = rs.population()           # genome payload >= 64B -> zlib
+        assert pop.genome.shape == (40, 8)
+        rec = cli.stats()
+        assert rec.counters["net_frames_compressed"] >= 1
+        assert rec.counters["net_bytes_saved"] > 0
+        # bitwise: the wire round trip of the same state, uncompressed
+        import http.client
+        conn = http.client.HTTPConnection(*srv.address, timeout=30)
+        conn.request("GET", "/v1/sessions/z",
+                     headers={"Content-Type": protocol.CONTENT_TYPE})
+        resp = conn.getresponse()
+        raw = resp.read()
+        conn.close()
+        _obj, meta = protocol.decode_frame_with_meta(raw)
+        assert meta["compressed"] is None   # no advertisement, no zlib
+        np.testing.assert_array_equal(_obj["genome"],
+                                      np.asarray(pop.genome))
+
+
+# ---------------------------------------------------------------------------
+# per-request timeout: hung backend -> typed DeadlineExceeded
+# ---------------------------------------------------------------------------
+
+
+class _HangingHandler(BaseHTTPRequestHandler):
+    """Answers nothing for `hang_s` seconds, then a valid empty frame —
+    simulating a wedged instance holding the socket open."""
+
+    hang_s = 5.0
+
+    def do_POST(self):  # noqa: N802
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        if length:
+            self.rfile.read(length)
+        time.sleep(self.hang_s)
+        payload = encode_frame({"ok": True})
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, fmt, *args):
+        pass
+
+
+def test_remote_request_timeout_is_typed_deadline():
+    """request_timeout fails the hung future with DeadlineExceeded (not
+    a raw socket error), and the worker thread survives to serve the
+    next request."""
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _HangingHandler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        cli = RemoteService(httpd.server_address, timeout=30,
+                            request_timeout=0.4)
+        from deap_tpu.serve.net.client import RemoteSession
+        rs = RemoteSession(cli, "phantom", weights=(1.0,), pop=8)
+        [fut] = rs.step(1)
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=10)
+        # the ordered worker dropped the poisoned connection and lives:
+        # a second request also times out typed (rather than hanging
+        # behind a dead pipeline or crashing the worker)
+        [fut2] = rs.step(1)
+        with pytest.raises(DeadlineExceeded):
+            fut2.result(timeout=10)
+        cli.close()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# THE fleet drill: health-driven failover, bitwise, with tenancy
+# ---------------------------------------------------------------------------
+
+
+def _fleet(tb, n=3, max_batch=4, **router_kw):
+    svcs = [EvolutionService(max_batch=max_batch) for _ in range(n)]
+    srvs = [NetServer(s, {"onemax": tb}).start() for s in svcs]
+    backends = [Backend(f"b{i}", s.url) for i, s in enumerate(srvs)]
+    router = FleetRouter(backends, **router_kw)
+    return svcs, srvs, backends, router
+
+
+def _close_fleet(svcs, srvs, front=None):
+    if front is not None:
+        front.close()               # closes the router too
+    for s in srvs:
+        s.close()
+    for s in svcs:
+        s.close()
+
+
+def test_fleet_drill_failover_bitwise_with_tenant_enforcement():
+    """ISSUE 12's in-gate drill (see module docstring)."""
+    tb = onemax_toolbox()
+    keys = jax.random.split(jax.random.PRNGKey(12), 2)
+    shapes = [(40, 8), (48, 8)]
+
+    # undisturbed single-instance reference: 4 + 4 generations
+    with EvolutionService(max_batch=4) as ref:
+        want = []
+        for i, (k, (n, d)) in enumerate(zip(keys, shapes)):
+            s = ref.open_session(k, onemax_pop(k, n, d), tb,
+                                 cxpb=0.6, mutpb=0.3, name=f"run-{i}")
+            for f in s.step(8):
+                f.result(timeout=60)
+            want.append(_final(s))
+
+    svcs, srvs, backends, router = _fleet(
+        tb, n=3,
+        quotas={"capped": TenantQuota(max_sessions=1)},
+        health=HealthPolicy(interval_s=0.1, fail_after=2,
+                            max_error_spans=0))
+    front = RouterServer(router, failover_wait=60).start()
+    try:
+        cli = RemoteService(front.url, timeout=120)
+        sessions = [
+            cli.open_session(k, onemax_pop(k, n, d), "onemax",
+                             cxpb=0.6, mutpb=0.3, name=f"run-{i}",
+                             tenant="acme")
+            for i, (k, (n, d)) in enumerate(zip(keys, shapes))]
+        # bucket-histogram affinity: sibling shapes (40 and 48 both pad
+        # to the 64-row bucket) co-locate on the warm instance
+        homes = {router.route_of(s.name).name for s in sessions}
+        assert len(homes) == 1
+        (victim_name,) = homes
+        for s in sessions:
+            for f in s.step(4):
+                assert f.result(timeout=120)["nevals"] >= 0
+
+        # make the HEALTH LOOP latch the victim sick: deadline-missed
+        # requests leave error spans in its /v1/trace window (they never
+        # execute, so the trajectories are untouched)
+        direct = RemoteService(srvs[int(victim_name[1:])].url, timeout=60)
+        phantom = direct.attach("run-0")
+        for _ in range(3):
+            [f] = phantom.step(1, deadline=0.0)
+            with pytest.raises(DeadlineExceeded):
+                f.result(timeout=60)
+        direct.close()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(router.route_of(s.name).name != victim_name
+                   for s in sessions):
+                break
+            time.sleep(0.05)
+        assert router.health.is_sick(victim_name)
+        new_homes = {router.route_of(s.name).name for s in sessions}
+        assert victim_name not in new_homes
+
+        # traffic continues through the SAME router client, bitwise
+        for s in sessions:
+            for f in s.step(4):
+                f.result(timeout=120)
+        for s, w in zip(sessions, want):
+            for got, ref_arr in zip(_final(s), w):
+                np.testing.assert_array_equal(got, ref_arr)
+
+        # tenant enforcement on the wire: capped tenant's second session
+        # is a typed rejection; the healthy tenant keeps stepping
+        k2 = jax.random.PRNGKey(99)
+        cli.open_session(k2, onemax_pop(k2, 40, 8), "onemax",
+                         name="cap-0", tenant="capped",
+                         evaluate_initial=False)
+        with pytest.raises(TenantQuotaExceeded):
+            cli.open_session(k2, onemax_pop(k2, 40, 8), "onemax",
+                             name="cap-1", tenant="capped",
+                             evaluate_initial=False)
+        sessions[0].step(1)[0].result(timeout=120)
+        counters = router.stats().counters
+        assert counters["router_failovers"] == 1
+        assert counters["router_failover_sessions"] == 2
+        assert counters["router_quota_rejections"] == 1
+        assert router.stats().gauges["router_failover_recovery_s"] > 0
+        cli.close()
+    finally:
+        _close_fleet(svcs, srvs, front)
+
+
+def test_restore_target_dies_mid_restore_replaced_on_third():
+    """Failover whose first restore target is dead re-places the
+    orphaned sessions on a third instance — the drain-during-restore
+    race ISSUE 12 pins (h_restore alone would just lose them)."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(31)
+    svcs, srvs, backends, router = _fleet(tb, n=3, start_health=False)
+    try:
+        cli_a = RemoteService(srvs[0].url, timeout=120)
+        s = cli_a.open_session(key, onemax_pop(key, 40, 8), "onemax",
+                               cxpb=0.6, mutpb=0.3, name="orph")
+        s.step(2)[0].result(timeout=120)
+        cli_a.close()
+        # the router only learns of the session via its own tables in
+        # normal operation; register the route directly for this drill
+        router.commit_session(
+            "orph", backends[0], 40,
+            genome_signature(np.zeros((1, 8), np.float32)), None)
+        # prime the toolbox model, then kill b1 (the least-loaded first
+        # choice) BEFORE the restore reaches it
+        assert router.toolbox_union() == ["onemax"]
+        srvs[1].close()
+        out = router.failover(backends[0], reason="drill")
+        assert out["restored"] == {"orph": "b2"}
+        assert out["lost"] == []
+        assert router.health.is_sick("b1")
+        assert router.route_of("orph").name == "b2"
+        # the session continues on the third instance
+        cli_c = RemoteService(srvs[2].url, timeout=120)
+        moved = cli_c.attach("orph")
+        assert moved.gen == 2
+        moved.step(1)[0].result(timeout=120)
+        cli_c.close()
+        assert router.stats().counters["router_orphans_replaced"] >= 1
+    finally:
+        router.close()
+        _close_fleet(svcs, [srvs[0], srvs[2]])
+
+
+def test_restore_target_with_native_sessions_dies_mid_restore():
+    """A restore target that dies mid-restore gets its OWN failover: its
+    native sessions are accounted lost (routes dropped, tenant quota
+    slots freed), never left pinned to the dead instance, while the
+    orphans still land on the third instance."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(41)
+    svcs, srvs, backends, router = _fleet(
+        tb, n=3, start_health=False,
+        quotas={"capT": TenantQuota(max_sessions=1)})
+    try:
+        cli_a = RemoteService(srvs[0].url, timeout=120)
+        s = cli_a.open_session(key, onemax_pop(key, 40, 8), "onemax",
+                               cxpb=0.6, mutpb=0.3, name="orph")
+        s.step(2)[0].result(timeout=120)
+        cli_a.close()
+        sig = genome_signature(np.zeros((1, 8), np.float32))
+        router.commit_session("orph", backends[0], 40, sig, None)
+        # b1 holds a quota'd native session; pad b2 so b1 stays the
+        # least-loaded (first) restore choice
+        router.scheduler.session_opened("capT")
+        router.commit_session("native", backends[1], 40, sig, "capT")
+        for i in range(2):
+            router.commit_session(f"pad-{i}", backends[2], 40, sig, None)
+        assert router.toolbox_union() == ["onemax"]
+        srvs[1].close()                  # b1 dies before the restore
+        out = router.failover(backends[0], reason="drill")
+        assert out["restored"] == {"orph": "b2"}
+        assert router.route_of("orph").name == "b2"
+        assert router.health.is_sick("b1")
+        # b1's own failover ran (not an already-down no-op): its native
+        # session is dropped and the tenant's quota slot is free again
+        with router._lock:
+            assert "native" not in router._routes
+        assert router.scheduler.sessions_of("capT") == 0
+        router.scheduler.session_opened("capT")      # re-admits
+        assert router.stats().counters["router_sessions_lost"] >= 1
+    finally:
+        router.close()
+        _close_fleet(svcs, [srvs[0], srvs[2]])
+
+
+def test_commit_session_never_stomps_failover_reroute():
+    """commit_session racing a failover: a route the failover already
+    wrote is kept (never stomped back to the drained backend), and a
+    backend declared down pre-commit never receives a new-session pin —
+    the session is accounted lost and its quota slot freed."""
+    backends = [Backend(f"b{i}", ("127.0.0.1", 1 + i)) for i in range(3)]
+    router = FleetRouter(backends, start_health=False,
+                         quotas={"capT": TenantQuota(max_sessions=1)})
+    try:
+        sig = genome_signature(np.zeros((1, 8), np.float32))
+        # normal commit: route lands on the forwarded backend
+        router.commit_session("plain", backends[1], 40, sig, None)
+        assert router.route_of("plain").name == "b1"
+        # failover re-routed first: its route wins, tenancy still lands
+        with router._lock:
+            router._routes["moved"] = "b2"
+        router.commit_session("moved", backends[0], 40, sig, "capT")
+        assert router.route_of("moved").name == "b2"
+        with router._lock:
+            assert router._tenant_of["moved"] == "capT"
+        # backend down pre-commit, session not restored anywhere: lost —
+        # no route written, quota slot freed
+        router.scheduler.session_opened("capT")      # the create admission
+        lost0 = router.stats().counters["router_sessions_lost"]
+        with router._lock:
+            router._down["b0"] = "drill"
+        router.commit_session("gone", backends[0], 40, sig, "capT")
+        with router._lock:
+            assert "gone" not in router._routes
+        assert router.stats().counters["router_sessions_lost"] == lost0 + 1
+        assert router.scheduler.sessions_of("capT") == 0
+    finally:
+        router.close()
+
+
+def test_restore_skip_toolbox_orphans_replaced():
+    """A target whose registry lost the toolbox skips the orphans
+    (h_restore contract); the router re-places them on an instance that
+    still holds it instead of dropping them."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(37)
+    svcs, srvs, backends, router = _fleet(tb, n=3, start_health=False)
+    front = RouterServer(router).start()
+    try:
+        cli = RemoteService(front.url, timeout=120)
+        s = cli.open_session(key, onemax_pop(key, 40, 8), "onemax",
+                             cxpb=0.6, mutpb=0.3, name="skipme")
+        s.step(2)[0].result(timeout=120)
+        home = router.route_of("skipme").name
+        others = [b for b in backends if b.name != home]
+        # the preferred (least-loaded) target silently loses the
+        # toolbox AFTER the router cached its registry
+        assert router.toolbox_union() == ["onemax"]
+        preferred = others[0]
+        srvs[int(preferred.name[1:])].toolboxes.pop("onemax")
+        out = router.failover(router.backends[home], reason="drill")
+        third = others[1].name
+        assert out["restored"] == {"skipme": third}
+        assert out["lost"] == []
+        # traffic continues through the router on the replacement
+        s.step(1)[0].result(timeout=120)
+        assert router.route_of("skipme").name == third
+        cli.close()
+    finally:
+        _close_fleet(svcs, srvs, front)
+
+
+# ---------------------------------------------------------------------------
+# transparent client redirect + cross-hop span join
+# ---------------------------------------------------------------------------
+
+
+def test_client_follows_failover_redirect():
+    """A drained instance that knows its replacement redirects stale
+    direct clients; RemoteService re-targets and continues without the
+    caller seeing an error."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(41)
+    svc_a, svc_b = EvolutionService(max_batch=4), EvolutionService(max_batch=4)
+    with NetServer(svc_a, {"onemax": tb}) as a, \
+            NetServer(svc_b, {"onemax": tb}) as b:
+        try:
+            ca = RemoteService(a.url, timeout=120)
+            s = ca.open_session(key, onemax_pop(key, 40, 8), "onemax",
+                                cxpb=0.6, mutpb=0.3, name="mv")
+            s.step(2)[0].result(timeout=120)
+            snap = ca.drain()
+            admin_b = Backend("b", b.url)
+            assert admin_b.restore(snap)["restored"] == ["mv"]
+            Backend("a", a.url).set_redirect(b.url)
+            # the stale client's next ordered request hits ServiceDraining
+            # + location, re-targets, and the SAME call succeeds
+            [f] = s.step(1)
+            assert f.result(timeout=120)["gen"] == 3
+            assert (ca.host, ca.port) == b.address
+            # sync paths follow too
+            assert ca.attach("mv").gen == 3
+            ca.close()
+        finally:
+            svc_a.close()
+            svc_b.close()
+
+
+def test_router_span_joins_client_router_backend():
+    """One request's spans from all three processes join into a single
+    tree: client hop → router.forward → backend http + phases."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(43)
+    svcs, srvs, backends, router = _fleet(tb, n=3, start_health=False)
+    front = RouterServer(router).start()
+    try:
+        cli = RemoteService(front.url, timeout=120)
+        s = cli.open_session(key, onemax_pop(key, 40, 8), "onemax",
+                             cxpb=0.6, mutpb=0.3, name="traced")
+        s.step(1)[0].result(timeout=120)
+        backend = router.route_of("traced")
+        svc = svcs[int(backend.name[1:])]
+        merged = join_spans({
+            "client": cli.tracer.recent(),
+            "router": router.tracer.recent(),
+            "backend": svc.tracer.recent()})
+        step_clients = [sp for sp in merged
+                        if sp["name"].startswith("client.POST")
+                        and sp["name"].endswith("/step")]
+        assert step_clients
+        trace_id = step_clients[-1]["trace_id"]
+        tree = span_tree([sp for sp in merged
+                          if sp["trace_id"] == trace_id])
+        [root] = [sp for sp in tree
+                  if sp["attrs"]["source"] == "client"]
+        router_hops = [c for c in root["children"]
+                       if c["attrs"]["source"] == "router"]
+        assert router_hops and \
+            router_hops[0]["name"].startswith("router.forward")
+        backend_spans = [g for c in router_hops
+                         for g in c["children"]
+                         if g["attrs"]["source"] == "backend"]
+        assert backend_spans        # server http span under the router hop
+        cli.close()
+    finally:
+        _close_fleet(svcs, srvs, front)
