@@ -31,7 +31,7 @@ __revision__ = "0.1.0"
 _SUBMODULES = (
     "base", "creator", "tools", "algorithms", "cma", "benchmarks", "ops",
     "utils", "parallel", "pso", "de", "eda", "coev", "gp", "resilience",
-    "observability", "serve", "lint", "analysis", "selftest",
+    "observability", "serve", "lint", "analysis", "sanitize", "selftest",
 )
 #: conveniences re-exported from deap_tpu.base on first access
 _BASE_EXPORTS = ("Toolbox", "Fitness", "Population")

@@ -11,6 +11,9 @@ the AST tier is ``deap-tpu-lint``).
     deap-tpu-analyze --update-budget      # refresh tools/program_budget.json
                                           # AND tools/memory_budget.json
     deap-tpu-analyze --list               # inventory catalog
+    deap-tpu-analyze --threads            # runtime concurrency sanitizer
+                                          # drill (deap_tpu.sanitize) over
+                                          # a loopback serve fleet
 
 The text summary ends with a per-pass wall-time attribution line
 (``pass wall: lower 16.4s, memory-budget 13.2s, ...``) — the gate
@@ -75,12 +78,101 @@ def build_parser() -> argparse.ArgumentParser:
                          "tools/memory_budget.json)")
     ap.add_argument("--list", action="store_true", dest="list_programs",
                     help="print the inventory catalog and exit")
+    ap.add_argument("--threads", action="store_true",
+                    help="run the runtime concurrency sanitizer instead: "
+                         "arm deap_tpu.sanitize (lockset race detection, "
+                         "lock-order witness, Condition stall watchdog) "
+                         "and drive a small loopback serve drill on real "
+                         "threads; findings ride the lint reporters")
+    ap.add_argument("--stall-s", type=float, default=10.0,
+                    help="--threads: Condition-stall watchdog bound "
+                         "(seconds)")
     return ap
+
+
+def _thread_drill(fmt: str, stall_s: float) -> int:
+    """``--threads``: arm the sanitizer, run a concurrency drill over
+    the real serving stack (concurrent remote sessions, a stats scraper,
+    a bucket-grid refit, and a drain), and report the runtime findings
+    through the lint reporters — the dynamic leg of the static-analysis
+    story, same Finding records, same output shapes."""
+    import threading
+
+    import jax
+
+    from deap_tpu import base, sanitize
+    from deap_tpu.benchmarks import rastrigin
+    from deap_tpu.lint.core import LintResult
+    from deap_tpu.lint.reporters import render_json, render_text
+    from deap_tpu.ops import crossover, mutation, selection
+    from deap_tpu.serve import EvolutionService
+    from deap_tpu.serve.net import NetServer, RemoteService
+
+    tb = base.Toolbox()
+    tb.register("evaluate", rastrigin)
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=0.3,
+                indpb=0.1)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+
+    def population(key, n, d):
+        genome = jax.random.uniform(key, (n, d), minval=-5.12, maxval=5.12)
+        return base.Population(genome=genome,
+                               fitness=base.Fitness.empty(n, (-1.0,)))
+
+    san = sanitize.arm(stall_s=stall_s)
+    try:
+        with EvolutionService(max_batch=2) as svc, \
+                NetServer(svc, {"drill": tb}) as srv, \
+                RemoteService(srv.url, timeout=120) as cli:
+            fleet = [cli.open_session(
+                jax.random.PRNGKey(i),
+                population(jax.random.PRNGKey(i), 24 + 8 * i, 8),
+                "drill", cxpb=0.6, mutpb=0.3) for i in range(2)]
+
+            def drive(session):
+                for f in session.step(3):
+                    f.result(timeout=120)
+
+            threads = [threading.Thread(target=drive, args=(s,))
+                       for s in fleet]
+            for t in threads:
+                t.start()
+            cli.stats()                     # scraper thread vs dispatcher
+            for t in threads:
+                t.join()
+            svc.rebucket(max_buckets=4)     # quiesce + refit interleaving
+            for s in fleet:
+                for f in s.step(1):
+                    f.result(timeout=120)
+            svc.drain(timeout=60.0)         # the failover boundary path
+    finally:
+        findings = sanitize.disarm()
+
+    result = LintResult(findings=findings, suppressed=[], baselined=[],
+                        expired=[], rules_run=list(sanitize.TSAN_RULES),
+                        files_scanned=0)
+    if fmt == "json":
+        doc = render_json(result)
+        doc["summary"]["sanitizer"] = dict(san.counts)
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        print(render_text(result))
+        print("sanitizer: " + ", ".join(
+            f"{k} {v}" for k, v in sorted(san.counts.items())))
+    return result.exit_code
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     _init_devices()
+    if args.threads:
+        if args.programs or args.select or args.update_budget:
+            print("deap-tpu-analyze: --threads is a standalone drill "
+                  "(no program names / --select / --update-budget)",
+                  file=sys.stderr)
+            return 2
+        return _thread_drill(args.format, args.stall_s)
     from pathlib import Path
     from .inventory import entries, lower_entry
     from .passes import (MEMORY_BUDGET_PATH, PROGRAM_BUDGET_PATH,

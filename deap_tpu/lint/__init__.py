@@ -32,8 +32,12 @@ Rule catalog (see ``docs/static_analysis.md`` for bad/good examples):
 ``no-bare-print``    library output must route through observability sinks
 ``no-blocking-sleep`` no ``time.sleep`` / polled ``asyncio.sleep`` in serve/
 ``lock-discipline``  ``_GUARDED_BY`` attrs written only under their lock
+                     (reads too, in return/condition position)
 ``lock-order``       nested lock acquisitions form a consistent acyclic
                      order per class (static deadlock lint)
+``sanitizer-factory`` serve-fleet locks built via ``deap_tpu.sanitize``
+                     so the runtime sanitizer can instrument them
+``guardedby-coverage`` factory-locked classes declare ``_GUARDED_BY``
 ``trace-impurity``   host side effects reachable inside traced functions
 ``rng-key-reuse``    a PRNG key consumed twice without split/fold_in
 ``tracer-leak``      ``int()``/``bool()``/``if`` on traced values
@@ -53,7 +57,8 @@ from .baseline import (load_baseline, write_baseline, apply_baseline,
 from .reporters import render_text, render_json, render_sarif
 
 # importing the rule modules registers their passes
-from . import rules_repo, rules_jax, rules_data, rules_locks  # noqa: F401  (registration)
+from . import rules_repo, rules_jax, rules_data, rules_locks, \
+    rules_sanitize  # noqa: F401  (registration)
 
 __all__ = [
     "Finding", "PyFile", "Rule", "LintContext", "LintResult",

@@ -40,7 +40,7 @@ def bench_json_targets(repo: Path) -> List[Tuple[str, Path]]:
     schema."""
     out: List[Tuple[str, Path]] = []
     _SPECIAL = {"BENCH_TRACE.json": "trace", "BENCH_MEMORY.json": "memory",
-                "BENCH_FLEET.json": "fleet"}
+                "BENCH_FLEET.json": "fleet", "BENCH_TSAN.json": "tsan"}
     for p in sorted(repo.glob("BENCH_*.json")):
         out.append((_SPECIAL.get(p.name, "bench"), p))
     for p in sorted(repo.glob("MULTICHIP_*.json")):
@@ -126,6 +126,38 @@ def _schema_errors(kind: str, doc) -> List[str]:
                     or not math.isfinite(float(p50)):
                 errors.append(f"key '{leg}.roundtrip_p50_ms' must be a "
                               "finite number")
+    elif kind == "tsan":
+        # BENCH_TSAN.json: the concurrency-sanitizer overhead record
+        # from ``tools/bench_serve.py --net --tsan`` — a metric triple
+        # plus the two interleaved loopback legs (sanitizer armed /
+        # off), and the armed leg's violation count, which MUST be zero:
+        # the committed artifact doubles as the proof that the real
+        # serving drill runs clean under the lockset detector
+        require("metric", str, "a string")
+        value = require("value", (int, float), "a number")
+        require("unit", str, "a string")
+        if isinstance(value, float) and not math.isfinite(value):
+            errors.append("key 'value' must be finite")
+        for leg in ("tsan_on", "tsan_off"):
+            sub = doc.get(leg)
+            if not isinstance(sub, dict):
+                errors.append(f"key '{leg}' must be an object with the "
+                              "leg's latency quantiles")
+                continue
+            p50 = sub.get("roundtrip_p50_ms")
+            if isinstance(p50, bool) or not isinstance(p50, (int, float)) \
+                    or not math.isfinite(float(p50)):
+                errors.append(f"key '{leg}.roundtrip_p50_ms' must be a "
+                              "finite number")
+        violations = doc.get("violations")
+        if isinstance(violations, bool) or not isinstance(violations, int):
+            errors.append("key 'violations' must be an integer (the armed "
+                          "leg's sanitizer finding count)")
+        elif violations != 0:
+            errors.append("key 'violations' must be 0 -- the committed "
+                          "artifact is the clean-drill proof; a nonzero "
+                          "count means the serving fleet raced under the "
+                          "sanitizer and must not be committed")
     elif kind == "memory":
         # BENCH_MEMORY.json: the footprint-trajectory record from
         # tools/bench_memory.py — runner status (int rc / bool ok) plus

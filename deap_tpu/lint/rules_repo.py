@@ -322,6 +322,32 @@ def _written_guarded_attrs(stmt: ast.stmt, guarded: Set[str]
     return hits
 
 
+def _read_guarded_attrs(stmt: ast.stmt, guarded: Set[str]
+                        ) -> List[Tuple[int, str, str]]:
+    """(line, attr, position) for guarded ``self.<attr>`` *reads* in the
+    two decision positions worth flagging: a ``return`` value and an
+    ``if``/``while`` condition.  A racy read that feeds a branch or a
+    caller's decision is the read that matters (Eraser's insight: reads
+    participate in races too); incidental reads elsewhere stay out of
+    scope so the fleet's accepted opportunistic-gauge reads don't drown
+    the signal."""
+    if isinstance(stmt, ast.Return) and stmt.value is not None:
+        roots = [(stmt.value, "return")]
+    elif isinstance(stmt, (ast.If, ast.While)):
+        roots = [(stmt.test, "condition")]
+    else:
+        return []
+    hits: List[Tuple[int, str, str]] = []
+    for root, where in roots:
+        for node in ast.walk(root):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                attr = _self_attr(node)
+                if attr in guarded:
+                    hits.append((node.lineno, attr, where))
+    return hits
+
+
 def _lock_aliases(meth: ast.AST, decl: Dict[str, Set[str]]) -> Dict[str, str]:
     """Local names bound to a registered lock inside ``meth``:
     ``cv = self._cv`` makes ``with cv:`` hold ``_cv``.  The alias map is
@@ -352,9 +378,12 @@ def lock_discipline_findings(tree: ast.AST, path: str) -> List[Finding]:
     * methods whose name ends ``_locked`` — the caller holds the lock
       (the serve codebase's existing convention).
 
-    Reads are deliberately NOT checked (opportunistic racy reads of
-    gauges/flags are an accepted pattern in the fleet, and flagging them
-    would drown the real races)."""
+    Reads are checked only in *decision positions* — a ``return`` value
+    or an ``if``/``while`` condition (:func:`_read_guarded_attrs`):
+    those are the racy reads that feed control flow, while incidental
+    opportunistic reads of gauges/flags stay out of scope so they don't
+    drown the real races.  Pre-existing benign decision reads are
+    grandfathered through the count-aware baseline."""
     findings: List[Finding] = []
     for cls, decl in guarded_declarations(tree):
         attr_lock = {a: lock for lock, attrs in decl.items() for a in attrs}
@@ -382,6 +411,20 @@ def lock_discipline_findings(tree: ast.AST, path: str) -> List[Finding]:
                                          "the lock, or rename the method "
                                          "*_locked if every caller "
                                          "already does)")))
+                    for line, attr, where in _read_guarded_attrs(stmt,
+                                                                 guarded):
+                        if attr_lock[attr] not in held:
+                            findings.append(Finding(
+                                rule="lock-discipline", path=path,
+                                line=line,
+                                message=(f"{cls.name}.{attr} read in "
+                                         f"{where} position in "
+                                         f"'{meth.name}' outside 'with "
+                                         f"self.{attr_lock[attr]}:' -- a "
+                                         "racy read feeding a decision; "
+                                         "hold the lock (or rename the "
+                                         "method *_locked if every "
+                                         "caller already does)")))
                     now = set(held)
                     if isinstance(stmt, (ast.With, ast.AsyncWith)):
                         for item in stmt.items:

@@ -1,0 +1,208 @@
+"""Passes pinning the concurrency sanitizer's coverage.
+
+``sanitizer-factory`` — the runtime sanitizer (:mod:`deap_tpu.sanitize`)
+can only see locks built through its factory; a raw
+``threading.Lock()``/``RLock()``/``Condition()`` constructor in the
+serving fleet is a lock the lockset detector, order witness, and
+watchdog are all blind to.  This pass pins that no raw constructor
+survives under ``deap_tpu/serve/`` (net and router included) or in
+``observability/fleettrace.py`` — the subpackages whose construction
+sites were migrated — with the same lost-coverage pin the
+``no-blocking-sleep`` pass carries: a package rename fails the gate
+instead of silently shrinking the scope.
+
+``guardedby-coverage`` — a class that constructs a lock *through the
+factory* but declares no ``_GUARDED_BY`` map gets mutual exclusion with
+no contract: neither the AST ``lock-discipline`` pass nor the runtime
+lockset detector can check anything about it.  Declaring which
+attributes the lock guards is one literal dict; this pass warns until it
+exists (grandfathered for the pre-existing classes via the count-aware
+baseline, so the warning gates only NEW undeclared locks)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set, Tuple
+
+from .core import Finding, LintContext, rule
+
+__all__ = ["FACTORY_SCOPE_PREFIXES", "FACTORY_SCOPE_MODULES",
+           "raw_lock_constructions", "factory_locked_classes"]
+
+#: repo-relative prefixes/modules whose lock construction must route
+#: through deap_tpu.sanitize — the sanitizer's instrumented surface
+FACTORY_SCOPE_PREFIXES = ("deap_tpu/serve/",)
+FACTORY_SCOPE_MODULES = ("deap_tpu/observability/fleettrace.py",)
+
+#: serve subpackages the scope walk must find modules under (the same
+#: lost-coverage contract as no-blocking-sleep's REQUIRED_SUBPACKAGES)
+REQUIRED_FACTORY_SUBPACKAGES = ("net", "router")
+
+#: threading constructors the factory replaces (Event carries no mutual
+#: exclusion to check and stays stdlib)
+_RAW_CTORS = ("Lock", "RLock", "Condition")
+
+#: factory call names, on a ``sanitize`` receiver or from-imported
+_FACTORY_NAMES = ("lock", "rlock", "condition")
+
+
+def _threading_spellings(tree: ast.AST) -> Tuple[Set[str], Set[str]]:
+    """(module aliases of ``threading``, local names bound to a raw
+    constructor via ``from threading import Lock [as L]``)."""
+    aliases = {"threading"}
+    ctor_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "threading":
+                    aliases.add(a.asname or "threading")
+        elif isinstance(node, ast.ImportFrom) and node.module == "threading":
+            for a in node.names:
+                if a.name in _RAW_CTORS:
+                    ctor_names.add(a.asname or a.name)
+    return aliases, ctor_names
+
+
+def raw_lock_constructions(tree: ast.AST) -> List[Tuple[int, str]]:
+    """(line, constructor) of every raw ``threading.Lock/RLock/
+    Condition`` call — through any module alias or from-import."""
+    aliases, ctor_names = _threading_spellings(tree)
+    hits: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in _RAW_CTORS
+                and isinstance(f.value, ast.Name)
+                and f.value.id in aliases):
+            hits.append((node.lineno, f.attr))
+        elif isinstance(f, ast.Name) and f.id in ctor_names:
+            hits.append((node.lineno, f.id))
+    return sorted(hits)
+
+
+@rule("sanitizer-factory",
+      "the serving fleet (deap_tpu/serve/** and observability/"
+      "fleettrace.py) must construct Lock/RLock/Condition through "
+      "deap_tpu.sanitize -- a raw threading constructor is invisible to "
+      "the runtime concurrency sanitizer")
+def _check_sanitizer_factory(ctx: LintContext) -> Iterable[Finding]:
+    scoped = [pf for pf in ctx.py_files
+              if any(pf.rel.startswith(p) for p in FACTORY_SCOPE_PREFIXES)
+              or pf.rel in FACTORY_SCOPE_MODULES]
+    pin_applies = (not ctx.path_restricted
+                   and (ctx.repo / "deap_tpu" / "__init__.py").exists())
+    if pin_applies:
+        # lost-coverage pin: the scope must actually contain the fleet
+        missing = []
+        if not any(pf.rel.startswith("deap_tpu/serve/") for pf in scoped):
+            missing.append("deap_tpu/serve/")
+        missing += [f"deap_tpu/serve/{sub}/"
+                    for sub in REQUIRED_FACTORY_SUBPACKAGES
+                    if not any(pf.rel.startswith(f"deap_tpu/serve/{sub}/")
+                               for pf in scoped)]
+        missing += [m for m in FACTORY_SCOPE_MODULES
+                    if m not in ctx.by_rel]
+        for lost in missing:
+            yield Finding(
+                rule="sanitizer-factory", path="deap_tpu/serve", line=1,
+                message=(f"no modules found under {lost} -- the "
+                         "sanitizer-factory pass lost coverage of a "
+                         "required package"))
+    for pf in scoped:
+        if pf.tree is None:
+            continue
+        for lineno, ctor in raw_lock_constructions(pf.tree):
+            yield Finding(
+                rule="sanitizer-factory", path=pf.rel, line=lineno,
+                message=(f"raw threading.{ctor}() in the serving fleet -- "
+                         "construct it via deap_tpu.sanitize."
+                         f"{ctor.lower()}() so "
+                         "the runtime concurrency sanitizer can "
+                         "instrument it under DEAP_TPU_TSAN=1"))
+
+
+# ---------------------------------------------------------------------------
+# guardedby-coverage
+
+
+def _factory_call(node: ast.Call, imported: Set[str]) -> bool:
+    """A ``sanitize.lock()``-style factory call: attribute access on a
+    name ``sanitize`` (the migration idiom, ``from .. import sanitize``)
+    or a bare name from-imported out of a ``sanitize`` module."""
+    f = node.func
+    if (isinstance(f, ast.Attribute) and f.attr in _FACTORY_NAMES
+            and isinstance(f.value, ast.Name) and f.value.id == "sanitize"):
+        return True
+    return isinstance(f, ast.Name) and f.id in imported
+
+
+def _factory_imports(tree: ast.AST) -> Set[str]:
+    """Local names bound to factory functions via
+    ``from deap_tpu.sanitize import lock [as L]`` (any relative
+    spelling whose module path ends in ``sanitize``)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.split(".")[-1] == "sanitize":
+            for a in node.names:
+                if a.name in _FACTORY_NAMES:
+                    out.add(a.asname or a.name)
+    return out
+
+
+def _declares_guarded_by(cls: ast.ClassDef) -> bool:
+    for stmt in cls.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "_GUARDED_BY"
+                and isinstance(stmt.value, ast.Dict)):
+            return True
+    return False
+
+
+def factory_locked_classes(tree: ast.AST
+                           ) -> List[Tuple[ast.ClassDef, int, bool]]:
+    """(class, first factory-lock line, declares _GUARDED_BY) for every
+    class that binds a factory-built lock to a ``self.`` attribute."""
+    imported = _factory_imports(tree)
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        lines = []
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Attribute)
+                    and isinstance(sub.targets[0].value, ast.Name)
+                    and sub.targets[0].value.id == "self"
+                    and isinstance(sub.value, ast.Call)
+                    and _factory_call(sub.value, imported)):
+                lines.append(sub.lineno)
+        if lines:
+            out.append((node, min(lines), _declares_guarded_by(node)))
+    return out
+
+
+@rule("guardedby-coverage",
+      "a class constructing a lock via the sanitize factory should "
+      "declare a _GUARDED_BY map -- an undeclared lock is mutual "
+      "exclusion with no checkable contract (neither the AST "
+      "lock-discipline pass nor the runtime lockset detector can "
+      "verify it)", severity="warning")
+def _check_guardedby_coverage(ctx: LintContext) -> Iterable[Finding]:
+    for pf in ctx.py_files:
+        if pf.tree is None:
+            continue
+        for cls, line, declared in factory_locked_classes(pf.tree):
+            if declared:
+                continue
+            yield Finding(
+                rule="guardedby-coverage", path=pf.rel, line=line,
+                severity="warning",
+                message=(f"{cls.name} constructs a sanitize-factory lock "
+                         "but declares no _GUARDED_BY map -- declare "
+                         "which attributes the lock guards so "
+                         "lock-discipline and the runtime sanitizer can "
+                         "check them (grandfathered in the baseline for "
+                         "pre-existing classes)"))

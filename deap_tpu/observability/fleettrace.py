@@ -43,6 +43,7 @@ import time
 from collections import deque
 from typing import Any, Dict, Iterator, List, Optional
 
+from .. import sanitize
 from .sinks import emit_text
 
 __all__ = ["TraceContext", "SpanRecord", "FleetTracer", "TRACE_KEY",
@@ -236,6 +237,11 @@ class FleetTracer:
         bypasses it.
     """
 
+    #: lock-guarded shared state (``lock-discipline`` lint + runtime
+    #: sanitizer): the span ring and dump rate-limit state are shared
+    #: between every recording thread and the trace-tail reader
+    _GUARDED_BY = {"_lock": ("_ring", "_dropped", "_last_dump")}
+
     def __init__(self, *, capacity: int = 2048, enabled: bool = True,
                  sinks=(), clock=time.monotonic,
                  dump_min_interval_s: float = 60.0):
@@ -245,7 +251,7 @@ class FleetTracer:
         self.clock = clock
         self.sinks = list(sinks)
         self.dump_min_interval_s = float(dump_min_interval_s)
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock()
         self._ring: "deque[SpanRecord]" = deque(maxlen=int(capacity))
         self._dropped = 0
         self._last_dump: Optional[float] = None

@@ -1,0 +1,155 @@
+"""``deap_tpu.sanitize`` — the runtime concurrency sanitizer tier.
+
+The repo's static-analysis story has three tiers: the jax-free AST lint
+(``deap_tpu.lint`` — trace purity, lock discipline, lock order), the
+compiled-program contract analyzer (``deap_tpu.analysis`` — donation,
+recompiles, budgets), and — this package — **runtime concurrency
+contracts**: Eraser-style lockset race detection, a lock-order witness
+over the *observed* acquisition graph, and a deadlock watchdog, all
+driven by the same ``_GUARDED_BY`` declarations the AST lint enforces
+lexically.
+
+The entry point is the **instrumented lock factory**::
+
+    from deap_tpu import sanitize
+    self._lock = sanitize.lock()        # threading.Lock() when off
+    self._cv = sanitize.condition()     # threading.Condition() when off
+
+With the sanitizer off (the default) the factory returns the stdlib
+primitives themselves — identical objects, zero overhead, and the
+compiled programs/trajectories of the serving fleet are bitwise
+unchanged (pinned by ``tests/test_sanitize.py``).  With
+``DEAP_TPU_TSAN=1`` in the environment, or after :func:`arm`, it
+returns :class:`~deap_tpu.sanitize.runtime.TsanLock` /
+``TsanRLock`` / :class:`~deap_tpu.sanitize.runtime.TsanCondition`
+wrappers that maintain a per-thread lockset, accumulate the cross-class
+acquisition graph, and run the Condition stall watchdog.  :func:`arm`
+additionally installs the guarded-attribute shims
+(:mod:`deap_tpu.sanitize.guards`) on every serve-fleet class declaring
+``_GUARDED_BY``, so each read and write of declared state is checked
+against the live lockset on real interleavings.
+
+Violations are :class:`deap_tpu.lint.core.Finding` records (rules
+``tsan-lockset``, ``tsan-lock-order``, ``tsan-stalled-wait``) and ride
+the lint reporters/SARIF stack; surface them with
+``deap-tpu-analyze --threads`` or the ``tsan`` pytest fixture
+(:mod:`deap_tpu.sanitize.pytest_plugin`), which arms the sanitizer
+around a test and fails it on any finding.
+
+All ``threading.Lock/RLock/Condition`` construction under
+``deap_tpu/serve/`` (net and router included) and
+``observability/fleettrace.py`` goes through this factory — pinned by
+the ``sanitizer-factory`` lint rule, so a raw constructor cannot sneak
+back in and silently shrink the sanitizer's coverage.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional, Sequence
+
+from ..lint.core import Finding
+from .runtime import (TSAN_ENV, TSAN_RULES, ThreadSanitizer, TsanCondition,
+                      TsanLock, TsanRLock)
+
+__all__ = ["TSAN_ENV", "TSAN_RULES", "ThreadSanitizer", "TsanLock",
+           "TsanRLock", "TsanCondition", "lock", "rlock", "condition",
+           "event", "active", "arm", "disarm", "runtime"]
+
+#: the process sanitizer (one per process; armed/disarmed in place)
+_RUNTIME = ThreadSanitizer()
+# DEAP_TPU_TSAN=1 arms the *factory* from process start, so services
+# constructed before any arm() call still get instrumented primitives;
+# guard shims still install at arm() (they need the serve imports)
+_RUNTIME.armed = os.environ.get(TSAN_ENV, "") == "1"
+
+
+def runtime() -> ThreadSanitizer:
+    """The process :class:`ThreadSanitizer` instance."""
+    return _RUNTIME
+
+
+def active() -> bool:
+    """True while the sanitizer is armed (env var or :func:`arm`)."""
+    return _RUNTIME.armed
+
+
+# ---------------------------------------------------------------------------
+# the lock factory — the ONLY way serve-fleet code constructs primitives
+
+
+def lock():
+    """A mutex: ``threading.Lock()`` when the sanitizer is off (the
+    identical stdlib object — zero overhead), an instrumented
+    :class:`TsanLock` when armed."""
+    if _RUNTIME.armed:
+        return TsanLock(_RUNTIME)
+    return threading.Lock()
+
+
+def rlock():
+    """A reentrant mutex (``threading.RLock()`` / :class:`TsanRLock`)."""
+    if _RUNTIME.armed:
+        return TsanRLock(_RUNTIME)
+    return threading.RLock()
+
+
+def condition(lock=None):
+    """A condition variable (``threading.Condition(lock)`` /
+    :class:`TsanCondition`); the default lock is reentrant, matching the
+    stdlib."""
+    if _RUNTIME.armed:
+        return TsanCondition(_RUNTIME, lock)
+    return threading.Condition(lock)
+
+
+def event():
+    """A ``threading.Event`` — never instrumented (events carry no
+    mutual exclusion to check), provided so factory call sites need no
+    second import."""
+    return threading.Event()
+
+
+# ---------------------------------------------------------------------------
+# arming
+
+
+def arm(*, stall_s: Optional[float] = None, guards: bool = True,
+        extra_classes: Sequence[type] = (),
+        fresh: bool = True) -> ThreadSanitizer:
+    """Arm the sanitizer: the factory starts returning instrumented
+    primitives, and (with ``guards=True``) the ``_GUARDED_BY`` shims
+    install on the serve fleet's declared classes (lazy import — this is
+    the one step that needs the serve modules importable).
+
+    ``stall_s`` sets the Condition-stall watchdog bound for THIS armed
+    window (omitted = the 30s default — a previous window's tightened
+    bound must not leak into the next test's drills); ``fresh``
+    (default) clears findings/graph from any previous armed window;
+    ``extra_classes`` shims additional ``_GUARDED_BY``-declaring classes
+    (the seeded-violation test fixtures use this).  Returns the runtime
+    for inspection."""
+    _RUNTIME.stall_s = (float(stall_s) if stall_s is not None
+                        else ThreadSanitizer.DEFAULT_STALL_S)
+    if fresh:
+        _RUNTIME.reset()
+    _RUNTIME.armed = True
+    from . import guards as _guards
+    if guards:
+        _guards.install_default_guards(_RUNTIME)
+    for cls in extra_classes:
+        _guards.install_guards(_RUNTIME, cls)
+    return _RUNTIME
+
+
+def disarm() -> List[Finding]:
+    """Disarm: run the final acquisition-graph cycle check, uninstall
+    every guard shim, return the armed window's findings.  The factory
+    reverts to stdlib primitives (unless ``DEAP_TPU_TSAN=1`` keeps the
+    process armed by policy)."""
+    findings = _RUNTIME.check()
+    from . import guards as _guards
+    _guards.uninstall_all()
+    _RUNTIME.armed = os.environ.get(TSAN_ENV, "") == "1"
+    return findings

@@ -1,0 +1,168 @@
+"""Guarded-attribute shims: runtime enforcement of ``_GUARDED_BY``.
+
+The ``lock-discipline`` AST pass proves writes *it can see lexically*
+hold the declared lock.  This module installs, **only while the
+sanitizer is armed**, a data-descriptor shim on each declared attribute
+of a participating class, so every read AND write — from any module, any
+thread, any aliasing path — is checked against the accessing thread's
+live lockset:
+
+* each attribute named in ``cls._GUARDED_BY`` is replaced by a
+  :class:`_GuardedAttribute` property that stores the real value in the
+  instance ``__dict__`` (data descriptors shadow the instance dict, so
+  the swap is invisible to the class's own code);
+* ``cls.__init__`` is wrapped to mark construction: accesses before the
+  constructor returns are exempt (the object is unpublished — the same
+  ``__init__`` exemption the AST pass grants), and on completion every
+  instrumented lock bound to an instance attribute is relabeled
+  ``Class._attr`` so acquisition-graph edges read as code, not ids;
+* :func:`uninstall_all` restores the original class surface — values
+  live in instance ``__dict__`` throughout, so instances straddling an
+  arm/disarm boundary keep working.
+
+The default install set (:data:`DEFAULT_GUARDED_CLASSES`) is the serve
+fleet's declared classes; it is imported lazily by
+:func:`install_default_guards` because the serve modules pull in jax.
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib
+from typing import Dict, List, Tuple
+
+from .runtime import ThreadSanitizer, TsanCondition, TsanLock
+
+__all__ = ["DEFAULT_GUARDED_CLASSES", "install_guards",
+           "install_default_guards", "uninstall_all"]
+
+#: (module, class) pairs shimmed by default when the sanitizer arms —
+#: every serve-fleet class that commits a ``_GUARDED_BY`` declaration
+DEFAULT_GUARDED_CLASSES: Tuple[Tuple[str, str], ...] = (
+    ("deap_tpu.serve.dispatcher", "BatchDispatcher"),
+    ("deap_tpu.serve.dispatcher", "ServeFuture"),
+    ("deap_tpu.serve.service", "EvolutionService"),
+    ("deap_tpu.serve.cache", "FitnessCache"),
+    ("deap_tpu.serve.buckets", "ShapeHistogram"),
+    ("deap_tpu.serve.metrics", "ServeMetrics"),
+    ("deap_tpu.serve.net.server", "NetServer"),
+    ("deap_tpu.serve.net.client", "_Worker"),
+    ("deap_tpu.serve.router.core", "FleetRouter"),
+    ("deap_tpu.serve.router.health", "HealthMonitor"),
+    ("deap_tpu.serve.router.tenants", "WeightedFairScheduler"),
+    ("deap_tpu.observability.fleettrace", "FleetTracer"),
+)
+
+_MISSING = object()
+
+#: live installs: cls -> (saved class attrs, original __init__)
+_INSTALLED: Dict[type, Tuple[Dict[str, object], object]] = {}
+
+_READY = "_tsan_ready"
+
+
+class _GuardedAttribute:
+    """Data descriptor checking every access to one guarded attribute
+    against the accessor's lockset.  The real value lives in the
+    instance ``__dict__`` under the same name (descriptors shadow it)."""
+
+    __slots__ = ("san", "cls_name", "attr", "lockname")
+
+    def __init__(self, san: ThreadSanitizer, cls_name: str, attr: str,
+                 lockname: str):
+        self.san = san
+        self.cls_name = cls_name
+        self.attr = attr
+        self.lockname = lockname
+
+    def __get__(self, obj, owner=None):
+        if obj is None:
+            return self
+        if obj.__dict__.get(_READY, False):
+            self.san.check_guarded(obj, self.cls_name, self.attr,
+                                   self.lockname, "read")
+        try:
+            return obj.__dict__[self.attr]
+        except KeyError:
+            raise AttributeError(
+                f"{self.cls_name!r} object has no attribute "
+                f"{self.attr!r}") from None
+
+    def __set__(self, obj, value) -> None:
+        if obj.__dict__.get(_READY, False):
+            self.san.check_guarded(obj, self.cls_name, self.attr,
+                                   self.lockname, "write")
+        obj.__dict__[self.attr] = value
+
+    def __delete__(self, obj) -> None:
+        if obj.__dict__.get(_READY, False):
+            self.san.check_guarded(obj, self.cls_name, self.attr,
+                                   self.lockname, "delete")
+        del obj.__dict__[self.attr]
+
+
+def install_guards(san: ThreadSanitizer, cls: type) -> bool:
+    """Shim ``cls``'s declared guarded attributes; no-op (returns False)
+    when the class declares no literal ``_GUARDED_BY`` dict or is
+    already shimmed."""
+    if cls in _INSTALLED:
+        return False
+    decl = getattr(cls, "_GUARDED_BY", None)
+    if not isinstance(decl, dict) or not decl:
+        return False
+    attr_lock = {a: lockname for lockname, attrs in decl.items()
+                 for a in (attrs if isinstance(attrs, (tuple, list, set))
+                           else (attrs,))}
+    saved: Dict[str, object] = {}
+    for attr, lockname in attr_lock.items():
+        saved[attr] = cls.__dict__.get(attr, _MISSING)
+        setattr(cls, attr, _GuardedAttribute(san, cls.__name__, attr,
+                                             lockname))
+
+    orig_init = cls.__init__
+
+    @functools.wraps(orig_init)
+    def _tsan_init(self, *args, **kwargs):
+        # accesses during construction are exempt: the object is not
+        # yet published to other threads (the AST pass's __init__ rule)
+        self.__dict__[_READY] = False
+        orig_init(self, *args, **kwargs)
+        for name, value in list(self.__dict__.items()):
+            if isinstance(value, (TsanLock, TsanCondition)):
+                value.label = f"{type(self).__name__}.{name}"
+        self.__dict__[_READY] = True
+
+    cls.__init__ = _tsan_init
+    _INSTALLED[cls] = (saved, orig_init)
+    return True
+
+
+def install_default_guards(san: ThreadSanitizer) -> List[type]:
+    """Install the serve-fleet default set (lazy imports — these modules
+    load jax).  Modules that fail to import are skipped: the sanitizer
+    must arm on a partial checkout/stub environment."""
+    installed: List[type] = []
+    for module, name in DEFAULT_GUARDED_CLASSES:
+        try:
+            cls = getattr(importlib.import_module(module), name)
+        except Exception:  # noqa: BLE001 — optional dep missing is fine
+            continue
+        if install_guards(san, cls):
+            installed.append(cls)
+    return installed
+
+
+def uninstall_all() -> None:
+    """Restore every shimmed class's original surface (values already
+    live in instance ``__dict__``, so live instances keep working)."""
+    for cls, (saved, orig_init) in list(_INSTALLED.items()):
+        for attr, value in saved.items():
+            if value is _MISSING:
+                try:
+                    delattr(cls, attr)
+                except AttributeError:
+                    pass
+            else:
+                setattr(cls, attr, value)
+        cls.__init__ = orig_init
+        del _INSTALLED[cls]

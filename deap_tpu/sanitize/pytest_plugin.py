@@ -1,0 +1,92 @@
+"""Pytest integration of the concurrency sanitizer.
+
+Two fixtures, registered into the suite via ``pytest_plugins`` in
+``tests/conftest.py``:
+
+* ``tsan`` — arms the sanitizer for one test (guard shims + lock
+  factory + watchdog), disarms at teardown, and **fails the test** with
+  the full diagnostic dump (stacks, held-lock snapshots) if any finding
+  fired.  The existing serve/net/router drills take this fixture, so
+  tier-1 exercises the lockset detector on the failover, rebucket-under-
+  churn, metrics-stream-under-churn, and weighted-fair interleavings
+  that already exist — no synthetic schedule needed.
+* ``thread_leak_check`` — snapshots live threads before the test and
+  asserts no stray fleet worker survives it: any new non-daemon thread,
+  or any new ``deap-tpu-*``-named daemon (dispatcher / HTTP frontend /
+  health loop / remote-client worker), still alive after a grace join is
+  a leak (a service someone forgot to close keeps real OS threads and
+  device buffers pinned for the rest of the suite).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+#: grace window for fleet workers to exit after the test's own
+#: close/teardown calls return (joins are polled, not slept through)
+_LEAK_GRACE_S = 5.0
+
+#: thread-name prefix of every worker the serving fleet spawns
+_FLEET_PREFIX = "deap-tpu-"
+
+
+@pytest.fixture
+def tsan():
+    """Arm the concurrency sanitizer around one test; fail the test on
+    any runtime finding.  Yields the :class:`ThreadSanitizer` so a test
+    can tighten ``stall_s`` or inspect the acquisition graph."""
+    from deap_tpu import sanitize
+    san = sanitize.arm()
+    try:
+        yield san
+    finally:
+        findings = sanitize.disarm()
+        if findings:
+            lines = [f"{f.path}:{f.line}: [{f.rule}] {f.message}"
+                     for f in findings]
+            for rep in san.reports:
+                if rep.get("stack"):
+                    lines.append(f"  -- {rep['rule']} at {rep['path']}:"
+                                 f"{rep['line']} on thread "
+                                 f"{rep.get('thread', '?')}:")
+                    lines.extend(f"     {fr}" for fr in rep["stack"])
+                if rep.get("held_elsewhere"):
+                    lines.append(f"     held elsewhere: "
+                                 f"{rep['held_elsewhere']}")
+            pytest.fail("concurrency sanitizer detected "
+                        f"{len(findings)} violation(s):\n"
+                        + "\n".join(lines), pytrace=False)
+
+
+def _leaked_threads(before: set) -> list:
+    """New threads that should NOT survive a serve/net/router test."""
+    return [t for t in threading.enumerate()
+            if t not in before and t.is_alive()
+            and (not t.daemon or t.name.startswith(_FLEET_PREFIX))]
+
+
+def assert_no_leaked_threads(before: set) -> None:
+    """Grace-join any new fleet worker / non-daemon thread not in
+    ``before``, then assert none survived — the one leak-check body
+    shared by :func:`thread_leak_check` and the suite's autouse gate."""
+    leaked = _leaked_threads(before)
+    for t in leaked:
+        t.join(timeout=_LEAK_GRACE_S / max(len(leaked), 1))
+    leaked = _leaked_threads(before)
+    assert not leaked, (
+        "thread leak: these workers survived the test (close the "
+        "service/server/client that owns them): "
+        + ", ".join(f"{t.name}{'' if t.daemon else ' [non-daemon]'}"
+                    for t in leaked))
+
+
+@pytest.fixture
+def thread_leak_check():
+    """Assert no stray fleet worker (or any non-daemon thread) survives
+    the test.  Leaked threads are joined with a grace timeout first, so
+    a close() that is merely slow does not flake the gate."""
+    before = set(threading.enumerate())
+    yield
+    assert_no_leaked_threads(before)

@@ -1,0 +1,505 @@
+"""Runtime core of the concurrency sanitizer: instrumented primitives,
+per-thread locksets, the observed acquisition graph, and the Condition
+stall watchdog.
+
+The design is Eraser-style lockset checking (Savage et al. 1997) scoped
+to the declarations the repo already commits: the ``_GUARDED_BY`` maps
+the ``lock-discipline`` AST lint enforces lexically.  The static pass
+proves every *write it can see* sits under ``with self.<lock>:`` — it
+cannot see reads, cross-module access (``service._exec_slots`` writing a
+``Session``'s phase), aliased locks passed between objects, or orderings
+that only materialize at runtime.  This module closes that gap when
+``DEAP_TPU_TSAN=1`` (or :func:`deap_tpu.sanitize.arm` is called):
+
+* :class:`TsanLock` / :class:`TsanRLock` / :class:`TsanCondition` wrap
+  the stdlib primitives and report every acquisition/release to the
+  process :class:`ThreadSanitizer`, which maintains one **lockset per
+  thread** (reentrant holds counted, Condition waits releasing and
+  restoring their lock correctly);
+* every acquisition made while other locks are held contributes an edge
+  to the **cross-class acquisition graph** — cycles (two code paths
+  taking the same locks in opposite orders, the textbook deadlock) are
+  detected by :func:`~deap_tpu.lint.rules_locks.graph_cycles`, the same
+  algorithm the single-class AST ``lock-order`` pass uses;
+* a :class:`TsanCondition` wait that exceeds ``stall_s`` with no wakeup
+  while *another* thread holds instrumented locks **continuously**
+  (double-sampled, so a thread merely passing through a critical
+  section is not blamed) files a stall report carrying the waiter's
+  stack and the held-lock snapshot (the other-thread gate keeps an idle
+  dispatcher's legitimate forever-wait quiet — nobody holding a lock
+  means nobody is wedged).
+
+Violations surface as :class:`deap_tpu.lint.core.Finding` records (rules
+``tsan-lockset`` / ``tsan-lock-order`` / ``tsan-stalled-wait``), so they
+ride the existing text/JSON/SARIF reporters unchanged.  Everything here
+is stdlib-only — the sanitizer must import on a box with no accelerator
+stack, exactly like the lint tier.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ..lint.core import Finding, REPO
+
+__all__ = ["TSAN_ENV", "ThreadSanitizer", "TsanLock", "TsanRLock",
+           "TsanCondition", "TSAN_RULES"]
+
+#: environment variable that arms the lock factory at import time
+TSAN_ENV = "DEAP_TPU_TSAN"
+
+#: the three runtime rules this tier reports under
+TSAN_RULES = ("tsan-lockset", "tsan-lock-order", "tsan-stalled-wait")
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_THREADING_FILE = os.path.abspath(threading.__file__)
+
+
+def _plumbing_frame(frame) -> bool:
+    """Sanitizer or stdlib-threading frame — never the site to report."""
+    fn = os.path.abspath(frame.f_code.co_filename)
+    return os.path.dirname(fn) == _PKG_DIR or fn == _THREADING_FILE
+
+
+#: filename -> repo-relative path memo (resolve() costs syscalls, and
+#: the armed fleet resolves the same handful of files thousands of times)
+_REL_CACHE: Dict[str, str] = {}
+
+
+def _rel_of(filename: str) -> str:
+    rel = _REL_CACHE.get(filename)
+    if rel is None:
+        path = Path(filename)
+        try:
+            rel = path.resolve().relative_to(
+                Path(REPO).resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        _REL_CACHE[filename] = rel
+    return rel
+
+
+def _caller_site(skip: int = 2) -> Tuple[str, int]:
+    """(repo-relative path, line) of the nearest frame outside this
+    package — the user code that constructed/acquired/accessed."""
+    frame = sys._getframe(skip)
+    while frame is not None and _plumbing_frame(frame):
+        frame = frame.f_back
+    if frame is None:
+        return "<unknown>", 0
+    return _rel_of(frame.f_code.co_filename), frame.f_lineno
+
+
+def _caller_stack(skip: int = 2, limit: int = 12) -> List[str]:
+    """Formatted stack of the calling thread, innermost last, sanitizer
+    frames dropped."""
+    out = []
+    for fs in traceback.extract_stack(sys._getframe(skip))[-limit:]:
+        fn = os.path.abspath(fs.filename)
+        if os.path.dirname(fn) == _PKG_DIR or fn == _THREADING_FILE:
+            continue
+        out.append(f"{fs.filename}:{fs.lineno} in {fs.name}")
+    return out
+
+
+class ThreadSanitizer:
+    """Process-wide sanitizer state: per-thread locksets, the observed
+    acquisition graph, and the violation list.
+
+    One instance exists per process (``deap_tpu.sanitize._RUNTIME``);
+    ``armed`` gates every record path so a disarmed sanitizer costs one
+    attribute check per event on instrumented objects and *nothing* on
+    stdlib primitives (the factory returns those when disarmed)."""
+
+    #: default Condition-stall watchdog bound (seconds); ``arm()``
+    #: resets to this when no explicit ``stall_s`` is given
+    DEFAULT_STALL_S = 30.0
+
+    def __init__(self, *, stall_s: Optional[float] = None):
+        self.armed = False
+        self.stall_s = float(stall_s if stall_s is not None
+                             else self.DEFAULT_STALL_S)
+        # the sanitizer's own lock is deliberately a RAW stdlib primitive:
+        # instrumenting it would recurse
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        #: thread ident -> (thread name, live held-list reference) — the
+        #: cross-thread view the watchdog snapshots
+        self._all_held: Dict[int, Tuple[str, list]] = {}
+        #: (held label, acquired label) -> first-observation record
+        self._edges: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._findings: List[Finding] = []
+        self._reports: List[dict] = []
+        self._seen: Set[Tuple[str, str, int, str]] = set()
+        self.counts = {"acquisitions": 0, "guarded_checks": 0, "waits": 0,
+                       "violations": 0}
+
+    # -- per-thread lockset --------------------------------------------------
+
+    def _held(self) -> list:
+        """This thread's live lockset: a list of ``[lock, count]`` pairs
+        in acquisition order."""
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = []
+            self._tls.held = held
+            ident = threading.get_ident()
+            with self._lock:
+                self._all_held[ident] = (threading.current_thread().name,
+                                         held)
+        return held
+
+    def holds(self, lock: Any) -> bool:
+        """True iff the calling thread's lockset contains ``lock``."""
+        return any(ent[0] is lock for ent in self._held())
+
+    def held_labels(self) -> List[str]:
+        """The calling thread's held-lock labels, acquisition order."""
+        return [ent[0].label for ent in self._held()]
+
+    def note_acquire(self, lock: Any) -> None:
+        held = self._held()
+        for ent in held:
+            if ent[0] is lock:
+                ent[1] += 1         # reentrant re-entry: no new edge
+                return
+        if self.armed:
+            # the caller-site walk is deferred until an UNSEEN edge needs
+            # recording: this path runs on every armed acquisition, and
+            # steady state sees no new edges
+            self.counts["acquisitions"] += 1
+            if held:
+                new = [ent[0].label for ent in held
+                       if ent[0].label != lock.label
+                       and (ent[0].label, lock.label) not in self._edges]
+                if new:
+                    site = _caller_site(3)
+                    with self._lock:
+                        for a in new:
+                            if (a, lock.label) not in self._edges:
+                                self._edges[(a, lock.label)] = {
+                                    "site": site,
+                                    "thread":
+                                        threading.current_thread().name}
+        held.append([lock, 1])
+
+    def note_release(self, lock: Any) -> None:
+        # lockset maintenance is unconditional: a lock acquired while
+        # armed must leave the set even if the release lands after
+        # disarm, or the next armed window inherits a phantom hold
+        held = self._held()
+        for i, ent in enumerate(held):
+            if ent[0] is lock:
+                ent[1] -= 1
+                if ent[1] <= 0:
+                    del held[i]
+                return
+
+    def forget(self, lock: Any) -> int:
+        """Drop every hold of ``lock`` (Condition ``_release_save``);
+        returns the recursion count so :meth:`restore` can rebuild it."""
+        held = self._held()
+        for i, ent in enumerate(held):
+            if ent[0] is lock:
+                n = ent[1]
+                del held[i]
+                return n
+        return 0
+
+    def restore(self, lock: Any, n: int) -> None:
+        """Re-enter ``lock`` after a Condition wait (``_acquire_restore``).
+        No new graph edges: the ordering edge was recorded at the
+        original acquisition, and a wait-reacquire under locks the thread
+        never released is exactly the state the watchdog reports."""
+        if n > 0:
+            self._held().append([lock, n])
+
+    # -- guarded-attribute checking (called by sanitize.guards) --------------
+
+    def check_guarded(self, obj: Any, cls_name: str, attr: str,
+                      lockname: str, mode: str) -> None:
+        if not self.armed:
+            return
+        lock = obj.__dict__.get(lockname)
+        key = getattr(lock, "tsan_lock", None)
+        if key is None:
+            return        # raw stdlib primitive (constructed disarmed):
+            # holds are invisible, so the check would only lie
+        # deliberately unlocked += : this runs on EVERY guarded attribute
+        # access, and a lost increment in a stats counter is cheaper than
+        # serializing the whole fleet through the sanitizer's lock
+        self.counts["guarded_checks"] += 1
+        if self.holds(key):
+            return
+        path, line = _caller_site(3)
+        self.report(
+            "tsan-lockset", path, line,
+            f"{cls_name}.{attr} {mode} without holding "
+            f"{cls_name}.{lockname} -- the attribute is declared in "
+            f"{cls_name}._GUARDED_BY and this thread's lockset does not "
+            "contain its lock (runtime lockset race)",
+            extra={"thread": threading.current_thread().name,
+                   "stack": _caller_stack(3),
+                   "held": self.held_labels()})
+
+    # -- stall watchdog (called by TsanCondition.wait) -----------------------
+
+    def note_wait_stall(self, cv: "TsanCondition", waited_s: float) -> bool:
+        """A Condition wait exceeded ``stall_s`` with no wakeup.  Only
+        suspicious when some OTHER thread holds instrumented locks
+        *continuously* (an idle worker parked on an empty queue is
+        normal, and a handler thread merely passing through a critical
+        section at the sampling instant is not a wedge — the held set is
+        sampled twice, a beat apart, and only locks held by the same
+        thread in BOTH samples count); the report carries the waiter's
+        stack and the surviving held-lock snapshot.  Returns True when a
+        report was filed."""
+        if not self.armed:
+            return False
+        me = threading.get_ident()
+
+        def _snap() -> Dict[int, Tuple[str, frozenset]]:
+            with self._lock:
+                return {ident: (name,
+                                frozenset(ent[0].label for ent in held))
+                        for ident, (name, held) in self._all_held.items()
+                        if ident != me and held}
+
+        first = _snap()
+        if not first:
+            return False
+        time.sleep(min(0.25, max(self.stall_s * 0.1, 0.01)))
+        second = _snap()
+        others = {}
+        for ident, (name, labels) in first.items():
+            still = labels & (second.get(ident, ("", frozenset()))[1])
+            if still:
+                others[name] = sorted(still)
+        if not others:
+            return False
+        path, line = _caller_site(3)
+        held_txt = "; ".join(f"{t} holds {', '.join(ls)}"
+                             for t, ls in sorted(others.items()))
+        self.report(
+            "tsan-stalled-wait", path, line,
+            f"Condition wait on {cv.label} stalled past the "
+            f"{self.stall_s:g}s bound with no wakeup while other threads "
+            "hold locks -- likely lost notify or deadlocked notifier "
+            f"({held_txt})",
+            extra={"thread": threading.current_thread().name,
+                   "waited_s": round(waited_s, 3),
+                   "stack": _caller_stack(3),
+                   "held_elsewhere": others})
+        return True
+
+    # -- reporting -----------------------------------------------------------
+
+    def report(self, rule: str, path: str, line: int, message: str,
+               *, extra: Optional[dict] = None) -> None:
+        """File one violation (deduplicated per site: a racy read in a
+        loop must not bury the report under thousands of repeats)."""
+        key = (rule, path, line, message)
+        with self._lock:
+            if key in self._seen:
+                return
+            self._seen.add(key)
+            self.counts["violations"] += 1
+            self._findings.append(Finding(rule=rule, path=path, line=line,
+                                          message=message))
+            self._reports.append({"rule": rule, "path": path, "line": line,
+                                  "message": message, **(extra or {})})
+
+    def order_findings(self) -> List[Finding]:
+        """Cycles of the observed acquisition graph, as findings.  Run at
+        :meth:`check` time — the graph accumulates across the whole armed
+        window, so orderings from different requests/threads compose."""
+        from ..lint.rules_locks import graph_cycles
+        with self._lock:
+            edges = dict(self._edges)
+        out: List[Finding] = []
+        for cyc in graph_cycles(set(edges)):
+            order = " -> ".join(cyc + [cyc[0]])
+            # anchor the finding at the observed site of the cycle's
+            # first edge (the acquisition that closed the inversion)
+            first = edges.get((cyc[0], cyc[1 % len(cyc)]),
+                              {"site": ("<unknown>", 0)})
+            path, line = first["site"]
+            msg = (f"observed lock acquisition cycle {order} -- two "
+                   "threads interleaving these paths deadlock; pick ONE "
+                   "cross-class order and hold it everywhere (witnessed "
+                   "at runtime; the AST lock-order pass only sees "
+                   "single-class nesting)")
+            self.report("tsan-lock-order", path, line, msg,
+                        extra={"edges": {f"{a} -> {b}": e["site"]
+                                         for (a, b), e in edges.items()}})
+            out.append(Finding(rule="tsan-lock-order", path=path,
+                               line=line, message=msg))
+        return out
+
+    def check(self) -> List[Finding]:
+        """All findings so far, with the acquisition-graph cycle check
+        folded in (lockset/stall findings file as they happen)."""
+        self.order_findings()
+        with self._lock:
+            return list(self._findings)
+
+    @property
+    def reports(self) -> List[dict]:
+        """Full diagnostic records (stacks, held-lock snapshots) behind
+        :meth:`check`'s findings — what the pytest fixture prints on
+        failure."""
+        with self._lock:
+            return list(self._reports)
+
+    def edges(self) -> Dict[Tuple[str, str], Tuple[str, int]]:
+        with self._lock:
+            return {k: v["site"] for k, v in self._edges.items()}
+
+    def reset(self) -> None:
+        """Clear findings/graph/counters for a fresh armed window
+        (per-thread locksets are live state and stay)."""
+        with self._lock:
+            self._edges.clear()
+            self._findings.clear()
+            self._reports.clear()
+            self._seen.clear()
+            for k in self.counts:
+                self.counts[k] = 0
+
+
+def _site_label(kind: str) -> str:
+    path, line = _caller_site(3)
+    return f"{kind}({path}:{line})"
+
+
+class TsanLock:
+    """Instrumented ``threading.Lock``: same surface, every transition
+    reported to the sanitizer.  ``label`` starts as the construction
+    site and is rewritten to ``Class._attr`` by the guard installer."""
+
+    def __init__(self, san: ThreadSanitizer, label: Optional[str] = None):
+        self._inner = threading.Lock()
+        self._san = san
+        self.label = label if label is not None else _site_label("Lock")
+
+    #: identity the lockset/guard checks key on (Condition overrides
+    #: this to its underlying lock, so "holding the cv" and "holding its
+    #: lock" are the same fact)
+    @property
+    def tsan_lock(self) -> "TsanLock":
+        return self
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._san.note_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        self._san.note_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self.label}>"
+
+
+class TsanRLock(TsanLock):
+    """Instrumented ``threading.RLock``: reentrant holds are counted in
+    the lockset (re-entry adds no acquisition-graph edge), and the
+    ``_release_save``/``_acquire_restore``/``_is_owned`` trio keeps
+    ``threading.Condition`` waits honest about what the thread holds."""
+
+    def __init__(self, san: ThreadSanitizer, label: Optional[str] = None):
+        super().__init__(san, label if label is not None
+                         else _site_label("RLock"))
+        self._inner = threading.RLock()
+
+    def locked(self) -> bool:      # RLock has no .locked() before 3.12
+        if self._inner.acquire(blocking=False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        n = self._san.forget(self)
+        return (self._inner._release_save(), n)
+
+    def _acquire_restore(self, state) -> None:
+        inner_state, n = state
+        self._inner._acquire_restore(inner_state)
+        self._san.restore(self, n)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+class TsanCondition(threading.Condition):
+    """Instrumented ``threading.Condition`` over a :class:`TsanRLock`
+    (the stdlib default lock is an RLock too).  Adds the stall watchdog:
+    :meth:`wait` runs in ``stall_s`` chunks, and a wait that exceeds the
+    bound with no wakeup files a :meth:`ThreadSanitizer.note_wait_stall`
+    report.  Chunking is invisible to callers — a waiter re-registers in
+    the waiter queue *before* releasing the lock, so a notify can never
+    fall between chunks."""
+
+    def __init__(self, san: ThreadSanitizer, lock=None,
+                 label: Optional[str] = None):
+        self._san = san
+        inner = lock if lock is not None else TsanRLock(
+            san, label=label if label is not None
+            else _site_label("Condition"))
+        super().__init__(inner)
+
+    @property
+    def label(self) -> str:
+        return self._lock.label
+
+    @label.setter
+    def label(self, value: str) -> None:
+        self._lock.label = value
+
+    @property
+    def tsan_lock(self):
+        return self._lock.tsan_lock
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        san = self._san
+        if not san.armed:
+            return super().wait(timeout)
+        san.counts["waits"] += 1
+        clock = time.monotonic
+        deadline = None if timeout is None else clock() + timeout
+        waited = 0.0
+        reported = False
+        while True:
+            if not san.armed:    # disarmed mid-wait: back to plain waits
+                return super().wait(
+                    None if deadline is None
+                    else max(0.0, deadline - clock()))
+            stall = max(san.stall_s, 1e-3)
+            chunk = (stall if deadline is None
+                     else min(stall, deadline - clock()))
+            if deadline is not None and chunk <= 0:
+                return False
+            t0 = clock()
+            if super().wait(chunk):
+                return True
+            waited += clock() - t0
+            if not reported and waited >= stall:
+                reported = san.note_wait_stall(self, waited)

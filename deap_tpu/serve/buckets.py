@@ -27,12 +27,12 @@ The bucketing policy is deliberately asymmetric:
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from .. import sanitize
 from ..base import Population, Fitness
 
 __all__ = ["BucketPolicy", "BucketKey", "BucketOverflow", "genome_signature",
@@ -133,8 +133,12 @@ class ShapeHistogram:
     power-of-two grid.  Thread-safe (request threads write, rebucket
     reads)."""
 
+    #: lock-guarded shared state (``lock-discipline`` lint +
+    #: runtime sanitizer): request threads write, rebucket reads
+    _GUARDED_BY = {"_lock": ("_counts",)}
+
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock()
         self._counts: Dict[int, int] = {}
 
     def observe(self, n: int, weight: int = 1) -> None:

@@ -22,12 +22,13 @@ from __future__ import annotations
 
 import collections
 import hashlib
-import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+from .. import sanitize
 
 __all__ = ["FitnessCache", "row_digests", "rep_indices", "flatten_rows"]
 
@@ -104,7 +105,7 @@ class FitnessCache:
             raise ValueError("capacity must be >= 1")
         self.capacity = int(capacity)
         self._metrics = metrics
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock()
         self._entries: "collections.OrderedDict[tuple, np.ndarray]" = \
             collections.OrderedDict()
 

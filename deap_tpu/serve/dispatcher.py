@@ -39,6 +39,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from .. import sanitize
 from ..resilience.retry import with_retries, RetriesExhausted
 
 __all__ = ["ServeFuture", "Request", "BatchDispatcher", "ServeError",
@@ -92,9 +93,15 @@ class ServeFuture:
     payload result or raises its failure; ``cancel()`` succeeds iff the
     request has not started executing."""
 
+    #: resolution state shared between the dispatch worker and any
+    #: number of waiting client threads (``_on_failure`` is deliberately
+    #: NOT declared: sessions assign the rollback hook after
+    #: construction but before the future is published via submit)
+    _GUARDED_BY = {"_lock": ("_result", "_exc", "_cancelled", "_started")}
+
     def __init__(self):
-        self._event = threading.Event()
-        self._lock = threading.Lock()
+        self._event = sanitize.event()
+        self._lock = sanitize.lock()
         self._result: Any = None
         self._exc: Optional[BaseException] = None
         self._cancelled = False
@@ -158,15 +165,22 @@ class ServeFuture:
     def result(self, timeout: Optional[float] = None) -> Any:
         if not self._event.wait(timeout):
             raise TimeoutError("request not complete")
-        if self._exc is not None:
-            raise self._exc
-        return self._result
+        # the event's set() already orders these reads after the writer,
+        # but they take the lock anyway: _GUARDED_BY declares them, and
+        # an exception the lockset sanitizer must special-case is worth
+        # more than an uncontended acquire on an already-resolved future
+        with self._lock:
+            exc, result = self._exc, self._result
+        if exc is not None:
+            raise exc
+        return result
 
     def exception(self, timeout: Optional[float] = None
                   ) -> Optional[BaseException]:
         if not self._event.wait(timeout):
             raise TimeoutError("request not complete")
-        return self._exc
+        with self._lock:
+            return self._exc
 
 
 _req_ids = itertools.count()
@@ -256,7 +270,7 @@ class BatchDispatcher:
         self.max_pending = int(max_pending)
         self.batch_window = float(batch_window)
         self._clock = clock
-        self._cv = threading.Condition()
+        self._cv = sanitize.condition()
         self._pending: "collections.deque[Request]" = collections.deque()
         self._closed = False
         self._draining = False

@@ -30,9 +30,9 @@ lint pass enforces exactly this split.
 from __future__ import annotations
 
 import collections
-import threading
 from typing import Dict, Iterable, Optional
 
+from .. import sanitize
 from ..observability.sinks import MetricRecord, emit_record
 
 __all__ = ["ServeMetrics", "SERVE_COUNTERS", "SERVE_GAUGES", "NET_COUNTERS",
@@ -107,10 +107,16 @@ class ServeMetrics:
     :data:`ROUTER_COUNTERS`/:data:`ROUTER_GAUGES`) — backend snapshots
     stay free of zero-valued router series."""
 
+    #: lock-guarded shared state (``lock-discipline`` lint + runtime
+    #: sanitizer): every counter/gauge/reservoir/tenant table access
+    #: is shared between the dispatch worker and scraper threads
+    _GUARDED_BY = {"_lock": ("_counters", "_gauges", "_latency",
+                             "_tenants")}
+
     def __init__(self, latency_window: int = 2048, max_tenants: int = 4096,
                  extra_counters: Iterable[str] = (),
                  extra_gauges: Iterable[str] = ()):
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock()
         self._counters: Dict[str, int] = {
             k: 0 for k in SERVE_COUNTERS + NET_COUNTERS
             + tuple(extra_counters)}

@@ -39,6 +39,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ... import sanitize
 from ...base import Population, Fitness
 from ...observability.fleettrace import FleetTracer
 from ...observability.sinks import MetricRecord
@@ -68,6 +69,11 @@ class _Worker:
     thread.  Jobs run strictly in submission order; a job's ``resolve``
     callback receives ``(result, exception)``."""
 
+    #: lock-guarded shared state (``lock-discipline`` lint + runtime
+    #: sanitizer): the failover retarget latch is written from any
+    #: redirect-following thread and consumed by the worker
+    _GUARDED_BY = {"_target_lock": ("_pending_target",)}
+
     def __init__(self, host: str, port: int, timeout: float,
                  request_timeout: Optional[float] = None):
         self._host, self._port, self._timeout = host, port, timeout
@@ -83,7 +89,7 @@ class _Worker:
         # a redirect) and are applied by the worker thread itself at its
         # next _connection() — the worker owns the live connection, and
         # closing it cross-thread would kill a response mid-read
-        self._target_lock = threading.Lock()
+        self._target_lock = sanitize.lock()
         self._pending_target: Optional[Tuple[str, int]] = None
         self._thread = threading.Thread(target=self._run,
                                         name="deap-tpu-remote", daemon=True)

@@ -45,19 +45,21 @@ from __future__ import annotations
 
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Any, Dict, Optional, Sequence
 from urllib.parse import parse_qs, unquote, urlparse
 
 import numpy as np
 import jax.numpy as jnp
 
+from ... import sanitize
 from ...base import Population, Fitness
 from ...observability import fleettrace
 from ...observability.sinks import emit_text
 from ..dispatcher import ServiceDraining, SessionUnknown
 from ..metrics import prometheus_text
 from . import protocol
+from .httpcommon import FrameHTTPHandler
 
 __all__ = ["NetServer"]
 
@@ -108,7 +110,7 @@ class NetServer:
         #: SessionUnknown error envelopes so direct clients follow the
         #: failover transparently
         self._redirect: Optional[str] = None
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock()
         net = self
 
         class Handler(_Handler):
@@ -315,7 +317,8 @@ class NetServer:
 
     @property
     def redirect_location(self) -> Optional[str]:
-        return self._redirect
+        with self._lock:
+            return self._redirect
 
 
 def _as_device(tree):
@@ -330,31 +333,34 @@ def _rows_of(genome) -> int:
     return jax.tree_util.tree_leaves(genome)[0].shape[0]
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(FrameHTTPHandler):
     """Routes one connection's requests into the :class:`NetServer`
     context.  Keep-alive HTTP/1.1 with explicit Content-Length (chunked
-    only on the metrics stream)."""
+    only on the metrics stream); the wire plumbing — body read, byte
+    counters, error envelopes, keep-alive drain — lives in
+    :class:`~deap_tpu.serve.net.httpcommon.FrameHTTPHandler`, shared
+    with the router's handler."""
 
-    protocol_version = "HTTP/1.1"
     server_ctx: NetServer = None  # bound by NetServer
+    log_prefix = "serve.net"
 
     # -- plumbing ------------------------------------------------------------
 
-    def log_message(self, fmt, *args):  # stdlib default prints to stderr
+    def _handler_metrics(self):
         net = self.server_ctx
-        if net is not None and net.verbose:
-            emit_text(f"[serve.net] {self.address_string()} {fmt % args}",
-                      net.sinks)
+        return net.service.metrics if net is not None else None
+
+    def _log_conf(self):
+        net = self.server_ctx
+        if net is None:
+            return False, ()
+        return net.verbose, net.sinks
 
     def _body(self) -> Any:
         net = self.server_ctx
         tracer = net.service.tracer if net is not None else None
         t0 = tracer.clock() if tracer is not None else 0.0
-        length = int(self.headers.get("Content-Length", 0) or 0)
-        data = self.rfile.read(length) if length else b""
-        self._body_consumed = True
-        if net is not None:
-            net.service.metrics.inc("net_bytes_in", len(data))
+        data = self._read_raw_body()
         if not data:
             return {}
         if data[:4] == protocol.MAGIC:
@@ -387,27 +393,6 @@ class _Handler(BaseHTTPRequestHandler):
                 fleettrace.set_current(ctx)
         return obj
 
-    def _drain_body(self) -> None:
-        """Consume an unread request body before replying on an error
-        path — leftover body bytes would be parsed as the NEXT request
-        line on this keep-alive connection, poisoning every subsequent
-        exchange."""
-        if getattr(self, "_body_consumed", False):
-            return
-        length = int(self.headers.get("Content-Length", 0) or 0)
-        if length:
-            self.rfile.read(length)
-        self._body_consumed = True
-
-    def _send(self, payload: bytes, status: int = 200,
-              content_type: str = protocol.CONTENT_TYPE) -> None:
-        self.send_response(status)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(payload)))
-        self.end_headers()
-        self.wfile.write(payload)
-        self.server_ctx.service.metrics.inc("net_bytes_out", len(payload))
-
     def _encode_response(self, obj: Any) -> bytes:
         """Encode a response frame, compressing the tensor payload when
         the request advertised a codec this build holds and the payload
@@ -436,24 +421,17 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send(self._encode_response(obj), status=status)
 
-    def _send_json(self, obj: Any, status: int = 200) -> None:
-        self._send(json.dumps(obj).encode("utf-8"), status=status,
-                   content_type="application/json")
-
     def _send_error_obj(self, exc: BaseException) -> None:
         net = self.server_ctx
         net.service.metrics.inc("net_errors")
-        self._drain_body()
-        status = protocol.status_of(exc)
         # a drained instance that knows its replacement attaches the
         # typed redirect (draining rejections AND post-drain lookup
         # misses — the two shapes a stale client sees after failover)
         location = (net.redirect_location
                     if isinstance(exc, (ServiceDraining, SessionUnknown))
                     else None)
-        self._send(protocol.error_payload(exc, location=location),
-                   status=status, content_type="application/json")
-        if status == 500:
+        self._send_error_envelope(exc, location=location)
+        if protocol.status_of(exc) == 500:
             # 500 = an UNMAPPED exception — a service bug, not a protocol
             # outcome (draining/deadline envelopes stay quiet) — dump the
             # flight recorder for the postmortem (rate-limited inside
@@ -593,14 +571,3 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(b"0\r\n\r\n")
         except BrokenPipeError:
             pass
-
-    # -- verbs ---------------------------------------------------------------
-
-    def do_GET(self):  # noqa: N802 (stdlib API)
-        self._route("GET")
-
-    def do_POST(self):  # noqa: N802
-        self._route("POST")
-
-    def do_DELETE(self):  # noqa: N802
-        self._route("DELETE")

@@ -33,9 +33,9 @@ place by affinity).
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ... import sanitize
 from ...observability.fleettrace import FleetTracer
 from ...observability.sinks import emit_text
 from ..buckets import genome_signature
@@ -113,12 +113,12 @@ class FleetRouter:
                        else FleetTracer(clock=self._clock))
         self.sinks = list(sinks)
         self.verbose = bool(verbose)
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock()
         # route-change signal: forwarders retrying a provably-unexecuted
         # request wait here for the failover to move their session (a
         # Condition with its own lock — never held while taking _lock's
         # critical sections, only around notify/wait)
-        self._route_cv = threading.Condition()
+        self._route_cv = sanitize.condition()
         self._routes: Dict[str, str] = {}        # session -> backend name
         self._tenant_of: Dict[str, Optional[str]] = {}
         self._plans: Dict[str, BackendPlan] = {

@@ -36,6 +36,7 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
+from ... import sanitize
 from ...observability.fleettrace import join_spans, span_tree
 from .backend import Backend, BackendDown
 
@@ -86,7 +87,7 @@ class HealthMonitor:
         self.on_sick = on_sick
         self._metrics = metrics
         self._clock = clock if clock is not None else time.monotonic
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock()
         self._backends: Dict[str, Backend] = {b.name: b for b in backends}
         self._strikes: Dict[str, int] = {}
         self._sick: Dict[str, str] = {}          # name -> latched reason

@@ -36,7 +36,7 @@ from __future__ import annotations
 
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Optional, Sequence, Tuple
 from urllib.parse import parse_qs, quote, unquote, urlparse
 
@@ -45,6 +45,7 @@ from ..dispatcher import (ServeError, ServiceOverloaded, SessionUnknown,
                           TenantQuotaExceeded)
 from ..metrics import prometheus_text
 from ..net import protocol
+from ..net.httpcommon import FrameHTTPHandler
 from .backend import Backend, BackendDown
 from .core import FleetRouter
 
@@ -119,49 +120,27 @@ class RouterServer:
         return f"http://{host}:{port}"
 
 
-class _RouterHandler(BaseHTTPRequestHandler):
+class _RouterHandler(FrameHTTPHandler):
     """One connection's requests, routed into the :class:`RouterServer`
-    context.  Mirrors the instance handler's keep-alive + explicit
-    Content-Length framing."""
+    context.  The keep-alive wire plumbing — body read, byte counters,
+    error envelopes, unread-body drain — is the shared
+    :class:`~deap_tpu.serve.net.httpcommon.FrameHTTPHandler` base, the
+    same copy the instance handler uses."""
 
-    protocol_version = "HTTP/1.1"
     server_ctx: RouterServer = None     # bound by RouterServer
+    log_prefix = "router"
 
     # -- plumbing ------------------------------------------------------------
 
-    def log_message(self, fmt, *args):
+    def _handler_metrics(self):
         ctx = self.server_ctx
-        if ctx is not None and ctx.verbose:
-            emit_text(f"[router] {self.address_string()} {fmt % args}",
-                      ctx.sinks)
+        return ctx.router.metrics if ctx is not None else None
 
-    def _read_body(self) -> bytes:
-        length = int(self.headers.get("Content-Length", 0) or 0)
-        data = self.rfile.read(length) if length else b""
-        self._body_consumed = True
-        self.server_ctx.router.metrics.inc("net_bytes_in", len(data))
-        return data
-
-    def _drain_body(self) -> None:
-        if getattr(self, "_body_consumed", False):
-            return
-        length = int(self.headers.get("Content-Length", 0) or 0)
-        if length:
-            self.rfile.read(length)
-        self._body_consumed = True
-
-    def _send(self, payload: bytes, status: int = 200,
-              content_type: str = protocol.CONTENT_TYPE) -> None:
-        self.send_response(status)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(payload)))
-        self.end_headers()
-        self.wfile.write(payload)
-        self.server_ctx.router.metrics.inc("net_bytes_out", len(payload))
-
-    def _send_json(self, obj, status: int = 200) -> None:
-        self._send(json.dumps(obj).encode("utf-8"), status=status,
-                   content_type="application/json")
+    def _log_conf(self):
+        ctx = self.server_ctx
+        if ctx is None:
+            return False, ()
+        return ctx.verbose, ctx.sinks
 
     def _send_error_obj(self, exc: BaseException) -> None:
         self.server_ctx.router.metrics.inc("router_errors")
@@ -169,10 +148,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
             # both rejection shapes — session quota at create, backlog
             # quota at the fair scheduler — count as admission decisions
             self.server_ctx.router.metrics.inc("router_quota_rejections")
-        self._drain_body()
-        self._send(protocol.error_payload(exc),
-                   status=protocol.status_of(exc),
-                   content_type="application/json")
+        self._send_error_envelope(exc)
 
     def _respond_raw(self, status: int, data: bytes) -> None:
         """Relay a backend's response bytes (frame or error envelope —
@@ -261,7 +237,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
 
     def _manual_failover(self) -> None:
         router = self.server_ctx.router
-        raw = self._read_body()
+        raw = self._read_raw_body()
         if raw[:4] == protocol.MAGIC:
             body = protocol.decode_frame(raw)
         else:
@@ -279,7 +255,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
     def _create(self) -> None:
         ctx = self.server_ctx
         router = ctx.router
-        raw = self._read_body()
+        raw = self._read_raw_body()
         if raw[:4] != protocol.MAGIC:
             raise ValueError("session create requires a DTF1 frame body")
         body, meta = protocol.decode_frame_with_meta(raw)
@@ -321,7 +297,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     op: Optional[str]) -> None:
         ctx = self.server_ctx
         router = ctx.router
-        raw = self._read_body() if method == "POST" else b""
+        raw = self._read_raw_body() if method == "POST" else b""
         tenant = router.tenant_of(name)
         quoted = quote(name, safe="")
         path = (f"/v1/sessions/{quoted}/{op}" if op
@@ -406,18 +382,6 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 f"{last_exc}") from last_exc
         return status, data, backend
 
-    # -- verbs ---------------------------------------------------------------
-
-    def do_GET(self):  # noqa: N802 (stdlib API)
-        self._route("GET")
-
-    def do_POST(self):  # noqa: N802
-        self._route("POST")
-
-    def do_DELETE(self):  # noqa: N802
-        self._route("DELETE")
-
-
 def _strip_redirect(data: bytes) -> bytes:
     """Drop ``location`` from a relayed JSON error envelope; anything
     unparsable is returned untouched."""
@@ -439,5 +403,3 @@ def _is_draining_envelope(data: bytes) -> bool:
     except (ValueError, UnicodeDecodeError):
         return False
     return doc.get("error") == "ServiceDraining"
-
-

@@ -31,9 +31,9 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import itertools
-import threading
 from typing import Dict, Optional
 
+from ... import sanitize
 from ..dispatcher import ServiceClosed, TenantQuotaExceeded
 
 __all__ = ["TenantQuota", "WeightedFairScheduler", "TenantQuotaExceeded"]
@@ -85,7 +85,7 @@ class WeightedFairScheduler:
         self.max_inflight = int(max_inflight)
         self.quotas = dict(quotas or {})
         self.default = default
-        self._cv = threading.Condition()
+        self._cv = sanitize.condition()
         self._virtual = 0.0                      # fair-queueing clock
         self._last_tag: Dict[str, float] = {}    # tenant -> last finish tag
         self._pending: Dict[str, int] = {}       # tenant -> queued acquires

@@ -44,7 +44,6 @@ from __future__ import annotations
 import contextlib
 import copy
 import dataclasses
-import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -53,6 +52,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as _P
 
+from .. import sanitize
 from ..base import Population, Fitness
 from ..algorithms import ea_step, ea_ask, ea_tell, _norm_eval
 from ..observability import events as _events
@@ -190,7 +190,7 @@ class Session:
         # from two client threads must not both pass the guard); NEVER
         # held across a submit — the dispatcher takes its own lock first
         # on some failure paths, and the reverse order would deadlock
-        self._phase_lock = threading.Lock()
+        self._phase_lock = sanitize.lock()
 
     def _rollback_ask(self) -> None:
         """Failure hook of an ask() that never executed (deadline miss,
@@ -393,7 +393,7 @@ class EvolutionService:
         self._sessions: Dict[str, Session] = {}
         self._reserved: set = set()   # names mid-admission (see _admit)
         self._names = 0
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock()
         self._closed = False
         self._draining = False
         self._dispatcher = BatchDispatcher(
@@ -433,10 +433,15 @@ class EvolutionService:
         occupancy, pad waste, latency p50/p90/p99); per-tenant SLO
         counters ride in ``meta["tenants"]``."""
         from .rebucket import pad_waste_of
-        self.metrics.set_gauge("sessions", len(self._sessions))
+        # one locked copy for both gauges: the stats scraper runs on its
+        # own thread while handler threads admit/close sessions (a bare
+        # len(self._sessions) here was the first race the runtime
+        # sanitizer caught)
+        live = self.sessions()
+        self.metrics.set_gauge("sessions", len(live))
         self.metrics.set_gauge(
             "sharded_sessions",
-            sum(1 for s in self.sessions().values() if s.sharded))
+            sum(1 for s in live.values() if s.sharded))
         self.metrics.set_gauge("pad_waste", pad_waste_of(self))
         return self.metrics.snapshot(self._dispatcher.batches)
 

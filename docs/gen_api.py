@@ -77,6 +77,7 @@ PAGES = {
                "deap_tpu.serve.metrics", "deap_tpu.serve.rebucket"]),
     "serve_net": ("Network frontend (deap_tpu.serve.net)",
                   ["deap_tpu.serve.net", "deap_tpu.serve.net.protocol",
+                   "deap_tpu.serve.net.httpcommon",
                    "deap_tpu.serve.net.server",
                    "deap_tpu.serve.net.client"]),
     "serve_router": ("Fleet control plane (deap_tpu.serve.router)",
@@ -102,10 +103,15 @@ PAGES = {
              ["deap_tpu.lint.core", "deap_tpu.lint.baseline",
               "deap_tpu.lint.reporters", "deap_tpu.lint.rules_repo",
               "deap_tpu.lint.rules_jax", "deap_tpu.lint.rules_data",
-              "deap_tpu.lint.rules_locks", "deap_tpu.lint.cli"]),
+              "deap_tpu.lint.rules_locks", "deap_tpu.lint.rules_sanitize",
+              "deap_tpu.lint.cli"]),
     "analysis": ("Program-contract analyzer (deap_tpu.analysis)",
                  ["deap_tpu.analysis.hlo", "deap_tpu.analysis.inventory",
                   "deap_tpu.analysis.passes", "deap_tpu.analysis.cli"]),
+    "sanitize": ("Concurrency sanitizer (deap_tpu.sanitize)",
+                 ["deap_tpu.sanitize", "deap_tpu.sanitize.runtime",
+                  "deap_tpu.sanitize.guards",
+                  "deap_tpu.sanitize.pytest_plugin"]),
 }
 
 

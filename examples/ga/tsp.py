@@ -13,7 +13,8 @@ from deap_tpu.ops import crossover, mutation, selection
 N_CITIES, POP, NGEN = 25, 200, 80
 
 
-def main(seed=3, verbose=True):
+def main(seed=3, verbose=True, ngen=None):
+    ngen = NGEN if ngen is None else int(ngen)
     rng = np.random.RandomState(169)
     coords = jnp.asarray(rng.rand(N_CITIES, 2), jnp.float32)
 
@@ -36,7 +37,7 @@ def main(seed=3, verbose=True):
     pop = base.Population(genome, base.Fitness.empty(POP, (-1.0,)))
 
     pop, logbook = algorithms.ea_simple(
-        key, pop, tb, cxpb=0.7, mutpb=0.2, ngen=NGEN)
+        key, pop, tb, cxpb=0.7, mutpb=0.2, ngen=ngen)
     best = float(jnp.min(pop.fitness.values))
     # sanity: tours must remain permutations
     tours = np.asarray(pop.genome, np.int32)

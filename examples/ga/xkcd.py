@@ -23,7 +23,8 @@ TARGET = 15.05
 MU, NGEN, MAX_COUNT = 40, 60, 3
 
 
-def main(seed=6, verbose=True):
+def main(seed=6, verbose=True, ngen=None):
+    ngen = NGEN if ngen is None else int(ngen)
     prices = jnp.asarray([p for _, p in ITEMS], jnp.float32)
 
     def evaluate(counts):
@@ -64,7 +65,7 @@ def main(seed=6, verbose=True):
     @jax.jit
     def run(key, pop):
         pop, _ = evaluate_population(tb, pop)
-        (key, pop), _ = lax.scan(gen_step, (key, pop), None, length=NGEN)
+        (key, pop), _ = lax.scan(gen_step, (key, pop), None, length=ngen)
         return pop
 
     pop = run(key, pop)

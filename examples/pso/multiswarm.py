@@ -16,7 +16,8 @@ NSWARMS, NPARTICLES, NDIM, NGEN = 5, 10, 5, 60
 BOUNDS = (0.0, 100.0)
 
 
-def main(seed=14, verbose=True):
+def main(seed=14, verbose=True, ngen=None):
+    ngen = NGEN if ngen is None else int(ngen)
     mp = MovingPeaks(dim=NDIM, key=jax.random.PRNGKey(seed), **SCENARIO_2)
     key = jax.random.PRNGKey(seed + 1)
     k_init, key = jax.random.split(key)
@@ -26,7 +27,7 @@ def main(seed=14, verbose=True):
     rexcl = (BOUNDS[1] - BOUNDS[0]) / (2 * NSWARMS ** (1.0 / NDIM))
 
     offline_errors = []
-    for gen in range(NGEN):
+    for gen in range(ngen):
         key, k_step = jax.random.split(key)
         peaks = mp.state           # freeze the current landscape for the step
         evaluate = lambda x: mp.evaluate(x, peaks)

@@ -17,6 +17,36 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# concurrency-sanitizer fixtures: `tsan` (arm deap_tpu.sanitize around a
+# test, fail it on any runtime finding) and `thread_leak_check` — the
+# serve/net/router drills take them so tier-1 exercises the lockset
+# detector on the interleavings that already exist
+pytest_plugins = ("deap_tpu.sanitize.pytest_plugin",)
+
+#: test modules whose every test must leave no stray fleet worker behind
+_THREAD_LEAK_MODULES = frozenset({
+    "test_serve", "test_serve_net", "test_serve_router", "test_fleettrace",
+    "test_sanitize",
+})
+
+
+@pytest.fixture(autouse=True)
+def _serve_thread_leaks(request):
+    """Auto thread-leak gate for the serving-layer test modules: any new
+    non-daemon thread, or any new ``deap-tpu-*`` fleet worker, still
+    alive after the test (plus a grace join) fails it — a leaked
+    dispatcher/health/forwarder keeps OS threads and device buffers
+    pinned for the rest of the suite."""
+    if request.module.__name__.rpartition(".")[2] not in \
+            _THREAD_LEAK_MODULES:
+        yield
+        return
+    import threading
+    from deap_tpu.sanitize.pytest_plugin import assert_no_leaked_threads
+    before = set(threading.enumerate())
+    yield
+    assert_no_leaked_threads(before)
+
 
 @pytest.fixture(scope="session", autouse=True)
 def _suite_compile_cache(tmp_path_factory):
@@ -36,7 +66,7 @@ def _suite_compile_cache(tmp_path_factory):
     programs, scanned loops, sharded selection) are worth the entry."""
     from deap_tpu.utils.compilecache import enable_compile_cache
     enable_compile_cache(tmp_path_factory.getbasetemp() / "xla-cache",
-                         min_compile_time_secs=0.25)
+                         min_compile_time_secs=0.05)
 
 
 @pytest.fixture(scope="session")

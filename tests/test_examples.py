@@ -47,8 +47,11 @@ SMOKE = {
         (np.asarray(r.fitness.values)[:, 0] <= 50).all())),
     "examples.ga.kursawefct": (dict(), None),
     "examples.ga.nqueens": (dict(), lambda r: r[1] <= 2),
-    "examples.ga.tsp": (dict(), lambda r: np.isfinite(r[1])),
-    "examples.ga.xkcd": (dict(), None),
+    # tsp/xkcd/multiswarm hold no quality gate (finiteness/None) — the
+    # smoke proves the pipeline runs, so a reduced horizon buys the same
+    # coverage at a fraction of the tier-1 budget (the harm/ant rule)
+    "examples.ga.tsp": (dict(ngen=24), lambda r: np.isfinite(r[1])),
+    "examples.ga.xkcd": (dict(ngen=20), None),
     "examples.ga.evosn": (dict(pop_size=200, ngen=20),
                           lambda r: r[1][0] <= 6),
     "examples.ga.evoknn": (dict(ngen=20), lambda r: r[1][0] >= 0.9),
@@ -86,7 +89,7 @@ SMOKE = {
     "examples.es.onefifth": (dict(), lambda r: r < 1e-4),
     # --- pso / de / eda ---
     "examples.pso.basic": (dict(), lambda r: r < 1.0),
-    "examples.pso.multiswarm": (dict(), None),
+    "examples.pso.multiswarm": (dict(ngen=20), None),   # see tsp note
     "examples.pso.speciation": (dict(), lambda r: r >= 1),
     "examples.de.basic": (dict(), lambda r: r < 1e-1),
     "examples.de.sphere": (dict(), None),

@@ -502,7 +502,7 @@ def test_latency_quantiles_sort_outside_lock():
 
 
 @pytest.mark.net
-def test_metrics_stream_survives_session_churn():
+def test_metrics_stream_survives_session_churn(tsan):
     """/v1/metrics?stream=1 keeps yielding valid records while sessions
     are created, stepped and closed mid-stream (the stats snapshot walks
     the live session table concurrently)."""

@@ -135,11 +135,55 @@ def test_lock_discipline_fires_off_lock(tmp_path):
                 self._count += 1          # rebind off-lock
 
             def read_ok(self):
-                return len(self._entries)   # reads are not checked
+                snap = dict(self._entries)  # incidental read: not checked
+                return snap
         """)
     r = _findings(tmp_path, "lock-discipline")
     assert [f.line for f in r.findings] == [20, 21, 22]
     assert all("with self._lock" in f.message for f in r.findings)
+
+
+def test_lock_discipline_flags_decision_reads(tmp_path):
+    """ISSUE 13 satellite: guarded reads are checked in the two decision
+    positions — a ``return`` value and an ``if``/``while`` condition —
+    while incidental reads (logging, local snapshots) stay out of scope,
+    and reads under the lock or in ``*_locked`` helpers stay clean."""
+    _write(tmp_path, "deap_tpu/serve/ready.py", """\
+        import threading
+
+        class Gate:
+            _GUARDED_BY = {"_lock": ("_open", "_waiters")}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._open = False
+                self._waiters = 0
+
+            def is_open(self):
+                return self._open           # return position, off-lock
+
+            def poll(self):
+                while self._waiters:        # condition position, off-lock
+                    pass
+                if self._open:              # condition position, off-lock
+                    return True
+
+            def good(self):
+                with self._lock:
+                    if self._open:          # under the lock: clean
+                        return self._waiters
+
+            def _peek_locked(self):
+                return self._open           # *_locked exempt
+
+            def log(self, sink):
+                sink(self._open)            # incidental read: not flagged
+        """)
+    r = _findings(tmp_path, "lock-discipline")
+    assert [f.line for f in r.findings] == [12, 15, 17], render_text(r)
+    assert "return position" in r.findings[0].message
+    assert "condition position" in r.findings[1].message
+    assert all("racy read" in f.message for f in r.findings)
 
 
 def test_trace_impurity_fires_on_host_effects(tmp_path):
@@ -1072,3 +1116,120 @@ def test_path_restricted_run_does_not_expire_unscanned_baseline(tmp_path):
     (tmp_path / "deap_tpu" / "old.py").unlink()
     whole = run_lint(repo=tmp_path, select=["no-bare-print"], baseline=bl)
     assert len(whole.expired) == 1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13: the concurrency-sanitizer lint tier (sanitizer-factory,
+# guardedby-coverage)
+
+
+def test_sanitizer_factory_fires_on_raw_ctors(tmp_path):
+    """Every raw-constructor spelling in the serving fleet flags —
+    module attribute, module alias, from-import (aliased too) — while
+    factory calls and out-of-scope modules stay clean."""
+    _write(tmp_path, "deap_tpu/__init__.py", "")
+    _write(tmp_path, "deap_tpu/serve/net/__init__.py", "")
+    _write(tmp_path, "deap_tpu/serve/router/__init__.py", "")
+    _write(tmp_path, "deap_tpu/observability/fleettrace.py", "x = 1\n")
+    _write(tmp_path, "deap_tpu/serve/raw.py", """\
+        import threading
+        import threading as th
+        from threading import Lock, Condition as Cond
+        from .. import sanitize
+
+        class A:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = th.RLock()
+                self._c = Lock()
+                self._d = Cond()
+                self._ok = sanitize.lock()
+                self._ev = threading.Event()   # Event stays stdlib
+        """)
+    _write(tmp_path, "deap_tpu/parallel/mapper.py", """\
+        import threading
+        lock = threading.Lock()    # outside the fleet: not this pass's job
+        """)
+    r = _findings(tmp_path, "sanitizer-factory")
+    assert [(f.path, f.line) for f in r.findings] == \
+        [("deap_tpu/serve/raw.py", n) for n in (8, 9, 10, 11)], \
+        render_text(r)
+    assert "deap_tpu.sanitize" in r.findings[0].message
+
+
+def test_sanitizer_factory_coverage_pin(tmp_path):
+    """The lost-coverage contract: a renamed serve/ subpackage (or a
+    vanished fleettrace.py) fails the gate instead of silently shrinking
+    the sanitizer's instrumented surface."""
+    _write(tmp_path, "deap_tpu/__init__.py", "")
+    _write(tmp_path, "deap_tpu/serve/mod.py", "x = 1\n")   # net/, router/
+    r = _findings(tmp_path, "sanitizer-factory")           # and tracer gone
+    lost = " ".join(f.message for f in r.findings)
+    assert len(r.findings) == 3, render_text(r)
+    assert "deap_tpu/serve/net/" in lost
+    assert "deap_tpu/serve/router/" in lost
+    assert "fleettrace.py" in lost
+    # fixture repos without a deap_tpu package stay clean
+    clean = _findings(tmp_path / "nowhere", "sanitizer-factory")
+    assert clean.findings == []
+
+
+def test_guardedby_coverage_warns_undeclared_factory_lock(tmp_path):
+    """A class holding a factory-built lock with no ``_GUARDED_BY`` map
+    warns (mutual exclusion with no checkable contract); declaring the
+    map — or binding no factory lock at all — is clean."""
+    _write(tmp_path, "deap_tpu/anywhere.py", """\
+        from deap_tpu import sanitize
+        from deap_tpu.sanitize import condition as make_cv
+
+        class Undeclared:
+            def __init__(self):
+                self._lock = sanitize.lock()
+
+        class UndeclaredFromImport:
+            def __init__(self):
+                self._cv = make_cv()
+
+        class Declared:
+            _GUARDED_BY = {"_lock": ("_state",)}
+
+            def __init__(self):
+                self._lock = sanitize.lock()
+                self._state = {}
+
+        class NoLock:
+            def __init__(self):
+                self._items = []
+        """)
+    r = _findings(tmp_path, "guardedby-coverage")
+    assert [(f.line, f.severity) for f in r.findings] == \
+        [(6, "warning"), (10, "warning")], render_text(r)
+    assert all("_GUARDED_BY" in f.message for f in r.findings)
+
+
+def test_sanitizer_rules_registered_default_on():
+    names = {r.name for r in iter_rules()}
+    assert {"sanitizer-factory", "guardedby-coverage"} <= names
+    assert get_rule("sanitizer-factory").default is True
+    assert get_rule("guardedby-coverage").default is True
+
+
+def test_bench_json_tsan_schema(tmp_path):
+    """BENCH_TSAN.json gets the sanitizer-overhead schema: both legs
+    with finite p50s AND a zero violation count are required — a commit
+    claiming the drill raced (violations > 0) fails the gate."""
+    good = ('{"metric": "serve_net_tsan_overhead_pct", "value": 42.0, '
+            '"unit": "%", "violations": 0, '
+            '"tsan_on": {"roundtrip_p50_ms": 14.2}, '
+            '"tsan_off": {"roundtrip_p50_ms": 10.0}}')
+    (tmp_path / "BENCH_TSAN.json").write_text(good)
+    r = _findings(tmp_path, "bench-json")
+    assert r.findings == [], r.findings
+
+    (tmp_path / "BENCH_TSAN.json").write_text(
+        '{"metric": "m", "value": 1.0, "unit": "%", "violations": 2, '
+        '"tsan_on": {"roundtrip_p50_ms": 14.2}}')
+    r = _findings(tmp_path, "bench-json")
+    msgs = " ".join(f.message for f in r.findings)
+    assert "'tsan_off' must be an object" in msgs
+    assert "'violations' must be 0" in msgs

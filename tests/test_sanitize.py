@@ -1,0 +1,440 @@
+"""Tests of ``deap_tpu.sanitize`` — the runtime concurrency sanitizer.
+
+The load-bearing assertions (ISSUE 13 acceptance criteria):
+
+* **off = stdlib**: with the sanitizer off, the factory returns the
+  stdlib primitives themselves, and a loopback serving drill run armed
+  vs disarmed produces **bitwise-identical** trajectories and the same
+  compile counters — instrumentation observes, never perturbs;
+* **seeded violations fire**: a guarded write (and read-in-decision)
+  without the lock, a reversed cross-class acquisition order, and a
+  stalled Condition wait each yield exactly the expected ``Finding``
+  records, rendered through the text/JSON/SARIF reporters unchanged;
+* **real drills run clean**: the serve/net/router drills armed via the
+  ``tsan`` fixture live in their own modules (test_serve_net /
+  test_serve_router / test_fleettrace); here the in-process loopback
+  drill asserts zero findings under full guard shims.
+
+Everything below builds its fixture classes with ``arm(guards=False,
+extra_classes=...)`` so the pure-runtime tests stay jax-free; only the
+drill tests import the serving stack.
+"""
+
+import threading
+import time
+
+import pytest
+
+from deap_tpu import sanitize
+from deap_tpu.lint.core import LintResult
+from deap_tpu.lint.reporters import render_json, render_sarif, render_text
+from deap_tpu.sanitize import guards as san_guards
+from deap_tpu.sanitize.runtime import TsanCondition, TsanLock, TsanRLock
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    """A failing test must not leave the process armed (the factory
+    would instrument every later-constructed service in the suite)."""
+    yield
+    sanitize.disarm()
+    sanitize.runtime().reset()
+
+
+# ---------------------------------------------------------------------------
+# the factory: off = stdlib, armed = instrumented
+
+
+def test_factory_returns_stdlib_primitives_when_off():
+    """The zero-overhead contract: disarmed, the factory returns the
+    *identical stdlib objects* — not wrappers with a fast path."""
+    assert not sanitize.active()
+    assert type(sanitize.lock()) is type(threading.Lock())
+    assert type(sanitize.rlock()) is type(threading.RLock())
+    assert type(sanitize.condition()) is threading.Condition
+    assert type(sanitize.event()) is threading.Event
+
+
+def test_factory_returns_instrumented_when_armed():
+    san = sanitize.arm(guards=False)
+    assert sanitize.active()
+    lk, rlk, cv = sanitize.lock(), sanitize.rlock(), sanitize.condition()
+    assert type(lk) is TsanLock and type(rlk) is TsanRLock
+    assert type(cv) is TsanCondition
+    with lk:
+        assert san.holds(lk)
+    assert not san.holds(lk)
+    with cv:
+        assert san.holds(cv.tsan_lock)
+    assert sanitize.disarm() == []
+    assert not sanitize.active()
+
+
+# ---------------------------------------------------------------------------
+# seeded violations: the three detector legs
+
+
+class _Racy:
+    """Seeded lock-discipline violator: ``_table`` is declared guarded
+    by ``_lock`` but accessed bare by the methods below."""
+
+    _GUARDED_BY = {"_lock": ("_table",)}
+
+    def __init__(self):
+        self._lock = sanitize.lock()
+        self._table = {}      # __init__ exempt: pre-publication
+
+    def good_write(self, k, v):
+        with self._lock:
+            self._table[k] = v
+
+    def bad_write(self):
+        # the seeded violation the RUNTIME detector must catch (the AST
+        # pass sees it too, hence the suppression)
+        self._table = {"clobbered": True}  # lint: disable=lock-discipline -- seeded runtime-sanitizer fixture
+
+    def bad_read(self):
+        return self._table  # lint: disable=lock-discipline -- seeded runtime-sanitizer fixture
+
+
+def test_lockset_write_and_read_violations_fire():
+    san = sanitize.arm(guards=False, extra_classes=[_Racy])
+    obj = _Racy()
+    obj.good_write("a", 1)        # under the lock: clean
+    obj.bad_write()
+    obj.bad_read()
+    findings = sanitize.disarm()
+    assert [f.rule for f in findings] == ["tsan-lockset", "tsan-lockset"]
+    msgs = [f.message for f in findings]
+    assert "_Racy._table write without holding _Racy._lock" in msgs[0]
+    assert "_Racy._table read without holding _Racy._lock" in msgs[1]
+    assert all(f.path == "tests/test_sanitize.py" for f in findings)
+    assert san.counts["violations"] == 2
+    # the diagnostic record behind each finding carries the thread+stack
+    assert all(rep["thread"] == threading.current_thread().name
+               for rep in san.reports)
+    assert all(rep["stack"] for rep in san.reports)
+
+
+def test_lockset_cross_thread_and_dedup():
+    """The same racy site repeated in a loop files ONE finding, and the
+    violation is attributed to the thread that raced."""
+    sanitize.arm(guards=False, extra_classes=[_Racy])
+    obj = _Racy()
+
+    def racer():
+        for _ in range(100):
+            obj.bad_read()
+
+    t = threading.Thread(target=racer)
+    t.start()
+    t.join()
+    findings = sanitize.disarm()
+    assert len(findings) == 1 and findings[0].rule == "tsan-lockset"
+
+
+def test_guard_shims_check_cross_module_access():
+    """The gap the AST pass cannot see: code OUTSIDE the class touching
+    declared state is checked against the accessor's lockset too."""
+    sanitize.arm(guards=False, extra_classes=[_Racy])
+    obj = _Racy()
+    with obj._lock:
+        obj._table["direct"] = 1       # external access, lock held: clean
+    assert obj._table.get("direct") == 1   # external bare read: flagged
+    findings = sanitize.disarm()
+    assert [f.rule for f in findings] == ["tsan-lockset"]
+    assert "read without holding" in findings[0].message
+
+
+def test_lock_order_cycle_witnessed_across_time():
+    """Two locks taken in opposite orders — even by the SAME thread at
+    different times — compose into an observed-graph cycle no single
+    lexical scope shows (the runtime leg of the AST lock-order pass)."""
+    san = sanitize.arm(guards=False)
+    a, b = sanitize.lock(), sanitize.lock()
+    a.label, b.label = "Svc._lock", "Disp._cv"
+    with a:
+        with b:
+            pass
+    with b:
+        with a:                       # the inversion
+            pass
+    findings = sanitize.disarm()
+    assert [f.rule for f in findings] == ["tsan-lock-order"]
+    assert "Svc._lock" in findings[0].message
+    assert "Disp._cv" in findings[0].message
+    assert "cycle" in findings[0].message
+    assert set(san.edges()) == {("Svc._lock", "Disp._cv"),
+                                ("Disp._cv", "Svc._lock")}
+
+
+def test_consistent_order_and_reentrancy_stay_clean():
+    san = sanitize.arm(guards=False)
+    a, r = sanitize.lock(), sanitize.rlock()
+    with a:
+        with r:
+            with r:                   # re-entry: no self-edge
+                pass
+    with a:
+        with r:
+            pass
+    assert sanitize.disarm() == []
+    assert ("Svc", "Svc") not in san.edges()
+
+
+def test_stalled_wait_watchdog_fires_when_others_hold_locks():
+    """A Condition wait past ``stall_s`` with no wakeup, while another
+    thread sits on an instrumented lock, dumps the waiter stack and the
+    fleet-wide held-lock snapshot."""
+    san = sanitize.arm(guards=False, stall_s=0.15)
+    cv = sanitize.condition()
+    cv.label = "Disp._cv"
+    blocker = sanitize.lock()
+    blocker.label = "Svc._lock"
+    woke = []
+
+    def waiter():
+        with cv:
+            woke.append(cv.wait(timeout=30.0))
+
+    blocker.acquire()          # main thread wedges the "fleet"
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.8)            # well past stall_s with the lock held
+    blocker.release()
+    with cv:
+        cv.notify_all()
+    t.join(timeout=10.0)
+    assert woke == [True]      # chunked waits still deliver the notify
+    findings = sanitize.disarm()
+    assert [f.rule for f in findings] == ["tsan-stalled-wait"]
+    assert "Disp._cv" in findings[0].message
+    assert "Svc._lock" in findings[0].message
+    rep = san.reports[0]
+    assert rep["waited_s"] >= 0.15 and rep["stack"]
+    assert any("Svc._lock" in locks
+               for locks in rep["held_elsewhere"].values())
+
+
+def test_idle_wait_does_not_stall_report():
+    """An idle worker parked on an empty queue is NOT a stall: nobody
+    else holds a lock, so a forever-wait is the system at rest (the
+    dispatcher's normal state between batches)."""
+    sanitize.arm(guards=False, stall_s=0.1)
+    cv = sanitize.condition()
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=0.5)      # expires unnotified
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    t.join(timeout=10.0)
+    assert sanitize.disarm() == []
+
+
+def test_condition_wait_releases_lockset():
+    """During ``cv.wait`` the thread does NOT hold the cv's lock — a
+    guarded check in another thread must see it free (the stdlib
+    release/reacquire protocol, mirrored into the lockset)."""
+    san = sanitize.arm(guards=False)
+    cv = sanitize.condition()
+    observed = []
+    in_wait = threading.Event()
+
+    def waiter():
+        with cv:
+            observed.append(san.holds(cv.tsan_lock))   # True: held
+            in_wait.set()
+            cv.wait(timeout=5.0)
+            observed.append(san.holds(cv.tsan_lock))   # True: reacquired
+        observed.append(san.holds(cv.tsan_lock))       # False: released
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    assert in_wait.wait(5.0)
+    with cv:                   # acquirable only because the waiter let go
+        cv.notify_all()
+    t.join(timeout=10.0)
+    assert observed == [True, True, False]
+    assert sanitize.disarm() == []
+
+
+# ---------------------------------------------------------------------------
+# reporters: runtime findings ride the lint stack unchanged
+
+
+def _seeded_result():
+    sanitize.arm(guards=False, extra_classes=[_Racy])
+    obj = _Racy()
+    obj.bad_write()
+    findings = sanitize.disarm()
+    return LintResult(findings=findings, suppressed=[], baselined=[],
+                      expired=[], rules_run=list(sanitize.TSAN_RULES),
+                      files_scanned=0)
+
+
+def test_findings_render_text_json_sarif():
+    result = _seeded_result()
+    assert result.exit_code == 1
+
+    text = render_text(result)
+    assert "tsan-lockset" in text and "tests/test_sanitize.py" in text
+
+    doc = render_json(result)
+    assert doc["summary"]["findings"] == 1
+    assert set(sanitize.TSAN_RULES) <= set(doc["summary"]["rules_run"])
+    assert doc["findings"][0]["rule"] == "tsan-lockset"
+
+    sarif = render_sarif(result)
+    res = sarif["runs"][0]["results"]
+    assert [r["ruleId"] for r in res] == ["tsan-lockset"]
+    assert res[0]["locations"][0]["physicalLocation"]["artifactLocation"][
+        "uri"] == "tests/test_sanitize.py"
+
+
+# ---------------------------------------------------------------------------
+# arm/disarm hygiene
+
+
+def test_disarm_uninstalls_shims_and_restores_class():
+    sanitize.arm(guards=False, extra_classes=[_Racy])
+    assert isinstance(_Racy.__dict__["_table"],
+                      san_guards._GuardedAttribute)
+    obj = _Racy()
+    sanitize.disarm()
+    assert "_table" not in _Racy.__dict__       # descriptor removed
+    # instances straddling the boundary keep their state and go unchecked
+    obj._table["after"] = 1
+    fresh = _Racy()
+    fresh.bad_write()
+    assert sanitize.runtime().check() == []
+
+
+def test_rearm_fresh_window_clears_prior_findings():
+    sanitize.arm(guards=False, extra_classes=[_Racy])
+    _Racy().bad_write()
+    assert len(sanitize.disarm()) == 1
+    sanitize.arm(guards=False, extra_classes=[_Racy])
+    assert sanitize.disarm() == []              # fresh window
+
+
+def test_locks_constructed_while_disarmed_are_skipped_not_lied_about():
+    """An object built before arming holds raw stdlib locks: the shim
+    cannot see its holds, so it must SKIP the check (a report would be a
+    false positive), and arming must not crash on it."""
+    obj = _Racy()                      # built disarmed: raw threading.Lock
+    sanitize.arm(guards=False, extra_classes=[_Racy])
+    obj.bad_write()                    # unverifiable, not reported
+    with obj._lock:
+        obj._table["x"] = 1
+    assert sanitize.disarm() == []
+
+
+# ---------------------------------------------------------------------------
+# the loopback drill: armed == disarmed bitwise, and armed runs clean
+
+
+def _loopback_drill(steps=3):
+    """One small GA session served over the loopback net stack; returns
+    (final genome ndarray, final fitness ndarray, compile count)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from deap_tpu import base
+    from deap_tpu.ops import crossover, mutation, selection
+    from deap_tpu.serve import EvolutionService
+    from deap_tpu.serve.net import NetServer, RemoteService
+
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: (jnp.sum(g),))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+
+    key = jax.random.PRNGKey(13)
+    genome = (jax.random.uniform(key, (40, 8)) < 0.5).astype(jnp.float32)
+    pop = base.Population(genome=genome,
+                          fitness=base.Fitness.empty(40, (1.0,)))
+    with EvolutionService(max_batch=2) as svc, \
+            NetServer(svc, {"onemax": tb}) as srv, \
+            RemoteService(srv.url, timeout=120) as cli:
+        s = cli.open_session(key, pop, "onemax", cxpb=0.6, mutpb=0.3)
+        for f in s.step(steps):
+            f.result(timeout=120)
+        final = s.population()
+        compiles = svc.stats().counters["compiles"]
+        s.close()
+    return (np.asarray(final.genome), np.asarray(final.fitness.values),
+            compiles)
+
+
+@pytest.mark.serve
+@pytest.mark.net
+def test_armed_drill_is_bitwise_identical_and_clean():
+    """ISSUE 13 acceptance: the sanitizer observes, never perturbs — the
+    armed loopback drill's trajectory and compile counters are bitwise
+    identical to the disarmed run, and the armed run (full guard shims
+    on the real serve classes) reports ZERO findings."""
+    import numpy as np
+
+    g_off, f_off, c_off = _loopback_drill()
+
+    san = sanitize.arm()               # full default guards: serve fleet
+    try:
+        g_on, f_on, c_on = _loopback_drill()
+    finally:
+        findings = sanitize.disarm()
+
+    assert findings == [], render_text(LintResult(
+        findings=findings, suppressed=[], baselined=[], expired=[],
+        rules_run=list(sanitize.TSAN_RULES), files_scanned=0))
+    assert san.counts["guarded_checks"] > 0, \
+        "the guard shims never engaged -- the drill proved nothing"
+    assert san.counts["acquisitions"] > 0
+    assert np.array_equal(g_off, g_on)
+    assert np.array_equal(f_off, f_on)
+    assert c_off == c_on
+
+
+def test_analyze_threads_flag_is_standalone():
+    """``deap-tpu-analyze --threads`` refuses program names/--select/
+    --update-budget (it is a drill, not a pass over the inventory)."""
+    from deap_tpu.analysis.cli import main
+    assert main(["--threads", "ga_generation_scan"]) == 2
+    assert main(["--threads", "--update-budget"]) == 2
+
+
+def test_env_var_arms_factory_at_import():
+    """``DEAP_TPU_TSAN=1`` arms the factory from process start (services
+    constructed before any arm() call get instrumented primitives), and
+    without it the factory is stdlib — pinned in fresh subprocesses so
+    the import-time path is the one tested."""
+    import os
+    import subprocess
+    import sys
+
+    snippet = ("from deap_tpu import sanitize\n"
+               "print(sanitize.active(), type(sanitize.lock()).__name__)")
+    env_on = dict(os.environ, DEAP_TPU_TSAN="1")
+    env_off = {k: v for k, v in os.environ.items()
+               if k != "DEAP_TPU_TSAN"}
+    on = subprocess.run([sys.executable, "-c", snippet], env=env_on,
+                        capture_output=True, text=True, timeout=60)
+    off = subprocess.run([sys.executable, "-c", snippet], env=env_off,
+                         capture_output=True, text=True, timeout=60)
+    assert on.stdout.split() == ["True", "TsanLock"], on.stderr
+    assert off.stdout.split() == ["False", "lock"], off.stderr
+
+
+def test_stall_bound_does_not_leak_across_armed_windows():
+    """A test that tightens ``stall_s`` must not infect the next armed
+    window (the drills arm with the default): arm() without an explicit
+    bound resets to the class default."""
+    from deap_tpu.sanitize.runtime import ThreadSanitizer
+    sanitize.arm(guards=False, stall_s=0.1)
+    sanitize.disarm()
+    san = sanitize.arm(guards=False)
+    assert san.stall_s == ThreadSanitizer.DEFAULT_STALL_S
+    sanitize.disarm()

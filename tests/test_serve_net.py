@@ -167,7 +167,7 @@ def test_shape_histogram_and_derive_sizes():
         capped.rows_for(257)
 
 
-def test_adaptive_rebucket_zero_unplanned_recompiles():
+def test_adaptive_rebucket_zero_unplanned_recompiles(tsan):
     """After a rebucket() quiesce point (grid learned from the observed
     shape histogram, moved sessions re-padded, warm compiles counted),
     steady-state traffic of the observed shapes triggers ZERO further
@@ -378,7 +378,7 @@ def test_drain_timeout_raises_instead_of_partial_snapshot():
 # ---------------------------------------------------------------------------
 
 
-def test_failover_drill_cross_instance_bitwise():
+def test_failover_drill_cross_instance_bitwise(tsan):
     """N live sessions served over HTTP on instance A are drained,
     shipped through the wire protocol, restored on instance B, and
     continue bitwise-identically to an undisturbed reference run; A

@@ -75,7 +75,7 @@ def _final(session):
 # ---------------------------------------------------------------------------
 
 
-def test_weighted_fair_scheduler_order_and_quotas():
+def test_weighted_fair_scheduler_order_and_quotas(tsan):
     """Virtual-time tags grant in weighted order; backlog and session
     quotas raise the typed TenantQuotaExceeded."""
     sched = WeightedFairScheduler(
@@ -516,7 +516,7 @@ def _close_fleet(svcs, srvs, front=None):
         s.close()
 
 
-def test_fleet_drill_failover_bitwise_with_tenant_enforcement():
+def test_fleet_drill_failover_bitwise_with_tenant_enforcement(tsan):
     """ISSUE 12's in-gate drill (see module docstring)."""
     tb = onemax_toolbox()
     keys = jax.random.split(jax.random.PRNGKey(12), 2)

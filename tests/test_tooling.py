@@ -34,7 +34,8 @@ def test_lint_gate():
     report = json.loads(out.stdout)
     assert report["summary"]["findings"] == 0
     assert {"no-bare-print", "no-blocking-sleep", "lock-discipline",
-            "lock-order", "metric-discipline", "trace-impurity",
+            "lock-order", "sanitizer-factory", "guardedby-coverage",
+            "metric-discipline", "trace-impurity",
             "rng-key-reuse", "tracer-leak",
             "bench-json"} <= set(report["summary"]["rules_run"])
     assert "collective-budget" not in report["summary"]["rules_run"], \
@@ -50,8 +51,8 @@ def test_lint_gate_runs_without_jax():
     out = subprocess.run(
         [sys.executable, "-c",
          "import sys\n"
-         "from deap_tpu.lint import run_lint\n"
-         "r = run_lint()\n"
+         "from deap_tpu.lint import run_lint, load_baseline\n"
+         "r = run_lint(baseline=load_baseline('tools/lint_baseline.json'))\n"
          "assert 'jax' not in sys.modules, 'jax imported while linting'\n"
          "print(len(r.findings))"],
         capture_output=True, text=True, cwd=REPO, timeout=60)

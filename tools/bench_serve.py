@@ -37,6 +37,16 @@ interleaved blocks (so machine drift hits both legs equally), reporting
 the p50 delta as ``trace_overhead_pct`` — the committed
 ``BENCH_TRACE.json`` artifact, schema-gated by the ``bench-json`` lint
 pass and accepted at <= 5%.
+
+``--net --tsan`` measures the COST OF THE CONCURRENCY SANITIZER
+(``deap_tpu.sanitize`` under ``DEAP_TPU_TSAN=1``): interleaved legs
+that rebuild the loopback fleet with the sanitizer armed (instrumented
+locks, guarded-attribute shims, stall watchdog) vs off (stdlib
+primitives — the zero-overhead default), reporting the p50 round-trip
+delta as ``tsan_overhead_pct`` plus the armed legs' violation count
+(which must be 0 — the drill doubles as a clean run of the lockset
+detector over the real serving threads).  The committed artifact is
+``BENCH_TSAN.json``, schema-gated by the ``bench-json`` lint pass.
 """
 
 from __future__ import annotations
@@ -326,6 +336,102 @@ def run_trace_bench(sessions: int, pops, dims, max_batch: int, seed: int,
     }
 
 
+def run_tsan_bench(sessions: int, pops, dims, max_batch: int, seed: int,
+                   probes: int = 40, rounds: int = 3) -> dict:
+    """Concurrency-sanitizer overhead benchmark: loopback single-step
+    round trips with ``deap_tpu.sanitize`` armed vs off.  Unlike the
+    tracer (a live toggle), the sanitizer instruments locks at
+    CONSTRUCTION, so each leg rebuilds the fleet — armed legs construct
+    the service/server/client under ``sanitize.arm()`` (instrumented
+    primitives + guarded-attribute shims + watchdog) and ``disarm()``
+    afterwards, off legs get the stdlib-primitive default.  Legs
+    alternate per round so machine drift hits both equally; per-leg
+    construction and the warmup step are excluded from timing.  The
+    armed legs' findings are summed into ``violations`` — 0 is part of
+    the committed artifact's contract (the real serving drill runs clean
+    under the lockset detector)."""
+    from deap_tpu import sanitize
+    from deap_tpu.serve import EvolutionService
+    from deap_tpu.serve.net import NetServer, RemoteService
+
+    if sanitize.active():
+        # DEAP_TPU_TSAN=1 in the environment re-arms at every disarm(),
+        # so the "off" legs would silently run instrumented and the
+        # committed overhead would read ~0%
+        raise SystemExit("bench_serve --tsan arms/disarms the sanitizer "
+                         "itself: unset DEAP_TPU_TSAN and rerun")
+
+    tb = _toolbox()
+    specs = _fleet_specs(sessions, pops, dims, seed)
+    lat = {True: [], False: []}
+    violations = []
+    counts = {}
+
+    def leg_run(armed: bool) -> None:
+        san = sanitize.arm(stall_s=120.0) if armed else None
+        try:
+            with EvolutionService(max_batch=max_batch) as svc, \
+                    NetServer(svc, {"bench": tb}) as srv, \
+                    RemoteService(srv.url, timeout=600) as cli:
+                fleet = [cli.open_session(k, _population(k, n, d), "bench",
+                                          cxpb=0.7, mutpb=0.3)
+                         for k, n, d in specs]
+                for s in fleet:
+                    s.step()[0].result(timeout=600)      # warmup / AOT
+                for i in range(probes):
+                    t0 = time.perf_counter()
+                    fleet[i % len(fleet)].step(1)[0].result(timeout=600)
+                    lat[armed].append(time.perf_counter() - t0)
+        finally:
+            if armed:
+                violations.extend(sanitize.disarm())
+                for k, v in san.counts.items():
+                    counts[k] = counts.get(k, 0) + v
+
+    for r in range(rounds):
+        for armed in (True, False) if r % 2 == 0 else (False, True):
+            leg_run(armed)
+
+    def leg(samples):
+        ms = sorted(x * 1e3 for x in samples)
+
+        def pct(q):
+            if not ms:
+                return None      # --latency-probes 0 / --trace-rounds 0
+            return round(ms[min(len(ms) - 1,
+                                int(round(q * (len(ms) - 1))))], 3)
+        return {"roundtrip_p50_ms": pct(0.50),
+                "roundtrip_p90_ms": pct(0.90),
+                "roundtrip_p99_ms": pct(0.99),
+                "samples": len(ms)}
+
+    on, off = leg(lat[True]), leg(lat[False])
+    if on["roundtrip_p50_ms"] is None or off["roundtrip_p50_ms"] is None:
+        overhead = None
+    else:
+        overhead = round(
+            100.0 * (on["roundtrip_p50_ms"] - off["roundtrip_p50_ms"])
+            / max(off["roundtrip_p50_ms"], 1e-9), 3)
+    return {
+        "metric": "serve_net_tsan_overhead_pct",
+        "value": overhead,
+        "unit": "% p50 single-step round-trip delta, concurrency "
+                "sanitizer armed vs off (loopback --net)",
+        "config": {"sessions": sessions, "pops": pops, "dims": dims,
+                   "max_batch": max_batch, "probes_per_block": probes,
+                   "rounds": rounds,
+                   "note": "legs rebuild the fleet (locks instrument at "
+                           "construction), alternate per round; "
+                           "construction + warmup excluded"},
+        "tsan_on": on,
+        "tsan_off": off,
+        "tsan_overhead_pct": overhead,
+        "violations": len(violations),
+        "violation_rules": sorted({f.rule for f in violations}),
+        "sanitizer": counts,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="bench_serve",
@@ -350,13 +456,29 @@ def main(argv=None) -> int:
                          "on vs off in interleaved blocks) -- the "
                          "BENCH_TRACE.json artifact")
     ap.add_argument("--trace-rounds", type=int, default=3,
-                    help="--trace: interleaved on/off block pairs")
+                    help="--trace/--tsan: interleaved on/off block pairs")
+    ap.add_argument("--tsan", action="store_true",
+                    help="with --net: measure the concurrency-sanitizer "
+                         "overhead instead (p50 round-trip delta, "
+                         "deap_tpu.sanitize armed vs off in interleaved "
+                         "fleet rebuilds) -- the BENCH_TSAN.json "
+                         "artifact")
     ap.add_argument("--out", default=None,
                     help="also write the JSON report to this path")
     args = ap.parse_args(argv)
+    if args.tsan and not args.net:
+        ap.error("--tsan requires --net (the sanitizer-overhead legs "
+                 "measure the loopback network path)")
 
     import jax
-    if args.net and args.trace:
+    if args.net and args.tsan:
+        report = run_tsan_bench(args.sessions,
+                                [int(p) for p in args.pops.split(",")],
+                                [int(d) for d in args.dims.split(",")],
+                                args.max_batch, args.seed,
+                                probes=args.latency_probes,
+                                rounds=args.trace_rounds)
+    elif args.net and args.trace:
         report = run_trace_bench(args.sessions,
                                  [int(p) for p in args.pops.split(",")],
                                  [int(d) for d in args.dims.split(",")],
@@ -380,6 +502,8 @@ def main(argv=None) -> int:
     if args.out:
         Path(args.out).write_text(text + "\n")
     print(text)
+    if report.get("violations"):
+        return 1      # --tsan: the drill must run clean to be committed
     return 0 if report.get("bitwise_identical", True) else 1
 
 
