@@ -11,6 +11,10 @@ the AST tier is ``deap-tpu-lint``).
     deap-tpu-analyze --update-budget      # refresh tools/program_budget.json
                                           # AND tools/memory_budget.json
     deap-tpu-analyze --list               # inventory catalog
+    deap-tpu-analyze --profile            # AOT cost/memory profiles of the
+                                          # inventory (JSON) — provenance
+                                          # for the serving profiler's
+                                          # per-program records
     deap-tpu-analyze --threads            # runtime concurrency sanitizer
                                           # drill (deap_tpu.sanitize) over
                                           # a loopback serve fleet
@@ -78,6 +82,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "tools/memory_budget.json)")
     ap.add_argument("--list", action="store_true", dest="list_programs",
                     help="print the inventory catalog and exit")
+    ap.add_argument("--profile", action="store_true",
+                    help="lower + compile the inventory (or the named "
+                         "programs) and print each entry's AOT "
+                         "cost/memory profile as JSON (flops, bytes "
+                         "accessed, peak-bytes upper bound, collective "
+                         "counts) — the provenance record the serving "
+                         "profiler's per-program /v1/profile table joins "
+                         "against")
     ap.add_argument("--threads", action="store_true",
                     help="run the runtime concurrency sanitizer instead: "
                          "arm deap_tpu.sanitize (lockset race detection, "
@@ -178,6 +190,20 @@ def main(argv=None) -> int:
     from .passes import (MEMORY_BUDGET_PATH, PROGRAM_BUDGET_PATH,
                          run_analysis, update_memory_budget,
                          update_program_budget)
+
+    if args.profile:
+        if args.select or args.update_budget:
+            print("deap-tpu-analyze: --profile takes only program names "
+                  "(no --select / --update-budget)", file=sys.stderr)
+            return 2
+        from ..observability.profiling import aot_cost_summary
+        out = {}
+        for e in entries(args.programs or None):
+            low = lower_entry(e)
+            out[e.name] = {"anchor": e.anchor,
+                           **aot_cost_summary(low.compiled())}
+        print(json.dumps({"programs": out}, indent=2, sort_keys=True))
+        return 0
 
     if args.list_programs:
         for e in entries():

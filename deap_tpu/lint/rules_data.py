@@ -40,7 +40,8 @@ def bench_json_targets(repo: Path) -> List[Tuple[str, Path]]:
     schema."""
     out: List[Tuple[str, Path]] = []
     _SPECIAL = {"BENCH_TRACE.json": "trace", "BENCH_MEMORY.json": "memory",
-                "BENCH_FLEET.json": "fleet", "BENCH_TSAN.json": "tsan"}
+                "BENCH_FLEET.json": "fleet", "BENCH_TSAN.json": "tsan",
+                "BENCH_PROFILE.json": "profile"}
     for p in sorted(repo.glob("BENCH_*.json")):
         out.append((_SPECIAL.get(p.name, "bench"), p))
     for p in sorted(repo.glob("MULTICHIP_*.json")):
@@ -48,6 +49,9 @@ def bench_json_targets(repo: Path) -> List[Tuple[str, Path]]:
     budget = repo / "tools" / "collective_budget.json"
     if budget.exists():
         out.append(("budget", budget))
+    ledger = repo / "PERF_LEDGER.json"
+    if ledger.exists():
+        out.append(("perf_ledger", ledger))
     return out
 
 
@@ -158,6 +162,41 @@ def _schema_errors(kind: str, doc) -> List[str]:
                           "artifact is the clean-drill proof; a nonzero "
                           "count means the serving fleet raced under the "
                           "sanitizer and must not be committed")
+    elif kind == "profile":
+        # BENCH_PROFILE.json: the device-phase profiler overhead record
+        # from ``tools/bench_serve.py --net --profile`` — a metric
+        # triple plus the two interleaved loopback legs (profiler
+        # on/off), mirroring the trace/tsan schemas so a malformed
+        # commit fails tier-1
+        require("metric", str, "a string")
+        value = require("value", (int, float), "a number")
+        require("unit", str, "a string")
+        if isinstance(value, float) and not math.isfinite(value):
+            errors.append("key 'value' must be finite")
+        for leg in ("profiled", "unprofiled"):
+            sub = doc.get(leg)
+            if not isinstance(sub, dict):
+                errors.append(f"key '{leg}' must be an object with the "
+                              "leg's latency quantiles")
+                continue
+            p50 = sub.get("roundtrip_p50_ms")
+            if isinstance(p50, bool) or not isinstance(p50, (int, float)) \
+                    or not math.isfinite(float(p50)):
+                errors.append(f"key '{leg}.roundtrip_p50_ms' must be a "
+                              "finite number")
+        programs = doc.get("programs_profiled")
+        if isinstance(programs, bool) or not isinstance(programs, int) \
+                or programs < 1:
+            errors.append("key 'programs_profiled' must be a positive "
+                          "integer (the profiled legs must actually have "
+                          "profiled something)")
+    elif kind == "perf_ledger":
+        # PERF_LEDGER.json: the perf-regression ledger deap-tpu-perfgate
+        # enforces — one schema, two gates (deap_tpu.perfledger is the
+        # shared jax-free validator): finite metrics, band in (0, 1],
+        # provenance required, baseline/history well-formed
+        from ..perfledger import ledger_schema_errors
+        errors.extend(ledger_schema_errors(doc))
     elif kind == "memory":
         # BENCH_MEMORY.json: the footprint-trajectory record from
         # tools/bench_memory.py — runner status (int rc / bool ok) plus

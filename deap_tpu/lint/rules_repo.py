@@ -37,6 +37,8 @@ SANCTIONED_PRINT_MODULES = {
     "observability/cli.py",
     "serve/cli.py",
     "serve/router/cli.py",
+    "serve/top.py",
+    "perfledger.py",
     "selftest.py",
     "resilience/faultdrill.py",
     "native/build.py",

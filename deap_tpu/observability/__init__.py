@@ -18,10 +18,19 @@ runtime where an entire evolution run is one ``lax.scan`` dispatch:
   :class:`TensorBoardSink`), process-0-only on multihost;
 * :mod:`~deap_tpu.observability.tracing` — wall-clock + profiler spans,
   AOT compile-vs-execute phase timers, ``capture_trace``, device-memory
-  reports; surfaced by the ``deap-tpu-trace`` console entry.
+  reports; surfaced by the ``deap-tpu-trace`` console entry;
+* :mod:`~deap_tpu.observability.profiling` — device-phase profiles of
+  compiled serving programs: XLA cost/memory analyses at AOT time,
+  min-of-k measured execute walls at runtime, and the roofline
+  transfer/compute/collective split of the ``device_execute`` span;
+  served per program key at ``/v1/profile``.
 """
 
-from . import events, fleettrace, metrics, sinks, telemetry, tracing   # noqa: F401
+from . import (events, fleettrace, metrics, profiling, sinks,  # noqa: F401
+               telemetry, tracing)
+from .profiling import (ProgramProfiler, ProgramProfile,  # noqa: F401
+                        aot_cost_summary, phase_split,
+                        describe_program_key)
 from .fleettrace import (FleetTracer, TraceContext, SpanRecord,  # noqa: F401
                          new_trace_id, new_span_id)
 from .metrics import (MetricBuffer, buffer_init, cross_host_sum,  # noqa: F401
@@ -43,4 +52,6 @@ __all__ = [
     "Telemetry", "STANDARD_COUNTERS", "STANDARD_GAUGES",
     "Span", "span", "PhaseTimes", "aot_phase_times", "capture_trace",
     "device_memory_report",
+    "ProgramProfiler", "ProgramProfile", "aot_cost_summary", "phase_split",
+    "describe_program_key",
 ]

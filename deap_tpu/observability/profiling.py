@@ -1,0 +1,375 @@
+"""Device-phase profiling of compiled serving programs.
+
+PR 9's fleet tracing made every request's story visible — but its
+``device_execute`` span is an opaque wall-clock blob: nothing says how
+much of it was memory traffic, arithmetic, or collectives, and nothing
+tracks a compiled program's cost trajectory over time.  This module is
+the missing breakdown, built from two honest sources:
+
+* **AOT cost model** (:func:`aot_cost_summary`) — XLA's own
+  ``cost_analysis()`` (flops, bytes accessed) and ``memory_analysis()``
+  (argument/output/temp/alias bytes, the ``peak_bytes_upper_bound``
+  formula ``tools/bench_donation.py`` committed) plus the optimized
+  HLO's collective instruction counts (the jax-free counter in
+  :mod:`deap_tpu.analysis.hlo` — the same rule the collective budgets
+  gate), all captured ONCE at compile time;
+* **measured runtime** (:class:`ProgramProfiler`) — per-program
+  min-of-k wall time over the recent execute window (min-of-k is the
+  repo's standing noise defense: the minimum is the run least disturbed
+  by the timeshared host), observed at the exact ``device_execute``
+  bounds the fleettrace span records.
+
+The split of one measured wall into transfer/compute/collective
+components (:func:`phase_split`) is a **normalized roofline model**,
+not a measurement: nominal per-backend throughputs convert the AOT
+flop/byte/collective counts into model seconds, which are then scaled
+so the components sum to the measured min-of-k wall.  The absolute
+numbers are estimates; their *ratios* (is this program memory-bound?
+did the collective share triple after a refit?) are the signal, and the
+raw inputs ride alongside so nothing is laundered.
+
+Everything here is host-side bookkeeping on the serving control plane:
+the profiler never touches a traced value and a disabled profiler
+(``enabled=False``) reduces every entry point to one attribute check —
+compiled programs and trajectories are bitwise identical either way
+(pinned by ``tests/test_profiling.py``, overhead committed in
+``BENCH_PROFILE.json`` via ``tools/bench_serve.py --net --profile``).
+
+Provenance: the same :func:`aot_cost_summary` runs over the canonical
+program inventory via ``deap-tpu-analyze --profile``, so a serving
+profile can be diffed against the committed inventory's cost records.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import math
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+from .. import sanitize
+# jax-free HLO text analyzers (the analysis package init is lazy, so
+# this pulls in no compiled-inventory machinery)
+from ..analysis import hlo as _hlo
+
+__all__ = ["ProgramProfiler", "ProgramProfile", "aot_cost_summary",
+           "phase_split", "describe_program_key", "NOMINAL_THROUGHPUT"]
+
+#: nominal (flops/s, bytes/s, seconds-per-collective) per backend — the
+#: roofline model's conversion constants.  Deliberately round numbers:
+#: they exist to apportion ONE measured wall into component shares, not
+#: to predict absolute times (the measured wall stays authoritative).
+NOMINAL_THROUGHPUT: Dict[str, tuple] = {
+    "cpu": (5e10, 2e10, 5e-6),
+    "gpu": (5e13, 1.5e12, 5e-6),
+    "tpu": (2e14, 1.2e12, 2e-6),
+}
+
+#: optimized-HLO text above this size skips the collective count (the
+#: regex walk over a many-MB megakernel dump is not worth one counter)
+_MAX_HLO_SCAN_BYTES = 4 * 1024 * 1024
+
+
+def _backend_name() -> str:
+    try:
+        import jax
+        return jax.default_backend()
+    except Exception:  # noqa: BLE001 — profiling must never fail a dispatch
+        return "cpu"
+
+
+def _finite(x) -> Optional[float]:
+    try:
+        v = float(x)
+    except (TypeError, ValueError):
+        return None
+    return v if math.isfinite(v) else None
+
+
+def describe_program_key(kind: str, program_key: tuple) -> str:
+    """Stable, readable name for one serve program key.
+
+    The service's keys are tuples mixing ``id()`` pins, bucket records
+    and genome signatures — process-local and unreadable.  This renders
+    the SHAPE identity (kind, bucket rows/nobj, sharded placement) in
+    clear text and folds the full key into a short digest suffix so two
+    same-shaped programs of different toolboxes stay distinct::
+
+        step[rows=64,nobj=1]#3f9a2c
+        step.sharded[rows=128,nobj=2]#b01d77
+        evaluate[rows=64,nobj=1]#8c44e1
+    """
+    rows = nobj = None
+    sharded = bool(program_key) and program_key[0] == "sharded"
+    for part in program_key:
+        r = getattr(part, "rows", None)
+        if r is not None:
+            rows, nobj = int(r), int(getattr(part, "nobj", 0))
+            break
+    if rows is None and kind == "evaluate" and len(program_key) >= 4:
+        # evaluate keys carry (id, sig, rows, nobj) as plain ints
+        rows, nobj = int(program_key[2]), int(program_key[3])
+    shape = (f"[rows={rows},nobj={nobj}]" if rows is not None else "[]")
+    digest = hashlib.blake2b(
+        repr((kind, program_key)).encode("utf-8"),
+        digest_size=3).hexdigest()
+    return f"{kind}{'.sharded' if sharded else ''}{shape}#{digest}"
+
+
+def aot_cost_summary(compiled, *, collectives: bool = True
+                     ) -> Dict[str, Any]:
+    """Cost/memory record of one compiled executable, from XLA's own
+    analyses — captured once at compile time, degrade-to-absent on
+    backends that implement neither API (a missing key means "the
+    backend would not say", never a fabricated zero)."""
+    out: Dict[str, Any] = {}
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — backend-optional API
+        ca = None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if isinstance(ca, dict):
+        flops = _finite(ca.get("flops"))
+        if flops is not None:
+            out["flops"] = flops
+        nbytes = _finite(ca.get("bytes accessed"))
+        if nbytes is not None:
+            out["bytes_accessed"] = nbytes
+        if out.get("flops") and out.get("bytes_accessed"):
+            out["arithmetic_intensity"] = round(
+                out["flops"] / max(out["bytes_accessed"], 1.0), 4)
+    try:
+        m = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — backend-optional API
+        m = None
+    if m is not None:
+        for attr, key in (("argument_size_in_bytes", "argument_bytes"),
+                          ("output_size_in_bytes", "output_bytes"),
+                          ("temp_size_in_bytes", "temp_bytes"),
+                          ("alias_size_in_bytes", "alias_bytes"),
+                          ("generated_code_size_in_bytes", "code_bytes")):
+            v = getattr(m, attr, None)
+            if v is not None:
+                out[key] = int(v)
+        if {"argument_bytes", "output_bytes"} <= set(out):
+            # the bench_donation formula: args + outputs + temps − aliased
+            out["peak_bytes_upper_bound"] = (
+                out["argument_bytes"] + out["output_bytes"]
+                + out.get("temp_bytes", 0) - out.get("alias_bytes", 0))
+    if collectives:
+        try:
+            txt = compiled.as_text()
+        except Exception:  # noqa: BLE001 — backend-optional API
+            txt = None
+        if txt and len(txt) <= _MAX_HLO_SCAN_BYTES:
+            # cheap substring pre-filter: single-device programs (the
+            # overwhelming majority) contain no collective opcode at
+            # all, and the per-line regex walk over a megakernel dump
+            # is the dominant cost of this one-time summary
+            if any(op in txt for op in _hlo.COLLECTIVES):
+                ops = _hlo.collective_ops(txt)
+            else:
+                ops = {}
+            out["collectives"] = dict(sorted(ops.items()))
+            out["collective_count"] = int(sum(ops.values()))
+    return out
+
+
+def phase_split(aot: Dict[str, Any], measured_s: Optional[float],
+                backend: Optional[str] = None) -> Dict[str, float]:
+    """Apportion one measured device wall into transfer/compute/
+    collective component estimates (see module docstring: a normalized
+    roofline model — the ratios are the signal).  ``{}`` when the AOT
+    record or the measurement cannot support a split."""
+    if not measured_s or measured_s <= 0.0:
+        return {}
+    peak_flops, peak_bw, coll_s = NOMINAL_THROUGHPUT.get(
+        backend or _backend_name(), NOMINAL_THROUGHPUT["cpu"])
+    t_compute = float(aot.get("flops") or 0.0) / peak_flops
+    t_transfer = float(aot.get("bytes_accessed") or 0.0) / peak_bw
+    t_coll = float(aot.get("collective_count") or 0) * coll_s
+    total = t_compute + t_transfer + t_coll
+    if total <= 0.0:
+        return {}
+    scale = measured_s / total
+    return {"compute_s_est": t_compute * scale,
+            "transfer_s_est": t_transfer * scale,
+            "collective_s_est": t_coll * scale,
+            "compute_frac": round(t_compute / total, 4),
+            "transfer_frac": round(t_transfer / total, 4),
+            "collective_frac": round(t_coll / total, 4)}
+
+
+@dataclasses.dataclass
+class ProgramProfile:
+    """One compiled program's profile: the AOT cost record plus the
+    measured execute-wall statistics (min-of-k over the recent
+    window)."""
+
+    key: str
+    kind: str
+    aot: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    compile_s: Optional[float] = None
+    calls: int = 0
+    device_total_s: float = 0.0
+    device_min_s: Optional[float] = None      # all-time minimum
+    window: deque = dataclasses.field(
+        default_factory=lambda: deque(maxlen=64))
+
+    def observe(self, seconds: float) -> None:
+        seconds = float(seconds)
+        self.calls += 1
+        self.device_total_s += seconds
+        if self.device_min_s is None or seconds < self.device_min_s:
+            self.device_min_s = seconds
+        self.window.append(seconds)
+
+    def window_stats(self) -> Dict[str, float]:
+        if not self.window:
+            return {}
+        w = sorted(self.window)
+        return {"k": len(w),
+                "min_s": w[0],
+                "p50_s": w[len(w) // 2],
+                "max_s": w[-1]}
+
+    def as_dict(self, backend: Optional[str] = None) -> Dict[str, Any]:
+        win = self.window_stats()
+        out: Dict[str, Any] = {
+            "kind": self.kind,
+            "calls": self.calls,
+            "device_total_s": round(self.device_total_s, 6),
+        }
+        if self.compile_s is not None:
+            out["compile_s"] = round(self.compile_s, 6)
+        if self.device_min_s is not None:
+            out["device_min_s"] = round(self.device_min_s, 6)
+        if win:
+            out["window"] = {k: (v if k == "k" else round(v, 6))
+                             for k, v in win.items()}
+        if self.aot:
+            out["aot"] = dict(self.aot)
+            split = phase_split(self.aot, win.get("min_s"), backend)
+            if split:
+                out["phase_split"] = {
+                    k: (round(v, 9) if k.endswith("_est") else v)
+                    for k, v in split.items()}
+        return out
+
+
+class ProgramProfiler:
+    """Thread-safe per-program profile store for one serving process.
+
+    The service calls :meth:`observe_compile` once per AOT compile
+    (beside its ``compiles*`` counters, so profile records and compile
+    counters always join on the same event) and :meth:`observe_execute`
+    at the same bounds its ``device_execute`` trace span uses.  Scrapers
+    read :meth:`profiles` (``/v1/profile``, the metrics snapshot's
+    ``meta["programs"]`` table, the Prometheus program series).
+
+    ``enabled`` is a live toggle like the tracer's: disabled, both
+    observe paths are one attribute check and the store stays empty.
+    """
+
+    #: lock-guarded shared state (``lock-discipline`` lint): the profile
+    #: table and the key-description memo are written by the dispatch
+    #: worker (observes) and read by scraper/handler threads
+    #: (profiles/aggregates)
+    _GUARDED_BY = {"_lock": ("_profiles", "_descs")}
+
+    def __init__(self, *, enabled: bool = True, window: int = 64,
+                 clock=time.monotonic, collectives: bool = True):
+        self.enabled = bool(enabled)
+        self.clock = clock
+        self.window = int(window)
+        self.collectives = bool(collectives)
+        self._lock = sanitize.lock()
+        self._profiles: Dict[str, ProgramProfile] = {}
+        # program keys repeat for every dispatch of a warm program: the
+        # repr+digest rendering is memoized so the steady-state observe
+        # path is one dict hit (bounded: one entry per compiled program)
+        self._descs: Dict[tuple, str] = {}
+
+    # -- writers (dispatch worker) -------------------------------------------
+
+    def _describe_locked(self, kind: str, program_key: tuple) -> str:
+        memo_key = (kind, program_key)
+        desc = self._descs.get(memo_key)
+        if desc is None:
+            desc = self._descs[memo_key] = describe_program_key(
+                kind, program_key)
+        return desc
+
+    def _profile_locked(self, desc: str, kind: str) -> ProgramProfile:
+        p = self._profiles.get(desc)
+        if p is None:
+            p = self._profiles[desc] = ProgramProfile(
+                key=desc, kind=kind,
+                window=deque(maxlen=self.window))
+        return p
+
+    def observe_compile(self, kind: str, program_key: tuple, compiled,
+                        compile_s: float) -> Optional[str]:
+        """Record one AOT compile: cost/memory analyses captured now
+        (one-time, off the steady-state path) under the program's
+        readable key."""
+        if not self.enabled:
+            return None
+        aot = aot_cost_summary(compiled, collectives=self.collectives)
+        with self._lock:
+            desc = self._describe_locked(kind, program_key)
+            p = self._profile_locked(desc, kind)
+            p.aot = aot
+            p.compile_s = float(compile_s)
+        return desc
+
+    def observe_execute(self, kind: str, program_key: tuple,
+                        seconds: float) -> Optional[Dict[str, Any]]:
+        """Record one measured device-execute wall; returns the compact
+        attr dict the ``device_execute`` trace span attaches (program
+        key + AOT flop/byte counts), ``None`` when disabled."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            desc = self._describe_locked(kind, program_key)
+            p = self._profile_locked(desc, kind)
+            p.observe(seconds)
+            aot = p.aot
+        attrs: Dict[str, Any] = {"program": desc}
+        for k in ("flops", "bytes_accessed", "collective_count"):
+            if k in aot:
+                attrs[k] = aot[k]
+        return attrs
+
+    # -- readers (scraper threads) -------------------------------------------
+
+    def profiles(self) -> Dict[str, Dict[str, Any]]:
+        """``{program key: profile dict}`` snapshot (phase split
+        included where the AOT record and a measured window exist)."""
+        with self._lock:
+            items = [(k, dataclasses.replace(p, window=deque(p.window)))
+                     for k, p in self._profiles.items()]
+        backend = _backend_name()
+        return {k: p.as_dict(backend) for k, p in sorted(items)}
+
+    def aggregates(self) -> Dict[str, float]:
+        """Fleet-gauge rollup: program count plus summed flop/byte and
+        max-peak footprints over every profiled program."""
+        with self._lock:
+            profs = list(self._profiles.values())
+        flops = sum(p.aot.get("flops") or 0.0 for p in profs)
+        nbytes = sum(p.aot.get("bytes_accessed") or 0.0 for p in profs)
+        peak = max((p.aot.get("peak_bytes_upper_bound") or 0
+                    for p in profs), default=0)
+        return {"programs": float(len(profs)),
+                "flops_total": float(flops),
+                "bytes_accessed_total": float(nbytes),
+                "peak_bytes_max": float(peak)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._profiles.clear()
+            self._descs.clear()

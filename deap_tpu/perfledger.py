@@ -1,0 +1,356 @@
+"""Perf-regression ledger: the committed ``BENCH_*.json`` trajectory as
+an enforced contract (``deap-tpu-perfgate``).
+
+The repo carries a dozen committed benchmark artifacts — the GA
+gens/sec series (``BENCH_r*.json``), serving throughput and loopback
+latency (``BENCH_SERVE``/``BENCH_NET``), the tracing/sanitizer/profiler
+overhead records, weak-scaling overheads, memory footprints, the fleet
+drill — but until this module their trajectory lived as prose in
+``CHANGES.md``: nothing machine-readable said what the tracked metrics
+ARE, what their last known-good values were, or how much noise each
+measurement carries.  ``PERF_LEDGER.json`` is that record, and
+``deap-tpu-perfgate`` is its gate:
+
+* each **tracked metric** names the artifact (glob — series like
+  ``BENCH_r*.json`` track their latest file), the JSON path of the
+  value inside it, the regression **direction** (``higher`` = bigger is
+  better, ``lower`` = smaller is better), a relative noise **band**
+  (``0 < band <= 1`` — the measured spread of that benchmark on the
+  timeshared hosts the repo benches on), and a human **provenance**
+  line recording how the number was measured (min-of-k interleaved
+  legs, marginal timing, deterministic compiler output, ...);
+* the **baseline** is the last value ``--update`` blessed, and
+  ``history`` keeps one entry per artifact file so the whole committed
+  series stays diffable after old artifacts are pruned;
+* the **gate** re-extracts every tracked value from the working tree
+  and fails (rc=1) when a value regresses past its tolerance: beyond
+  ``baseline*(1±band)`` in the bad direction — or past the metric's
+  absolute ``max_value``/``min_value`` bar when one is declared (the
+  overhead metrics use absolute bars: a 1%→3% tracing-overhead change
+  is inside measurement noise of a ≤5% budget, and a relative band
+  around a near-zero baseline would reject it).
+
+Workflow: commit a new bench artifact → ``deap-tpu-perfgate`` compares
+it against the ledger in tier-1 (and at pre-push) → a regression beyond
+band fails the commit; an intentional change (or a real improvement)
+is blessed with ``deap-tpu-perfgate --update``, which rewrites
+baselines + history from the current tree.
+
+This module is **jax-free** (stdlib only, <1s on the whole artifact
+set) so the gate runs beside the AST lint on any box; the ledger's
+schema is additionally enforced by the ``bench-json`` lint pass (via
+:func:`ledger_schema_errors` — one schema, two gates).  Its stdout is
+its interface (sanctioned print site, like ``lint/cli.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["DEFAULT_LEDGER", "ledger_schema_errors", "resolve_path",
+           "artifact_series", "evaluate_ledger", "update_ledger", "main"]
+
+DEFAULT_LEDGER = "PERF_LEDGER.json"
+
+_DIRECTIONS = ("higher", "lower")
+
+
+# ---------------------------------------------------------------------------
+# schema (shared with the bench-json lint pass)
+# ---------------------------------------------------------------------------
+
+
+def _is_finite_number(v) -> bool:
+    return (not isinstance(v, bool) and isinstance(v, (int, float))
+            and math.isfinite(float(v)))
+
+
+def ledger_schema_errors(doc: Any) -> List[str]:
+    """Schema violations of one parsed ``PERF_LEDGER.json`` document —
+    the single source of truth for both ``deap-tpu-perfgate`` (rc=2 on
+    a malformed ledger) and the ``bench-json`` lint pass (a malformed
+    ledger commit fails tier-1)."""
+    errors: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"top level must be a JSON object, got "
+                f"{type(doc).__name__}"]
+    if not isinstance(doc.get("version"), int) \
+            or isinstance(doc.get("version"), bool):
+        errors.append("key 'version' must be an integer")
+    metrics = doc.get("metrics")
+    if not isinstance(metrics, dict) or not metrics:
+        errors.append("key 'metrics' must be a non-empty object "
+                      "{name: spec}")
+        return errors
+    for name, spec in metrics.items():
+        where = f"metrics[{name!r}]"
+        if not isinstance(spec, dict):
+            errors.append(f"{where} must be an object")
+            continue
+        for key in ("artifact", "path", "provenance"):
+            v = spec.get(key)
+            if not isinstance(v, str) or not v.strip():
+                errors.append(f"{where}.{key} must be a non-empty string "
+                              "(provenance records HOW the number was "
+                              "measured)")
+        if spec.get("direction") not in _DIRECTIONS:
+            errors.append(f"{where}.direction must be one of "
+                          f"{_DIRECTIONS}")
+        band = spec.get("band")
+        if not _is_finite_number(band) or not (0.0 < float(band) <= 1.0):
+            errors.append(f"{where}.band must be a number in (0, 1] "
+                          "(the metric's relative noise tolerance)")
+        for key in ("max_value", "min_value"):
+            if key in spec and not _is_finite_number(spec[key]):
+                errors.append(f"{where}.{key} must be a finite number")
+        base = spec.get("baseline")
+        if not isinstance(base, dict) \
+                or not isinstance(base.get("artifact"), str) \
+                or not _is_finite_number(base.get("value")):
+            errors.append(f"{where}.baseline must be "
+                          "{'artifact': str, 'value': finite number}")
+        hist = spec.get("history")
+        if not isinstance(hist, list):
+            errors.append(f"{where}.history must be a list")
+        else:
+            for i, row in enumerate(hist):
+                if not isinstance(row, dict) \
+                        or not isinstance(row.get("artifact"), str) \
+                        or not _is_finite_number(row.get("value")):
+                    errors.append(
+                        f"{where}.history[{i}] must be "
+                        "{'artifact': str, 'value': finite number}")
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# extraction
+# ---------------------------------------------------------------------------
+
+
+def resolve_path(doc: Any, dotted: str):
+    """Walk ``a.b.0.c`` through dicts and lists; raises ``KeyError``
+    with the failing segment."""
+    node = doc
+    for seg in dotted.split("."):
+        if isinstance(node, list):
+            try:
+                node = node[int(seg)]
+                continue
+            except (ValueError, IndexError):
+                raise KeyError(f"segment {seg!r} of {dotted!r} does not "
+                               "index the list")
+        if not isinstance(node, dict) or seg not in node:
+            raise KeyError(f"segment {seg!r} of {dotted!r} missing")
+        node = node[seg]
+    return node
+
+
+def artifact_series(repo: Path, pattern: str, path: str
+                    ) -> List[Tuple[str, Optional[float], Optional[str]]]:
+    """``(artifact name, value, error)`` for every file matching
+    ``pattern`` (sorted by name — the rXX series' natural order).  A
+    file whose JSON or path fails contributes an error string instead
+    of a value; the caller decides whether that file is load-bearing
+    (the latest is; historical files are best-effort)."""
+    out: List[Tuple[str, Optional[float], Optional[str]]] = []
+    for p in sorted(repo.glob(pattern)):
+        try:
+            doc = json.loads(p.read_text())
+            value = resolve_path(doc, path)
+        except (ValueError, KeyError) as e:
+            out.append((p.name, None, str(e)))
+            continue
+        if not _is_finite_number(value):
+            out.append((p.name, None,
+                        f"value at {path!r} is not a finite number: "
+                        f"{value!r}"))
+            continue
+        out.append((p.name, float(value), None))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+
+
+def _tolerance(spec: Dict[str, Any]) -> Tuple[float, str]:
+    """(limit, description) of the metric's regression bar.  An absolute
+    ``max_value``/``min_value`` bar — when declared — replaces the
+    relative band: overhead-percentage metrics sit near zero, where a
+    relative band would reject changes far inside their real budget."""
+    direction = spec["direction"]
+    base = float(spec["baseline"]["value"])
+    band = float(spec["band"])
+    if direction == "lower":
+        if "max_value" in spec:
+            return float(spec["max_value"]), \
+                f"absolute bar {spec['max_value']}"
+        limit = base * (1.0 + band)
+        return limit, f"baseline {base:g} * (1+{band:g})"
+    if "min_value" in spec:
+        return float(spec["min_value"]), f"absolute bar {spec['min_value']}"
+    limit = base * (1.0 - band)
+    return limit, f"baseline {base:g} * (1-{band:g})"
+
+
+def evaluate_ledger(repo: Path, doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """One result row per tracked metric: ``status`` is ``ok`` /
+    ``improved`` (beyond band in the GOOD direction — informational) /
+    ``regressed`` / ``error`` (artifact missing or unreadable)."""
+    results: List[Dict[str, Any]] = []
+    for name in sorted(doc["metrics"]):
+        spec = doc["metrics"][name]
+        series = artifact_series(repo, spec["artifact"], spec["path"])
+        row: Dict[str, Any] = {"metric": name,
+                               "direction": spec["direction"],
+                               "baseline": float(spec["baseline"]["value"])}
+        if not series:
+            row.update(status="error",
+                       detail=f"no artifact matches {spec['artifact']!r}")
+            results.append(row)
+            continue
+        artifact, value, err = series[-1]
+        row["artifact"] = artifact
+        if err is not None:
+            row.update(status="error", detail=err)
+            results.append(row)
+            continue
+        row["value"] = value
+        limit, how = _tolerance(spec)
+        row["limit"] = limit
+        base = row["baseline"]
+        band = float(spec["band"])
+        if spec["direction"] == "lower":
+            regressed = value > limit
+            improved = value < base * (1.0 - band)
+        else:
+            regressed = value < limit
+            improved = value > base * (1.0 + band)
+        if regressed:
+            row.update(status="regressed",
+                       detail=f"{value:g} is past {how} = {limit:g}")
+        elif improved:
+            row.update(status="improved",
+                       detail=f"{value:g} beats baseline {base:g} beyond "
+                              f"the {band:g} band — bless it with "
+                              "--update")
+        else:
+            row.update(status="ok")
+        results.append(row)
+    return results
+
+
+def update_ledger(repo: Path, doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Rebless: baseline := the latest artifact's current value, and
+    ``history`` merged with one row per artifact file present in the
+    tree (rows for artifacts since deleted are preserved — the ledger
+    is the durable record).  The latest artifact must extract cleanly;
+    a broken historical file is skipped."""
+    out = json.loads(json.dumps(doc))    # deep copy, JSON-clean
+    for name, spec in out["metrics"].items():
+        series = artifact_series(repo, spec["artifact"], spec["path"])
+        live = [(a, v) for a, v, err in series if err is None]
+        if not series:
+            raise FileNotFoundError(
+                f"metric {name!r}: no artifact matches "
+                f"{spec['artifact']!r}")
+        latest_name, latest_value, latest_err = series[-1]
+        if latest_err is not None:
+            raise ValueError(f"metric {name!r}: latest artifact "
+                             f"{latest_name} unreadable: {latest_err}")
+        spec["baseline"] = {"artifact": latest_name, "value": latest_value}
+        merged = {row["artifact"]: row["value"]
+                  for row in spec.get("history", ())}
+        merged.update(dict(live))
+        spec["history"] = [{"artifact": a, "value": merged[a]}
+                           for a in sorted(merged)]
+    return out
+
+
+def render_text(results: List[Dict[str, Any]]) -> str:
+    lines = []
+    width = max((len(r["metric"]) for r in results), default=10)
+    for r in results:
+        val = f"{r['value']:g}" if "value" in r else "-"
+        mark = {"ok": "ok", "improved": "OK+", "regressed": "FAIL",
+                "error": "ERR"}[r["status"]]
+        line = (f"{mark:4s} {r['metric']:{width}s} {val:>12s} "
+                f"({r['direction']}, baseline {r['baseline']:g})")
+        if r.get("detail"):
+            line += f" -- {r['detail']}"
+        lines.append(line)
+    bad = sum(1 for r in results if r["status"] in ("regressed", "error"))
+    lines.append(f"{len(results)} tracked metrics, {bad} failing")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="deap-tpu-perfgate",
+        description="Perf-regression gate over the committed BENCH_*.json "
+                    "artifacts: every PERF_LEDGER.json metric must sit "
+                    "inside its noise band (or absolute bar) relative to "
+                    "its blessed baseline.")
+    ap.add_argument("--ledger", default=None,
+                    help=f"ledger path (default: <repo>/{DEFAULT_LEDGER})")
+    ap.add_argument("--repo", default=".",
+                    help="repo root the artifact globs resolve against "
+                         "(default: cwd)")
+    ap.add_argument("--update", action="store_true",
+                    help="rebless: rewrite baselines + history from the "
+                         "current artifact tree, then exit 0")
+    ap.add_argument("--json", action="store_true", dest="json_out",
+                    help="machine output on stdout")
+    args = ap.parse_args(argv)
+
+    repo = Path(args.repo).resolve()
+    ledger_path = (Path(args.ledger) if args.ledger
+                   else repo / DEFAULT_LEDGER)
+    try:
+        doc = json.loads(ledger_path.read_text())
+    except FileNotFoundError:
+        print(f"deap-tpu-perfgate: no ledger at {ledger_path}")
+        return 2
+    except ValueError as e:
+        print(f"deap-tpu-perfgate: ledger is not valid JSON: {e}")
+        return 2
+    errors = ledger_schema_errors(doc)
+    if errors:
+        for e in errors:
+            print(f"deap-tpu-perfgate: schema: {e}")
+        return 2
+
+    if args.update:
+        try:
+            doc = update_ledger(repo, doc)
+        except (FileNotFoundError, ValueError) as e:
+            print(f"deap-tpu-perfgate: {e}")
+            return 2
+        ledger_path.write_text(json.dumps(doc, indent=1, sort_keys=True)
+                               + "\n")
+        print(f"deap-tpu-perfgate: reblessed {len(doc['metrics'])} "
+              f"baselines into {ledger_path}")
+        return 0
+
+    results = evaluate_ledger(repo, doc)
+    if args.json_out:
+        bad = sum(1 for r in results
+                  if r["status"] in ("regressed", "error"))
+        print(json.dumps({"ledger": str(ledger_path),
+                          "results": results, "failing": bad},
+                         indent=2, sort_keys=True))
+    else:
+        print(render_text(results))
+    return 1 if any(r["status"] in ("regressed", "error")
+                    for r in results) else 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

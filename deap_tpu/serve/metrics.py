@@ -30,6 +30,7 @@ lint pass enforces exactly this split.
 from __future__ import annotations
 
 import collections
+import re
 from typing import Dict, Iterable, Optional
 
 from .. import sanitize
@@ -37,7 +38,7 @@ from ..observability.sinks import MetricRecord, emit_record
 
 __all__ = ["ServeMetrics", "SERVE_COUNTERS", "SERVE_GAUGES", "NET_COUNTERS",
            "ROUTER_COUNTERS", "ROUTER_GAUGES", "TENANT_COUNTERS",
-           "prometheus_text"]
+           "prometheus_text", "prometheus_fleet_text"]
 
 #: Counters the service maintains (cumulative over the service lifetime).
 SERVE_COUNTERS = (
@@ -78,10 +79,15 @@ ROUTER_GAUGES = (
     "router_inflight", "router_failover_recovery_s",
 )
 
-#: Gauges (last-value).
+#: Gauges (last-value).  The ``profile_*`` family is the device-phase
+#: profiler's aggregate rollup (per-program records ride the snapshot's
+#: ``meta["programs"]`` table and the labelled Prometheus series — a
+#: program key must never become part of a metric NAME).
 SERVE_GAUGES = (
     "queue_depth", "sessions", "sharded_sessions", "slot_occupancy",
     "row_occupancy", "pad_waste",
+    "profile_programs", "profile_flops_total",
+    "profile_bytes_accessed_total", "profile_peak_bytes_max",
 )
 
 #: Per-tenant (per-session) counters — the SLO attribution set.  Tenant
@@ -231,6 +237,27 @@ class ServeMetrics:
 
 _PROM_PREFIX = "deap_tpu_serve"
 
+#: ``latency_<kind?>_p<q>_ms`` gauge names (the reservoir snapshot) —
+#: exported as the proper ``deap_tpu_latency_seconds`` summary series
+#: instead of flat per-quantile gauge names
+_LATENCY_GAUGE_RE = re.compile(
+    r"\Alatency_(?:(?P<kind>.+)_)?p(?P<q>50|90|99)_ms\Z")
+_QUANTILE_OF = {"50": "0.5", "90": "0.9", "99": "0.99"}
+
+#: per-program profile values exported as labelled gauge series (the
+#: program key is a label, never a metric name)
+_PROGRAM_SERIES = (
+    ("calls", "program_calls"),
+    ("device_min_s", "program_device_min_seconds"),
+    ("compile_s", "program_compile_seconds"),
+)
+_PROGRAM_AOT_SERIES = (
+    ("flops", "program_flops"),
+    ("bytes_accessed", "program_bytes_accessed"),
+    ("peak_bytes_upper_bound", "program_peak_bytes"),
+    ("collective_count", "program_collectives"),
+)
+
 
 def _prom_label(value: str) -> str:
     """Escape a label value per the Prometheus text format."""
@@ -238,35 +265,113 @@ def _prom_label(value: str) -> str:
             .replace("\n", "\\n"))
 
 
-def prometheus_text(record: MetricRecord) -> str:
-    """Render a serve :class:`MetricRecord` in the Prometheus text
-    exposition format (version 0.0.4): counters as
-    ``deap_tpu_serve_<name>_total``, gauges (latency quantiles included)
-    as ``deap_tpu_serve_<name>``, and the per-tenant SLO counters as
-    ``deap_tpu_serve_tenant_<name>_total{tenant="..."}`` labelled
-    series."""
-    lines = []
+def _label_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_prom_label(str(v))}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _families_of(record: MetricRecord,
+                 instance: Optional[str] = None) -> "collections.OrderedDict":
+    """``{metric name: (type, [(labels, formatted value), ...])}`` for
+    one record — the shared decomposition :func:`prometheus_text`
+    renders directly and :func:`prometheus_fleet_text` merges across
+    instances (so a fleet exposition declares each TYPE exactly once)."""
+    base = {} if instance is None else {"instance": str(instance)}
+    fams: "collections.OrderedDict[str, tuple]" = collections.OrderedDict()
+
+    def add(metric: str, typ: str, labels: Dict[str, str],
+            value: str) -> None:
+        fam = fams.setdefault(metric, (typ, []))
+        fam[1].append((dict(base, **labels), value))
+
     # 0.0.4 text format: a TYPE line must name the SAMPLE's metric
     # exactly, so the counter families carry their _total suffix in both
     for name in sorted(record.counters):
-        metric = f"{_PROM_PREFIX}_{name}_total"
-        lines.append(f"# TYPE {metric} counter")
-        lines.append(f"{metric} {int(record.counters[name])}")
+        add(f"{_PROM_PREFIX}_{name}_total", "counter", {},
+            str(int(record.counters[name])))
+    latency: list = []
     for name in sorted(record.gauges):
-        metric = f"{_PROM_PREFIX}_{name}"
-        lines.append(f"# TYPE {metric} gauge")
-        lines.append(f"{metric} {float(record.gauges[name]):g}")
+        m = _LATENCY_GAUGE_RE.match(name)
+        if m is not None:
+            latency.append((m.group("kind") or "all",
+                            _QUANTILE_OF[m.group("q")],
+                            float(record.gauges[name]) / 1e3))
+            continue
+        add(f"{_PROM_PREFIX}_{name}", "gauge", {},
+            f"{float(record.gauges[name]):g}")
+    # reservoir quantiles as one summary family, labelled by request
+    # kind ("all" = the pooled reservoir) and quantile
+    for kind, quantile, seconds in latency:
+        add("deap_tpu_latency_seconds", "summary",
+            {"kind": kind, "quantile": quantile}, f"{seconds:g}")
     tenants = record.meta.get("tenants") or {}
     by_counter: Dict[str, list] = {}
     for tenant in sorted(tenants):
         for cname, v in sorted(tenants[tenant].items()):
             by_counter.setdefault(cname, []).append((tenant, v))
     for cname in sorted(by_counter):
-        metric = f"{_PROM_PREFIX}_tenant_{cname}_total"
-        lines.append(f"# TYPE {metric} counter")
         for tenant, v in by_counter[cname]:
-            lines.append(
-                f'{metric}{{tenant="{_prom_label(tenant)}"}} {int(v)}')
-    lines.append(f"# TYPE {_PROM_PREFIX}_batches_seq gauge")
-    lines.append(f"{_PROM_PREFIX}_batches_seq {int(record.gen)}")
+            add(f"{_PROM_PREFIX}_tenant_{cname}_total", "counter",
+                {"tenant": tenant}, str(int(v)))
+    # per-program device-phase profiles (meta["programs"], when the
+    # service runs with its profiler enabled): program key as a label
+    programs = record.meta.get("programs") or {}
+    for key in sorted(programs):
+        prof = programs[key]
+        labels = {"program": key, "kind": str(prof.get("kind", ""))}
+        for field, series in _PROGRAM_SERIES:
+            v = prof.get(field)
+            if v is not None:
+                add(f"{_PROM_PREFIX}_{series}", "gauge", labels,
+                    f"{float(v):g}")
+        aot = prof.get("aot") or {}
+        for field, series in _PROGRAM_AOT_SERIES:
+            v = aot.get(field)
+            if v is not None:
+                add(f"{_PROM_PREFIX}_{series}", "gauge", labels,
+                    f"{float(v):g}")
+    add(f"{_PROM_PREFIX}_batches_seq", "gauge", {}, str(int(record.gen)))
+    return fams
+
+
+def _render_families(fams) -> str:
+    lines = []
+    for metric, (typ, samples) in fams.items():
+        lines.append(f"# TYPE {metric} {typ}")
+        for labels, value in samples:
+            lines.append(f"{metric}{_label_str(labels)} {value}")
     return "\n".join(lines) + "\n"
+
+
+def prometheus_text(record: MetricRecord,
+                    instance: Optional[str] = None) -> str:
+    """Render a serve :class:`MetricRecord` in the Prometheus text
+    exposition format (version 0.0.4): counters as
+    ``deap_tpu_serve_<name>_total``, gauges as
+    ``deap_tpu_serve_<name>``, the latency reservoir quantiles as
+    summary-style ``deap_tpu_latency_seconds{kind=...,quantile=...}``
+    series (seconds, per request kind plus the pooled ``kind="all"``),
+    per-tenant SLO counters as
+    ``deap_tpu_serve_tenant_<name>_total{tenant="..."}`` and — when the
+    record carries the profiler's ``meta["programs"]`` table —
+    per-compiled-program ``deap_tpu_serve_program_*{program=...}``
+    series.  ``instance`` (optional) adds an ``instance`` label to every
+    sample — the fleet exposition's disambiguator."""
+    return _render_families(_families_of(record, instance))
+
+
+def prometheus_fleet_text(records: Dict[str, MetricRecord]) -> str:
+    """One exposition covering a whole fleet: ``{instance name:
+    record}`` merged so each metric family is declared once and every
+    sample carries its ``instance`` label — what the router serves at
+    ``GET /v1/admin/fleet?format=prometheus`` (one scrape, N
+    instances)."""
+    merged: "collections.OrderedDict[str, tuple]" = collections.OrderedDict()
+    for inst, rec in records.items():
+        for metric, (typ, samples) in _families_of(rec, inst).items():
+            fam = merged.setdefault(metric, (typ, []))
+            fam[1].extend(samples)
+    return _render_families(merged)

@@ -391,6 +391,12 @@ class RemoteService:
         return MetricRecord(gen=rec["gen"], counters=rec["counters"],
                             gauges=rec["gauges"], meta=rec.get("meta", {}))
 
+    def profile(self) -> dict:
+        """``GET /v1/profile`` — the server's per-compiled-program
+        device-phase profiles (``{"enabled", "programs": {key: ...}}``;
+        see :class:`~deap_tpu.observability.profiling.ProgramProfiler`)."""
+        return self._sync("GET", "/v1/profile")
+
     def trace_tail(self, *, max_spans: int = 256,
                    trace_id: Optional[str] = None) -> dict:
         """``GET /v1/trace`` — the server's recent span window

@@ -24,6 +24,10 @@ noted)::
     GET    /v1/metrics                      one MetricRecord (JSON); add
                                             ?stream=1&max=K&timeout=S for
                                             chunked ND-JSON tailing
+    GET    /v1/profile                      per-compiled-program device-
+                                            phase profiles (JSON): AOT
+                                            cost/memory records + min-of-k
+                                            measured execute walls
     POST   /v1/admin/drain                  failover step 1: quiesce +
                                             snapshot every live session
     POST   /v1/admin/restore                failover step 2: adopt a
@@ -300,6 +304,16 @@ class NetServer:
                       f"skipped {sorted(skipped)}", self.sinks)
         return {"restored": sorted(restored), "skipped": skipped}
 
+    def h_profile(self) -> dict:
+        """``GET /v1/profile`` — the device-phase profiler's
+        per-program table (see
+        :class:`~deap_tpu.observability.profiling.ProgramProfiler`):
+        AOT flop/byte/peak records joined with min-of-k measured
+        execute walls, keyed by readable program identity."""
+        prof = self.service.profiler
+        return {"enabled": bool(prof.enabled),
+                "programs": prof.profiles()}
+
     def h_rebucket(self, body: dict) -> dict:
         return self.service.rebucket(
             max_buckets=int(body.get("max_buckets", 8)),
@@ -469,6 +483,8 @@ class _Handler(FrameHTTPHandler):
                 return self._metrics(parse_qs(url.query))
             if method == "GET" and rest == ["trace"]:
                 return self._trace_tail(parse_qs(url.query))
+            if method == "GET" and rest == ["profile"]:
+                return self._send_json(net.h_profile())
             if rest[:1] == ["sessions"]:
                 if method == "POST" and len(rest) == 1:
                     return self._send_obj(net.h_create(self._body()))
@@ -566,7 +582,10 @@ class _Handler(FrameHTTPHandler):
                     continue
                 waited = 0.0
                 seen = now
-                chunk(svc.stats().to_json())
+                # per-batch records skip the per-program profile table
+                # (per-scrape rebuild work the stream's consumers never
+                # read); the one-shot GET stays the full view
+                chunk(svc.stats(programs=False).to_json())
                 emitted += 1
             self.wfile.write(b"0\r\n\r\n")
         except BrokenPipeError:

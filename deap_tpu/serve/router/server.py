@@ -29,6 +29,11 @@ router URL.  Per request the router:
 Router-only surface (on top of the NetServer paths)::
 
     GET  /v1/admin/fleet            topology: backends, health, routes
+                                    (?format=prometheus: ONE exposition
+                                    covering the router plus every live
+                                    backend's metrics, each sample
+                                    labelled instance="..." — one scrape
+                                    covers the fleet)
     POST /v1/admin/fleet/failover   {"backend": name} — manual drill
 """
 
@@ -41,9 +46,10 @@ from typing import Optional, Sequence, Tuple
 from urllib.parse import parse_qs, quote, unquote, urlparse
 
 from ...observability.sinks import emit_text
+from ...observability.sinks import MetricRecord
 from ..dispatcher import (ServeError, ServiceOverloaded, SessionUnknown,
                           TenantQuotaExceeded)
-from ..metrics import prometheus_text
+from ..metrics import prometheus_fleet_text, prometheus_text
 from ..net import protocol
 from ..net.httpcommon import FrameHTTPHandler
 from .backend import Backend, BackendDown
@@ -185,6 +191,9 @@ class _RouterHandler(FrameHTTPHandler):
             if method == "GET" and rest == ["trace"]:
                 return self._trace_tail(parse_qs(url.query))
             if method == "GET" and rest == ["admin", "fleet"]:
+                query = parse_qs(url.query)
+                if query.get("format", [""])[0] == "prometheus":
+                    return self._fleet_prometheus()
                 return self._send_json(router.topology())
             if (method == "POST" and rest == ["admin", "fleet",
                                               "failover"]):
@@ -226,6 +235,60 @@ class _RouterHandler(FrameHTTPHandler):
                 prometheus_text(rec).encode("utf-8"),
                 content_type="text/plain; version=0.0.4; charset=utf-8")
         self._send_json(json.loads(rec.to_json()))
+
+    def _fleet_prometheus(self) -> None:
+        """``GET /v1/admin/fleet?format=prometheus`` — the whole fleet
+        in one exposition: the router's own record plus every reachable
+        backend's ``/v1/metrics`` snapshot, merged so each metric family
+        is declared once and every sample carries an ``instance`` label.
+        Unreachable/down backends degrade to a comment line (the scrape
+        must not fail because one instance is mid-failover)."""
+        router = self.server_ctx.router
+        records = {"router": router.stats()}
+        down: list = []
+        sick = router.health.sick()
+        live = [n for n in sorted(router.backends) if n not in sick]
+        down += [f"# backend {n} sick: excluded"
+                 for n in sorted(router.backends) if n in sick]
+        # fetch the backends CONCURRENTLY: one wedged-but-not-yet-sick
+        # instance must cost the scrape its own control timeout once,
+        # not once per position in a sequential walk — a fleet scrape
+        # that overruns Prometheus's scrape_timeout drops every
+        # instance's samples, not just the slow one's
+        results: dict = {}
+
+        def fetch(name: str) -> None:
+            try:
+                results[name] = router.backends[name].metrics()
+            except (BackendDown, ServeError, OSError, ValueError) as e:
+                # ValueError covers a malformed/truncated body from an
+                # instance mid-restart (Backend._control's json.loads;
+                # UnicodeDecodeError is its subclass) — the scrape must
+                # degrade that instance to a comment, not kill the thread
+                results[name] = e
+        threads = [threading.Thread(target=fetch, args=(n,),
+                                    name=f"deap-tpu-router-scrape-{n}",
+                                    daemon=True) for n in live]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()        # bounded by each backend's control timeout
+        for name in live:
+            rec = results.get(name)
+            if rec is None or isinstance(rec, Exception):
+                down.append(f"# backend {name} unreachable: "
+                            f"{type(rec).__name__ if rec else 'missing'}")
+                continue
+            records[name] = MetricRecord(
+                gen=int(rec.get("gen", 0)),
+                counters=rec.get("counters", {}),
+                gauges=rec.get("gauges", {}),
+                meta=rec.get("meta", {}) or {})
+        text = prometheus_fleet_text(records)
+        if down:
+            text += "\n".join(down) + "\n"
+        self._send(text.encode("utf-8"),
+                   content_type="text/plain; version=0.0.4; charset=utf-8")
 
     def _trace_tail(self, query) -> None:
         tracer = self.server_ctx.router.tracer

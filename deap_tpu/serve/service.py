@@ -58,6 +58,7 @@ from ..algorithms import ea_step, ea_ask, ea_tell, _norm_eval
 from ..observability import events as _events
 from ..observability import fleettrace
 from ..observability.fleettrace import FleetTracer
+from ..observability.profiling import ProgramProfiler
 from ..observability.sinks import emit_text
 from .buckets import (BucketPolicy, BucketKey, ShapeHistogram, pad_rows,
                       unpad_rows, pad_population, genome_signature)
@@ -331,6 +332,18 @@ class EvolutionService:
         service clock; pass ``FleetTracer(enabled=False)`` to opt out —
         the compiled programs and trajectories are identical either way
         (tracing is pure host bookkeeping, pinned by test).
+    profiler:
+        :class:`~deap_tpu.observability.profiling.ProgramProfiler`
+        recording per-compiled-program device-phase profiles: XLA
+        cost/memory analyses at AOT time (beside the ``compiles*``
+        counters — same event, same program key) and min-of-k measured
+        execute walls at the exact ``device_execute`` span bounds.
+        Default: a fresh enabled profiler on the service clock; pass
+        ``ProgramProfiler(enabled=False)`` to opt out — pure host
+        bookkeeping, bitwise-identical trajectories either way (pinned
+        by test; overhead committed in ``BENCH_PROFILE.json``).  Read
+        it back via :meth:`stats` (``meta["programs"]`` + ``profile_*``
+        gauges) or the network frontend's ``GET /v1/profile``.
     rebucket_policy:
         Optional :class:`~deap_tpu.serve.rebucket.RebucketPolicy` —
         evaluated after every dispatched batch; fires
@@ -360,7 +373,9 @@ class EvolutionService:
                  retry_backoff: float = 0.05, sinks: Sequence = (),
                  stats_every: int = 0, verbose: bool = False,
                  shard_threshold: Optional[int] = None, mesh=None,
-                 tracer: Optional[FleetTracer] = None, rebucket_policy=None,
+                 tracer: Optional[FleetTracer] = None,
+                 profiler: Optional[ProgramProfiler] = None,
+                 rebucket_policy=None,
                  fault_hook=None, clock=time.monotonic):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -378,6 +393,8 @@ class EvolutionService:
         self.shapes = ShapeHistogram()
         self.tracer = (tracer if tracer is not None
                        else FleetTracer(clock=clock))
+        self.profiler = (profiler if profiler is not None
+                         else ProgramProfiler(clock=clock))
         self._rebucket_policy = None
         self._fault_hook = fault_hook
         self._clock = clock
@@ -427,11 +444,19 @@ class EvolutionService:
         finally:
             self._dispatcher.resume()
 
-    def stats(self):
+    def stats(self, *, programs: bool = True):
         """Current :class:`~deap_tpu.observability.sinks.MetricRecord` —
         counters (requests/compiles/cache/...) + gauges (queue depth,
         occupancy, pad waste, latency p50/p90/p99); per-tenant SLO
-        counters ride in ``meta["tenants"]``."""
+        counters ride in ``meta["tenants"]`` and (with the profiler
+        enabled) the per-program device-phase table in
+        ``meta["programs"]``.  ``programs=False`` skips building the
+        program table — the streaming metrics endpoint emits one record
+        per dispatched batch, and rebuilding + re-serializing every
+        program's phase split per batch is per-scrape work its
+        consumers (``deap-tpu-top`` aggregates counters/gauges) never
+        read; the one-shot ``/v1/metrics`` GET and ``/v1/profile``
+        remain the full views."""
         from .rebucket import pad_waste_of
         # one locked copy for both gauges: the stats scraper runs on its
         # own thread while handler threads admit/close sessions (a bare
@@ -443,7 +468,24 @@ class EvolutionService:
             "sharded_sessions",
             sum(1 for s in live.values() if s.sharded))
         self.metrics.set_gauge("pad_waste", pad_waste_of(self))
-        return self.metrics.snapshot(self._dispatcher.batches)
+        # always written: after a live `profiler.enabled = False` the
+        # gauges must read zero, not freeze at the last enabled-state
+        # values (a dashboard would conclude profiling is live + current)
+        agg = (self.profiler.aggregates() if self.profiler.enabled
+               else {"programs": 0.0, "flops_total": 0.0,
+                     "bytes_accessed_total": 0.0, "peak_bytes_max": 0.0})
+        self.metrics.set_gauge("profile_programs", agg["programs"])
+        self.metrics.set_gauge("profile_flops_total", agg["flops_total"])
+        self.metrics.set_gauge("profile_bytes_accessed_total",
+                               agg["bytes_accessed_total"])
+        self.metrics.set_gauge("profile_peak_bytes_max",
+                               agg["peak_bytes_max"])
+        rec = self.metrics.snapshot(self._dispatcher.batches)
+        if programs and self.profiler.enabled:
+            table = self.profiler.profiles()
+            if table:
+                rec.meta["programs"] = table
+        return rec
 
     def set_rebucket_policy(self, policy) -> None:
         """Install (or, with ``None``, remove) the auto-rebucket policy.
@@ -829,10 +871,17 @@ class EvolutionService:
         key = (kind, program_key)
         compiled = self._programs.get(key)
         if compiled is None:
+            t0 = self._clock()
             compiled = jax.jit(build()).lower(*args).compile()
             self._programs[key] = compiled
             self.metrics.inc("compiles")
             self.metrics.inc(f"compiles_{kind}")
+            if self.profiler.enabled:
+                # same event as the compiles* counters, so profile
+                # records and compile counters always join; the one-time
+                # cost/memory analyses run here, off the steady path
+                self.profiler.observe_compile(kind, program_key, compiled,
+                                              self._clock() - t0)
             if _events.active():     # in-trace telemetry tap, if one is open
                 _events.emit("serve_compiles", 1)
             if self.verbose:
@@ -957,12 +1006,14 @@ class EvolutionService:
                     s.phase = "idle"
                 s.gen += 1
             results = [{"gen": s.gen, "nevals": int(np.asarray(nevals))}]
+        t_dev1 = self._clock()
+        prof_attrs = self.profiler.observe_execute(kind, program_key,
+                                                   t_dev1 - t_dev0)
         if req.trace is not None and self.tracer.enabled:
-            t_dev1 = self._clock()
             self.tracer.phase("pad_bucket", req.trace, t_pad0, t_pad1,
                               attrs={"rows": rows, "sharded": True})
             self.tracer.phase("device_execute", req.trace, t_dev0, t_dev1,
-                              attrs={"kind": kind})
+                              attrs={"kind": kind, **(prof_attrs or {})})
         self._maybe_emit_stats()
         return results
 
@@ -1026,10 +1077,12 @@ class EvolutionService:
                         s.phase = "idle"
                     s.gen += 1
                 results.append({"gen": s.gen, "nevals": int(nevals[i])})
+        t_dev1 = self._clock()
+        prof_attrs = self.profiler.observe_execute(kind, program_key,
+                                                   t_dev1 - t_dev0)
         if self.tracer.enabled:
             # the microbatch's phases are shared work: each traced
             # request gets the same bounds under its own span
-            t_dev1 = self._clock()
             for r in requests:
                 if r.trace is not None:
                     self.tracer.phase(
@@ -1037,7 +1090,9 @@ class EvolutionService:
                         attrs={"rows": sessions[0].bucket.rows,
                                "slots": len(requests)})
                     self.tracer.phase("device_execute", r.trace,
-                                      t_dev0, t_dev1, attrs={"kind": kind})
+                                      t_dev0, t_dev1,
+                                      attrs={"kind": kind,
+                                             **(prof_attrs or {})})
         self._maybe_emit_stats()
         return results
 
@@ -1104,6 +1159,10 @@ class EvolutionService:
                     values[i] = h
             t_dev1 = self._clock()
         self.metrics.inc("evaluations", total)
+        prof_attrs = None
+        if t_dev0 is not None:
+            prof_attrs = self.profiler.observe_execute(
+                "evaluate", program_key, t_dev1 - t_dev0)
         if self.tracer.enabled:
             for r in requests:
                 if r.trace is None:
@@ -1115,7 +1174,8 @@ class EvolutionService:
                 if t_dev0 is not None:
                     self.tracer.phase("device_execute", r.trace,
                                       t_dev0, t_dev1,
-                                      attrs={"kind": "evaluate"})
+                                      attrs={"kind": "evaluate",
+                                             **(prof_attrs or {})})
 
         results, off = [], 0
         for n in counts:

@@ -70,11 +70,15 @@ PAGES = {
                        "deap_tpu.observability.telemetry",
                        "deap_tpu.observability.sinks",
                        "deap_tpu.observability.tracing",
-                       "deap_tpu.observability.fleettrace"]),
+                       "deap_tpu.observability.fleettrace",
+                       "deap_tpu.observability.profiling"]),
     "serve": ("Serving layer (deap_tpu.serve)",
               ["deap_tpu.serve.service", "deap_tpu.serve.dispatcher",
                "deap_tpu.serve.buckets", "deap_tpu.serve.cache",
-               "deap_tpu.serve.metrics", "deap_tpu.serve.rebucket"]),
+               "deap_tpu.serve.metrics", "deap_tpu.serve.rebucket",
+               "deap_tpu.serve.top"]),
+    "perf": ("Perf-regression ledger (deap_tpu.perfledger)",
+             ["deap_tpu.perfledger"]),
     "serve_net": ("Network frontend (deap_tpu.serve.net)",
                   ["deap_tpu.serve.net", "deap_tpu.serve.net.protocol",
                    "deap_tpu.serve.net.httpcommon",

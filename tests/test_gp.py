@@ -146,7 +146,8 @@ def test_static_limit(pset):
     assert h <= 2  # the oversized mutation was rejected
 
 
-def test_symbreg_evolution(pset):
+@pytest.mark.slow   # PR 14 budget: the HARM run below is the
+def test_symbreg_evolution(pset):   # in-gate GP-evolution e2e
     """End-to-end GP: evolve x^4+x^3+x^2+x on 20 points (reference
     examples/gp/symbreg.py); expect strong fitness improvement."""
     f = pset.freeze()

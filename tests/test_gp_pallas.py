@@ -65,7 +65,8 @@ def test_pallas_two_arg_pset():
                                rtol=1e-6, atol=1e-6)
 
 
-def test_batch_size_invariance():
+@pytest.mark.slow   # PR 14 budget: the interp parity tests keep
+def test_batch_size_invariance():   # the Pallas kernel in-gate
     """Chunked-vs-full oracle: evaluating a population in one batch must
     equal evaluating it in small chunks, for BOTH interpreters, at batch
     sizes past 1024.  On CPU this is a plain invariant; on TPU it is the

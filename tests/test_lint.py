@@ -1233,3 +1233,58 @@ def test_bench_json_tsan_schema(tmp_path):
     msgs = " ".join(f.message for f in r.findings)
     assert "'tsan_off' must be an object" in msgs
     assert "'violations' must be 0" in msgs
+
+
+# ---------------------------------------------------------------------------
+# bench-json: BENCH_PROFILE.json + PERF_LEDGER.json schemas (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+def test_bench_json_profile_schema(tmp_path):
+    """BENCH_PROFILE.json gets the profiler-overhead schema: metric
+    triple, both interleaved legs with finite p50s, and a positive
+    programs_profiled count (the legs must actually have profiled
+    something)."""
+    good = ('{"metric": "serve_net_profile_overhead_pct", "value": 1.9, '
+            '"unit": "%", "programs_profiled": 4, '
+            '"profiled": {"roundtrip_p50_ms": 12.6}, '
+            '"unprofiled": {"roundtrip_p50_ms": 12.4}}')
+    (tmp_path / "BENCH_PROFILE.json").write_text(good)
+    r = _findings(tmp_path, "bench-json")
+    assert r.findings == [], r.findings
+
+    (tmp_path / "BENCH_PROFILE.json").write_text(
+        '{"metric": "m", "value": 1.9, "unit": "%", '
+        '"programs_profiled": 0, '
+        '"profiled": {"roundtrip_p50_ms": "NaN"}}')
+    r = _findings(tmp_path, "bench-json")
+    msgs = " ".join(f.message for f in r.findings)
+    assert "'unprofiled' must be an object" in msgs
+    assert "'profiled.roundtrip_p50_ms' must be a finite" in msgs
+    assert "'programs_profiled' must be a positive" in msgs
+
+
+def test_bench_json_perf_ledger_schema(tmp_path):
+    """PERF_LEDGER.json rides the bench-json gate through the shared
+    deap_tpu.perfledger validator: band outside (0,1], a missing
+    provenance, or a non-finite baseline fails tier-1."""
+    good = {"version": 1, "metrics": {"m": {
+        "artifact": "BENCH_X.json", "path": "value",
+        "direction": "higher", "band": 0.3, "provenance": "fixture",
+        "baseline": {"artifact": "BENCH_X.json", "value": 1.0},
+        "history": [{"artifact": "BENCH_X.json", "value": 1.0}]}}}
+    import json as _json
+    (tmp_path / "PERF_LEDGER.json").write_text(_json.dumps(good))
+    r = _findings(tmp_path, "bench-json")
+    assert r.findings == [], r.findings
+
+    bad = _json.loads(_json.dumps(good))
+    bad["metrics"]["m"]["band"] = 1.5
+    bad["metrics"]["m"]["provenance"] = ""
+    bad["metrics"]["m"]["baseline"] = {"artifact": "x", "value": "NaN"}
+    (tmp_path / "PERF_LEDGER.json").write_text(_json.dumps(bad))
+    r = _findings(tmp_path, "bench-json")
+    msgs = " ".join(f.message for f in r.findings)
+    assert "band must be a number in (0, 1]" in msgs
+    assert "provenance" in msgs
+    assert "baseline" in msgs

@@ -1,0 +1,256 @@
+"""Device-phase profiler tests (ISSUE 14 tentpole a).
+
+The load-bearing assertions:
+
+* every compiled serve program gains a profile record joining the AOT
+  cost/memory analyses with min-of-k measured execute walls, at the
+  same event the ``compiles*`` counters fire;
+* a DISABLED profiler leaves compile counters and trajectories
+  **bitwise identical** (pure host bookkeeping — the same contract the
+  tracer pins);
+* the profile surfaces everywhere the tentpole promises: ``stats()``
+  gauges + ``meta["programs"]``, the ``device_execute`` span attrs,
+  ``GET /v1/profile`` over the wire, and labelled Prometheus series
+  (latency summary series included — ISSUE 14 satellite 1).
+
+Shapes mirror ``tests/test_serve.py`` (40×8 onemax at ``max_batch=2``)
+so the session-wide persistent compile cache turns the programs into
+disk hits.
+"""
+
+import http.client
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deap_tpu import base
+from deap_tpu.observability.profiling import (ProgramProfiler,
+                                              aot_cost_summary,
+                                              describe_program_key,
+                                              phase_split)
+from deap_tpu.ops import crossover, mutation, selection
+from deap_tpu.serve import EvolutionService
+from deap_tpu.serve.buckets import BucketKey
+from deap_tpu.serve.metrics import (ServeMetrics, prometheus_text,
+                                    prometheus_fleet_text)
+from deap_tpu.serve.net import NetServer, RemoteService
+
+pytestmark = [pytest.mark.serve]
+
+
+def onemax_toolbox():
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: (jnp.sum(g),))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    return tb
+
+
+def onemax_pop(key, n=40, nbits=8):
+    g = jax.random.bernoulli(key, 0.5, (n, nbits)).astype(jnp.float32)
+    return base.Population(genome=g, fitness=base.Fitness.empty(n, (1.0,)))
+
+
+# ---------------------------------------------------------------------------
+# units
+# ---------------------------------------------------------------------------
+
+
+def test_describe_program_key_shapes_and_stability():
+    bucket = BucketKey(rows=64, genome_sig=("f32", ()), nobj=1,
+                       weights=(1.0,))
+    slot = describe_program_key("step", (12345, bucket))
+    assert slot.startswith("step[rows=64,nobj=1]#")
+    sharded = describe_program_key("step", ("sharded", 12345, bucket))
+    assert sharded.startswith("step.sharded[rows=64,nobj=1]#")
+    ev = describe_program_key("evaluate", (999, ("sig",), 128, 2))
+    assert ev.startswith("evaluate[rows=128,nobj=2]#")
+    # same key -> same name; different toolbox id -> different digest
+    assert slot == describe_program_key("step", (12345, bucket))
+    assert slot != describe_program_key("step", (54321, bucket))
+
+
+def test_aot_cost_summary_and_phase_split():
+    def f(x):
+        return jnp.sum(x * 2.0) + jnp.dot(x, x)
+    compiled = jax.jit(f).lower(jnp.ones((256,), jnp.float32)).compile()
+    aot = aot_cost_summary(compiled)
+    # CPU exposes both analyses in this jax; every reported number is
+    # finite and the derived peak follows the bench_donation formula
+    assert aot["flops"] > 0
+    assert aot["bytes_accessed"] > 0
+    assert aot["peak_bytes_upper_bound"] == (
+        aot["argument_bytes"] + aot["output_bytes"]
+        + aot.get("temp_bytes", 0) - aot.get("alias_bytes", 0))
+    assert aot["collective_count"] == 0
+    split = phase_split(aot, measured_s=1e-3, backend="cpu")
+    assert split, "a costed program must split"
+    assert abs(split["compute_frac"] + split["transfer_frac"]
+               + split["collective_frac"] - 1.0) < 1e-6
+    total = (split["compute_s_est"] + split["transfer_s_est"]
+             + split["collective_s_est"])
+    assert abs(total - 1e-3) < 1e-9      # components sum to the wall
+    assert phase_split({}, 1e-3) == {}   # no cost record -> no split
+    assert phase_split(aot, None) == {}  # no measurement -> no split
+
+
+def test_profiler_window_min_of_k_and_disabled_noop():
+    prof = ProgramProfiler(window=4)
+    key = (1, BucketKey(rows=8, genome_sig=("f32", ()), nobj=1,
+                        weights=(1.0,)))
+    for s in (0.5, 0.2, 0.9, 0.3, 0.4, 0.8):
+        attrs = prof.observe_execute("step", key, s)
+    assert attrs["program"].startswith("step[rows=8")
+    [p] = prof.profiles().values()
+    assert p["calls"] == 6
+    assert p["device_min_s"] == pytest.approx(0.2)     # all-time min
+    assert p["window"]["k"] == 4                       # bounded window
+    assert p["window"]["min_s"] == pytest.approx(0.3)  # 0.2 rolled off
+    off = ProgramProfiler(enabled=False)
+    assert off.observe_execute("step", key, 0.1) is None
+    assert off.profiles() == {}
+
+
+# ---------------------------------------------------------------------------
+# service wiring
+# ---------------------------------------------------------------------------
+
+
+def test_service_profiles_every_compiled_program():
+    """One profile record per compiled program, carrying AOT cost data
+    and measured walls; aggregates land as stats() gauges and the
+    per-program table rides meta["programs"]; the device_execute spans
+    carry the program identity."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(7)
+    with EvolutionService(max_batch=2) as svc:
+        s = svc.open_session(key, onemax_pop(key), tb, name="prof-a")
+        for f in s.step(3):
+            f.result(timeout=120)
+        s.evaluate(np.ones((4, 8), np.float32)).result(timeout=120)
+        profs = svc.profiler.profiles()
+        kinds = {p["kind"] for p in profs.values()}
+        assert {"init", "step", "evaluate"} <= kinds
+        # profile records and compile counters fire on the same event
+        assert len(profs) == svc.metrics.counter("compiles")
+        step = next(p for p in profs.values() if p["kind"] == "step")
+        assert step["calls"] == 3
+        assert step["device_min_s"] > 0
+        assert step["compile_s"] > 0
+        assert step["aot"]["flops"] > 0
+        assert step["aot"]["bytes_accessed"] > 0
+        assert step["phase_split"]["transfer_frac"] > 0
+        rec = svc.stats()
+        assert rec.gauges["profile_programs"] == len(profs)
+        assert rec.gauges["profile_flops_total"] > 0
+        assert rec.meta["programs"].keys() == profs.keys()
+        # span attrs: device_execute spans name the profiled program
+        devs = [sp for sp in svc.tracer.recent()
+                if sp["name"] == "device_execute"]
+        assert devs and all("program" in sp["attrs"] for sp in devs)
+        assert any("flops" in sp["attrs"] for sp in devs)
+
+
+def test_profiler_disabled_bitwise_identical_and_absent():
+    """Profiler off: identical compile counters, bitwise-identical
+    trajectory, no profile surface anywhere (the tracer contract,
+    extended)."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(11)
+
+    def run(enabled):
+        with EvolutionService(
+                max_batch=2,
+                profiler=ProgramProfiler(enabled=enabled)) as svc:
+            s = svc.open_session(key, onemax_pop(key), tb, name="p")
+            for f in s.step(3):
+                f.result(timeout=120)
+            p = s.population()
+            return (np.asarray(p.genome), np.asarray(p.fitness.values),
+                    svc.metrics.counter("compiles"), svc.stats())
+
+    g_on, v_on, c_on, rec_on = run(True)
+    g_off, v_off, c_off, rec_off = run(False)
+    np.testing.assert_array_equal(g_on, g_off)
+    np.testing.assert_array_equal(v_on, v_off)
+    assert c_on == c_off
+    assert "programs" in rec_on.meta
+    assert "programs" not in rec_off.meta
+    assert "profile_programs" not in rec_off.gauges \
+        or rec_off.gauges["profile_programs"] == 0.0
+
+
+@pytest.mark.net
+def test_profile_route_over_http():
+    """``GET /v1/profile`` serves the per-program table; the labelled
+    Prometheus program series render from the same snapshot."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(13)
+    with EvolutionService(max_batch=2) as svc, \
+            NetServer(svc, {"onemax": tb}) as srv, \
+            RemoteService(srv.url, timeout=120) as cli:
+        s = cli.open_session(key, onemax_pop(key), "onemax",
+                             cxpb=0.6, mutpb=0.3)
+        for f in s.step(2):
+            f.result(timeout=120)
+        prof = cli.profile()
+        assert prof["enabled"] is True
+        assert prof["programs"]
+        step_keys = [k for k, p in prof["programs"].items()
+                     if p["kind"] == "step"]
+        assert step_keys and step_keys[0].startswith("step[rows=")
+        conn = http.client.HTTPConnection(cli.host, cli.port, timeout=30)
+        try:
+            conn.request("GET", "/v1/metrics?format=prometheus")
+            text = conn.getresponse().read().decode("utf-8")
+        finally:
+            conn.close()
+        assert "# TYPE deap_tpu_serve_program_flops gauge" in text
+        assert 'deap_tpu_serve_program_calls{kind="step",program=' in text
+
+
+# ---------------------------------------------------------------------------
+# prometheus exposition (satellite 1 + fleet merge)
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_latency_summary_series():
+    """The reservoir quantiles export as summary-style
+    ``deap_tpu_latency_seconds{kind,quantile}`` series in SECONDS —
+    per kind plus the pooled kind="all" — and the flat ``latency_*_ms``
+    gauge spellings no longer leak into the exposition."""
+    m = ServeMetrics()
+    for v in (0.010, 0.020, 0.030):
+        m.observe_latency("step", v)
+    m.observe_latency("ask", 0.050)
+    prom = prometheus_text(m.snapshot())
+    assert "# TYPE deap_tpu_latency_seconds summary" in prom
+    assert 'deap_tpu_latency_seconds{kind="step",quantile="0.5"} 0.02' \
+        in prom
+    assert 'deap_tpu_latency_seconds{kind="ask",quantile="0.99"} 0.05' \
+        in prom
+    assert 'kind="all",quantile="0.9"' in prom
+    assert "latency_step_p50_ms" not in prom
+    # the snapshot's own gauge dict still carries the ms spellings for
+    # the JSON//v1/metrics consumers
+    assert "latency_step_p50_ms" in m.snapshot().gauges
+
+
+def test_prometheus_instance_label_and_fleet_merge():
+    """``instance`` labels every sample when asked; the fleet merger
+    declares each family once across N instances (satellite 2's
+    exposition contract)."""
+    a, b = ServeMetrics(), ServeMetrics()
+    a.inc("steps", 3)
+    b.inc("steps", 4)
+    solo = prometheus_text(a.snapshot(), instance="a")
+    assert 'deap_tpu_serve_steps_total{instance="a"} 3' in solo
+    fleet = prometheus_fleet_text({"a": a.snapshot(), "b": b.snapshot()})
+    assert fleet.count("# TYPE deap_tpu_serve_steps_total counter") == 1
+    assert 'deap_tpu_serve_steps_total{instance="a"} 3' in fleet
+    assert 'deap_tpu_serve_steps_total{instance="b"} 4' in fleet
+    # unlabelled rendering unchanged (the existing pins' spelling)
+    assert "deap_tpu_serve_steps_total 3" in prometheus_text(a.snapshot())

@@ -825,3 +825,122 @@ def test_router_span_joins_client_router_backend():
         cli.close()
     finally:
         _close_fleet(svcs, srvs, front)
+
+
+def test_trace_and_compression_keys_coexist_in_one_frame():
+    """PR 12 × PR 9, wire level: one frame carrying the ``__trace__``
+    header AND the compression negotiation keys (``__zip__`` +
+    ``__accept__``) round-trips all three intact, payload bit-exact."""
+    payload = np.tile(np.asarray([np.nan, -0.0, 2.5, 7.0], np.float32),
+                      4096)
+    frame = encode_frame({"g": payload},
+                         trace={"trace_id": "ab" * 16, "span_id": "cd" * 8},
+                         compress="zlib", accept=("zlib",),
+                         min_compress_bytes=1)
+    obj, meta = protocol.decode_frame_with_meta(frame)
+    assert meta["compressed"] == "zlib"
+    assert meta["accept"] == ("zlib",)
+    assert meta["trace"] == {"trace_id": "ab" * 16, "span_id": "cd" * 8}
+    assert (obj["g"].view(np.uint32) == payload.view(np.uint32)).all()
+    # the router hop rewrite swaps the trace and leaves negotiation +
+    # deflated payload untouched
+    rt = protocol.rewrite_trace(frame, {"trace_id": "ef" * 16,
+                                        "span_id": "01" * 8})
+    obj2, meta2 = protocol.decode_frame_with_meta(rt)
+    assert meta2["compressed"] == "zlib"
+    assert meta2["accept"] == ("zlib",)
+    assert meta2["trace"]["span_id"] == "01" * 8
+    assert (obj2["g"].view(np.uint32) == payload.view(np.uint32)).all()
+
+
+def test_trace_rides_compressed_frame_across_fleet():
+    """PR 12 × PR 9 regression, end to end: a trace context riding a
+    COMPRESSED DTF1 frame survives the router hop — the backend
+    inflates the payload (``net_frames_compressed`` moves) AND the span
+    tree still joins client → router.forward → backend phases on the
+    shared trace id."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(47)
+    svcs, srvs, backends, router = _fleet(tb, n=2, start_health=False)
+    front = RouterServer(router).start()
+    try:
+        cli = RemoteService(front.url, timeout=120, compress="zlib")
+        # 160×10 float32 rows = 6400 B payloads: past the client's
+        # 4096 B compression floor on both the create and the evaluate
+        s = cli.open_session(key, onemax_pop(key, 160, 10), "onemax",
+                             cxpb=0.6, mutpb=0.3, name="zipped")
+        genomes = np.asarray(
+            jax.random.bernoulli(jax.random.PRNGKey(48), 0.5, (160, 10)),
+            np.float32)
+        s.evaluate(genomes).result(timeout=120)
+        backend = router.route_of("zipped")
+        svc = svcs[int(backend.name[1:])]
+        # the compressed request frames actually reached the backend
+        # compressed (negotiation survived both hops)
+        assert svc.metrics.counter("net_frames_compressed") >= 1
+        merged = join_spans({
+            "client": cli.tracer.recent(),
+            "router": router.tracer.recent(),
+            "backend": svc.tracer.recent()})
+        ev_clients = [sp for sp in merged
+                      if sp["name"].startswith("client.POST")
+                      and sp["name"].endswith("/evaluate")]
+        assert ev_clients
+        trace_id = ev_clients[-1]["trace_id"]
+        spans = [sp for sp in merged if sp["trace_id"] == trace_id]
+        tree = span_tree(spans)
+        [root] = [sp for sp in tree
+                  if sp["attrs"]["source"] == "client"]
+        router_hops = [c for c in root["children"]
+                       if c["attrs"]["source"] == "router"]
+        assert router_hops and \
+            router_hops[0]["name"].startswith("router.forward")
+        backend_spans = [g for c in router_hops
+                         for g in c["children"]
+                         if g["attrs"]["source"] == "backend"]
+        assert backend_spans
+        # the backend-side request tree still carries the per-phase
+        # breakdown (wire_decode of the inflated frame included)
+        names = {sp["name"] for sp in spans
+                 if sp["attrs"].get("source") == "backend"}
+        assert "wire_decode" in names
+        assert "serve.evaluate" in names
+        cli.close()
+    finally:
+        _close_fleet(svcs, srvs, front)
+
+
+@pytest.mark.slow
+def test_fleet_prometheus_exposition_one_scrape():
+    """``GET /v1/admin/fleet?format=prometheus`` (ISSUE 14 satellite):
+    one scrape covers router + every backend, each sample labelled
+    ``instance``, each metric family declared exactly once."""
+    import http.client as _http
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(51)
+    svcs, srvs, backends, router = _fleet(tb, n=2, start_health=False)
+    front = RouterServer(router).start()
+    try:
+        cli = RemoteService(front.url, timeout=120)
+        s = cli.open_session(key, onemax_pop(key, 40, 8), "onemax",
+                             cxpb=0.6, mutpb=0.3, name="prom")
+        for f in s.step(2):
+            f.result(timeout=120)
+        conn = _http.HTTPConnection(*front.address, timeout=30)
+        try:
+            conn.request("GET", "/v1/admin/fleet?format=prometheus")
+            resp = conn.getresponse()
+            text = resp.read().decode("utf-8")
+        finally:
+            conn.close()
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        assert text.count("# TYPE deap_tpu_serve_steps_total counter") == 1
+        assert 'deap_tpu_serve_steps_total{instance="router"} 0' in text
+        home = router.route_of("prom").name
+        assert f'deap_tpu_serve_steps_total{{instance="{home}"}} 2' in text
+        # the backend's latency reservoir rides as the summary family
+        assert 'deap_tpu_latency_seconds{instance=' in text
+        cli.close()
+    finally:
+        _close_fleet(svcs, srvs, front)

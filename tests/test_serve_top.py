@@ -1,0 +1,194 @@
+"""``deap-tpu-top`` tests (ISSUE 14 tentpole c).
+
+The acceptance pin: ``deap-tpu-top --once --json`` against an
+in-process 2-backend router fleet reports a fleet-aggregate
+``counters`` section EQUAL to the per-counter sum of the instances'
+own counters — the dashboard must never invent or lose a step.
+
+Shapes mirror ``tests/test_serve_router.py`` (40/48×8 onemax at
+``max_batch=4``) so the session-wide persistent compile cache turns
+every service's programs into disk hits.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deap_tpu import base
+from deap_tpu.ops import crossover, mutation, selection
+from deap_tpu.serve import EvolutionService
+from deap_tpu.serve.net import NetServer, RemoteService
+from deap_tpu.serve.router import (Backend, FleetRouter, PlacementPolicy,
+                                   RouterServer)
+from deap_tpu.serve.top import FleetTop, aggregate, main, render_screen
+
+pytestmark = [pytest.mark.serve, pytest.mark.net]
+
+
+def onemax_toolbox():
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: (jnp.sum(g),))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    return tb
+
+
+def onemax_pop(key, n=40, nbits=8):
+    g = jax.random.bernoulli(key, 0.5, (n, nbits)).astype(jnp.float32)
+    return base.Population(genome=g, fitness=base.Fitness.empty(n, (1.0,)))
+
+
+def _two_backend_fleet(tb):
+    """2 NetServer instances behind a router whose placement spreads
+    (spread=1 -> sessions alternate), so BOTH instances carry traffic
+    and the sum pin is non-degenerate."""
+    svcs = [EvolutionService(max_batch=4) for _ in range(2)]
+    srvs = [NetServer(s, {"onemax": tb}).start() for s in svcs]
+    router = FleetRouter([Backend(f"b{i}", s.address)
+                          for i, s in enumerate(srvs)],
+                         placement=PlacementPolicy(spread=1),
+                         start_health=False)
+    front = RouterServer(router).start()
+    return svcs, srvs, router, front
+
+
+def _close(svcs, srvs, front):
+    front.close()
+    for s in srvs:
+        s.close()
+    for s in svcs:
+        s.close()
+
+
+def _drive(front_url, sessions=4, gens=3):
+    cli = RemoteService(front_url, timeout=120)
+    keys = jax.random.split(jax.random.PRNGKey(21), sessions)
+    fleet = [cli.open_session(k, onemax_pop(k, 40 + 8 * (i % 2)), "onemax",
+                              cxpb=0.6, mutpb=0.3, tenant=f"tenant-{i % 2}")
+             for i, k in enumerate(keys)]
+    for s in fleet:
+        for f in s.step(gens):
+            f.result(timeout=120)
+    cli.close()
+    return sessions * gens
+
+
+def test_once_json_fleet_counters_equal_instance_sum(capsys):
+    """THE acceptance pin: the --once --json document's fleet.counters
+    is the exact per-counter sum of the instances' counters (steps
+    pinned against the known total), backends discovered through the
+    router's /v1/admin/fleet — asserted both on the library surface and
+    through the console entry (one fleet serves both, keeping the gate
+    lean)."""
+    tb = onemax_toolbox()
+    svcs, srvs, router, front = _two_backend_fleet(tb)
+    try:
+        total_steps = _drive(front.url)
+        top = FleetTop(router=front.url)
+        doc = top.collect_once()
+        assert set(doc["instances"]) == {"b0", "b1"}
+        per = {n: rec["counters"] for n, rec in doc["instances"].items()}
+        assert all(rec["error"] is None
+                   for rec in doc["instances"].values())
+        # spread placement: both instances actually stepped
+        assert per["b0"]["steps"] > 0 and per["b1"]["steps"] > 0
+        for name, total in doc["fleet"]["counters"].items():
+            assert total == sum(c.get(name, 0) for c in per.values()), name
+        assert doc["fleet"]["counters"]["steps"] == total_steps
+        assert doc["fleet"]["instances_up"] == 2
+        assert doc["router"]["sessions"] == 4
+        assert doc["fleet"]["tenants"]
+        # the console entry end-to-end: --once --json prints the same
+        # document shape with the same sum contract; bare --once renders
+        rc = main(["--router", front.url, "--once", "--json"])
+        assert rc == 0
+        cli_doc = json.loads(capsys.readouterr().out)
+        cli_per = [rec["counters"] for rec in cli_doc["instances"].values()
+                   if rec["error"] is None]
+        assert cli_doc["fleet"]["counters"]["steps"] == \
+            sum(c.get("steps", 0) for c in cli_per) == total_steps
+        rc = main(["--router", front.url, "--once"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "deap-tpu-top" in out and "b0" in out and "b1" in out
+    finally:
+        _close(svcs, srvs, front)
+
+
+def test_instances_mode_without_router():
+    """Explicit --instances targets (no router): same aggregation, plus
+    an unreachable instance degrades to an error row instead of failing
+    the snapshot."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(5)
+    with EvolutionService(max_batch=4) as svc, \
+            NetServer(svc, {"onemax": tb}) as srv, \
+            RemoteService(srv.url, timeout=120) as cli:
+        s = cli.open_session(key, onemax_pop(key), "onemax",
+                             cxpb=0.6, mutpb=0.3)
+        for f in s.step(2):
+            f.result(timeout=120)
+        top = FleetTop(instances=(f"live={srv.url}",
+                                  "dead=127.0.0.1:9"))
+        doc = top.collect_once()
+        assert doc["instances"]["live"]["error"] is None
+        assert doc["instances"]["live"]["counters"]["steps"] == 2
+        assert doc["instances"]["dead"]["error"]
+        assert doc["fleet"]["instances_up"] == 1
+        assert doc["fleet"]["counters"]["steps"] == 2
+        # the screen renders the down row instead of crashing
+        assert "DOWN" in render_screen(doc)
+
+
+@pytest.mark.slow
+def test_live_mode_streams_and_joins_threads():
+    """Live mode: stream-tail threads feed the screen (no polling
+    sleeps — the tails block on the server's Condition-driven metrics
+    stream), frames render, and close() joins every thread (the
+    module-level thread-leak gate double-checks)."""
+    import io
+    tb = onemax_toolbox()
+    svcs, srvs, router, front = _two_backend_fleet(tb)
+    try:
+        _drive(front.url, sessions=2, gens=2)
+        buf = io.StringIO()
+        top = FleetTop(router=front.url)
+        rc = top.run_live(refresh=0.3, max_refreshes=2, out=buf)
+        assert rc == 0
+        out = buf.getvalue()
+        assert out.count("deap-tpu-top") == 2      # two frames
+        assert "b0" in out and "b1" in out
+        assert not top._threads                    # joined at close()
+    finally:
+        _close(svcs, srvs, front)
+
+
+def test_aggregate_unit():
+    """Counter sum / gauge max / tenant merge, with error rows
+    excluded."""
+    instances = {
+        "a": {"error": None,
+              "counters": {"steps": 3, "requests": 5},
+              "gauges": {"queue_depth": 1, "pad_waste": 0.2,
+                         "latency_p99_ms": 9.0},
+              "meta": {"tenants": {"t": {"requests": 2}}}},
+        "b": {"error": None,
+              "counters": {"steps": 4},
+              "gauges": {"queue_depth": 2, "pad_waste": 0.5,
+                         "latency_p99_ms": 4.0},
+              "meta": {"tenants": {"t": {"requests": 1},
+                                   "u": {"requests": 7}}}},
+        "c": {"error": "ConnectionRefusedError: down"},
+    }
+    fleet = aggregate(instances)
+    assert fleet["instances_up"] == 2
+    assert fleet["instances_total"] == 3
+    assert fleet["counters"] == {"steps": 7, "requests": 5}
+    assert fleet["gauges"]["queue_depth"] == 3
+    assert fleet["gauges"]["pad_waste_max"] == 0.5
+    assert fleet["gauges"]["latency_p99_ms_max"] == 9.0
+    assert fleet["tenants"] == {"t": {"requests": 3},
+                                "u": {"requests": 7}}

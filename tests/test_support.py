@@ -136,7 +136,8 @@ def test_incremental_ranks_match_naive():
         assert int(nf) == expected.max() + 1
 
 
-def test_sweep2d_ranks_match_peel():
+@pytest.mark.slow   # PR 14 budget: 2-obj partition parity stays
+def test_sweep2d_ranks_match_peel():    # in-gate via hybrid_peel + spea2
     """Both 2-objective specialisations — the parallel staircase peel (the
     nobj=2 default) and the serial O(n log n) sweep — must produce the
     exact count-peel partition on every tricky regime: deep fronts (F=N),
@@ -593,7 +594,8 @@ def test_grid_ranks_match_peel():
         np.testing.assert_array_equal(np.asarray(cnt), np.asarray(ref))
 
 
-def test_grid_counts_source_masked():
+@pytest.mark.slow   # PR 14 budget: grid coverage stays in-gate via
+def test_grid_counts_source_masked():   # grid_method_nobj2 + massive_ties
     """Source-masked grid counts (the recompute peel's per-round kernel)
     must equal the brute-force dominator count among the masked rows for
     every query — including non-uniform masks, whose bug class (mask
@@ -740,7 +742,8 @@ def test_densegrid_ranks_match_peel():
     np.testing.assert_array_equal(np.asarray(r_d), np.asarray(r_p))
 
 
-def test_spea2_staged_matches_single_program():
+@pytest.mark.slow   # PR 14 budget: SPEA2 parity stays in-gate via the
+def test_spea2_staged_matches_single_program():     # chunked + incremental tests
     """The two-dispatch staged SPEA2 (axon pool>=2e5 path) must select
     exactly what the single-program form selects, in both the fill and
     the truncation regimes, with either kth method."""

@@ -38,6 +38,15 @@ the p50 delta as ``trace_overhead_pct`` — the committed
 ``BENCH_TRACE.json`` artifact, schema-gated by the ``bench-json`` lint
 pass and accepted at <= 5%.
 
+``--net --profile`` measures the COST OF THE DEVICE-PHASE PROFILER
+(``deap_tpu.observability.profiling.ProgramProfiler``): the same
+loopback single-step round trips with the service profiler toggled
+on/off in interleaved blocks (the tracer stays at its default in both
+legs, so the delta is the profiler alone), reporting the p50 delta as
+``profile_overhead_pct`` — the committed ``BENCH_PROFILE.json``
+artifact, schema-gated by the ``bench-json`` lint pass and accepted at
+<= 5%.
+
 ``--net --tsan`` measures the COST OF THE CONCURRENCY SANITIZER
 (``deap_tpu.sanitize`` under ``DEAP_TPU_TSAN=1``): interleaved legs
 that rebuild the loopback fleet with the sanitizer armed (instrumented
@@ -336,6 +345,80 @@ def run_trace_bench(sessions: int, pops, dims, max_batch: int, seed: int,
     }
 
 
+def run_profile_bench(sessions: int, pops, dims, max_batch: int, seed: int,
+                      probes: int = 40, rounds: int = 3) -> dict:
+    """Profiler-overhead benchmark: loopback single-step round trips
+    with the service :class:`ProgramProfiler` enabled vs disabled,
+    interleaved per round so clock drift and cache warmth hit both legs
+    equally (the run_trace_bench recipe).  The profiler is a live
+    toggle like the tracer, so one fleet serves both legs; its one-time
+    AOT cost analyses happen at the warmup compiles, OUTSIDE the timed
+    blocks — the measured delta is the steady-state observe path."""
+    from deap_tpu.serve import EvolutionService
+    from deap_tpu.serve.net import NetServer, RemoteService
+
+    tb = _toolbox()
+    specs = _fleet_specs(sessions, pops, dims, seed)
+    lat = {True: [], False: []}
+    programs = 0
+
+    with EvolutionService(max_batch=max_batch) as svc, \
+            NetServer(svc, {"bench": tb}) as srv, \
+            RemoteService(srv.url, timeout=600) as cli:
+        fleet = [cli.open_session(k, _population(k, n, d), "bench",
+                                  cxpb=0.7, mutpb=0.3)
+                 for k, n, d in specs]
+        for s in fleet:
+            s.step()[0].result(timeout=600)          # warmup / AOT
+        for r in range(rounds):
+            for enabled in (True, False) if r % 2 == 0 else (False, True):
+                svc.profiler.enabled = enabled
+                for i in range(probes):
+                    t0 = time.perf_counter()
+                    fleet[i % len(fleet)].step(1)[0].result(timeout=600)
+                    lat[enabled].append(time.perf_counter() - t0)
+        programs = len(svc.profiler.profiles())
+
+    def leg(samples):
+        ms = sorted(x * 1e3 for x in samples)
+
+        def pct(q):
+            if not ms:
+                return None      # --latency-probes 0 / --trace-rounds 0
+            return round(ms[min(len(ms) - 1,
+                                int(round(q * (len(ms) - 1))))], 3)
+        return {"roundtrip_p50_ms": pct(0.50),
+                "roundtrip_p90_ms": pct(0.90),
+                "roundtrip_p99_ms": pct(0.99),
+                "samples": len(ms)}
+
+    profiled, unprofiled = leg(lat[True]), leg(lat[False])
+    if profiled["roundtrip_p50_ms"] is None \
+            or unprofiled["roundtrip_p50_ms"] is None:
+        overhead = None
+    else:
+        overhead = round(
+            100.0 * (profiled["roundtrip_p50_ms"]
+                     - unprofiled["roundtrip_p50_ms"])
+            / max(unprofiled["roundtrip_p50_ms"], 1e-9), 3)
+    return {
+        "metric": "serve_net_profile_overhead_pct",
+        "value": overhead,
+        "unit": "% p50 single-step round-trip delta, device-phase "
+                "profiler on vs off (loopback --net)",
+        "config": {"sessions": sessions, "pops": pops, "dims": dims,
+                   "max_batch": max_batch, "probes_per_block": probes,
+                   "rounds": rounds,
+                   "note": "blocks interleaved on/off per round; warmup "
+                           "step per session (and its one-time AOT cost "
+                           "analyses) excluded"},
+        "profiled": profiled,
+        "unprofiled": unprofiled,
+        "profile_overhead_pct": overhead,
+        "programs_profiled": programs,
+    }
+
+
 def run_tsan_bench(sessions: int, pops, dims, max_batch: int, seed: int,
                    probes: int = 40, rounds: int = 3) -> dict:
     """Concurrency-sanitizer overhead benchmark: loopback single-step
@@ -456,7 +539,13 @@ def main(argv=None) -> int:
                          "on vs off in interleaved blocks) -- the "
                          "BENCH_TRACE.json artifact")
     ap.add_argument("--trace-rounds", type=int, default=3,
-                    help="--trace/--tsan: interleaved on/off block pairs")
+                    help="--trace/--profile/--tsan: interleaved on/off "
+                         "block pairs")
+    ap.add_argument("--profile", action="store_true",
+                    help="with --net: measure the device-phase profiler "
+                         "overhead instead (p50 round-trip delta, "
+                         "ProgramProfiler on vs off in interleaved "
+                         "blocks) -- the BENCH_PROFILE.json artifact")
     ap.add_argument("--tsan", action="store_true",
                     help="with --net: measure the concurrency-sanitizer "
                          "overhead instead (p50 round-trip delta, "
@@ -469,6 +558,9 @@ def main(argv=None) -> int:
     if args.tsan and not args.net:
         ap.error("--tsan requires --net (the sanitizer-overhead legs "
                  "measure the loopback network path)")
+    if args.profile and not args.net:
+        ap.error("--profile requires --net (the profiler-overhead legs "
+                 "measure the loopback network path)")
 
     import jax
     if args.net and args.tsan:
@@ -478,6 +570,13 @@ def main(argv=None) -> int:
                                 args.max_batch, args.seed,
                                 probes=args.latency_probes,
                                 rounds=args.trace_rounds)
+    elif args.net and args.profile:
+        report = run_profile_bench(args.sessions,
+                                   [int(p) for p in args.pops.split(",")],
+                                   [int(d) for d in args.dims.split(",")],
+                                   args.max_batch, args.seed,
+                                   probes=args.latency_probes,
+                                   rounds=args.trace_rounds)
     elif args.net and args.trace:
         report = run_trace_bench(args.sessions,
                                  [int(p) for p in args.pops.split(",")],

@@ -1,0 +1,15 @@
+#!/usr/bin/env python
+"""Thin shim over :mod:`deap_tpu.perfledger` (the historical ``tools/``
+invocation path — the console entry is ``deap-tpu-perfgate``)."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from deap_tpu.perfledger import (main, evaluate_ledger,  # noqa: E402,F401
+                                 ledger_schema_errors, update_ledger)
+
+if __name__ == "__main__":
+    sys.exit(main())
