@@ -87,6 +87,15 @@ half of that claim; the ICI-bandwidth half still needs real chips.
 Env overrides: BENCH_POP (default 1_000_000), BENCH_DIM (100), BENCH_NGEN
 (30 timed generations), BENCH_PRNG (default "rbg" — the TPU hardware RNG;
 set "threefry" for the portable default), BENCH_DEVICES, BENCH_WEAK.
+
+BENCH_ENGINE ("xla" default | "megakernel") selects the generation
+engine: "megakernel" routes every generation through the fused
+select→mate→mutate Pallas pass (deap_tpu/ops/generation_pallas.py; the
+dedicated before/after driver is tools/bench_megakernel.py).
+BENCH_STORAGE ("float32" default | "bfloat16" | "int8") selects the
+genome residency dtype — narrow storage with f32 fitness accumulation
+and f32 mutation arithmetic (int8 quantizes symmetrically over the
+rastrigin domain ±5.12).
 """
 
 import json
@@ -100,6 +109,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 POP = int(os.environ.get("BENCH_POP", 1_000_000))
 DIM = int(os.environ.get("BENCH_DIM", 100))
 NGEN = int(os.environ.get("BENCH_NGEN", 30))
+ENGINE = os.environ.get("BENCH_ENGINE", "xla")
+STORAGE = os.environ.get("BENCH_STORAGE", "float32")
 TOURNSIZE = 3
 CXPB, MUTPB, INDPB = 0.9, 0.5, 0.05
 
@@ -130,8 +141,24 @@ def run_tpu():
     tb.register("select", selection.sel_tournament, tournsize=TOURNSIZE,
                 tie_break="rank")
 
+    storage = None
+    if STORAGE != "float32":
+        from deap_tpu.ops.generation_pallas import GenomeStorage
+        storage = GenomeStorage(STORAGE,
+                                5.12 if STORAGE == "int8" else 0.0)
+        tb.genome_storage = storage     # vary/evaluate widen around it
+    if ENGINE == "megakernel":
+        tb.generation_engine = "megakernel"
+    elif ENGINE != "xla":
+        raise SystemExit(f"BENCH_ENGINE={ENGINE!r}: expected 'xla' or "
+                         "'megakernel'")
+
     def generation(carry, _):
         key, pop = carry
+        if ENGINE == "megakernel":
+            from deap_tpu.algorithms import ea_step
+            key, off, _ = ea_step(key, pop, tb, CXPB, MUTPB)
+            return (key, off), jnp.min(off.fitness.values[:, 0])
         key, k_sel, k_var = jax.random.split(key, 3)
         idx = tb.select(k_sel, pop.fitness, POP)
         genome = jax.tree_util.tree_map(lambda x: x[idx], pop.genome)
@@ -148,6 +175,8 @@ def run_tpu():
 
     key = jax.random.PRNGKey(0)
     genome = jax.random.uniform(key, (POP, DIM), jnp.float32, -5.12, 5.12)
+    if storage is not None:
+        genome = storage.to_storage(genome)   # narrow from generation 0
     pop = base.Population(genome=genome,
                           fitness=base.Fitness.empty(POP, (-1.0,)))
     pop, _ = evaluate_population(tb, pop)
@@ -280,6 +309,8 @@ def main():
                         "reported value is marginal (t2N-tN)/N",
             },
             "best_fitness_end": best,
+            "engine": ENGINE,
+            "genome_storage": STORAGE,
             "phases": phases,
             "fitness_evals_per_sec":
                 round(gens_per_sec * POP, 1) if linear_ok else -1,

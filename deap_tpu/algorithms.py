@@ -67,6 +67,52 @@ def _where_rows(mask, new, old):
     return jax.tree_util.tree_map(w, new, old)
 
 
+# ---------------------------------------------------------------------------
+# mixed-precision genome storage (toolbox.genome_storage)
+# ---------------------------------------------------------------------------
+#
+# A toolbox may declare a narrow on-device genome residency
+# (``toolbox.genome_storage = GenomeStorage("bfloat16")`` — see
+# deap_tpu/ops/generation_pallas.py): genome leaves whose dtype matches
+# the declaration live narrow between generations (half/quarter the HBM
+# traffic of f32) and are WIDENED to f32 at the two compute boundaries —
+# variation arithmetic and fitness evaluation — then narrowed again on
+# the single store.  Fitness values stay f32 end to end (f32
+# accumulation).  A toolbox without the attribute takes code paths that
+# are bitwise-identical to before the storage tier existed.
+
+
+def _genome_storage(toolbox):
+    from .ops.generation_pallas import storage_of
+    return storage_of(toolbox)
+
+
+def _widen_genome(storage, g):
+    """Storage→compute widening of the genome pytree: leaves in the
+    declared narrow dtype become f32 (int8 dequantizes); every other
+    leaf passes through untouched."""
+    if storage is None or not storage.is_narrow:
+        return g
+    narrow = storage.jax_dtype
+
+    def widen(x):
+        return storage.to_compute(x) if x.dtype == narrow else x
+    return jax.tree_util.tree_map(widen, g)
+
+
+def _narrow_genome(storage, new, ref):
+    """Compute→storage narrowing: leaves that were narrow in ``ref``
+    (the pre-widening genome) are re-quantized/cast; the rest pass
+    through."""
+    if storage is None or not storage.is_narrow:
+        return new
+    narrow = storage.jax_dtype
+
+    def narrow_leaf(x, r):
+        return storage.to_storage(x) if r.dtype == narrow else x
+    return jax.tree_util.tree_map(narrow_leaf, new, ref)
+
+
 def _batched_form(tool):
     """Population-level form of a registered operator, if it advertises one.
 
@@ -140,16 +186,17 @@ def evaluate_population(toolbox, population: Population):
     assigned rows: NaN/Inf from a user evaluator would otherwise poison
     every downstream comparison silently."""
     invalid = ~population.fitness.valid
+    eval_genome = _widen_genome(_genome_storage(toolbox), population.genome)
     if hasattr(toolbox, "evaluate_population"):
         tool = toolbox.evaluate_population
         if _accepts_skip(tool):
-            values = tool(population.genome, skip=population.fitness.valid)
+            values = tool(eval_genome, skip=population.fitness.valid)
         else:
-            values = tool(population.genome)
+            values = tool(eval_genome)
         if values.ndim == 1:
             values = values[:, None]
     else:
-        values = jax.vmap(_norm_eval(toolbox.evaluate))(population.genome)
+        values = jax.vmap(_norm_eval(toolbox.evaluate))(eval_genome)
     nevals = jnp.sum(invalid)
     population = population.evaluated(values, where=invalid)
     quarantine = getattr(toolbox, "quarantine", None)
@@ -187,6 +234,9 @@ def vary_genome(key, g, toolbox, cxpb: float, mutpb: float,
     order (the reference's offspring layout)."""
     n = jax.tree_util.tree_leaves(g)[0].shape[0]
     n2 = n // 2
+    storage = _genome_storage(toolbox)
+    g_ref = g
+    g = _widen_genome(storage, g)      # f32 mutation arithmetic
     k_cx, k_cxkeys, k_mut, k_mutkeys = jax.random.split(key, 4)
 
     # --- crossover on pairs (reference algorithms.py:70-76) ---
@@ -228,7 +278,7 @@ def vary_genome(key, g, toolbox, cxpb: float, mutpb: float,
     g = _where_rows(do_mut, mutated, g)
     touched = touched | do_mut
 
-    return g, touched
+    return _narrow_genome(storage, g, g_ref), touched
 
 
 def var_or(key, population: Population, toolbox, lambda_: int,
@@ -304,7 +354,25 @@ def ea_ask(key, population: Population, toolbox, cxpb: float, mutpb: float,
     With ``live`` (bool prefix mask, see module comment above) pad rows
     pass through untouched and any selected pad index is remapped into the
     live prefix (``idx % live_n``), so the trajectory of the live rows is a
-    pure function of the live rows."""
+    pure function of the live rows.
+
+    A toolbox declaring ``generation_engine = "megakernel"`` routes the
+    whole ask half through the fused select→mate→mutate Pallas pass
+    (:func:`deap_tpu.ops.generation_pallas.fused_ea_step`): selection
+    winner indices stay bitwise-identical to this path, variation runs
+    in one tiled kernel with its own deterministic in-kernel stream, and
+    every produced row comes back invalid (reevaluate-all semantics).
+    The routing happens here — the one choke point — so ``ea_step``,
+    ``ea_simple``'s scan body, and the serving layer's step/ask programs
+    all inherit the engine from the toolbox."""
+    engine = getattr(toolbox, "generation_engine", "xla")
+    if engine == "megakernel":
+        from .ops.generation_pallas import fused_ea_step
+        return fused_ea_step(key, population, toolbox, cxpb, mutpb,
+                             live=live)
+    if engine != "xla":
+        raise ValueError(f"unknown toolbox.generation_engine {engine!r}: "
+                         "expected 'xla' or 'megakernel'")
     key, k_sel, k_var = jax.random.split(key, 3)
     idx = toolbox.select(k_sel, population.fitness, population.size)
     if live is None:
@@ -360,7 +428,16 @@ def ea_step(key, population: Population, toolbox, cxpb: float, mutpb: float,
     the loop body, reusable outside the scan (the compiled unit the
     :mod:`deap_tpu.serve` dispatcher invokes).  Returns ``(key, population,
     nevals)``; bitwise identical to a generation of :func:`ea_simple` under
-    the same key."""
+    the same key.
+
+    With ``toolbox.generation_engine = "megakernel"`` the generation
+    dispatches through :func:`ea_ask`'s fused-kernel route (which is
+    already reevaluate-all — the flag is redundant there) followed by a
+    full evaluation."""
+    if getattr(toolbox, "generation_engine", "xla") == "megakernel":
+        key, off = ea_ask(key, population, toolbox, cxpb, mutpb, live=live)
+        off, nevals = ea_tell(toolbox, off, live=live)
+        return key, off, nevals
     if reevaluate_all:
         if live is not None:
             raise ValueError("reevaluate_all is incompatible with a live "

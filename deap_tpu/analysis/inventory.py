@@ -41,7 +41,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["ProgramEntry", "Lowered", "INVENTORY", "entries", "get_entry",
-           "lower_entry", "require_mesh", "build_ga_scan", "N_DEV"]
+           "lower_entry", "require_mesh", "build_ga_scan",
+           "build_megakernel_scan", "N_DEV"]
 
 #: mesh width every sharded entry lowers at (tests/conftest.py and the
 #: analyze CLI both stand up this many virtual CPU devices)
@@ -250,6 +251,59 @@ def build_ga_scan(pop: int = POP, dim: int = DIM, ngen: int = 2,
         key.dtype, jax.dtypes.prng_key) else key, genome, values)
 
 
+def build_megakernel_scan(pop: int = 256, dim: int = DIM, ngen: int = 2,
+                          variant: int = 0,
+                          storage_dtype: str = "float32",
+                          storage_bound: float = 5.12,
+                          gather: str | None = None):
+    """The fused-generation whole-run scan: the flagship GA body with
+    select→mate→mutate collapsed into the Pallas megakernel
+    (:mod:`deap_tpu.ops.generation_pallas`), at the declared genome
+    storage dtype with f32 fitness accumulation.  Public and
+    parameterized for the same reason as :func:`build_ga_scan`: the
+    measurement driver (``tools/bench_megakernel.py``) and the two
+    inventory entries lower the SAME program at their respective
+    shapes.  On a non-TPU backend the kernel lowers its interpret-mode
+    host-gather composition — deterministic, so the committed budgets
+    are reproducible anywhere the gate runs."""
+    from .. import benchmarks
+    from ..ops.generation_pallas import (GenomeStorage, fused_generation,
+                                         pad_dim)
+    storage = GenomeStorage(
+        storage_dtype, storage_bound if storage_dtype == "int8" else 0.0)
+    # layout follows the executor: lane-padded tiles for the Pallas
+    # kernels (TPU), the unpadded (pop, dim) form for the traced-XLA
+    # executor the host-gather composition uses everywhere else
+    dpad = pad_dim(dim) if jax.default_backend() == "tpu" else dim
+
+    def eval_rows(g):
+        wide = storage.to_compute(g)[:, :dim]
+        return jax.vmap(lambda x: benchmarks.rastrigin(x)[0])(wide)[:, None]
+
+    def generation(carry, _):
+        key, g, fv = carry
+        key, k_sel, k_var = jax.random.split(key, 3)
+        g2, _ = fused_generation(
+            k_sel, k_var, g, -fv, dim=dim, cxpb=0.9, mutpb=0.5,
+            mut_sigma=0.3, indpb=0.05, tournsize=3, storage=storage,
+            gather=gather)
+        fv2 = eval_rows(g2)
+        return (key, g2, fv2), jnp.min(fv2)
+
+    def run(key, genome, values):
+        return lax.scan(generation, (key, genome, values), None,
+                        length=ngen)
+
+    key = jax.random.PRNGKey(variant)
+    g0 = jax.random.uniform(jax.random.fold_in(key, 1), (pop, dpad),
+                            jnp.float32, -5.12, 5.12)
+    g0 = g0.at[:, dim:].set(0.0)
+    genome = storage.to_storage(g0)
+    values = eval_rows(genome)
+    return run, (jax.random.key_data(key) if jax.dtypes.issubdtype(
+        key.dtype, jax.dtypes.prng_key) else key, genome, values)
+
+
 def _build_session_step(variant: int = 0):
     """One serve session's step program, un-vmapped (the per-state form
     every slot/sharded executable wraps)."""
@@ -415,6 +469,21 @@ INVENTORY: Tuple[ProgramEntry, ...] = (
         doc="flagship GA whole-run scan (select/vary/evaluate per gen); "
             "the ROADMAP raw-speed item donates key+genome+fitness "
             "across it"),
+    ProgramEntry(
+        name="ga_generation_megakernel",
+        anchor="deap_tpu/ops/generation_pallas.py",
+        build=build_megakernel_scan, donate=(0, 1, 2), budget=True,
+        storage_dtype="float32",
+        doc="fused select/mate/mutate Pallas generation scan, f32 "
+            "storage; winner indices bitwise-equal to the XLA path"),
+    ProgramEntry(
+        name="ga_generation_megakernel_bf16",
+        anchor="deap_tpu/ops/generation_pallas.py",
+        build=partial(build_megakernel_scan, storage_dtype="bfloat16"),
+        donate=(0, 1, 2), budget=True, storage_dtype="bfloat16",
+        doc="fused generation scan with bf16 genome residency (f32 "
+            "fitness accumulation + f32 mutation arithmetic); the "
+            "dtype-traffic pass audits the narrow-storage contract"),
     ProgramEntry(
         name="ea_step_session", anchor="deap_tpu/algorithms.py",
         build=_build_session_step, donate_waiver=_SERVE_WAIVER,

@@ -504,7 +504,8 @@ def compare_memory_budget(rows: Dict[str, Dict[str, int]],
                           slack_frac: float = MEMORY_SLACK_FRAC,
                           *, byte_keys: Sequence[str] = GATED_BYTE_KEYS,
                           count_keys: Sequence[str] = GATED_COUNT_KEYS,
-                          report_missing: bool = True) -> List[str]:
+                          report_missing: bool = True,
+                          require_count_keys: bool = False) -> List[str]:
     """Pure comparison (unit-tested without lowering anything): one
     violation string per gated metric over budget.  Byte metrics allow
     ``slack_frac`` headroom (toolchain bumps shift buffer assignment by
@@ -541,6 +542,17 @@ def compare_memory_budget(rows: Dict[str, Dict[str, int]],
                     f"(+{int(slack_frac * 100)}% slack = {ceil})")
         for k in count_keys:
             got, cap = row.get(k), allowed.get(k)
+            if cap is None and require_count_keys and _usable_int(got):
+                # a budget row with no committed count for a gated key
+                # is an UNGATED entry, not a passing one: new inventory
+                # programs must land with their fusion counts committed
+                # (deap-tpu-analyze --update-budget writes every key
+                # off the same one-lowering refresh)
+                violations.append(
+                    f"{name}: no committed {k} count -- the entry is "
+                    "ungated; refresh with deap-tpu-analyze "
+                    "--update-budget")
+                continue
             if not _usable_int(got) or not _usable_int(cap):
                 continue
             if got > cap:
@@ -663,24 +675,35 @@ def fusion_findings(lows: Sequence[Lowered],
                          "this platform"))
             continue
         rows[low.entry.name] = fus
-    # missing rows are the memory-budget pass's finding (one defect,
-    # one report); this pass gates only the materialization counts
+    # an entry with NO budget row at all is the memory-budget pass's
+    # finding (one defect, one report); a row that exists but carries
+    # no fusion counts is THIS pass's — it would otherwise gate nothing
+    # for a freshly added inventory entry until someone hand-edited the
+    # counts in (require_count_keys)
     for v in compare_memory_budget(rows, budget, slack, byte_keys=(),
-                                   report_missing=False):
+                                   report_missing=False,
+                                   require_count_keys=True):
         name = v.split(":", 1)[0]
+        kind = ("fusion budget missing" if "no committed" in v
+                else "materialization budget exceeded")
         yield Finding(
             rule="fusion-materialization",
             path=anchors.get(name, "tools/memory_budget.json"), line=1,
-            message=(f"materialization budget exceeded -- {v} (every "
-                     "count above budget is a population-sized buffer "
-                     "XLA re-materialized between operator stages; an "
-                     "intentional change is committed via "
-                     "deap-tpu-analyze --update-budget)"))
+            message=(f"{kind} -- {v}" + (
+                "" if "no committed" in v else
+                " (every count above budget is a population-sized "
+                "buffer XLA re-materialized between operator stages; an "
+                "intentional change is committed via "
+                "deap-tpu-analyze --update-budget)")))
 
 
-#: floating dtypes ordered by width, for the storage-dtype audit
+#: dtype widths for the storage-dtype audit: floating leaf dtypes (the
+#: flaggable side) plus the declarable integer storage (int8 — the
+#: quantized-genome tier); an int8 declaration makes EVERY floating
+#: leaf at pop size a width violation
 _FLOAT_WIDTH = {"bfloat16": 2, "float16": 2, "float8_e4m3fn": 1,
                 "float8_e5m2": 1, "float32": 4, "float64": 8}
+_STORAGE_WIDTH = {**_FLOAT_WIDTH, "int8": 1}
 
 
 def dtype_findings(low: Lowered) -> Iterable[Finding]:
@@ -729,28 +752,52 @@ def dtype_findings(low: Lowered) -> Iterable[Finding]:
                          "typed consumer widens it (and forks a "
                          "recompile); pin with jnp.asarray(x, dtype)"))
     if entry.storage_dtype:
-        declared_w = _FLOAT_WIDTH.get(entry.storage_dtype)
-        wide: List[int] = []
-        flat = 0
-        for arg in low.args:
-            for leaf in _flat_leaves(arg):
-                name = str(leaf.dtype)
-                w = _FLOAT_WIDTH.get(name)
+        # the audit threshold is the entry's POP-SIZED buffer floor (its
+        # largest argument leaf, per-device on mesh entries) — f32
+        # fitness accumulation and scalar knobs are the *design* of the
+        # mixed-precision tier and must not trip the gate; a genome-
+        # sized wide buffer is exactly the silently-given-back win
+        declared_w = _STORAGE_WIDTH.get(entry.storage_dtype)
+        threshold = large_bytes_for(low)
+
+        def wide_leaves(leaves) -> List[int]:
+            out = []
+            for i, leaf in enumerate(leaves):
+                w = _FLOAT_WIDTH.get(str(leaf.dtype))
                 if (w is not None and declared_w is not None
                         and w > declared_w
-                        and _leaf_bytes(leaf) >= DONATION_MIN_BYTES):
-                    wide.append(flat)
-                flat += 1
+                        and _leaf_bytes(leaf) >= threshold):
+                    out.append(i)
+            return out
+
+        wide = wide_leaves([leaf for arg in low.args
+                            for leaf in _flat_leaves(arg)])
         if wide:
             yield Finding(
                 rule="dtype-traffic", path=entry.anchor, line=1,
                 message=(f"program '{entry.name}': flat argument "
-                         f"leaf(s) {wide} are wider than the declared "
-                         f"storage dtype {entry.storage_dtype} -- the "
-                         "narrow-genome traffic win is silently given "
-                         "back; store narrow and widen inside the "
+                         f"leaf(s) {wide} are pop-sized "
+                         f"(>= {threshold} bytes) and wider than the "
+                         f"declared storage dtype {entry.storage_dtype} "
+                         "-- the narrow-genome traffic win is silently "
+                         "given back; store narrow and widen inside the "
                          "program (f32 accumulate), or update the "
                          "declaration"))
+        try:
+            out_leaves = _flat_leaves(low.out_shapes())
+        except Exception:   # noqa: BLE001 — shape eval is advisory
+            out_leaves = []
+        wide_out = wide_leaves(out_leaves)
+        if wide_out:
+            yield Finding(
+                rule="dtype-traffic", path=entry.anchor, line=1,
+                message=(f"program '{entry.name}': flat output "
+                         f"leaf(s) {wide_out} are pop-sized "
+                         f"(>= {threshold} bytes) and wider than the "
+                         f"declared storage dtype {entry.storage_dtype} "
+                         "-- the program returns the population wide, so "
+                         "every consumer inherits the widened traffic; "
+                         "narrow on the final store"))
 
 
 # ---------------------------------------------------------------------------

@@ -77,13 +77,32 @@ class IndividualSpec:
         n = jax.tree_util.tree_leaves(genome)[0].shape[0]
         return Population(genome=genome, fitness=self.fitness.empty(n))
 
-    def init_population(self, key: jax.Array, n: int, attr: Callable, **extra_leaves) -> Population:
+    def init_population(self, key: jax.Array, n: int, attr: Callable,
+                        storage_dtype: str | None = None,
+                        storage_bound: float = 0.0,
+                        **extra_leaves) -> Population:
         """Initialize ``n`` individuals by vmapping the per-individual
         initializer ``attr(key) -> genome`` — the array-native
         ``tools.initRepeat(list, toolbox.individual, n)`` (reference
-        init.py:3-25)."""
+        init.py:3-25).
+
+        ``storage_dtype`` opts the primary genome into the
+        mixed-precision storage tier (``"bfloat16"`` / ``"int8"``, see
+        :class:`deap_tpu.ops.generation_pallas.GenomeStorage`): the
+        initializer draws in f32 — the PRNG stream is unchanged — and
+        the drawn values are narrowed once here, so the population's
+        on-device residency is narrow from generation zero.
+        ``storage_bound`` is int8's symmetric quantization range."""
         keys = jax.random.split(key, n)
         genome = jax.vmap(attr)(keys)
+        if storage_dtype is not None and storage_dtype != "float32":
+            from .ops.generation_pallas import GenomeStorage
+            storage = GenomeStorage(storage_dtype, storage_bound)
+
+            def narrow(x):
+                return (storage.to_storage(x)
+                        if jnp.issubdtype(x.dtype, jnp.floating) else x)
+            genome = jax.tree_util.tree_map(narrow, genome)
         # retire `key` before drawing extra leaves: it was just consumed
         # by the split above, and split(key, 2) is a prefix of
         # split(key, n) — re-splitting it would hand the first extra leaf

@@ -41,7 +41,9 @@ def bench_json_targets(repo: Path) -> List[Tuple[str, Path]]:
     out: List[Tuple[str, Path]] = []
     _SPECIAL = {"BENCH_TRACE.json": "trace", "BENCH_MEMORY.json": "memory",
                 "BENCH_FLEET.json": "fleet", "BENCH_TSAN.json": "tsan",
-                "BENCH_PROFILE.json": "profile"}
+                "BENCH_PROFILE.json": "profile",
+                "BENCH_MEGAKERNEL.json": "megakernel",
+                "BENCH_PROBE_GA.json": "probe_ga"}
     for p in sorted(repo.glob("BENCH_*.json")):
         out.append((_SPECIAL.get(p.name, "bench"), p))
     for p in sorted(repo.glob("MULTICHIP_*.json")):
@@ -190,6 +192,84 @@ def _schema_errors(kind: str, doc) -> List[str]:
             errors.append("key 'programs_profiled' must be a positive "
                           "integer (the profiled legs must actually have "
                           "profiled something)")
+    elif kind == "megakernel":
+        # BENCH_MEGAKERNEL.json: the fused-generation before/after from
+        # tools/bench_megakernel.py — interleaved XLA-vs-Pallas legs
+        # plus the mixed-precision traffic fractions; a malformed
+        # commit (missing leg, non-finite wall, savings outside [0,1])
+        # fails tier-1 before the perf ledger reads it
+        require("cmd", str, "a string")
+        res = doc.get("result")
+        if not isinstance(res, dict):
+            errors.append("key 'result' must be an object")
+        else:
+            for leg in ("xla_f32", "mega_f32", "mega_bf16"):
+                sub = res.get(leg)
+                if not isinstance(sub, dict):
+                    errors.append(f"result.{leg} must be an object with "
+                                  "the leg's per-generation wall")
+                    continue
+                pg = sub.get("per_gen_ms")
+                if isinstance(pg, bool) or not isinstance(pg, (int, float)) \
+                        or not math.isfinite(float(pg)) or pg <= 0:
+                    errors.append(f"result.{leg}.per_gen_ms must be a "
+                                  "finite positive number")
+            for key in ("speedup_mega_f32", "bf16_traffic_savings_frac"):
+                v = res.get(key)
+                if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                        or not math.isfinite(float(v)):
+                    errors.append(f"result.{key} must be a finite number")
+            frac = res.get("bf16_traffic_savings_frac")
+            if isinstance(frac, (int, float)) and not isinstance(frac, bool) \
+                    and math.isfinite(float(frac)) \
+                    and not (0.0 <= float(frac) <= 1.0):
+                errors.append("result.bf16_traffic_savings_frac must lie "
+                              "in [0, 1] (a fraction of argument traffic)")
+    elif kind == "probe_ga":
+        # BENCH_PROBE_GA.json: the committed stage-budget report from
+        # tools/pallas_probe_ga.py --json — per-probe marginal walls +
+        # linearity witnesses; probes the backend cannot run must land
+        # in 'errors' (never as fabricated rows)
+        require("cmd", str, "a string")
+        res = doc.get("result")
+        if not isinstance(res, dict):
+            errors.append("key 'result' must be an object")
+        else:
+            for key in ("pop", "dim"):
+                v = res.get(key)
+                if isinstance(v, bool) or not isinstance(v, int) or v < 1:
+                    errors.append(f"result.{key} must be a positive "
+                                  "integer")
+            probes = res.get("probes")
+            if not isinstance(probes, list) or not probes:
+                errors.append("result.probes must be a non-empty list of "
+                              "probe records")
+            else:
+                for i, row in enumerate(probes):
+                    if not isinstance(row, dict) \
+                            or not isinstance(row.get("probe"), str):
+                        errors.append(f"result.probes[{i}] must be an "
+                                      "object with a 'probe' name")
+                        continue
+                    for key in ("ms", "linearity_t2k_over_tk"):
+                        v = row.get(key)
+                        if isinstance(v, bool) \
+                                or not isinstance(v, (int, float)) \
+                                or not math.isfinite(float(v)):
+                            errors.append(
+                                f"result.probes[{i}].{key} must be a "
+                                "finite number")
+            errs = res.get("errors")
+            if not isinstance(errs, list):
+                errors.append("result.errors must be a list (probes the "
+                              "backend could not run)")
+            else:
+                for i, row in enumerate(errs):
+                    if not isinstance(row, dict) \
+                            or not isinstance(row.get("probe"), str) \
+                            or not isinstance(row.get("error"), str):
+                        errors.append(f"result.errors[{i}] must be "
+                                      "{'probe': str, 'error': str}")
     elif kind == "perf_ledger":
         # PERF_LEDGER.json: the perf-regression ledger deap-tpu-perfgate
         # enforces — one schema, two gates (deap_tpu.perfledger is the

@@ -1,0 +1,662 @@
+"""Fused select→mate→mutate Pallas megakernel for the fixed-shape GA
+generation, plus the mixed-precision genome-storage tier it rides on.
+
+The flagship generation (``bench.py``: rank-tournament select, two-point
+crossover, Gaussian mutation) compiles under XLA into a chain of
+population-sized kernels, each materializing its output before the next
+reads it — the fusion-materialization pass counts those intermediates,
+and ``tools/pallas_probe_ga.py`` measured the stage budget (sort ~5 ms,
+winner-index gather ~7 ms, genome row-gather ~8 ms, fused var ~6-8 ms at
+pop=10⁶).  This module collapses the post-sort stages into ONE tiled
+Pallas pass over the population:
+
+* **selection** — the fitness argsort stays in XLA (the probes measured
+  XLA's sort as already near the floor); the kernel receives the rank
+  table VMEM-resident (``(pop/128, 128)`` int32) plus the tournament
+  winner *positions* (drawn by the exact inverse-CDF law of
+  :func:`deap_tpu.ops.selection.tournament_positions`, same key stream
+  as ``sel_tournament`` — winner indices are pinned bitwise-equal to the
+  XLA path) and resolves each row's winner with a dynamic-sublane read +
+  one-hot lane extract (the ``lookup`` probe pattern);
+* **gather** — winner genome rows are DMA-gathered from the HBM-resident
+  population with a window of in-flight ``make_async_copy``s (the
+  ``dmagather`` probe pattern);
+* **mate + mutate** — two-point crossover and Gaussian mutation applied
+  in-registers on the gathered tile, with an in-kernel counter-based
+  PRNG (`lowbias32`-style integer hash over ``(seed, row, lane, draw)``
+  — portable across interpret mode and TPU, so trajectories are
+  deterministic AND backend-independent; Box-Muller turns two uniforms
+  into the Gaussian noise).  ``hw_rng=True`` swaps in the TPU hardware
+  PRNG (``pltpu.prng_random_bits``) for maximum rate on chip, at the
+  cost of a hardware-specific stream;
+* **one output population written** — no per-operator materialization.
+
+**Gather modes.**  ``gather="dma"`` is the in-kernel form above.
+``gather="host"`` resolves winners and gathers rows with XLA's gather
+(measured on the bench chip as the best row-gather engine) and runs only
+the fused variation in-kernel — the profitable composition on backends
+whose Pallas path is the interpreter emulation (CPU), and the live-mask
+(serving) form.  Both modes draw the identical variation stream, so
+their outputs are bitwise-equal (test-pinned).
+
+**Mixed-precision storage.**  :class:`GenomeStorage` declares the
+on-device genome residency dtype: ``float32`` (default), ``bfloat16``
+(half traffic), or ``int8`` (quarter traffic; symmetric quantization
+``q = round(x * 127 / bound)``).  The kernel widens tiles to f32 on
+load, does ALL variation arithmetic in f32, and narrows on the single
+store; fitness stays f32 end to end (f32 accumulation).  An integer-
+valued genome stored ``int8`` with ``bound=127`` (scale 1) round-trips
+exactly — the exact-match contract the mixed-precision parity suite
+pins on OneMax.
+
+Interpret-mode fallback (``interpret=None`` → auto off-TPU) keeps
+tier-1 green on ``JAX_PLATFORMS=cpu``, same contract as
+:mod:`deap_tpu.ops.dominance_pallas` and :mod:`deap_tpu.gp.interp_pallas`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..base import lex_sort_indices
+from .selection import tournament_positions
+
+__all__ = ["GenomeStorage", "STORAGE_DTYPES", "fused_generation",
+           "fused_ea_step", "megakernel_params", "pad_dim", "LANE"]
+
+LANE = 128
+#: tile-row candidates, largest first; all are multiples of the int8
+#: sublane tile (32), so one list serves every storage dtype
+_TILE_ROWS = (512, 256, 128, 64, 32)
+
+STORAGE_DTYPES = ("float32", "bfloat16", "int8")
+
+
+# ---------------------------------------------------------------------------
+# genome storage (the mixed-precision tier)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class GenomeStorage:
+    """Declared on-device genome residency: ``dtype`` ∈
+    :data:`STORAGE_DTYPES`; ``bound`` is the symmetric quantization
+    range for ``int8`` (``scale = bound / 127``; required there, ignored
+    otherwise).  ``bound=127`` gives scale 1 — exact for integer-valued
+    genomes in [-127, 127]."""
+
+    dtype: str = "float32"
+    bound: float = 0.0
+
+    def __post_init__(self):
+        if self.dtype not in STORAGE_DTYPES:
+            raise ValueError(f"storage dtype {self.dtype!r}: expected one "
+                             f"of {STORAGE_DTYPES}")
+        if self.dtype == "int8" and not self.bound > 0.0:
+            raise ValueError("int8 genome storage needs bound > 0 "
+                             "(symmetric quantization range)")
+
+    @property
+    def jax_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def scale(self) -> float:
+        return float(self.bound) / 127.0 if self.dtype == "int8" else 1.0
+
+    @property
+    def is_narrow(self) -> bool:
+        return self.dtype != "float32"
+
+    def to_storage(self, x: jax.Array) -> jax.Array:
+        """f32 compute values → storage representation."""
+        x = jnp.asarray(x, jnp.float32)
+        if self.dtype == "int8":
+            q = jnp.round(x / jnp.float32(self.scale))
+            return jnp.clip(q, -127.0, 127.0).astype(jnp.int8)
+        return x.astype(self.jax_dtype)
+
+    def to_compute(self, x: jax.Array) -> jax.Array:
+        """Storage representation → f32 compute values."""
+        if self.dtype == "int8":
+            return x.astype(jnp.float32) * jnp.float32(self.scale)
+        return x.astype(jnp.float32)
+
+
+def storage_of(toolbox) -> Optional[GenomeStorage]:
+    """The toolbox's declared genome storage (``toolbox.genome_storage``,
+    a :class:`GenomeStorage`), or ``None`` — the f32 default whose code
+    path is bitwise-identical to before the storage tier existed."""
+    st = getattr(toolbox, "genome_storage", None)
+    if st is not None and not isinstance(st, GenomeStorage):
+        raise TypeError("toolbox.genome_storage must be a GenomeStorage")
+    return st
+
+
+def pad_dim(dim: int) -> int:
+    """Genome lane padding: the kernel streams (rows, pad_dim) tiles, so
+    the trailing axis rounds up to the 128-lane vector width."""
+    return max(LANE, -(-dim // LANE) * LANE)
+
+
+def _pick_rows(pop: int) -> int:
+    for r in _TILE_ROWS:
+        if pop % r == 0:
+            return r
+    raise ValueError(
+        f"megakernel population {pop} must be divisible by one of "
+        f"{_TILE_ROWS} (and by {LANE} for the VMEM rank table); pad the "
+        "population or use the XLA path")
+
+
+# ---------------------------------------------------------------------------
+# in-kernel counter PRNG (portable: interpret mode and TPU compile alike)
+# ---------------------------------------------------------------------------
+#
+# lowbias32-style avalanche hash over a (seed, draw, row, lane) counter.
+# Quality target is EC operator decisions (crossover points, Bernoulli
+# masks, Gaussian noise), not cryptography; the double multiply-xorshift
+# round passes the avalanche tests the lowbias32 constants were tuned
+# for.  All arithmetic is uint32 (wrapping), which Pallas vectorizes on
+# the VPU and the interpreter emulates exactly — one stream, every
+# backend.
+
+
+def _mix32(x: jax.Array) -> jax.Array:
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x21F0AAAD)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x735A2D97)
+    x = x ^ (x >> 15)
+    return x
+
+
+def _uniform_tile(seed: jax.Array, draw: int, shape: Tuple[int, int],
+                  row_base) -> jax.Array:
+    """(rows, lanes) uniforms in [0, 1): hash of the global (row, lane)
+    coordinates, the per-call seed, and a per-draw constant."""
+    rows = lax.broadcasted_iota(jnp.uint32, shape, 0) + row_base
+    lanes = lax.broadcasted_iota(jnp.uint32, shape, 1)
+    ctr = (rows * jnp.uint32(0x9E3779B9)
+           + lanes * jnp.uint32(0x85EBCA6B)
+           + jnp.uint32(draw) * jnp.uint32(0xC2B2AE35))
+    bits = _mix32(ctr ^ seed)
+    return (bits >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+
+
+def _seed_from_key(key: jax.Array) -> jax.Array:
+    """One int32 seed word from a jax PRNG key (typed or raw uint32):
+    the fold_in stream stays the single source of trajectory identity,
+    and the kernel's counter hash fans it out per (row, lane, draw)."""
+    data = (jax.random.key_data(key)
+            if jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key)
+            else jnp.asarray(key))
+    data = data.reshape(-1).astype(jnp.uint32)
+    mixed = data[-1] ^ (data[0] * jnp.uint32(0x9E3779B9))
+    return lax.bitcast_convert_type(mixed, jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# the fused variation (shared by both gather modes)
+# ---------------------------------------------------------------------------
+
+
+def _widen_tile(v: jax.Array, sdt, scale: float) -> jax.Array:
+    """Storage→f32 inside an executor body — the kernel-safe spelling
+    of :meth:`GenomeStorage.to_compute` (static dtype/scale operands).
+    One definition shared by all three executors, so the quantization
+    law cannot drift between them."""
+    v = v.astype(jnp.float32)
+    if sdt == jnp.int8:
+        v = v * jnp.float32(scale)
+    return v
+
+
+def _narrow_tile(v: jax.Array, sdt, scale: float) -> jax.Array:
+    """f32→storage on the single store — the kernel-safe spelling of
+    :meth:`GenomeStorage.to_storage`."""
+    if sdt == jnp.int8:
+        q = jnp.round(v * jnp.float32(1.0 / scale))
+        return jnp.clip(q, -127.0, 127.0).astype(jnp.int8)
+    return v.astype(sdt)
+
+
+def _vary_tile(v: jax.Array, seed: jax.Array, row_base, dim: int,
+               knobs, hw_rng: bool) -> jax.Array:
+    """Crossover + mutation on one gathered f32 tile ``v`` of shape
+    (R, dim_pad).  Pairing is halves-in-tile (row i mates row i + R/2):
+    winners are iid draws, so any fixed pairing is distributionally
+    identical to the reference's adjacent pairing (the same argument as
+    ``vary_genome(pairing="halves")``).  ``knobs`` is the SMEM scalar
+    vector [cxpb, mutpb, mut_mu, mut_sigma, indpb].  Draw order is
+    fixed; every draw folds the per-call seed with a distinct draw id,
+    so streams never collide across draws, tiles, or generations."""
+    R, dpad = v.shape
+    half = R // 2
+    cxpb, mutpb = knobs[0], knobs[1]
+    mu, sigma, indpb = knobs[2], knobs[3], knobs[4]
+
+    if hw_rng:
+        pltpu.prng_seed(seed, row_base // jnp.int32(max(R, 1)))
+        useed = jnp.uint32(0)
+
+        def draw(d, shape):
+            del d
+            bits = pltpu.prng_random_bits(shape)
+            return ((bits.astype(jnp.uint32) >> 8).astype(jnp.float32)
+                    * jnp.float32(1.0 / (1 << 24)))
+    else:
+        useed = lax.bitcast_convert_type(seed, jnp.uint32)
+
+        def draw(d, shape):
+            return _uniform_tile(useed, d, shape, row_base)
+
+    cols = lax.broadcasted_iota(jnp.int32, (half, dpad), 1)
+
+    # --- two-point crossover on (i, i + R/2) pairs -----------------------
+    # the counter hash is COORDINATE-based: a (half, 8) draw grid holds
+    # the identical values at lanes 0..2 as a (half, LANE) one would, so
+    # narrow per-row draws cost 8 lanes of hashing, not 128
+    u_pair = draw(1, (half, 8))             # lanes 0..2 consumed
+    do_cx = u_pair[:, 0:1] < cxpb
+    # reference _two_cut_points law: c1 ∈ [1, dim], c2 ∈ [1, dim-1]
+    # bumped past c1, then ordered
+    c1 = 1 + jnp.floor(u_pair[:, 1:2] * dim).astype(jnp.int32)
+    c1 = jnp.minimum(c1, dim)
+    c2 = 1 + jnp.floor(u_pair[:, 2:3] * (dim - 1)).astype(jnp.int32)
+    c2 = jnp.minimum(c2, dim - 1)
+    c2 = jnp.where(c2 >= c1, c2 + 1, c2)
+    lo = jnp.minimum(c1, c2)
+    hi = jnp.maximum(c1, c2)
+    swap = do_cx & (cols >= lo) & (cols < hi)
+    ga, gb = v[:half], v[half:]
+    na = jnp.where(swap, gb, ga)
+    nb = jnp.where(swap, ga, gb)
+    v = jnp.concatenate([na, nb], axis=0)
+
+    # --- Gaussian mutation (per-row gate, per-gene mask + noise) ---------
+    # ONE uniform grid serves both the per-gene Bernoulli mask and the
+    # Gaussian draw: conditional on u < indpb, u/indpb is itself
+    # uniform(0, 1) and independent across genes, so feeding it through
+    # the inverse normal CDF is distributionally exact while halving
+    # the hash traffic of a separate noise draw; the clip bounds the
+    # tail at ~5.4σ (the same truncation a 24-bit Box-Muller radius
+    # carries)
+    u_row = draw(2, (R, 8))
+    do_mut = u_row[:, 0:1] < mutpb
+    u_gene = draw(3, (R, dpad))
+    gene = u_gene < indpb
+    un = jnp.clip(u_gene * (1.0 / indpb),
+                  jnp.float32(2.0 ** -25), jnp.float32(1.0 - 2.0 ** -25))
+    z = jnp.float32(1.4142135623730951) * lax.erf_inv(2.0 * un - 1.0)
+    noise = mu + sigma * z
+    cols_full = lax.broadcasted_iota(jnp.int32, (R, dpad), 1)
+    return jnp.where(do_mut & gene & (cols_full < dim), v + noise, v)
+
+
+# ---------------------------------------------------------------------------
+# the megakernel
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "dim", "tournsize", "rows", "window", "storage_dtype", "scale",
+    "hw_rng", "interpret"))
+def _megakernel_dma(order, pos, seed, knobs, genome, *, dim: int,
+                    tournsize: int, rows: int, window: int,
+                    storage_dtype: str, scale: float, hw_rng: bool,
+                    interpret: bool):
+    """The one-pass form: winner resolution against the VMEM-resident
+    rank table, per-row DMA genome gather from HBM, fused variation,
+    one output tile written.  Returns ``(new_genome, winner_idx)``."""
+    del tournsize      # consumed by the position law outside
+    pop, dpad = genome.shape
+    tab_rows = pop // LANE
+    sdt = jnp.dtype(storage_dtype)
+
+    def kernel(pos_ref, order_ref, seed_ref, knobs_ref, g_ref,
+               out_ref, widx_ref, parents, sems):
+        lanes1 = lax.broadcasted_iota(jnp.int32, (1, LANE), 1)
+
+        def resolve(r):
+            p = pos_ref[r, 0]
+            row = order_ref[p // LANE, :].reshape(1, LANE)
+            return jnp.sum(jnp.where(lanes1 == p % LANE, row, 0))
+
+        def copy(r, w):
+            return pltpu.make_async_copy(
+                g_ref.at[pl.ds(w, 1), :],
+                parents.at[pl.ds(r, 1), :],
+                sems.at[r % window])
+
+        def wait(r):
+            copy(r, widx_ref[r, 0]).wait()
+
+        def body(r, _):
+            w = resolve(r)
+            widx_ref[r, 0] = w
+            copy(r, w).start()
+            lax.cond(r >= window, lambda: wait(r - window), lambda: None)
+            return 0
+
+        lax.fori_loop(0, rows, body, 0, unroll=False)
+
+        def drain(r, _):
+            wait(r)
+            return 0
+
+        lax.fori_loop(rows - window, rows, drain, 0, unroll=False)
+
+        v = _widen_tile(parents[:], sdt, scale)
+        row_base = (pl.program_id(0) * rows).astype(jnp.uint32)
+        v = _vary_tile(v, seed_ref[0], row_base, dim, knobs_ref, hw_rng)
+        out_ref[:] = _narrow_tile(v, sdt, scale)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(pop // rows,),
+        in_specs=[
+            pl.BlockSpec((rows, 1), lambda g: (g, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((tab_rows, LANE), lambda g: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((rows, dpad), lambda g: (g, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, 1), lambda g: (g, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((pop, dpad), sdt),
+            jax.ShapeDtypeStruct((pop, 1), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((rows, dpad), sdt),
+                        pltpu.SemaphoreType.DMA((window,))],
+        interpret=interpret,
+    )(pos[:, None], order.reshape(tab_rows, LANE), seed.reshape(1),
+      knobs, genome)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "dim", "rows", "storage_dtype", "scale"))
+def _megakernel_xla_exec(parents, seed, knobs, *, dim: int, rows: int,
+                         storage_dtype: str, scale: float):
+    """The fused variation evaluated as plain traced XLA ops: the SAME
+    tile function, vmapped over the tile axis with the same per-tile
+    row bases, so the output is bitwise-identical to the Pallas
+    executor (test-pinned).  This is the non-TPU execution engine — the
+    Pallas interpreter emulates refs per grid step and measured ~6x
+    slower than XLA's own fusion of the identical op graph, while on
+    TPU the hand-scheduled kernel is the point."""
+    sdt = jnp.dtype(storage_dtype)
+    pop, dpad = parents.shape
+    v = _widen_tile(parents, sdt, scale)
+    tiles = v.reshape(pop // rows, rows, dpad)
+    row_bases = jnp.arange(pop // rows, dtype=jnp.uint32) * jnp.uint32(rows)
+    out = jax.vmap(lambda t, rb: _vary_tile(t, seed, rb, dim, knobs,
+                                            False))(tiles, row_bases)
+    return _narrow_tile(out.reshape(pop, dpad), sdt, scale)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "dim", "rows", "storage_dtype", "scale", "hw_rng", "interpret"))
+def _megakernel_host(parents, seed, knobs, *, dim: int, rows: int,
+                     storage_dtype: str, scale: float, hw_rng: bool,
+                     interpret: bool):
+    """The host-gather form: winners already gathered (XLA's gather —
+    measured the best row-gather engine on the bench chip, and the only
+    compiled one under the interpreter); the kernel runs the fused
+    variation pass only.  Identical draw stream to the DMA form, so the
+    two outputs are bitwise-equal."""
+    pop, dpad = parents.shape
+    sdt = jnp.dtype(storage_dtype)
+
+    def kernel(seed_ref, knobs_ref, p_ref, out_ref):
+        v = _widen_tile(p_ref[:], sdt, scale)
+        row_base = (pl.program_id(0) * rows).astype(jnp.uint32)
+        v = _vary_tile(v, seed_ref[0], row_base, dim, knobs_ref, hw_rng)
+        out_ref[:] = _narrow_tile(v, sdt, scale)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(pop // rows,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((rows, dpad), lambda g: (g, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rows, dpad), lambda g: (g, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((pop, dpad), sdt),
+        interpret=interpret,
+    )(seed.reshape(1), knobs, parents)
+
+
+def fused_generation(k_sel, k_var, genome, wvalues, *, dim: int,
+                     cxpb, mutpb, mut_mu=0.0, mut_sigma=0.3, indpb=0.05,
+                     tournsize: int = 3,
+                     storage: Optional[GenomeStorage] = None,
+                     live_n=None, rows: Optional[int] = None,
+                     window: int = 16, gather: Optional[str] = None,
+                     vary_exec: Optional[str] = None,
+                     hw_rng: bool = False,
+                     interpret: Optional[bool] = None):
+    """One fused GA generation over a ``(pop, pad_dim(dim))`` genome in
+    storage representation: tournament-select pop winners against
+    ``wvalues`` (``(pop, nobj)`` f32 weighted fitness, ``-inf`` for
+    invalid rows), two-point-cross and Gaussian-mutate them in one
+    Pallas pass, and return ``(new_genome, winner_idx)`` — the new
+    population in the same storage dtype plus the ``(pop,)`` int32
+    winner indices (bitwise-equal to
+    ``sel_tournament(..., tie_break="rank")`` under the same ``k_sel``).
+
+    ``gather`` picks the composition (module docstring): ``"dma"``
+    (in-kernel winner resolution + HBM row DMA), ``"host"`` (XLA
+    gather + fused variation), or ``None`` — dma on TPU, host
+    elsewhere.  ``vary_exec`` picks the variation executor in host
+    mode: ``"pallas"`` (the kernel; interpret-emulated off TPU) or
+    ``"xla"`` (the same tile function as traced ops — bitwise-equal,
+    and the fast engine wherever Pallas runs interpreted); ``None`` =
+    pallas on TPU, xla elsewhere.  ``live_n`` (host mode only) is the
+    serving layer's live-prefix contract: winner indices remap into the
+    live prefix and pad rows pass through bitwise-untouched."""
+    storage = storage or GenomeStorage()
+    pop, dpad = genome.shape
+    if genome.dtype != storage.jax_dtype:
+        raise ValueError(f"genome dtype {genome.dtype} != declared "
+                         f"storage {storage.dtype}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if gather is None:
+        gather = "host" if interpret else "dma"
+    if gather not in ("dma", "host"):
+        raise ValueError(f"gather {gather!r}: expected 'dma' or 'host'")
+    if gather == "dma" and live_n is not None:
+        raise ValueError("live-masked megakernel steps use gather='host' "
+                         "(the serving composition); the dma form is the "
+                         "fixed-shape flagship path")
+    if vary_exec is None:
+        vary_exec = "xla" if interpret else "pallas"
+    if vary_exec not in ("pallas", "xla"):
+        raise ValueError(f"vary_exec {vary_exec!r}: expected 'pallas' "
+                         "or 'xla'")
+    # the Pallas executors stream (rows, 128k) VMEM tiles and need the
+    # lane padding; the traced-XLA executor computes the identical
+    # values on an unpadded (pop, dim) layout (the hash stream is
+    # coordinate-based), skipping ~28% dead-lane work at dim=100
+    unpadded_ok = gather == "host" and vary_exec == "xla"
+    if dpad != pad_dim(dim) and not (unpadded_ok and dpad == dim):
+        raise ValueError(
+            f"genome trailing axis {dpad} != pad_dim({dim}) = "
+            f"{pad_dim(dim)} (the unpadded (pop, {dim}) layout is only "
+            "valid for the host-gather + XLA-executor composition)")
+    rows = rows or _pick_rows(pop)
+    if pop % rows or rows % 2:
+        raise ValueError(f"rows {rows} must divide pop {pop} and be even")
+    if gather == "dma":
+        if pop % LANE:
+            raise ValueError(
+                f"gather='dma' needs pop % {LANE} == 0 (the winner rank "
+                f"table is VMEM-resident as (pop/{LANE}, {LANE})); got "
+                f"pop={pop}")
+        if window < 1:
+            raise ValueError(f"window {window} must be >= 1")
+        # more in-flight copies than rows would drain semaphores whose
+        # copies never started (negative drain range)
+        window = min(window, rows)
+
+    order = lex_sort_indices(jnp.asarray(wvalues, jnp.float32),
+                             descending=True).astype(jnp.int32)
+    pos = tournament_positions(k_sel, pop, pop, tournsize)
+    seed = _seed_from_key(k_var)
+    knobs = jnp.stack([jnp.asarray(v, jnp.float32) for v in
+                       (cxpb, mutpb, mut_mu, mut_sigma, indpb)])
+
+    if gather == "dma":
+        new_genome, widx = _megakernel_dma(
+            order, pos, seed, knobs, genome, dim=dim, tournsize=tournsize,
+            rows=rows, window=window, storage_dtype=storage.dtype,
+            scale=storage.scale, hw_rng=hw_rng, interpret=interpret)
+        return new_genome, widx[:, 0]
+
+    widx = order.at[pos].get(mode="promise_in_bounds")
+    if live_n is not None:
+        live_n = jnp.maximum(jnp.asarray(live_n, jnp.int32), 1)
+        widx = jnp.where(widx < live_n, widx, widx % live_n)
+    parents = genome.at[widx].get(mode="promise_in_bounds")
+    if vary_exec == "xla":
+        varied = _megakernel_xla_exec(parents, seed, knobs, dim=dim,
+                                      rows=rows,
+                                      storage_dtype=storage.dtype,
+                                      scale=storage.scale)
+    else:
+        varied = _megakernel_host(parents, seed, knobs, dim=dim, rows=rows,
+                                  storage_dtype=storage.dtype,
+                                  scale=storage.scale, hw_rng=hw_rng,
+                                  interpret=interpret)
+    if live_n is not None:
+        live = jnp.arange(pop)[:, None] < live_n
+        varied = jnp.where(live, varied, genome)
+    return varied, widx
+
+
+# ---------------------------------------------------------------------------
+# algorithm-level integration (the ea_step engine)
+# ---------------------------------------------------------------------------
+
+
+def megakernel_params(toolbox) -> dict:
+    """Extract (and validate) the megakernel's operator parameters from
+    a toolbox.  The fused kernel hard-codes the flagship operator set —
+    ``sel_tournament`` (rank positions), ``cx_two_point``, and
+    ``mut_gaussian`` — so a toolbox registered with anything else raises
+    here instead of silently running different operators."""
+    from . import crossover, mutation, selection as sel_mod
+
+    def base_fn(tool):
+        return getattr(tool, "func", tool)
+
+    if base_fn(toolbox.select) is not sel_mod.sel_tournament:
+        raise ValueError("megakernel generation needs "
+                         "select=sel_tournament (rank-position law); got "
+                         f"{getattr(base_fn(toolbox.select), '__name__', '?')}")
+    if base_fn(toolbox.mate) is not crossover.cx_two_point:
+        raise ValueError("megakernel generation needs mate=cx_two_point; "
+                         f"got {getattr(base_fn(toolbox.mate), '__name__', '?')}")
+    if base_fn(toolbox.mutate) is not mutation.mut_gaussian:
+        raise ValueError("megakernel generation needs mutate=mut_gaussian; "
+                         f"got {getattr(base_fn(toolbox.mutate), '__name__', '?')}")
+    for name in ("select", "mate", "mutate"):
+        if getattr(getattr(toolbox, name), "args", ()):
+            # positional frozen args are ambiguous (same rule as the
+            # algorithms-layer batched dispatch): silently substituting
+            # defaults would run parameters the user never set
+            raise ValueError(
+                f"megakernel generation: toolbox.{name} froze positional "
+                "arguments; register operator parameters as keywords "
+                "(tournsize=, mu=, sigma=, indpb=)")
+    sel_kw = dict(getattr(toolbox.select, "keywords", {}))
+    mut_kw = dict(getattr(toolbox.mutate, "keywords", {}))
+    if sel_kw.get("tie_break", "random") != "rank":
+        # the kernel resolves winners from the deterministic rank table
+        # (no per-call tie jitter); honoring the bitwise-index contract
+        # means refusing a toolbox that asked for the jittered tie law
+        raise ValueError(
+            "megakernel generation resolves winners from the rank table: "
+            "register select=sel_tournament with tie_break='rank' (the "
+            "default tie_break='random' jitters ties per call, which the "
+            "fused kernel does not implement)")
+    return {"tournsize": int(sel_kw.get("tournsize", 3)),
+            "mut_mu": mut_kw.get("mu", 0.0),
+            "mut_sigma": mut_kw.get("sigma", 0.3),
+            "indpb": mut_kw.get("indpb", 0.05)}
+
+
+def fused_ea_step(key, population, toolbox, cxpb, mutpb, *, live=None,
+                  gather: Optional[str] = None, hw_rng: bool = False):
+    """The megakernel form of one :func:`deap_tpu.algorithms.ea_step`
+    generation — selected by registering ``toolbox.generation_engine =
+    "megakernel"`` (``ea_step`` routes here, which also covers the
+    serving layer's step programs).  Semantics are *reevaluate-all*:
+    every produced row comes back invalid and the caller's tell half
+    evaluates the full (live) population; selection winner indices are
+    bitwise-identical to the XLA path, the variation stream is the
+    kernel's own (deterministic per key).  The genome must be a single
+    2-D float leaf; it is lane-padded around the kernel call."""
+    import dataclasses as _dc
+
+    from ..base import Fitness, Population
+
+    genome = population.genome
+    if not isinstance(genome, jax.Array) or genome.ndim != 2:
+        raise ValueError("megakernel generation needs a single 2-D array "
+                         "genome (pop, dim)")
+    params = megakernel_params(toolbox)
+    storage = storage_of(toolbox) or GenomeStorage()
+    pop, dim = genome.shape
+    interpret = jax.default_backend() != "tpu"
+    if live is not None and gather is None:
+        gather = "host"
+    resolved_gather = gather or ("host" if interpret else "dma")
+    # the traced-XLA executor (non-TPU host composition) runs unpadded
+    dpad = dim if (resolved_gather == "host" and interpret) else pad_dim(dim)
+
+    key, k_sel, k_var = jax.random.split(key, 3)
+    live_n = None
+    if live is not None:
+        live = jnp.asarray(live, bool)
+        live_n = jnp.sum(live.astype(jnp.int32))
+
+    padded = genome
+    if dpad != dim:
+        pad = jnp.zeros((pop, dpad - dim), genome.dtype)
+        padded = jnp.concatenate([genome, pad], axis=1)
+    new_padded, _ = fused_generation(
+        k_sel, k_var, padded, population.fitness.masked_wvalues(),
+        dim=dim, cxpb=cxpb, mutpb=mutpb, storage=storage,
+        tournsize=params["tournsize"], mut_mu=params["mut_mu"],
+        mut_sigma=params["mut_sigma"], indpb=params["indpb"],
+        live_n=live_n, gather=gather, hw_rng=hw_rng)
+    new_genome = new_padded[:, :dim] if dpad != dim else new_padded
+
+    fit = Fitness.empty(pop, population.fitness.weights,
+                        population.fitness.values.dtype)
+    if live is not None:
+        # pad rows keep their (invalid) fitness row values; the live
+        # prefix is freshly invalid, same as the XLA ask half
+        fit = _dc.replace(fit, values=jnp.where(
+            live[:, None], fit.values, population.fitness.values))
+    return key, Population(new_genome, fit)

@@ -21,7 +21,8 @@ from jax import lax
 from ..base import Fitness, lex_argmax, lex_sort_indices
 
 __all__ = [
-    "sel_random", "sel_best", "sel_worst", "sel_tournament", "sel_roulette",
+    "sel_random", "sel_best", "sel_worst", "sel_tournament",
+    "tournament_positions", "sel_roulette",
     "sel_double_tournament", "sel_stochastic_universal_sampling",
     "sel_lexicase", "sel_epsilon_lexicase", "sel_automatic_epsilon_lexicase",
 ]
@@ -50,6 +51,20 @@ def sel_worst(key, fitness, k):
     """Bottom-``k`` (reference selection.py:39-49)."""
     del key
     return lex_sort_indices(_wv(fitness), descending=False)[:k]
+
+
+def tournament_positions(key, n, k, tournsize):
+    """The rank positions of ``k`` tournament winners: the best rank
+    among ``tournsize`` iid uniform ranks, drawn by inverse CDF
+    (``P(pos < r) = 1 - (1 - r/n)^tournsize``).  Factored out of
+    :func:`sel_tournament` so the fused Pallas generation kernel
+    (:mod:`deap_tpu.ops.generation_pallas`) draws the *identical*
+    position stream — winner indices of the two paths are pinned
+    bitwise-equal by test."""
+    u = jax.random.uniform(key, (k,))
+    # best rank among tournsize iid uniforms: F(r) = 1 - (1 - r/n)^ts
+    pos = jnp.floor(n * -jnp.expm1(jnp.log1p(-u) / tournsize)).astype(jnp.int32)
+    return jnp.clip(pos, 0, n - 1)
 
 
 def sel_tournament(key, fitness, k, tournsize, tie_break="random"):
@@ -98,10 +113,7 @@ def sel_tournament(key, fitness, k, tournsize, tie_break="random"):
     else:
         raise ValueError(f"tie_break {tie_break!r}: expected 'random' or "
                          "'rank'")
-    u = jax.random.uniform(key, (k,))
-    # best rank among tournsize iid uniforms: F(r) = 1 - (1 - r/n)^ts
-    pos = jnp.floor(n * -jnp.expm1(jnp.log1p(-u) / tournsize)).astype(jnp.int32)
-    pos = jnp.clip(pos, 0, n - 1)
+    pos = tournament_positions(key, n, k, tournsize)
     return order[pos]
 
 

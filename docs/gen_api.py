@@ -39,6 +39,10 @@ PAGES = {
                       ["deap_tpu.ops.selection"]),
     "ops.emo": ("Multi-objective selection (deap_tpu.ops.emo)",
                 ["deap_tpu.ops.emo"]),
+    "ops.generation_pallas": (
+        "Fused generation megakernel & genome storage "
+        "(deap_tpu.ops.generation_pallas)",
+        ["deap_tpu.ops.generation_pallas"]),
     "ops.migration": ("Island migration (deap_tpu.ops.migration)",
                       ["deap_tpu.ops.migration"]),
     "ops.constraint": ("Constraint handling (deap_tpu.ops.constraint)",

@@ -17,7 +17,7 @@ import pytest
 
 from deap_tpu.analysis import hlo
 from deap_tpu.analysis.inventory import (INVENTORY, Lowered, ProgramEntry,
-                                         entries, lower_entry)
+                                         entries, get_entry, lower_entry)
 from deap_tpu.analysis.passes import (DONATION_MIN_BYTES, PASS_NAMES,
                                       AnalysisResult, budget_findings,
                                       callback_findings, compare_budget,
@@ -430,6 +430,99 @@ def test_dtype_traffic_enforces_declared_storage_dtype():
         return fn, (jnp.zeros((64, 8), jnp.bfloat16),)
     ok = _entry(narrow_build, storage_dtype="bfloat16")
     assert list(dtype_findings(lower_entry(ok))) == []
+
+
+def test_dtype_traffic_threshold_is_pop_sized():
+    """The storage audit fires on POP-SIZED wide buffers only: an f32
+    fitness column beside a (larger) narrow genome is the mixed-
+    precision tier's *design* (f32 accumulation) and stays clean; an
+    f32 buffer at genome size is the width-mismatch can-fail."""
+    def mixed_build(variant: int = 0):
+        def fn(g, fit):
+            return g, fit * 2.0
+        return fn, (jnp.zeros((64, 32), jnp.bfloat16),     # 4096 B genome
+                    jnp.zeros((64, 1), jnp.float32))       # 256 B fitness
+    ok = _entry(mixed_build, storage_dtype="bfloat16")
+    assert list(dtype_findings(lower_entry(ok))) == []
+
+    def leaked_build(variant: int = 0):
+        def fn(g, g_wide):
+            return g, g_wide.sum()        # wide ARG, narrow outputs
+        return fn, (jnp.zeros((64, 32), jnp.bfloat16),
+                    jnp.zeros((64, 32), jnp.float32))      # genome-sized!
+    f = list(dtype_findings(lower_entry(
+        _entry(leaked_build, storage_dtype="bfloat16"))))
+    assert len(f) == 1 and "pop-sized" in f[0].message \
+        and "argument" in f[0].message
+
+
+def test_dtype_traffic_flags_wide_output_and_int8_declaration():
+    """Output-side twin of the width audit (a program that RETURNS the
+    population wide gives the win back to every consumer), and the int8
+    declaration makes any pop-sized float leaf a violation."""
+    def widening_build(variant: int = 0):
+        def fn(g):
+            return g.astype(jnp.float32) * 2.0             # wide return
+        return fn, (jnp.zeros((64, 32), jnp.bfloat16),)
+    f = list(dtype_findings(lower_entry(
+        _entry(widening_build, storage_dtype="bfloat16"))))
+    assert len(f) == 1 and "output" in f[0].message
+
+    def f32_build(variant: int = 0):
+        def fn(g):
+            return g * 2.0
+        return fn, (jnp.zeros((64, 32), jnp.float32),)
+    f = list(dtype_findings(lower_entry(
+        _entry(f32_build, storage_dtype="int8"))))
+    assert len(f) == 2          # argument AND output side
+    assert all("int8" in x.message for x in f)
+
+
+def test_megakernel_entries_declare_storage_and_budget():
+    """The two fused-generation entries are gated from day one:
+    budget=True, donation declared, storage dtypes declared (the bf16
+    entry is the dtype-traffic pass's standing clean pin)."""
+    for name, sd in (("ga_generation_megakernel", "float32"),
+                     ("ga_generation_megakernel_bf16", "bfloat16")):
+        e = get_entry(name)
+        assert e.budget and e.donate == (0, 1, 2)
+        assert e.storage_dtype == sd
+        assert list(dtype_findings(lower_entry(e))) == []
+
+
+def test_fusion_budget_requires_committed_counts(tmp_path):
+    """Satellite: a NEW inventory entry whose committed budget row
+    carries footprint bytes but no fusion-materialization counts was
+    silently ungated — now it is a finding, and the one-lowering
+    ``--update-budget`` refresh (update_memory_budget) writes the
+    counts that clear it."""
+    from deap_tpu.analysis.passes import compare_memory_budget
+    rows = {"prog": {"large_intermediates": 3, "elementwise_roots": 0}}
+    hand_edited = {"prog": {"peak_bytes": 999999}}    # no fusion counts
+    v = compare_memory_budget(rows, hand_edited, byte_keys=(),
+                              report_missing=False,
+                              require_count_keys=True)
+    assert len(v) == 2 and all("no committed" in x for x in v)
+    # without the requirement (the memory pass's view) nothing fires
+    assert compare_memory_budget(rows, hand_edited, byte_keys=(),
+                                 report_missing=False) == []
+
+    low = lower_entry(_entry(_clean_mem_build, name="fixture_prog",
+                             donate_waiver="fixture"))
+    path = tmp_path / "memory_budget.json"
+    doc = update_memory_budget(path, lows=[low])
+    # the refresh wrote the gated count keys off the same lowering
+    assert "large_intermediates" in doc["budget"]["fixture_prog"]
+    assert "elementwise_roots" in doc["budget"]["fixture_prog"]
+    assert list(fusion_findings([low], path=path)) == []
+    # strip the counts (the hand-edit) -> the fusion pass fails
+    stripped = json.loads(path.read_text())
+    for k in ("large_intermediates", "elementwise_roots"):
+        stripped["budget"]["fixture_prog"].pop(k)
+    path.write_text(json.dumps(stripped))
+    f = list(fusion_findings([low], path=path))
+    assert len(f) == 2 and all("fusion budget missing" in x.message
+                               for x in f)
 
 
 def test_run_analysis_reports_per_pass_wall_time():

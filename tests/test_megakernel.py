@@ -1,0 +1,402 @@
+"""Fused select→mate→mutate megakernel + mixed-precision genome storage
+(deap_tpu/ops/generation_pallas.py; ISSUE 15 acceptance surface).
+
+Pins, in interpret mode on CPU:
+
+* selection winner indices of the fused kernel bitwise-identical to the
+  XLA ``sel_tournament(tie_break="rank")`` path under the same key;
+* the three executors (in-kernel DMA gather, host-gather Pallas
+  variation, host-gather traced-XLA variation) produce bitwise-equal
+  populations — one trajectory, every backend;
+* cx/mut statistics and the no-op passthrough;
+* the ``ea_step`` engine routing (``toolbox.generation_engine``) and
+  the serving live-mask contract (frozen pads, live-prefix purity);
+* the statistical-parity suite for mixed precision: OneMax bf16/int8
+  trajectories bitwise-equal to f32 (exact-representable genomes), and
+  rastrigin convergence within tolerance horizons at every storage
+  dtype.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import base, benchmarks, creator
+from deap_tpu.algorithms import ea_simple, ea_step, evaluate_population
+from deap_tpu.base import Fitness, Population
+from deap_tpu.ops import crossover, mutation, selection
+from deap_tpu.ops import generation_pallas as gpk
+from deap_tpu.ops.generation_pallas import (GenomeStorage, fused_generation,
+                                            megakernel_params, pad_dim)
+
+POP, DIM = 256, 20
+DPAD = pad_dim(DIM)
+
+
+@pytest.fixture(scope="module")
+def small_pop():
+    key = jax.random.PRNGKey(42)
+    genome = jax.random.uniform(jax.random.fold_in(key, 1), (POP, DPAD),
+                                jnp.float32, -5.12, 5.12)
+    genome = genome.at[:, DIM:].set(0.0)
+    values = jax.vmap(lambda x: jnp.sum(x[:DIM] ** 2))(genome)[:, None]
+    fit = Fitness(values=values, valid=jnp.ones(POP, bool),
+                  weights=(-1.0,))
+    return key, genome, fit
+
+
+def _mega_toolbox(storage=None):
+    tb = base.Toolbox()
+    tb.register("evaluate", benchmarks.rastrigin)
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=0.3,
+                indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3,
+                tie_break="rank")
+    tb.generation_engine = "megakernel"
+    if storage is not None:
+        tb.genome_storage = storage
+    return tb
+
+
+# ---------------------------------------------------------------------------
+# selection identity + executor equivalence (the acceptance pins)
+# ---------------------------------------------------------------------------
+
+
+def test_winner_indices_bitwise_identical_to_xla(small_pop):
+    """THE index-identity pin: the kernel resolves tournament winners
+    from the same rank table + position stream as sel_tournament, so
+    the f32 megakernel's selection indices are bitwise-equal to the XLA
+    path under the same key — in interpret mode, through the in-kernel
+    VMEM lookup."""
+    key, genome, fit = small_pop
+    k_sel, k_var = jax.random.split(key)
+    idx_xla = selection.sel_tournament(k_sel, fit, POP, tournsize=3,
+                                       tie_break="rank")
+    _, widx = fused_generation(k_sel, k_var, genome,
+                               fit.masked_wvalues(), dim=DIM,
+                               cxpb=0.9, mutpb=0.5, gather="dma")
+    assert np.array_equal(np.asarray(widx), np.asarray(idx_xla))
+
+
+def test_three_executors_bitwise_equal(small_pop):
+    """dma (in-kernel lookup + DMA gather), host+pallas (XLA gather +
+    kernel variation) and host+xla (same tile function as traced ops)
+    are one program: bitwise-equal outputs, including the unpadded
+    layout of the XLA executor."""
+    key, genome, fit = small_pop
+    k_sel, k_var = jax.random.split(key)
+    w = fit.masked_wvalues()
+    kw = dict(dim=DIM, cxpb=0.9, mutpb=0.5, rows=128)
+    g_dma, i_dma = fused_generation(k_sel, k_var, genome, w,
+                                    gather="dma", **kw)
+    g_hp, i_hp = fused_generation(k_sel, k_var, genome, w,
+                                  gather="host", vary_exec="pallas", **kw)
+    g_hx, i_hx = fused_generation(k_sel, k_var, genome[:, :DIM], w,
+                                  gather="host", vary_exec="xla", **kw)
+    assert np.array_equal(np.asarray(g_dma), np.asarray(g_hp))
+    assert np.array_equal(np.asarray(g_dma)[:, :DIM], np.asarray(g_hx))
+    assert np.array_equal(np.asarray(i_dma), np.asarray(i_hp))
+    assert np.array_equal(np.asarray(i_dma), np.asarray(i_hx))
+
+
+def test_noop_variation_is_pure_gather(small_pop):
+    """cxpb=0, mutpb=0: the fused pass degenerates to the selection
+    gather — output rows are exactly the winners' rows (pad lanes
+    included)."""
+    key, genome, fit = small_pop
+    k_sel, k_var = jax.random.split(key)
+    out, widx = fused_generation(k_sel, k_var, genome,
+                                 fit.masked_wvalues(), dim=DIM,
+                                 cxpb=0.0, mutpb=0.0, gather="dma")
+    ref = np.asarray(genome)[np.asarray(widx)]
+    assert np.array_equal(np.asarray(out), ref)
+
+
+def test_variation_statistics(small_pop):
+    """Coarse operator-law checks of the in-kernel stream: mutation
+    touches ~indpb of genes when every row mutates, the noise is
+    ~N(mu, sigma), and pad lanes never change."""
+    key, genome, fit = small_pop
+    k_sel, k_var = jax.random.split(key)
+    out, widx = fused_generation(k_sel, k_var, genome,
+                                 fit.masked_wvalues(), dim=DIM,
+                                 cxpb=0.0, mutpb=1.0, indpb=1.0,
+                                 mut_mu=0.0, mut_sigma=1.0, gather="dma")
+    d = (np.asarray(out) - np.asarray(genome)[np.asarray(widx)])
+    body, pad = d[:, :DIM].ravel(), d[:, DIM:]
+    assert np.array_equal(pad, np.zeros_like(pad))
+    assert (body != 0).mean() > 0.99
+    assert abs(body.mean()) < 0.05 and abs(body.std() - 1.0) < 0.05
+
+    out2, widx2 = fused_generation(k_sel, k_var, genome,
+                                   fit.masked_wvalues(), dim=DIM,
+                                   cxpb=0.0, mutpb=1.0, indpb=0.1,
+                                   gather="dma")
+    frac = ((np.asarray(out2) - np.asarray(genome)[np.asarray(widx2)])
+            [:, :DIM] != 0).mean()
+    assert 0.06 < frac < 0.14        # ~indpb of genes
+
+
+def test_shape_and_mode_validation(small_pop):
+    key, genome, fit = small_pop
+    k_sel, k_var = jax.random.split(key)
+    w = fit.masked_wvalues()
+    with pytest.raises(ValueError, match="pad_dim"):
+        fused_generation(k_sel, k_var, genome[:, :DIM], w, dim=DIM,
+                         cxpb=0.5, mutpb=0.5, gather="dma")
+    with pytest.raises(ValueError, match="gather"):
+        fused_generation(k_sel, k_var, genome, w, dim=DIM,
+                         cxpb=0.5, mutpb=0.5, gather="nope")
+    with pytest.raises(ValueError, match="live-masked"):
+        fused_generation(k_sel, k_var, genome, w, dim=DIM, cxpb=0.5,
+                         mutpb=0.5, gather="dma", live_n=10)
+    with pytest.raises(ValueError, match="dtype"):
+        fused_generation(k_sel, k_var, genome.astype(jnp.bfloat16), w,
+                         dim=DIM, cxpb=0.5, mutpb=0.5)
+
+
+# ---------------------------------------------------------------------------
+# GenomeStorage (the mixed-precision tier)
+# ---------------------------------------------------------------------------
+
+
+def test_genome_storage_validation():
+    with pytest.raises(ValueError, match="storage dtype"):
+        GenomeStorage("float16")
+    with pytest.raises(ValueError, match="bound"):
+        GenomeStorage("int8")
+    st = GenomeStorage("int8", bound=5.12)
+    assert st.is_narrow and st.jax_dtype == jnp.int8
+    assert not GenomeStorage().is_narrow
+
+
+def test_int8_scale_one_roundtrips_integers_exactly():
+    """bound=127 → scale 1: integer-valued genomes round-trip bit-exact
+    — the contract the OneMax parity pin rides on."""
+    st = GenomeStorage("int8", bound=127.0)
+    x = jnp.asarray([[0.0, 1.0, -7.0, 127.0, -127.0]], jnp.float32)
+    assert np.array_equal(np.asarray(st.to_compute(st.to_storage(x))),
+                          np.asarray(x))
+
+
+def test_creator_init_population_storage_dtype():
+    """The storage knob narrows the drawn genome without changing the
+    PRNG stream: narrow(init_f32) == init(storage_dtype=...)."""
+    creator.create("FitnessMinMk", weights=(-1.0,))
+    spec = creator.create("IndividualMk", fitness=creator.FitnessMinMk)
+    key = jax.random.PRNGKey(9)
+
+    def attr(k):
+        return jax.random.uniform(k, (DIM,), jnp.float32, -5.12, 5.12)
+
+    pop_f32 = spec.init_population(key, 32, attr)
+    pop_bf16 = spec.init_population(key, 32, attr,
+                                    storage_dtype="bfloat16")
+    assert pop_bf16.genome.dtype == jnp.bfloat16
+    assert np.array_equal(
+        np.asarray(pop_f32.genome.astype(jnp.bfloat16)),
+        np.asarray(pop_bf16.genome))
+    pop_i8 = spec.init_population(key, 32, attr, storage_dtype="int8",
+                                  storage_bound=5.12)
+    assert pop_i8.genome.dtype == jnp.int8
+
+
+# ---------------------------------------------------------------------------
+# ea_step engine routing + serving live-mask contract
+# ---------------------------------------------------------------------------
+
+
+def test_ea_step_engine_routing():
+    tb = _mega_toolbox()
+    key = jax.random.PRNGKey(5)
+    genome = jax.random.uniform(key, (128, DIM), jnp.float32, -5.12, 5.12)
+    pop = Population(genome, Fitness.empty(128, (-1.0,)))
+    pop, _ = evaluate_population(tb, pop)
+    key2, off, nevals = ea_step(key, pop, tb, 0.9, 0.5)
+    assert off.genome.shape == (128, DIM)
+    assert int(nevals) == 128                 # reevaluate-all semantics
+    assert bool(np.asarray(off.fitness.valid).all())
+
+    tb.generation_engine = "warp-drive"
+    with pytest.raises(ValueError, match="generation_engine"):
+        ea_step(key, pop, tb, 0.9, 0.5)
+
+
+def test_megakernel_params_rejects_foreign_operators():
+    tb = _mega_toolbox()
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.1)
+    with pytest.raises(ValueError, match="mut_gaussian"):
+        megakernel_params(tb)
+    tb2 = _mega_toolbox()
+    tb2.register("select", selection.sel_best)
+    with pytest.raises(ValueError, match="sel_tournament"):
+        megakernel_params(tb2)
+
+
+def test_megakernel_params_rejects_mismatched_semantics():
+    """The fused kernel must not silently run different semantics than
+    the toolbox declares: the jittered tie law (tie_break default) and
+    positionally-frozen operator parameters are refused, not
+    substituted with defaults."""
+    tb = _mega_toolbox()
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    with pytest.raises(ValueError, match="tie_break"):
+        megakernel_params(tb)
+    tb2 = _mega_toolbox()
+    tb2.register("mutate", mutation.mut_gaussian, 0.0, 0.8, 0.2)
+    with pytest.raises(ValueError, match="positional"):
+        megakernel_params(tb2)
+
+
+def test_dma_mode_validates_pop_and_window(small_pop):
+    """gather='dma' refuses a population the VMEM rank table cannot
+    tile (pop % 128) with a named error, and clamps the DMA window to
+    the tile rows instead of draining never-started copies."""
+    key, genome, fit = small_pop
+    k_sel, k_var = jax.random.split(key)
+    w = fit.masked_wvalues()
+    with pytest.raises(ValueError, match="128"):
+        fused_generation(k_sel, k_var, genome[:96], w[:96], dim=DIM,
+                         cxpb=0.5, mutpb=0.5, gather="dma", rows=32)
+    with pytest.raises(ValueError, match="window"):
+        fused_generation(k_sel, k_var, genome, w, dim=DIM, cxpb=0.5,
+                         mutpb=0.5, gather="dma", window=0)
+    # window > rows: clamped, and still bitwise-equal to the default
+    g_wide, _ = fused_generation(k_sel, k_var, genome, w, dim=DIM,
+                                 cxpb=0.9, mutpb=0.5, gather="dma",
+                                 rows=128, window=512)
+    g_ref, _ = fused_generation(k_sel, k_var, genome, w, dim=DIM,
+                                cxpb=0.9, mutpb=0.5, gather="dma",
+                                rows=128)
+    assert np.array_equal(np.asarray(g_wide), np.asarray(g_ref))
+
+
+def test_live_mask_freezes_pads_and_isolates_live_rows():
+    """The serving contract through the fused path: pad rows pass
+    through bitwise, and the live prefix's trajectory is a pure
+    function of the live rows (pad contents can be anything)."""
+    tb = _mega_toolbox()
+    rows, live_n = 64, 41
+    key = jax.random.PRNGKey(7)
+    genome = jax.random.uniform(key, (rows, DIM), jnp.float32,
+                                -5.12, 5.12)
+    genome = genome.at[live_n:].set(0.0)
+    live = jnp.arange(rows) < live_n
+    pop = Population(genome, Fitness.empty(rows, (-1.0,)))
+    pop, _ = evaluate_population(tb, pop)
+    pop = Population(pop.genome, Fitness(
+        values=pop.fitness.values,
+        valid=pop.fitness.valid & live, weights=(-1.0,)))
+
+    key2, off, nevals = ea_step(key, pop, tb, 0.8, 0.4, live=live)
+    out = np.asarray(off.genome)
+    assert np.array_equal(out[live_n:], np.zeros((rows - live_n, DIM)))
+    assert int(nevals) == live_n
+
+    poisoned = Population(pop.genome.at[live_n:].set(123.0), pop.fitness)
+    _, off2, _ = ea_step(key, poisoned, tb, 0.8, 0.4, live=live)
+    assert np.array_equal(out[:live_n],
+                          np.asarray(off2.genome)[:live_n])
+
+
+def test_serve_step_program_with_megakernel_toolbox():
+    """build_slot_program('step') — the executable the serving layer
+    dispatches — compiles and advances a session whose toolbox declares
+    the megakernel engine."""
+    from deap_tpu.serve.service import build_slot_program
+    tb = _mega_toolbox()
+    rows, live_n = 32, 27
+    key = jax.random.PRNGKey(3)
+    genome = jax.random.uniform(key, (rows, 12), jnp.float32,
+                                -5.12, 5.12).at[live_n:].set(0.0)
+    state = {"key": jax.random.key_data(key) if jax.dtypes.issubdtype(
+                 key.dtype, jax.dtypes.prng_key) else key,
+             "genome": genome,
+             "values": jnp.zeros((rows, 1), jnp.float32),
+             "valid": jnp.zeros((rows,), bool),
+             "live_n": jnp.asarray(live_n, jnp.int32),
+             "cxpb": jnp.asarray(0.6, jnp.float32),
+             "mutpb": jnp.asarray(0.3, jnp.float32)}
+    fn = build_slot_program("step", tb, (-1.0,), vmapped=False)
+    out, nevals = jax.jit(fn)(state)
+    assert int(nevals) == live_n
+    assert np.array_equal(np.asarray(out["genome"][live_n:]),
+                          np.zeros((rows - live_n, 12), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# mixed-precision statistical parity (the acceptance suite)
+# ---------------------------------------------------------------------------
+
+
+def _onemax_toolbox(storage=None):
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: (jnp.sum(g),))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    if storage is not None:
+        tb.genome_storage = storage
+    return tb
+
+
+def _run_onemax(storage):
+    key = jax.random.PRNGKey(3)
+    g0 = jax.random.bernoulli(jax.random.fold_in(key, 1), 0.5,
+                              (64, 40)).astype(jnp.float32)
+    tb = _onemax_toolbox(storage)
+    g = g0 if storage is None else storage.to_storage(g0)
+    pop = Population(genome=g, fitness=Fitness.empty(64, (1.0,)))
+    pop, logbook = ea_simple(key, pop, tb, cxpb=0.6, mutpb=0.3, ngen=10)
+    return np.asarray(pop.fitness.values)
+
+
+def test_onemax_exact_match_across_storage_dtypes():
+    """The exact-match pin for int-genome problems: {0,1} genomes are
+    representable in bf16 and (bound=127) int8, the draws are
+    shape-identical, and f32 accumulation evaluates the same sums — so
+    the whole trajectory is BITWISE equal to the f32 run."""
+    vf32 = _run_onemax(None)
+    assert np.array_equal(vf32, _run_onemax(GenomeStorage("bfloat16")))
+    assert np.array_equal(vf32, _run_onemax(GenomeStorage("int8",
+                                                          bound=127.0)))
+
+
+@pytest.mark.parametrize("storage_dtype", [
+    # the f32 leg rides behind `slow`: in-gate, the three-executor
+    # bitwise pins + the narrow-storage params exercise the identical
+    # code path, and only the dtype differs between the legs
+    pytest.param("float32", marks=pytest.mark.slow),
+    "bfloat16", "int8"])
+def test_rastrigin_convergence_parity(storage_dtype):
+    """Tolerance-horizon convergence of the fused scan at every storage
+    dtype: 40 generations must cut the best rastrigin fitness by ~10x
+    at this (pop, dim) — the same horizon the f32 leg meets, so narrow
+    storage costs no convergence at these shapes."""
+    from deap_tpu.analysis.inventory import build_megakernel_scan
+    run, args = build_megakernel_scan(pop=512, dim=16, ngen=40,
+                                      storage_dtype=storage_dtype)
+    (_, _, fv), best = jax.jit(run)(*args)
+    best = np.asarray(best)
+    assert best[-1] < best[0] * 0.1, (storage_dtype, best[0], best[-1])
+    assert np.isfinite(best).all()
+
+
+@pytest.mark.slow
+def test_megakernel_vs_xla_convergence_parity():
+    """The fused generation and the XLA generation are different
+    variation streams of the same algorithm: from one population, both
+    must reach comparable fitness on the same horizon."""
+    from deap_tpu.analysis.inventory import (build_ga_scan,
+                                             build_megakernel_scan)
+    run_m, args_m = build_megakernel_scan(pop=512, dim=16, ngen=40)
+    run_x, args_x = build_ga_scan(pop=512, dim=16, ngen=40)
+    (_, _, _), best_m = jax.jit(run_m)(*args_m)
+    (_, _, _), best_x = jax.jit(run_x)(*args_x)
+    end_m, end_x = float(np.asarray(best_m)[-1]), \
+        float(np.asarray(best_x)[-1])
+    assert end_m < 3.0 * max(end_x, 1e-3) and end_x < 3.0 * max(end_m, 1e-3)

@@ -402,3 +402,96 @@ def test_perfgate_update_reblesses_baseline(tmp_path):
     arts = {r["artifact"] for r in doc["metrics"]["m"]["history"]}
     assert arts == {"BENCH_OLD.json", "BENCH_X.json"}
     assert perfgate(args) == 0
+
+
+# ---------------------------------------------------------------------------
+# megakernel bench artifacts (ISSUE 15): schemas + ledger wiring
+# ---------------------------------------------------------------------------
+
+
+def test_bench_megakernel_schema_canfail():
+    """The bench-json pass knows BENCH_MEGAKERNEL.json's shape: missing
+    legs, non-finite walls, and out-of-range traffic fractions are
+    schema violations; the committed artifact parses clean."""
+    from deap_tpu.lint.rules_data import _schema_errors
+    good = {"cmd": "python tools/bench_megakernel.py",
+            "result": {"xla_f32": {"per_gen_ms": 250.0},
+                       "mega_f32": {"per_gen_ms": 180.0},
+                       "mega_bf16": {"per_gen_ms": 178.0},
+                       "speedup_mega_f32": 1.4,
+                       "bf16_traffic_savings_frac": 0.49}}
+    assert _schema_errors("megakernel", good) == []
+    bad = json.loads(json.dumps(good))
+    del bad["result"]["mega_bf16"]
+    bad["result"]["bf16_traffic_savings_frac"] = 1.7
+    errs = _schema_errors("megakernel", bad)
+    assert any("mega_bf16" in e for e in errs)
+    assert any("[0, 1]" in e for e in errs)
+    zero = json.loads(json.dumps(good))
+    zero["result"]["mega_f32"]["per_gen_ms"] = 0
+    assert any("per_gen_ms" in e
+               for e in _schema_errors("megakernel", zero))
+    with open(os.path.join(REPO, "BENCH_MEGAKERNEL.json")) as f:
+        committed = json.load(f)
+    assert _schema_errors("megakernel", committed) == []
+    # the committed artifact IS the acceptance evidence: fused beats the
+    # XLA scan wall and bf16 cuts the argument traffic >= 40%
+    assert committed["result"]["speedup_mega_f32"] > 1.0
+    assert committed["result"]["bf16_traffic_savings_frac"] >= 0.4
+
+
+def test_probe_ga_schema_canfail():
+    """Satellite: the probe's --json report is a committed, schema-gated
+    artifact — per-probe finite walls + linearity witnesses, backend
+    failures recorded as errors (never fabricated rows)."""
+    from deap_tpu.lint.rules_data import _schema_errors
+    good = {"cmd": "python tools/pallas_probe_ga.py sort --json X",
+            "result": {"pop": 65536, "dim": 100,
+                       "probes": [{"probe": "xla_sort", "ms": 18.2,
+                                   "linearity_t2k_over_tk": 1.96}],
+                       "errors": [{"probe": "rng",
+                                   "error": "NotImplementedError: ..."}]}}
+    assert _schema_errors("probe_ga", good) == []
+    bad = json.loads(json.dumps(good))
+    bad["result"]["probes"] = []
+    assert any("non-empty" in e for e in _schema_errors("probe_ga", bad))
+    nan = json.loads(json.dumps(good))
+    nan["result"]["probes"][0]["ms"] = None
+    assert any("finite" in e for e in _schema_errors("probe_ga", nan))
+    with open(os.path.join(REPO, "BENCH_PROBE_GA.json")) as f:
+        committed = json.load(f)
+    assert _schema_errors("probe_ga", committed) == []
+    assert len(committed["result"]["probes"]) >= 4
+
+
+def test_megakernel_ledger_rows_wired():
+    """Satellite: megakernel_gens_per_sec and bf16_traffic_savings_frac
+    are tracked PERF_LEDGER metrics (direction/band/provenance), and the
+    savings metric carries the 0.4 absolute acceptance floor."""
+    with open(os.path.join(REPO, "PERF_LEDGER.json")) as f:
+        doc = json.load(f)
+    for name in ("megakernel_gens_per_sec", "bf16_traffic_savings_frac"):
+        spec = doc["metrics"][name]
+        assert spec["artifact"] == "BENCH_MEGAKERNEL.json"
+        assert spec["direction"] == "higher"
+        assert spec["provenance"].strip()
+    assert doc["metrics"]["bf16_traffic_savings_frac"]["min_value"] == 0.4
+
+
+def test_megakernel_entries_in_committed_budgets():
+    """Both fused-generation inventory entries carry committed rows in
+    BOTH budget files (the one-lowering --update-budget refresh)."""
+    with open(os.path.join(REPO, "tools", "program_budget.json")) as f:
+        prog = json.load(f)["budget"]
+    with open(os.path.join(REPO, "tools", "memory_budget.json")) as f:
+        mem = json.load(f)["budget"]
+    for name in ("ga_generation_megakernel",
+                 "ga_generation_megakernel_bf16"):
+        assert name in prog, f"{name} missing from program budget"
+        assert name in mem, f"{name} missing from memory budget"
+        for key in ("peak_bytes", "large_intermediates",
+                    "elementwise_roots", "bytes_moved"):
+            assert key in mem[name], f"{name} row lost {key}"
+    # the deterministic traffic claim, from the committed rows
+    assert mem["ga_generation_megakernel_bf16"]["bytes_moved"] < \
+        0.6 * mem["ga_generation_megakernel"]["bytes_moved"]

@@ -33,8 +33,20 @@ reports the marginal (t2k - tk)/k, and carries the t2k/tk linearity ratio
 so a wedged measurement is visible (expect ~2.0).  One TPU process at a
 time; run subsets via argv, e.g. ``python tools/pallas_probe_ga.py stream
 chain rng``.  Results feed docs/performance.md's roofline re-derivation.
+
+``--json PATH`` additionally writes the whole run as ONE structured
+document — per-probe walls (tk, t2k), the marginal ms, linearity, and
+every derived rate (GB/s, M rows/s, ...) — so the probe's stage budget
+is a committed, schema-gated artifact (``BENCH_PROBE_GA.json``; the
+``bench-json`` lint pass knows the shape) instead of stdout
+archaeology.  ``--pop`` / ``--dim`` override the flagship shape (the
+committed CPU artifact uses a smaller pop; the per-record shape fields
+keep every row self-describing).  Probes that cannot run on the active
+backend (e.g. the hardware-PRNG probe off TPU) land in the document's
+``errors`` list, never as fabricated numbers.
 """
 
+import argparse
 import functools
 import json
 import sys
@@ -51,6 +63,10 @@ POP = 1 << 20          # 1,048,576 -- the flagship population
 DIM = 100
 LANE = 128
 K_ITERS = 48           # enough iterations to swamp ~40 ms dispatch noise
+
+#: sink for structured records (--json); report() feeds it
+_RECORDS = []
+_ERRORS = []
 
 _ON_TPU = None
 
@@ -83,13 +99,20 @@ def marginal(make_run, init, k=None):
     t0 = time.perf_counter()
     run(r2)
     t2 = time.perf_counter() - t0
+    marginal.last_walls = (t1, t2, k)
     return (t2 - t1) / k, t2 / t1
 
 
 def report(name, sec, ratio, **extra):
-    print(json.dumps({"probe": name, "ms": round(sec * 1e3, 3),
-                      "linearity_t2k_over_tk": round(ratio, 2),
-                      **extra}), flush=True)
+    rec = {"probe": name, "ms": round(sec * 1e3, 3),
+           "linearity_t2k_over_tk": round(ratio, 2), **extra}
+    walls = getattr(marginal, "last_walls", None)
+    if walls is not None:
+        rec["wall_tk_s"] = round(walls[0], 4)
+        rec["wall_t2k_s"] = round(walls[1], 4)
+        rec["k"] = walls[2]
+    _RECORDS.append(rec)
+    print(json.dumps(rec), flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -492,15 +515,58 @@ PROBES = {
 
 
 def main(argv):
-    names = argv or list(PROBES)
+    global POP, DIM
+    ap = argparse.ArgumentParser(
+        prog="pallas_probe_ga",
+        description="Stage-level probes for the flagship GA generation "
+                    "(XLA stages + Pallas hand-kernel counterparts).")
+    ap.add_argument("probes", nargs="*",
+                    help=f"probe subset (default: all of "
+                         f"{', '.join(PROBES)})")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="also write the run as one structured JSON "
+                         "document (per-probe walls + derived rates + "
+                         "backend errors) — the committed, schema-gated "
+                         "form of the stage budget")
+    ap.add_argument("--pop", type=int, default=POP,
+                    help=f"population (default {POP})")
+    ap.add_argument("--dim", type=int, default=DIM,
+                    help=f"genome dim (default {DIM})")
+    args = ap.parse_args(argv)
+    POP, DIM = args.pop, args.dim
+    unknown = [n for n in args.probes if n not in PROBES]
+    if unknown:
+        ap.error(f"unknown probe(s) {unknown} "
+                 f"(have: {', '.join(PROBES)})")
+
+    names = args.probes or list(PROBES)
     print(json.dumps({"platform": jax.devices()[0].platform,
                       "pop": POP, "dim": DIM}), flush=True)
     for n in names:
         try:
             PROBES[n]()
         except Exception as e:                      # keep probing
-            print(json.dumps({"probe": n, "error": f"{type(e).__name__}: "
-                              f"{str(e)[:300]}"}), flush=True)
+            err = {"probe": n,
+                   "error": f"{type(e).__name__}: {str(e)[:300]}"}
+            _ERRORS.append(err)
+            print(json.dumps(err), flush=True)
+
+    if args.json:
+        doc = {"cmd": "python tools/pallas_probe_ga.py "
+                      + " ".join(argv if argv is not None
+                                 else sys.argv[1:]),
+               "result": {"platform": jax.devices()[0].platform,
+                          "pop": POP, "dim": DIM, "k_iters": K_ITERS,
+                          "probes": _RECORDS, "errors": _ERRORS,
+                          "note": ("marginal (t2k-tk)/k per probe with "
+                                   "the t2k/tk linearity witness; "
+                                   "derived GB/s rates from the probe's "
+                                   "own byte accounting; errors record "
+                                   "probes the active backend cannot "
+                                   "run (never fabricated numbers)")}}
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
 
 
 if __name__ == "__main__":
