@@ -41,6 +41,7 @@ def bench_json_targets(repo: Path) -> List[Tuple[str, Path]]:
     out: List[Tuple[str, Path]] = []
     _SPECIAL = {"BENCH_TRACE.json": "trace", "BENCH_MEMORY.json": "memory",
                 "BENCH_FLEET.json": "fleet", "BENCH_TSAN.json": "tsan",
+                "BENCH_CHAOS.json": "chaos",
                 "BENCH_PROFILE.json": "profile",
                 "BENCH_MEGAKERNEL.json": "megakernel",
                 "BENCH_PROBE_GA.json": "probe_ga"}
@@ -164,6 +165,37 @@ def _schema_errors(kind: str, doc) -> List[str]:
                           "artifact is the clean-drill proof; a nonzero "
                           "count means the serving fleet raced under the "
                           "sanitizer and must not be committed")
+    elif kind == "chaos":
+        # BENCH_CHAOS.json: the fleet chaos-drill report from
+        # ``deap-tpu-chaosdrill`` — goodput under the canonical fault
+        # plan, recovery wall after heal, and the bitwise-survivor
+        # verdict, which MUST be true: the committed artifact doubles as
+        # the proof that blind retry under the request-leg-only fault
+        # plan never double-executed a generation
+        goodput = doc.get("goodput_frac")
+        if isinstance(goodput, bool) or not isinstance(goodput,
+                                                       (int, float)) \
+                or not math.isfinite(float(goodput)) \
+                or not (0.0 <= float(goodput) <= 1.0):
+            errors.append("key 'goodput_frac' must be a finite number in "
+                          "[0, 1] (storm successes / attempts)")
+        recovery = doc.get("recovery_s")
+        if isinstance(recovery, bool) or not isinstance(recovery,
+                                                        (int, float)) \
+                or not math.isfinite(float(recovery)) or recovery < 0:
+            errors.append("key 'recovery_s' must be a finite non-negative "
+                          "number (heal-act wall until breakers closed)")
+        if doc.get("bitwise_identical") is not True:
+            errors.append("key 'bitwise_identical' must be true -- the "
+                          "committed artifact is the no-divergence proof; "
+                          "anything else means a survivor's trajectory "
+                          "diverged from the single-instance reference "
+                          "and must not be committed")
+        fired = doc.get("faults_injected")
+        if not isinstance(fired, dict) or not fired:
+            errors.append("key 'faults_injected' must be a non-empty "
+                          "object {target: {kind: count}} -- a chaos "
+                          "drill that injected nothing proves nothing")
     elif kind == "profile":
         # BENCH_PROFILE.json: the device-phase profiler overhead record
         # from ``tools/bench_serve.py --net --profile`` — a metric

@@ -41,6 +41,7 @@ SANCTIONED_PRINT_MODULES = {
     "perfledger.py",
     "selftest.py",
     "resilience/faultdrill.py",
+    "resilience/chaosdrill.py",
     "native/build.py",
     "lint/cli.py",
     "analysis/cli.py",

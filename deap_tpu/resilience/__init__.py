@@ -19,6 +19,10 @@ deterministic fault-injection harness in :mod:`.faultinject`
   checkpoint I/O and the cluster coordinator connection (:mod:`.retry`).
 * :class:`FaultPlan` / :class:`FaultInjector` — declarative fault
   schedules for tests and drills (:mod:`.faultinject`).
+* :class:`ChaosPlan` / :class:`ChaosInjector` — their wire-level
+  sibling: seed-deterministic network-fault schedules executed by
+  :class:`deap_tpu.serve.net.faultwire.FaultWire` proxies during fleet
+  chaos drills (:mod:`.chaos`, ``deap-tpu-chaosdrill``).
 * :func:`save_session_states` / :func:`load_session_states` — the
   retried checkpoint tier for every live session of a
   :class:`deap_tpu.serve.EvolutionService` (:mod:`.runner`).
@@ -28,6 +32,8 @@ from .retry import with_retries, RetriesExhausted  # noqa: F401
 from .quarantine import (Quarantine, NonFiniteFitnessError,  # noqa: F401
                          nonfinite_rows)
 from .faultinject import FaultPlan, FaultInjector, VirtualClock  # noqa: F401
+from .chaos import (ChaosLeg, ChaosPlan, ChaosFault,  # noqa: F401
+                    ChaosInjector, canonical_plan)
 from .runner import (run_resumable, Preempted,  # noqa: F401
                      save_session_states, load_session_states)
 
@@ -37,4 +43,6 @@ __all__ = [
     "Quarantine", "NonFiniteFitnessError", "nonfinite_rows",
     "with_retries", "RetriesExhausted",
     "FaultPlan", "FaultInjector", "VirtualClock",
+    "ChaosLeg", "ChaosPlan", "ChaosFault", "ChaosInjector",
+    "canonical_plan",
 ]

@@ -1,0 +1,302 @@
+"""Declarative, seed-deterministic chaos plans for fleet drills.
+
+PR 1's :class:`~deap_tpu.resilience.faultinject.FaultPlan` injects
+faults *inside one process* (an evaluation raises on schedule).  This
+module is its wire-level sibling: a :class:`ChaosPlan` declares **which
+network faults** hit **which backend** during **which drill phase**, and
+a :class:`ChaosInjector` turns the plan into per-exchange decisions that
+:class:`~deap_tpu.serve.net.faultwire.FaultWire` proxies execute on the
+actual DTF1 socket path — drop, delay, bandwidth throttle, frame
+truncation/corruption, wedge-after-headers, asymmetric partition, and
+slow-drip responses.
+
+Determinism is the whole point: every decision is a pure function of
+``(plan.seed, target, leg index, per-target exchange index)`` through
+SHA-256, **never** of wall time or thread interleaving.  Two runs that
+present the same per-target exchange sequences draw the identical fault
+sequence, so a chaos drill's failures reproduce from its seed (pinned by
+``tests/test_chaos.py``).  Every fired fault is recorded; a leg that
+never fired is detectable (:meth:`ChaosInjector.unfired_legs`) — a drill
+whose fault never fired is a broken drill, not a passing one.
+
+Phases are script-driven, not timer-driven: the drill calls
+:meth:`ChaosInjector.set_phase` at its own act boundaries (``"warmup"``
+→ ``"storm"`` → ``"heal"``), and a leg with ``phase=""`` applies in
+every act.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+from .. import sanitize
+
+__all__ = ["ChaosLeg", "ChaosPlan", "ChaosFault", "ChaosInjector",
+           "CHAOS_KINDS", "canonical_plan"]
+
+#: Wire-fault vocabulary a :class:`~deap_tpu.serve.net.faultwire.FaultWire`
+#: proxy knows how to execute (see that module for exact semantics).
+CHAOS_KINDS = ("drop", "delay", "throttle", "truncate", "corrupt",
+               "wedge", "partition", "drip")
+
+_DIRECTIONS = ("request", "response", "both")
+
+#: Exchange classes a leg may be scoped to: ``"data"`` is the session
+#: plane (``/v1/sessions...``), ``"control"`` is everything else
+#: (healthz/metrics/trace/admin), ``"any"`` hits both.  Scoping a leg to
+#: ``"data"`` builds a GRAY failure — the instance's control plane keeps
+#: answering politely while its data path misbehaves, exactly the
+#: condition circuit breakers exist for and health polling alone misses.
+_SCOPES = ("any", "data", "control")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosLeg:
+    """One scheduled fault stream against one target.
+
+    ``target`` names the proxied backend; ``kind`` is one of
+    :data:`CHAOS_KINDS`.  ``phase`` restricts the leg to one drill act
+    (``""`` = all acts); ``start``/``stop`` bound the affected
+    per-target exchange indices (``stop=None`` = unbounded);
+    ``probability`` is the per-exchange firing chance drawn from the
+    plan's seeded hash stream; ``direction`` selects which half of the
+    exchange the fault mangles (``"request"`` faults provably never
+    execute upstream — the only kind a drill may blindly retry);
+    ``scope`` restricts the leg to one exchange class (see
+    :data:`_SCOPES` — ``"data"`` legs build gray failures the control
+    plane can't see); ``params`` are kind-specific knobs (``seconds``
+    for delay,
+    ``bytes_per_s`` for throttle, ``frac`` for truncate, ``xor`` for
+    corrupt, ``chunk``/``seconds`` for drip) as a hashable item tuple.
+    """
+
+    target: str
+    kind: str
+    phase: str = ""
+    start: int = 0
+    stop: Optional[int] = None
+    probability: float = 1.0
+    direction: str = "both"
+    scope: str = "any"
+    params: Tuple[Tuple[str, float], ...] = ()
+
+    def __post_init__(self):
+        if self.kind not in CHAOS_KINDS:
+            raise ValueError(f"unknown chaos kind {self.kind!r} "
+                             f"(one of {CHAOS_KINDS})")
+        if self.direction not in _DIRECTIONS:
+            raise ValueError(f"direction must be one of {_DIRECTIONS}, "
+                             f"got {self.direction!r}")
+        if self.scope not in _SCOPES:
+            raise ValueError(f"scope must be one of {_SCOPES}, "
+                             f"got {self.scope!r}")
+        if not 0.0 <= self.probability <= 1.0:
+            raise ValueError("probability must be in [0, 1]")
+        if self.start < 0:
+            raise ValueError("start must be >= 0")
+        if self.stop is not None and self.stop <= self.start:
+            raise ValueError("stop must be > start")
+        # normalize params to a sorted item tuple so two equal-by-value
+        # legs hash and compare equal regardless of construction order
+        object.__setattr__(self, "params",
+                           tuple(sorted(dict(self.params).items())))
+
+    def param(self, name: str, default=None):
+        return dict(self.params).get(name, default)
+
+    def active(self, phase: str, exchange: int) -> bool:
+        if self.phase and phase != self.phase:
+            return False
+        if exchange < self.start:
+            return False
+        return self.stop is None or exchange < self.stop
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosPlan:
+    """A seed plus the full leg schedule — everything a drill needs to
+    reproduce its fault sequence bit-for-bit."""
+
+    seed: int
+    legs: Tuple[ChaosLeg, ...]
+
+    def __post_init__(self):
+        object.__setattr__(self, "legs", tuple(self.legs))
+        for leg in self.legs:
+            if not isinstance(leg, ChaosLeg):
+                raise TypeError(f"plan legs must be ChaosLeg, got {leg!r}")
+
+    def for_target(self, target: str) -> Tuple[Tuple[int, ChaosLeg], ...]:
+        """(leg index, leg) pairs aimed at ``target`` — the index is the
+        leg's identity in the hash stream, so reordering OTHER targets'
+        legs never changes this target's draws."""
+        return tuple((i, leg) for i, leg in enumerate(self.legs)
+                     if leg.target == target)
+
+    def describe(self) -> List[dict]:
+        return [dataclasses.asdict(leg) for leg in self.legs]
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosFault:
+    """One fired fault — what a FaultWire proxy executes on one
+    exchange."""
+
+    leg_index: int
+    leg: ChaosLeg
+    exchange: int
+    phase: str
+
+
+def _u01(seed: int, target: str, leg_index: int, exchange: int) -> float:
+    """Deterministic uniform draw in [0, 1) — SHA-256 of the identifying
+    tuple, so the stream is independent of thread interleaving, wall
+    time and Python hash randomization."""
+    h = hashlib.sha256(
+        f"{seed}:{target}:{leg_index}:{exchange}".encode()).digest()
+    return int.from_bytes(h[:8], "big") / float(1 << 64)
+
+
+class ChaosInjector:
+    """Turns a :class:`ChaosPlan` into per-exchange fault decisions and
+    records every firing (see module docstring).
+
+    One injector serves every proxy of a drill: each proxy calls
+    :meth:`decide(target)` exactly once per HTTP exchange it relays, and
+    the injector advances that target's private exchange counter.  The
+    drill script moves acts with :meth:`set_phase`."""
+
+    #: lock-guarded shared state: per-target exchange counters, the
+    #: current phase and the fired-fault record are written by every
+    #: proxy relay thread — writes only under ``self._lock``
+    _GUARDED_BY = {"_lock": ("_counts", "_phase", "_fired", "_log")}
+
+    def __init__(self, plan: ChaosPlan):
+        self.plan = plan
+        self._lock = sanitize.lock()
+        self._counts: Dict[str, int] = {}
+        self._phase = ""
+        self._fired: List[ChaosFault] = []
+        #: replayable decision log: (target, phase, klass) per decide()
+        #: call, in call order — feeding it to :meth:`replay` on a fresh
+        #: injector must reproduce the identical fired sequence
+        self._log: List[Tuple[str, str, str]] = []
+
+    # -- drill script surface ------------------------------------------------
+
+    def set_phase(self, phase: str) -> None:
+        with self._lock:
+            self._phase = str(phase)
+
+    def phase(self) -> str:
+        with self._lock:
+            return self._phase
+
+    def decide(self, target: str,
+               klass: str = "data") -> List[ChaosFault]:
+        """The faults that hit ``target``'s next exchange (possibly
+        empty).  ``klass`` is the exchange class (``"data"`` /
+        ``"control"``) matched against each leg's ``scope``.  Pure in
+        ``(seed, target, leg, exchange)`` — the lock only orders the
+        per-target counter, it never feeds the draw."""
+        with self._lock:
+            exchange = self._counts.get(target, 0)
+            self._counts[target] = exchange + 1
+            phase = self._phase
+            self._log.append((target, phase, klass))
+            out: List[ChaosFault] = []
+            for i, leg in self.plan.for_target(target):
+                if not leg.active(phase, exchange):
+                    continue
+                if leg.scope != "any" and leg.scope != klass:
+                    continue
+                if _u01(self.plan.seed, target, i, exchange) \
+                        < leg.probability:
+                    fault = ChaosFault(leg_index=i, leg=leg,
+                                       exchange=exchange, phase=phase)
+                    out.append(fault)
+                    self._fired.append(fault)
+            return out
+
+    # -- accounting ----------------------------------------------------------
+
+    def fired(self) -> List[ChaosFault]:
+        with self._lock:
+            return list(self._fired)
+
+    def fired_counts(self) -> Dict[str, int]:
+        """Fired-fault tally by kind (the drill report's
+        ``faults_injected`` table)."""
+        out: Dict[str, int] = {}
+        for f in self.fired():
+            out[f.leg.kind] = out.get(f.leg.kind, 0) + 1
+        return out
+
+    def unfired_legs(self) -> List[ChaosLeg]:
+        """Legs that never fired — a drill that planned a fault which
+        never happened tested nothing and must FAIL, not pass."""
+        hit = {f.leg_index for f in self.fired()}
+        return [leg for i, leg in enumerate(self.plan.legs) if i not in hit]
+
+    def decision_log(self) -> List[Tuple[str, str, str]]:
+        with self._lock:
+            return list(self._log)
+
+    @classmethod
+    def replay(cls, plan: ChaosPlan,
+               log: List[Tuple[str, str, str]]) -> "ChaosInjector":
+        """Re-run a recorded decision sequence against a fresh injector
+        — the determinism oracle: ``replay(plan, inj.decision_log())``
+        fires the identical fault sequence as ``inj`` did."""
+        fresh = cls(plan)
+        for target, phase, klass in log:
+            fresh.set_phase(phase)
+            fresh.decide(target, klass)
+        return fresh
+
+
+def canonical_plan(targets=("b0", "b1", "b2"), *, seed: int = 20,
+                   storm: str = "storm") -> ChaosPlan:
+    """The committed drill plan ``deap-tpu-chaosdrill`` runs (and
+    ``BENCH_CHAOS.json`` reports): against a three-instance fleet, the
+    storm act combines
+
+    * a **delay** drag plus occasional request-frame **corruption** and
+      **truncation** on the first backend (it stays up — typed 400s and
+      latency, never lost state),
+    * a full **asymmetric partition** of the second backend — control
+      plane included, so the health loop latches it sick and the
+      failover drain finds it unreachable (its sessions are LOST, the
+      hard half of the drill), and
+    * **wedge-after-headers** on the third backend's data plane only —
+      the gray failure: healthz keeps answering, so only the circuit
+      breaker (fed by forward outcomes) protects the fleet, and
+    * a **slow-drip** response leg on the first backend (bandwidth
+      starvation without failure).
+
+    Request-direction-only mangling on the surviving backends is load-
+    bearing: a request fault provably never executed upstream, so the
+    drill may retry it blindly and still demand bitwise-identical
+    surviving trajectories."""
+    t0, t1, t2 = tuple(targets)[:3]
+    return ChaosPlan(seed=seed, legs=(
+        ChaosLeg(target=t0, kind="delay", phase=storm, probability=0.5,
+                 direction="request", scope="data",
+                 params=(("seconds", 0.02),)),
+        ChaosLeg(target=t0, kind="corrupt", phase=storm, probability=0.15,
+                 direction="request", scope="data",
+                 params=(("xor", 0xA5),)),
+        ChaosLeg(target=t0, kind="truncate", phase=storm, probability=0.15,
+                 direction="request", scope="data",
+                 params=(("frac", 0.5),)),
+        ChaosLeg(target=t0, kind="drip", phase=storm, probability=0.1,
+                 direction="response", scope="data",
+                 params=(("chunk", 512), ("seconds", 0.005))),
+        ChaosLeg(target=t1, kind="partition", phase=storm,
+                 probability=1.0, direction="both", scope="any"),
+        ChaosLeg(target=t2, kind="wedge", phase=storm, probability=0.45,
+                 direction="request", scope="data",
+                 params=(("seconds", 1.0),)),
+    ))

@@ -1,0 +1,433 @@
+"""Fleet chaos drill: the canonical plan against a live 3-instance fleet.
+
+``deap-tpu-chaosdrill`` stands up three :class:`NetServer` instances,
+each behind a :class:`~deap_tpu.serve.net.faultwire.FaultWire` proxy,
+fronted by one :class:`RouterServer`, and runs scripted traffic through
+:func:`~deap_tpu.resilience.chaos.canonical_plan`'s storm:
+
+* **b0** survives corrupt/truncated/delayed request frames (typed
+  ``ProtocolError`` + latency, blind-retried — request-direction faults
+  provably never executed);
+* **b1** is fully partitioned: the health loop latches it sick, the
+  failover drain finds it unreachable, its sessions are LOST (the one
+  failover shape that loses state);
+* **b2** is the gray failure: healthz answers, the data path wedges —
+  only its circuit breaker protects the fleet (opens, jittered half-open
+  probes, typed ``CircuitOpen`` short-circuits).
+
+The drill demands, and the committed ``BENCH_CHAOS.json`` records:
+
+* surviving sessions **bitwise equal** to an undisturbed single-instance
+  reference (no retried fault ever double-executed);
+* goodput under storm and seconds-to-recovery after heal;
+* breaker opens/probes, router+instance deadline sheds, and an
+  in-process priority-brownout segment (``brownout_sheds``) all visible
+  in metrics;
+* every planned leg FIRED (a fault that never fired tested nothing);
+* the injector's decision log REPLAYS to the identical fault sequence
+  (the determinism oracle ``tests/test_chaos.py`` also pins).
+
+    deap-tpu-chaosdrill                       # writes BENCH_CHAOS.json
+    CHAOSDRILL_OUT=- deap-tpu-chaosdrill      # report to stdout only
+    python -m deap_tpu.resilience.chaosdrill  # equivalent module form
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+POP = int(os.environ.get("CHAOSDRILL_POP", 40))
+NGEN = int(os.environ.get("CHAOSDRILL_NGEN", 8))
+WARM = 2                                 # clean-wire generations
+SEED = int(os.environ.get("CHAOSDRILL_SEED", 20))
+OUT = os.environ.get("CHAOSDRILL_OUT", "BENCH_CHAOS.json")
+
+#: six sessions over three bucket classes — cold placement spreads the
+#: classes across the fleet, warm affinity pairs them up, so every
+#: backend (the partitioned one included) hosts real traffic
+SHAPES = ((POP, 8), (POP, 16), (POP, 32)) * 2
+
+
+def _toolbox():
+    import jax.numpy as jnp
+    from deap_tpu import base
+    from deap_tpu.ops import crossover, mutation, selection
+
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: (jnp.sum(g),))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    return tb
+
+
+def _pop(key, n, d):
+    import jax
+    import jax.numpy as jnp
+    from deap_tpu import base
+
+    g = jax.random.bernoulli(key, 0.5, (n, d)).astype(jnp.float32)
+    return base.Population(genome=g,
+                           fitness=base.Fitness.empty(n, (1.0,)))
+
+
+def _keys():
+    import jax
+    return list(jax.random.split(jax.random.PRNGKey(SEED), len(SHAPES)))
+
+
+def _final(pop):
+    return (np.asarray(pop.genome), np.asarray(pop.fitness.values),
+            np.asarray(pop.fitness.valid))
+
+
+def _reference():
+    """Undisturbed single-instance trajectories — the bitwise oracle."""
+    from deap_tpu.serve import EvolutionService
+
+    tb = _toolbox()
+    finals = []
+    with EvolutionService(max_batch=4) as svc:
+        for i, (k, (n, d)) in enumerate(zip(_keys(), SHAPES)):
+            s = svc.open_session(k, _pop(k, n, d), tb, cxpb=0.6,
+                                 mutpb=0.3, name=f"chaos-{i}")
+            for f in s.step(NGEN):
+                f.result(timeout=600)
+            finals.append(_final(s.population()))
+    return finals
+
+
+def _retryable(exc) -> bool:
+    """True when the failed op PROVABLY never executed.  Typed
+    pre-execution rejections always qualify; a generic mid-request
+    ``ServeError`` qualifies here ONLY because every fault of the
+    canonical plan that can kill an exchange (partition, wedge, drop)
+    acts on the request leg at the proxy — the instance never saw the
+    op, so a blind retry cannot double-execute anything."""
+    from deap_tpu.serve.dispatcher import (CircuitOpen, ServeError,
+                                           ServiceBrownout,
+                                           ServiceOverloaded)
+    from deap_tpu.serve.net.protocol import ProtocolError
+
+    return isinstance(exc, (ProtocolError, CircuitOpen, ServiceBrownout,
+                            ServiceOverloaded, ServeError))
+
+
+def _step_once(sess, counters):
+    """One storm step attempt: 'ok' | 'retry' | 'lost'."""
+    from deap_tpu.serve.dispatcher import SessionUnknown
+
+    counters["attempts"] += 1
+    try:
+        [f] = sess.step(1)
+        f.result(timeout=120)
+    except SessionUnknown:
+        return "lost"
+    except Exception as e:  # noqa: BLE001 — typed check below
+        if _retryable(e):
+            return "retry"
+        raise
+    counters["successes"] += 1
+    return "ok"
+
+
+def _eval_once(sess, genomes, counters):
+    counters["attempts"] += 1
+    try:
+        sess.evaluate(genomes).result(timeout=120)
+    except Exception as e:  # noqa: BLE001 — typed check below
+        if _retryable(e):
+            return False
+        raise
+    counters["successes"] += 1
+    return True
+
+
+def _brownout_segment():
+    """In-process priority-shedding proof: queue pressure of priority-2
+    work; a priority-1 admission sheds typed, an equal-priority one is
+    admitted (uniform-priority fleets degrade exactly as before)."""
+    from deap_tpu.serve.dispatcher import (BatchDispatcher, Request,
+                                           ServiceBrownout)
+    from deap_tpu.serve.metrics import ServeMetrics
+
+    hold = threading.Event()
+
+    def execute(kind, program_key, requests):
+        hold.wait(30)
+        return [None] * len(requests)
+
+    def req(priority):
+        return Request(kind="noop", program_key=("k",), payload={},
+                       priority=priority)
+
+    m = ServeMetrics()
+    d = BatchDispatcher(execute, metrics=m, max_pending=8,
+                        brownout_watermark=0.25, brownout_grace_s=0.0)
+    shed_typed = equal_admitted = False
+    try:
+        d.submit(req(2))                    # the worker picks this up
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:  # wait until it's in-flight
+            with d._cv:
+                if d._busy and not d._pending:
+                    break
+        for _ in range(3):                  # sustained pressure: 3 >= 2
+            d.submit(req(2))
+        try:
+            d.submit(req(1))
+        except ServiceBrownout:
+            shed_typed = True
+        d.submit(req(2))                    # equal priority: admitted
+        equal_admitted = True
+    finally:
+        hold.set()
+        d.close()
+    return {"brownout_sheds": m.counter("brownout_sheds"),
+            "shed_typed": shed_typed, "equal_admitted": equal_admitted}
+
+
+def main() -> int:  # noqa: PLR0915 — one scripted drill, linear acts
+    import jax
+
+    from deap_tpu.resilience.chaos import ChaosInjector, canonical_plan
+    from deap_tpu.serve import DeadlineExceeded, EvolutionService
+    from deap_tpu.serve.net import NetServer, RemoteService
+    from deap_tpu.serve.net.faultwire import FaultWire
+    from deap_tpu.serve.router import (Backend, FleetRouter, HealthPolicy,
+                                       RouterServer)
+
+    print(f"backend={jax.default_backend()} pop={POP} ngen={NGEN} "
+          f"seed={SEED} sessions={len(SHAPES)}")
+    t_all = time.monotonic()
+    print("[reference] undisturbed single-instance trajectories ...")
+    want = _reference()
+
+    plan = canonical_plan(seed=SEED)
+    injector = ChaosInjector(plan)
+    tb = _toolbox()
+    svcs = [EvolutionService(max_batch=4) for _ in range(3)]
+    srvs = [NetServer(s, {"onemax": tb}).start() for s in svcs]
+    proxies = [FaultWire(srv.address, f"b{i}", injector).start()
+               for i, srv in enumerate(srvs)]
+    # generous forward timeout (first-step compiles must not read as
+    # faults); wedges close the wire themselves after their hold
+    backends = [Backend(f"b{i}", p.address, timeout=30.0,
+                        control_timeout=2.0)
+                for i, p in enumerate(proxies)]
+    # health latches ONLY on unreachability (the partition): error spans
+    # and failed counters are expected storm noise on surviving backends
+    router = FleetRouter(
+        backends,
+        health=HealthPolicy(interval_s=0.2, fail_after=2,
+                            max_failed_delta=10**9,
+                            max_error_spans=10**9, stall_s=3600.0),
+        breaker_policy={"fail_threshold": 1, "reset_s": 0.5},
+        drain_timeout=5.0)
+    front = RouterServer(router, failover_wait=5.0).start()
+    cli = RemoteService(front.url, timeout=120)
+    counters = {"attempts": 0, "successes": 0}
+    report = {"bench": "chaos", "pop": POP, "ngen": NGEN, "seed": SEED,
+              "plan_legs": len(plan.legs), "sessions": len(SHAPES)}
+    failures = []
+    try:
+        # -- act 1: warmup (clean wire) ---------------------------------
+        injector.set_phase("warmup")
+        sessions = [cli.open_session(k, _pop(k, n, d), "onemax",
+                                     cxpb=0.6, mutpb=0.3,
+                                     name=f"chaos-{i}")
+                    for i, (k, (n, d)) in enumerate(zip(_keys(), SHAPES))]
+        for s in sessions:
+            for f in s.step(WARM):
+                f.result(timeout=600)
+        homes = {s.name: router.route_of(s.name).name for s in sessions}
+        print(f"[warmup] {WARM} gens clean; placement: {homes}")
+
+        # -- act 2: storm -----------------------------------------------
+        injector.set_phase("storm")
+        t_storm = time.monotonic()
+        remaining = {s.name: NGEN - 1 - WARM for s in sessions}
+        lost = set()
+        storm_deadline = t_storm + 240
+        while time.monotonic() < storm_deadline:
+            pending = [s for s in sessions
+                       if s.name not in lost and remaining[s.name] > 0]
+            if not pending:
+                break
+            for s in pending:
+                out = _step_once(s, counters)
+                if out == "ok":
+                    remaining[s.name] -= 1
+                elif out == "lost":
+                    lost.add(s.name)
+                    print(f"[storm] session {s.name} lost "
+                          f"(was on {homes[s.name]})")
+                else:
+                    time.sleep(0.1)     # back off before the blind retry
+        if any(remaining[s.name] > 0 for s in sessions
+               if s.name not in lost):
+            failures.append("storm generations did not complete in time")
+        # keep storming with trajectory-neutral evaluates until every
+        # leg aimed at a REACHABLE backend has fired — a planned fault
+        # that never fired means the drill tested nothing
+        survivors = [s for s in sessions if s.name not in lost]
+        by_target = {}
+        for s in survivors:
+            by_target.setdefault(router.route_of(s.name).name, s)
+        probe_g = {s.name: np.asarray(_pop(k, 8, d).genome)
+                   for s, (k, (n, d)) in zip(sessions,
+                                             zip(_keys(), SHAPES))}
+        pad_deadline = time.monotonic() + 120
+        while time.monotonic() < pad_deadline:
+            unfired = [leg for leg in injector.unfired_legs()
+                       if leg.target in by_target]
+            if not unfired:
+                break
+            for leg in unfired:
+                s = by_target[leg.target]
+                if not _eval_once(s, probe_g[s.name], counters):
+                    time.sleep(0.1)
+        storm_s = time.monotonic() - t_storm
+        goodput = (counters["successes"] / counters["attempts"]
+                   if counters["attempts"] else 0.0)
+        print(f"[storm] {storm_s:.1f}s: {counters['successes']}/"
+              f"{counters['attempts']} ops succeeded "
+              f"(goodput {goodput:.2f}), lost={sorted(lost)}")
+
+        # -- act 3: heal ------------------------------------------------
+        injector.set_phase("heal")
+        t_heal = time.monotonic()
+        heal_counters = {"attempts": 0, "successes": 0}
+        for s in survivors:             # the reserved final generation
+            out = "retry"
+            while out == "retry" and time.monotonic() < t_heal + 60:
+                out = _step_once(s, heal_counters)
+                if out == "retry":
+                    time.sleep(0.1)
+            if out != "ok":
+                failures.append(f"{s.name} never completed its final "
+                                f"generation after the heal ({out})")
+        # recovery is complete when every reachable backend's breaker
+        # reads closed again — drive half-open probes with trajectory-
+        # neutral evaluates until the probes succeed
+        close_deadline = time.monotonic() + 60
+        while time.monotonic() < close_deadline:
+            open_b = [n for n, b in router.backends.items()
+                      if not router.health.is_sick(n)
+                      and b.breaker is not None
+                      and b.breaker.state() != "closed"]
+            if not open_b:
+                break
+            for n in open_b:
+                s = by_target.get(n)
+                if s is not None:
+                    _eval_once(s, probe_g[s.name], heal_counters)
+            time.sleep(0.05)
+        else:
+            failures.append("a circuit breaker never closed after heal")
+        recovery_s = time.monotonic() - t_heal
+        print(f"[heal] recovered in {recovery_s:.2f}s "
+              f"({heal_counters['successes']} clean ops)")
+
+        # -- act 4: verdicts --------------------------------------------
+        bitwise = True
+        for i, s in enumerate(sessions):
+            if s.name in lost:
+                continue
+            got = _final(s.population())
+            for g, w in zip(got, want[i]):
+                if not np.array_equal(g, w):
+                    bitwise = False
+                    failures.append(f"{s.name} diverged from the "
+                                    "undisturbed reference")
+                    break
+        unfired = [f"{leg.target}:{leg.kind}"
+                   for leg in injector.unfired_legs()]
+        if unfired:
+            failures.append(f"planned legs never fired: {unfired}")
+        replayed = ChaosInjector.replay(plan, injector.decision_log())
+        replay_ok = replayed.fired() == injector.fired()
+        if not replay_ok:
+            failures.append("decision-log replay diverged (determinism "
+                            "broken)")
+
+        # deadline-budget sheds on the clean wire: the router hop sheds
+        # a spent budget pre-forward; the instance sheds pre-dispatch
+        probe = survivors[0]
+        r0 = router.stats().counters["router_deadline_shed"]
+        try:
+            probe.step(1, deadline=1e-9)[0].result(timeout=60)
+            failures.append("router accepted a spent deadline budget")
+        except DeadlineExceeded:
+            pass
+        router_shed = router.stats().counters["router_deadline_shed"] - r0
+        home_i = int(router.route_of(probe.name).name[1:])
+        direct = RemoteService(srvs[home_i].url, timeout=60)
+        ph = direct.attach(probe.name)
+        try:
+            ph.step(1, deadline=0.0)[0].result(timeout=60)
+            failures.append("instance accepted a spent deadline budget")
+        except DeadlineExceeded:
+            pass
+        direct.close()
+        inst_shed = svcs[home_i].metrics.counter("deadline_shed")
+        brown = _brownout_segment()
+        if not brown["shed_typed"] or brown["brownout_sheds"] < 1:
+            failures.append("brownout segment shed nothing")
+        rc = router.stats().counters
+        if rc["router_breaker_opens"] < 1 or rc["router_breaker_probes"] < 1:
+            failures.append("breaker never opened/probed under the wedge")
+        if router_shed < 1 or inst_shed < 1:
+            failures.append("deadline sheds not visible in metrics")
+
+        report.update({
+            "goodput_frac": round(goodput, 4),
+            "recovery_s": round(recovery_s, 3),
+            "bitwise_identical": bitwise,
+            "survivors": len(survivors), "lost": sorted(lost),
+            "storm_s": round(storm_s, 2),
+            "storm_attempts": counters["attempts"],
+            "storm_successes": counters["successes"],
+            "faults_injected": injector.fired_counts(),
+            "unfired_legs": unfired,
+            "determinism_replay_ok": replay_ok,
+            "breaker": {"opens": rc["router_breaker_opens"],
+                        "probes": rc["router_breaker_probes"],
+                        "rejections": rc["router_breaker_rejections"]},
+            "sheds": {"router_deadline_shed": router_shed,
+                      "instance_deadline_shed": inst_shed,
+                      "brownout_sheds": brown["brownout_sheds"]},
+            "wall_s": round(time.monotonic() - t_all, 2),
+        })
+    finally:
+        cli.close()
+        front.close()               # closes the router too
+        for p in proxies:
+            p.close()
+        for srv in srvs:
+            srv.close()
+        for svc in svcs:
+            svc.close()
+
+    text = json.dumps(report, indent=2, sort_keys=True)
+    if OUT == "-":
+        print(text)
+    else:
+        with open(OUT, "w", encoding="utf-8") as fh:
+            fh.write(text + "\n")
+        print(f"[report] wrote {OUT}")
+    if failures:
+        print(f"FAILED: {failures}")
+        return 1
+    print(f"chaos drill clean: goodput {report['goodput_frac']:.2f}, "
+          f"recovery {report['recovery_s']:.2f}s, survivors bitwise "
+          "identical")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,7 @@ the exact backoff sequence without real waiting
 
 from __future__ import annotations
 
+import random
 import time
 from functools import wraps
 from typing import Callable
@@ -36,6 +37,8 @@ def with_retries(fn: Callable | None = None, *, retries: int = 3,
                  retry_on: tuple = (OSError, TimeoutError, ConnectionError),
                  sleep: Callable[[float], None] = time.sleep,
                  clock: Callable[[], float] = time.monotonic,
+                 jitter: bool = False,
+                 rng: Callable[[], float] | None = None,
                  on_retry: Callable | None = None):
     """Wrap ``fn`` so transient failures are retried with exponential
     backoff.
@@ -49,8 +52,17 @@ def with_retries(fn: Callable | None = None, *, retries: int = 3,
     * ``retry_on`` — exception classes considered transient; anything else
       propagates on the first occurrence (a ``ValueError`` from a corrupt
       checkpoint must not be retried into oblivion).
+    * ``jitter`` — FULL jitter (AWS-style): the actual delay before retry
+      ``i`` is uniform in ``[0, min(backoff * factor**i, max_backoff)]``.
+      Off by default so existing callers keep their exact deterministic
+      backoff sequence; reconnect storms (every client of a crashed
+      backend retrying in lockstep) are what it exists to break up.
+    * ``rng`` — zero-arg callable returning a float in ``[0, 1)`` used by
+      ``jitter`` (defaults to :func:`random.random`); injectable so tests
+      can pin the jittered sequence.
     * ``sleep`` / ``clock`` — injectable for deterministic tests.
-    * ``on_retry(attempt, exc, delay)`` — optional observer hook.
+    * ``on_retry(attempt, exc, delay)`` — optional observer hook
+      (receives the post-jitter delay actually slept).
 
     When every attempt fails, raises :class:`RetriesExhausted` chained to
     the last exception.  Usable as a decorator (``@with_retries(...)``) or
@@ -59,6 +71,7 @@ def with_retries(fn: Callable | None = None, *, retries: int = 3,
     """
     if retries < 0:
         raise ValueError("retries must be >= 0")
+    draw = rng if rng is not None else random.random
 
     def deco(func: Callable) -> Callable:
         @wraps(func)
@@ -73,6 +86,8 @@ def with_retries(fn: Callable | None = None, *, retries: int = 3,
                     if attempt == retries:
                         break
                     delay = min(backoff * factor ** attempt, max_backoff)
+                    if jitter:
+                        delay *= draw()
                     if timeout is not None and \
                             clock() - start + delay > timeout:
                         break

@@ -45,7 +45,7 @@ from ..resilience.retry import with_retries, RetriesExhausted
 __all__ = ["ServeFuture", "Request", "BatchDispatcher", "ServeError",
            "ServiceClosed", "ServiceOverloaded", "DeadlineExceeded",
            "RequestCancelled", "ServiceDraining", "SessionUnknown",
-           "TenantQuotaExceeded"]
+           "TenantQuotaExceeded", "CircuitOpen", "ServiceBrownout"]
 
 
 class ServeError(RuntimeError):
@@ -76,6 +76,25 @@ class TenantQuotaExceeded(ServeError):
     backpressure).  Raised by
     :mod:`deap_tpu.serve.router.tenants` and rebuilt typed on the client
     from the wire error envelope."""
+
+
+class CircuitOpen(ServeError):
+    """A per-backend circuit breaker is open: the backend failed enough
+    consecutive forwards that the router stopped sending it work until a
+    half-open probe succeeds (:class:`deap_tpu.serve.router.backend.
+    CircuitBreaker`).  The request was NEVER sent — retrying against the
+    fleet later (or another instance) is always safe.  Travels the typed
+    error envelope with status 503."""
+
+
+class ServiceBrownout(ServeError):
+    """The request was shed by priority under sustained queue pressure:
+    the dispatcher's pending queue stayed at/above its brownout watermark
+    and this admission's priority class is lower than work already
+    queued.  Distinct from :class:`ServiceOverloaded` (the queue is not
+    necessarily full — the service is degrading *selectively* so
+    higher-priority tenants keep their deadlines).  Status 429; clients
+    should back off longer than for a plain overload."""
 
 
 class DeadlineExceeded(ServeError):
@@ -207,6 +226,11 @@ class Request:
     submitted: float = 0.0
     seq: int = dataclasses.field(default_factory=lambda: next(_req_ids))
     trace: Any = None
+    #: tenant priority class (higher = more important; router tenants
+    #: stamp it from their quota).  Under sustained queue pressure the
+    #: dispatcher sheds admissions whose priority is lower than work
+    #: already queued (:class:`ServiceBrownout`).
+    priority: int = 1
 
     @property
     def tenant(self) -> Optional[str]:
@@ -231,7 +255,7 @@ class BatchDispatcher:
     #: flags, and the batch counter are shared between every client
     #: thread and the dispatch worker
     _GUARDED_BY = {"_cv": ("_pending", "_closed", "_draining", "_paused",
-                           "_busy", "_batches")}
+                           "_busy", "_batches", "_pressure_since")}
 
     def __init__(self, execute: Callable[[str, tuple, List[Request]], list],
                  *, max_pending: int = 256, batch_window: float = 0.0,
@@ -239,9 +263,14 @@ class BatchDispatcher:
                  retry_on: tuple = (OSError, TimeoutError, ConnectionError),
                  clock: Callable[[], float] = time.monotonic,
                  on_retry: Optional[Callable] = None,
-                 tracer=None, after_batch: Optional[Callable] = None):
+                 tracer=None, after_batch: Optional[Callable] = None,
+                 brownout_watermark: Optional[float] = None,
+                 brownout_grace_s: float = 0.0):
         if max_pending < 1:
             raise ValueError("max_pending must be >= 1")
+        if brownout_watermark is not None and not (
+                0.0 < float(brownout_watermark) <= 1.0):
+            raise ValueError("brownout_watermark must be in (0, 1]")
         self._execute_once = execute
         self._metrics = metrics
         #: fleettrace.FleetTracer (or None): queue-wait phase spans and
@@ -269,6 +298,12 @@ class BatchDispatcher:
             on_retry=_note_retry)
         self.max_pending = int(max_pending)
         self.batch_window = float(batch_window)
+        #: queue depth at/above which brownout pressure accrues
+        #: (``None`` disables priority shedding entirely)
+        self._brownout_depth = (
+            None if brownout_watermark is None
+            else max(1, int(float(brownout_watermark) * max_pending)))
+        self._brownout_grace_s = float(brownout_grace_s)
         self._clock = clock
         self._cv = sanitize.condition()
         self._pending: "collections.deque[Request]" = collections.deque()
@@ -277,6 +312,9 @@ class BatchDispatcher:
         self._paused = False
         self._busy = False
         self._batches = 0
+        #: clock at which queue depth first reached the brownout
+        #: watermark; ``None`` while below it
+        self._pressure_since: Optional[float] = None
         self._thread = threading.Thread(
             target=self._run, name="deap-tpu-serve-dispatch", daemon=True)
         self._thread.start()
@@ -314,6 +352,18 @@ class BatchDispatcher:
                 # drain wait — the failover snapshot sits at a boundary
                 # every client observed
                 raise ServiceDraining("service is draining for failover")
+            if any(r.deadline is not None and now > r.deadline
+                   for r in requests):
+                # deadline-budget shed: the remaining budget that rode in
+                # with the request (client hop + router hop already
+                # subtracted) is spent on ARRIVAL — queueing it would
+                # only burn a batch slot on work nobody is waiting for.
+                # The whole atomic batch fails together (none of it ran,
+                # so a re-send with a fresh budget is safe).
+                for r in requests:
+                    self._shed_expired(r, now)
+                return [r.future for r in requests]
+            self._check_brownout_locked(requests, now)
             if len(requests) > self.max_pending:
                 # an atomic batch bigger than the queue can EVER hold
                 # would wait on a predicate no completion satisfies —
@@ -366,6 +416,58 @@ class BatchDispatcher:
                 self._metrics.set_gauge("queue_depth", len(self._pending))
             self._cv.notify_all()
         return [r.future for r in requests]
+
+    def _shed_expired(self, req: Request, now: float) -> None:
+        """Fail a request whose deadline budget was already spent at
+        submission (pre-dispatch shed).  Counts ``deadline_shed`` on top
+        of the ordinary miss accounting, and records the same error span
+        :meth:`_prune_locked` would — a shed must look identical to a
+        queue-pruned miss to the health monitor's trace window."""
+        req.future._set_exception(DeadlineExceeded(
+            f"deadline budget spent {now - req.deadline:.3f}s before "
+            "submission (shed pre-dispatch)"))
+        if self._metrics is not None:
+            self._metrics.inc("deadline_shed")
+            self._metrics.inc("deadline_misses")
+            self._metrics.inc_tenant(req.tenant, "deadline_misses")
+        if self._tracer is not None and req.trace is not None:
+            self._tracer.record(
+                f"serve.{req.kind}", req.trace, req.submitted, now,
+                attrs={"error": "DeadlineExceeded", "session": req.tenant})
+
+    def _check_brownout_locked(self, requests: List[Request],
+                               now: float) -> None:
+        """Priority load shedding (holds ``_cv``).  While the queue sits
+        at/above the brownout watermark for longer than the grace
+        period, an admission whose priority class is LOWER than work
+        already queued is refused with :class:`ServiceBrownout` — the
+        graceful middle ground between admitting everything (every
+        tenant's deadline misses) and a hard :class:`ServiceOverloaded`
+        at the brim.  Equal-priority traffic is never shed here, so a
+        fleet with uniform priorities behaves exactly as before."""
+        if self._brownout_depth is None:
+            return
+        if len(self._pending) >= self._brownout_depth:
+            if self._pressure_since is None:
+                self._pressure_since = now
+        else:
+            self._pressure_since = None
+            return
+        if now - self._pressure_since < self._brownout_grace_s:
+            return
+        queued_top = max((r.priority for r in self._pending), default=None)
+        incoming = min(r.priority for r in requests)
+        if queued_top is None or incoming >= queued_top:
+            return
+        if self._metrics is not None:
+            self._metrics.inc("brownout_sheds", len(requests))
+            for r in requests:
+                self._metrics.inc_tenant(r.tenant, "rejected")
+        raise ServiceBrownout(
+            f"priority {incoming} admission shed: queue at "
+            f"{len(self._pending)}/{self.max_pending} holds priority "
+            f"{queued_top} work (sustained {now - self._pressure_since:.1f}s "
+            "over the brownout watermark)")
 
     def set_draining(self, value: bool = True) -> None:
         """Reject (``ServiceDraining``) every submission from now on —

@@ -48,6 +48,7 @@ SERVE_COUNTERS = (
     "steps", "steps_sharded", "evaluations", "cache_hits", "cache_misses",
     "cache_evictions", "cache_nan_skipped", "cache_purged", "dedup_rows",
     "quarantined", "rebuckets", "rebuckets_auto", "rebucket_policy_errors",
+    "deadline_shed", "brownout_sheds",
 )
 
 #: Counters the network frontend (deap_tpu.serve.net) adds on top —
@@ -71,12 +72,15 @@ ROUTER_COUNTERS = (
     "router_quota_rejections", "router_health_probes",
     "router_backends_sick", "router_failovers", "router_failover_sessions",
     "router_orphans_replaced", "router_sessions_lost",
+    "router_breaker_opens", "router_breaker_probes",
+    "router_breaker_rejections", "router_deadline_shed",
 )
 
 #: Gauges of the fleet router (last-value).
 ROUTER_GAUGES = (
     "router_backends_alive", "router_sessions_routed",
     "router_inflight", "router_failover_recovery_s",
+    "router_backends_degraded",
 )
 
 #: Gauges (last-value).  The ``profile_*`` family is the device-phase

@@ -32,6 +32,7 @@ import http.client
 import json
 import queue
 import threading
+import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 from urllib.parse import quote
 
@@ -43,6 +44,7 @@ from ... import sanitize
 from ...base import Population, Fitness
 from ...observability.fleettrace import FleetTracer
 from ...observability.sinks import MetricRecord
+from ...resilience.retry import with_retries, RetriesExhausted
 from ..dispatcher import (DeadlineExceeded, ServeError, ServeFuture,
                           ServiceClosed)
 from . import protocol
@@ -75,13 +77,29 @@ class _Worker:
     _GUARDED_BY = {"_target_lock": ("_pending_target",)}
 
     def __init__(self, host: str, port: int, timeout: float,
-                 request_timeout: Optional[float] = None):
+                 request_timeout: Optional[float] = None,
+                 retry_budget: int = 2, backoff: float = 0.05,
+                 max_backoff: float = 2.0,
+                 rng: Optional[Callable[[], float]] = None):
         self._host, self._port, self._timeout = host, port, timeout
         #: per-request response deadline (socket timeout on the ordered
         #: connection): a hung backend fails the ONE waiting future with
         #: typed DeadlineExceeded instead of blocking this worker thread
         #: forever; None falls back to the connection timeout
         self._request_timeout = request_timeout
+        #: send-phase reconnect budget PER REQUEST: a request that never
+        #: hit the wire may be re-sent at most this many times, each
+        #: retry backed off exponentially with full jitter so a fleet of
+        #: clients doesn't hammer a flapping backend in lockstep
+        self._retry_budget = int(retry_budget)
+        if self._retry_budget < 0:
+            raise ValueError("retry_budget must be >= 0")
+        self._backoff = float(backoff)
+        self._max_backoff = float(max_backoff)
+        self._rng = rng
+        #: set by close(): interrupts any in-progress backoff nap so a
+        #: closing client never waits out a retry schedule
+        self._wake = sanitize.event()
         self._conn: Optional[http.client.HTTPConnection] = None
         self._jobs: "queue.Queue" = queue.Queue()
         self._closed = False
@@ -111,6 +129,7 @@ class _Worker:
     def close(self) -> None:
         if not self._closed:
             self._closed = True
+            self._wake.set()          # abort any backoff nap in progress
             self._jobs.put(None)
             self._thread.join(timeout=10.0)
         if self._conn is not None:
@@ -130,7 +149,28 @@ class _Worker:
                 self._host, self._port, timeout=t)
         return self._conn
 
+    def _backoff_wait(self, delay: float) -> None:
+        """Interruptible backoff nap between send-phase reconnects —
+        an Event wait, never a blocking sleep, so close() aborts the
+        schedule instead of waiting it out."""
+        if self._wake.wait(delay):
+            raise ServiceClosed("remote client closed during backoff")
+
+    def _attempt(self, job: Callable) -> Any:
+        """One send attempt; a send-phase failure drops the (poisoned)
+        connection before propagating so the next attempt reconnects."""
+        try:
+            return job(self._connection())
+        except _SendFailed:
+            self._drop_connection()
+            raise
+
     def _run(self) -> None:
+        # the per-request send retry policy: only _SendFailed (request
+        # provably never hit the wire) is retried — capped exponential
+        # backoff with FULL jitter, at most retry_budget re-sends.  A
+        # response-phase failure is never re-sent: the server may have
+        # executed the request, and re-sending would double-apply it.
         while True:
             item = self._jobs.get()
             if item is None:
@@ -140,32 +180,18 @@ class _Worker:
                         tail[1](None, ServiceClosed("remote client closed"))
                 return
             job, resolve = item
+            send = with_retries(
+                lambda: self._attempt(job), retries=self._retry_budget,
+                backoff=self._backoff, max_backoff=self._max_backoff,
+                jitter=True, rng=self._rng, retry_on=(_SendFailed,),
+                sleep=self._backoff_wait)
             try:
-                result = job(self._connection())
-            except _SendFailed:
-                # the request never hit the wire (stale keep-alive
-                # connection, server restart) — retrying on a fresh
-                # connection cannot double-execute anything
-                self._drop_connection()
-                try:
-                    result = job(self._connection())
-                except _SendFailed as e2:
-                    self._drop_connection()
-                    resolve(None, e2.cause)
-                    continue
-                except TimeoutError as e2:
-                    self._drop_connection()
-                    resolve(None, DeadlineExceeded(
-                        "no response from "
-                        f"{self._host}:{self._port} within "
-                        f"{self._request_timeout or self._timeout}s "
-                        f"({e2 or 'socket timeout'})"))
-                    continue
-                except Exception as e2:  # noqa: BLE001
-                    self._drop_connection()
-                    resolve(None, e2)
-                    continue
-                resolve(result, None)
+                result = send()
+            except RetriesExhausted as e:
+                # every send attempt failed before reaching the wire —
+                # surface the last transport error, budget spent
+                resolve(None, e.last.cause
+                        if isinstance(e.last, _SendFailed) else e.last)
                 continue
             except TimeoutError as e:
                 # the per-request deadline passed with no response: the
@@ -212,11 +238,12 @@ class _SendFailed(Exception):
 
 def _request(conn: http.client.HTTPConnection, method: str, path: str,
              obj: Any = None, trace: Any = None,
+             deadline: Optional[float] = None,
              compress: Optional[str] = None,
              accept: Tuple[str, ...] = ("zlib",)) -> Any:
     body = (None if obj is None
-            else protocol.encode_frame(obj, trace=trace, compress=compress,
-                                       accept=accept))
+            else protocol.encode_frame(obj, trace=trace, deadline=deadline,
+                                       compress=compress, accept=accept))
     headers = {"Content-Type": protocol.CONTENT_TYPE}
     if accept:
         # bodyless requests (population GETs — the responses most worth
@@ -264,12 +291,19 @@ class RemoteService:
     so servers compress responses regardless.  ``follow_redirects``
     (default on) makes the client transparently re-target when a drained
     instance's error envelope names the replacement — the failover moves
-    without the caller seeing an exception."""
+    without the caller seeing an exception.
+
+    ``retry_budget`` caps how many times ONE request may be re-sent after
+    a send-phase transport failure (the request provably never reached
+    the wire); the re-sends back off exponentially with full jitter, so
+    a flapping backend sees a bounded, de-synchronized retry stream
+    instead of every client hammering it in lockstep."""
 
     def __init__(self, address, *, timeout: float = 600.0,
                  request_timeout: Optional[float] = None,
                  compress: Optional[str] = None,
                  follow_redirects: bool = True,
+                 retry_budget: int = 2,
                  tracer: Optional[FleetTracer] = None):
         self.host, self.port = _parse_address(address)
         self.timeout = float(timeout)
@@ -287,7 +321,8 @@ class RemoteService:
         self.tracer = tracer if tracer is not None else FleetTracer(
             capacity=1024)
         self._worker = _Worker(self.host, self.port, self.timeout,
-                               request_timeout=self.request_timeout)
+                               request_timeout=self.request_timeout,
+                               retry_budget=retry_budget)
         self._closed = False
 
     # -- plumbing ------------------------------------------------------------
@@ -330,21 +365,29 @@ class RemoteService:
                 conn.close()
 
     def _ordered_raw(self, method: str, path: str, obj: Any,
-                     resolve: Callable[[Any, Optional[BaseException]], None]
-                     ) -> None:
+                     resolve: Callable[[Any, Optional[BaseException]], None],
+                     deadline: Optional[float] = None) -> None:
         """Queue one request on the ordered worker connection;
         ``resolve(result, exc)`` runs on the worker thread.  With tracing
         on, the request's root :class:`TraceContext` is minted HERE (at
         submission) and reused verbatim across the worker's send-phase
-        reconnect retry — a retried request keeps its trace identity."""
+        reconnect retry — a retried request keeps its trace identity.
+        ``deadline`` (seconds from now) becomes the request's deadline
+        BUDGET: the time already burned waiting in the client queue (and
+        across reconnect backoffs) is subtracted at send, so the header's
+        ``__deadline__`` carries what actually remains."""
         ctx = self.tracer.context() if self.tracer.enabled else None
+        t_submit = time.monotonic()
 
         def job(conn):
             t0 = self.tracer.clock() if ctx is not None else 0.0
             wire_ctx = None if ctx is None else ctx.wire()
+            budget = (None if deadline is None else
+                      max(0.0, float(deadline)
+                          - (time.monotonic() - t_submit)))
             try:
                 out = _request(conn, method, path, obj, trace=wire_ctx,
-                               compress=self.compress)
+                               deadline=budget, compress=self.compress)
             except ServeError as e:
                 # transparent redirect-on-failover: the drained instance
                 # rejected this request (never executed) and named its
@@ -353,8 +396,11 @@ class RemoteService:
                 if target is None:
                     raise
                 self._retarget(*target)
+                budget = (None if deadline is None else
+                          max(0.0, float(deadline)
+                              - (time.monotonic() - t_submit)))
                 out = _request(self._worker._connection(), method, path,
-                               obj, trace=wire_ctx,
+                               obj, trace=wire_ctx, deadline=budget,
                                compress=self.compress)
             if ctx is not None:
                 self.tracer.record(f"client.{method} {path}", ctx, t0,
@@ -363,8 +409,8 @@ class RemoteService:
         self._worker.submit(job, resolve)
 
     def _ordered(self, method: str, path: str, obj: Any,
-                 on_result: Callable[[Any, ServeFuture], None] = None
-                 ) -> ServeFuture:
+                 on_result: Callable[[Any, ServeFuture], None] = None,
+                 deadline: Optional[float] = None) -> ServeFuture:
         future = ServeFuture()
 
         def resolve(result, exc):
@@ -375,7 +421,7 @@ class RemoteService:
             else:
                 future._set_result(result)
 
-        self._ordered_raw(method, path, obj, resolve)
+        self._ordered_raw(method, path, obj, resolve, deadline=deadline)
         return future
 
     # -- service surface -----------------------------------------------------
@@ -552,7 +598,7 @@ class RemoteSession:
 
         self._service._ordered_raw("POST", self._path("step"),
                                    {"n": int(n), "deadline": deadline},
-                                   resolve)
+                                   resolve, deadline=deadline)
         return futures
 
     def ask(self, deadline: Optional[float] = None) -> ServeFuture:
@@ -563,7 +609,7 @@ class RemoteSession:
             future._set_result(result["offspring"])
         return self._service._ordered("POST", self._path("ask"),
                                       {"deadline": deadline},
-                                      on_result=keep_gen)
+                                      on_result=keep_gen, deadline=deadline)
 
     def tell(self, values,
              deadline: Optional[float] = None) -> ServeFuture:
@@ -573,7 +619,7 @@ class RemoteSession:
         return self._service._ordered(
             "POST", self._path("tell"),
             {"values": np.asarray(values), "deadline": deadline},
-            on_result=keep_gen)
+            on_result=keep_gen, deadline=deadline)
 
     def evaluate(self, genomes,
                  deadline: Optional[float] = None) -> ServeFuture:
@@ -582,7 +628,7 @@ class RemoteSession:
         return self._service._ordered(
             "POST", self._path("evaluate"),
             {"genome": _host_tree(genomes), "deadline": deadline},
-            on_result=unwrap)
+            on_result=unwrap, deadline=deadline)
 
     # -- introspection -------------------------------------------------------
 

@@ -1,0 +1,386 @@
+""":class:`FaultWire` — a fault-injecting TCP proxy on the DTF1 path.
+
+A drill places one proxy in front of each backend and points the fleet
+router at the proxies; every HTTP exchange relayed asks the drill's
+:class:`~deap_tpu.resilience.chaos.ChaosInjector` which faults hit this
+exchange and executes them on the real socket path — the router, client
+and instance all experience genuine wire failures, not mocked
+exceptions.  Fault semantics (direction-sensitive; ``"request"`` faults
+provably never reach the upstream):
+
+* ``partition`` / ``drop`` — the exchange's connection dies without the
+  upstream seeing the request (``direction="response"``: the upstream
+  executes, the reply never returns — the asymmetric half);
+* ``wedge`` — wedge-after-headers: the proxy reads the full request and
+  then goes silent (``response``: relays the request, returns only the
+  response head, then stalls) until the peer gives up;
+* ``delay`` — holds the exchange for ``seconds`` before relaying;
+* ``throttle`` — relays the body at ``bytes_per_s``;
+* ``truncate`` — cuts the body to ``frac`` of its bytes and rewrites
+  ``Content-Length`` to match, producing a well-framed HTTP message
+  carrying a truncated DTF1 frame (what
+  :func:`~deap_tpu.serve.net.protocol.decode_frame` must reject with a
+  typed ``ProtocolError``, not a struct crash);
+* ``corrupt`` — XORs a 64-byte window in the middle of the body
+  (length preserved);
+* ``drip`` — relays the response ``chunk`` bytes per ``seconds``.
+
+All waits are ``threading.Event`` waits on the proxy's stop event
+(never ``time.sleep`` — the ``no-blocking-sleep`` gate covers this
+package), so :meth:`close` interrupts every in-flight fault
+immediately.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ... import sanitize
+from ...resilience.chaos import ChaosFault, ChaosInjector
+
+__all__ = ["FaultWire"]
+
+_CRLF2 = b"\r\n\r\n"
+
+
+class _Message:
+    """One parsed HTTP message: raw head bytes, lowercase header map,
+    body bytes (for chunked bodies, the raw chunk framing — relayed
+    verbatim, length-rewriting faults skip it)."""
+
+    def __init__(self, head: bytes, headers: Dict[str, str], body: bytes,
+                 chunked: bool):
+        self.head = head
+        self.headers = headers
+        self.body = body
+        self.chunked = chunked
+
+    def serialize(self, body: Optional[bytes] = None) -> bytes:
+        """Wire bytes; passing a REPLACEMENT body rewrites
+        ``Content-Length`` to match (chunked messages relay as-is)."""
+        if body is None or self.chunked:
+            return self.head + self.body
+        if body != self.body and not self.chunked:
+            head = _rewrite_content_length(self.head, len(body))
+            return head + body
+        return self.head + body
+
+
+def _rewrite_content_length(head: bytes, n: int) -> bytes:
+    lines = head[:-len(_CRLF2)].split(b"\r\n")
+    out = []
+    for line in lines:
+        if line.lower().startswith(b"content-length:"):
+            out.append(b"Content-Length: " + str(n).encode())
+        else:
+            out.append(line)
+    return b"\r\n".join(out) + _CRLF2
+
+
+class _Reader:
+    """Buffered HTTP-message reader over one socket, interruptible by
+    the proxy's stop event (short socket timeouts, re-checked per
+    recv)."""
+
+    def __init__(self, sock: socket.socket, stop: threading.Event):
+        self.sock = sock
+        self.buf = b""
+        self._stop = stop
+        sock.settimeout(0.25)
+
+    def _fill(self) -> bool:
+        """One recv into the buffer; False on EOF/stop/error."""
+        while not self._stop.is_set():
+            try:
+                data = self.sock.recv(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                return False
+            if not data:
+                return False
+            self.buf += data
+            return True
+        return False
+
+    def _until(self, marker: bytes) -> Optional[int]:
+        while marker not in self.buf:
+            if not self._fill():
+                return None
+        return self.buf.index(marker)
+
+    def _take(self, n: int) -> Optional[bytes]:
+        while len(self.buf) < n:
+            if not self._fill():
+                return None
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+    def read_message(self) -> Optional[_Message]:
+        """The next complete message, or ``None`` on EOF/stop.  Chunked
+        bodies are consumed up to the terminal ``0\\r\\n\\r\\n`` and
+        kept as raw framing (our servers send no trailers)."""
+        end = self._until(_CRLF2)
+        if end is None:
+            return None
+        head = self._take(end + len(_CRLF2))
+        headers: Dict[str, str] = {}
+        for line in head[:-len(_CRLF2)].split(b"\r\n")[1:]:
+            k, _, v = line.partition(b":")
+            headers[k.strip().lower().decode("latin-1")] = \
+                v.strip().decode("latin-1")
+        chunked = "chunked" in headers.get("transfer-encoding", "").lower()
+        if chunked:
+            end = self._until(b"0\r\n\r\n")
+            if end is None:
+                return None
+            body = self._take(end + 5)
+            if body is None:
+                return None
+            return _Message(head, headers, body, chunked=True)
+        n = int(headers.get("content-length", "0") or "0")
+        body = self._take(n) if n else b""
+        if body is None:
+            return None
+        return _Message(head, headers, body, chunked=False)
+
+
+class FaultWire:
+    """Fault-injecting HTTP relay in front of one backend (see module
+    docstring).
+
+    ``target`` is this proxy's name in the injector's plan;
+    ``upstream`` is the fronted instance's ``(host, port)``.  The
+    proxy listens on ``(host, port)`` (``port=0`` picks a free one —
+    read :attr:`address` back and point the router's
+    :class:`~deap_tpu.serve.router.backend.Backend` at it)."""
+
+    #: lock-guarded shared state: the live-socket set is written by the
+    #: accept loop and every relay thread, and swept by close()
+    _GUARDED_BY = {"_lock": ("_conns",)}
+
+    def __init__(self, upstream: Tuple[str, int], target: str,
+                 injector: ChaosInjector, *, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.upstream = (str(upstream[0]), int(upstream[1]))
+        self.target = str(target)
+        self.injector = injector
+        self._stop = threading.Event()
+        self._lock = sanitize.lock()
+        self._conns: set = set()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self._listener.settimeout(0.25)
+        self._threads: List[threading.Thread] = []
+        self._accept_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._listener.getsockname()[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FaultWire":
+        if self._accept_thread is None:
+            self._accept_thread = threading.Thread(
+                target=self._accept_loop,
+                name=f"deap-tpu-faultwire-{self.target}", daemon=True)
+            self._accept_thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for sock in conns:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+            self._accept_thread = None
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    def __enter__(self) -> "FaultWire":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- relay ---------------------------------------------------------------
+
+    def _track(self, sock: socket.socket) -> None:
+        with self._lock:
+            self._conns.add(sock)
+
+    def _untrack(self, sock: Optional[socket.socket]) -> None:
+        if sock is None:
+            return
+        with self._lock:
+            self._conns.discard(sock)
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            self._track(client)
+            t = threading.Thread(target=self._relay, args=(client,),
+                                 name=f"deap-tpu-faultwire-{self.target}-c",
+                                 daemon=True)
+            self._threads.append(t)
+            t.start()
+
+    def _relay(self, client: socket.socket) -> None:
+        upstream: Optional[socket.socket] = None
+        up_reader: Optional[_Reader] = None
+        cl_reader = _Reader(client, self._stop)
+        try:
+            while not self._stop.is_set():
+                req = cl_reader.read_message()
+                if req is None:
+                    return
+                faults = self.injector.decide(self.target,
+                                              _exchange_class(req.head))
+                req_faults = [f for f in faults
+                              if f.leg.direction in ("request", "both")]
+                resp_faults = [f for f in faults
+                               if f.leg.direction in ("response", "both")]
+                body = req.body
+                for f in req_faults:
+                    kind = f.leg.kind
+                    if kind in ("partition", "drop"):
+                        return          # upstream never sees the request
+                    if kind == "wedge":
+                        # wedge-after-headers: the request was read, the
+                        # upstream never hears of it; hold the line dead
+                        # until the peer (or the drill) gives up
+                        self._stop.wait(float(f.leg.param("seconds", 60.0)))
+                        return
+                    if kind == "delay":
+                        if self._stop.wait(
+                                float(f.leg.param("seconds", 0.05))):
+                            return
+                    elif kind == "truncate" and not req.chunked and body:
+                        body = body[:int(len(body)
+                                         * float(f.leg.param("frac", 0.5)))]
+                    elif kind == "corrupt":
+                        body = _corrupt(body, int(f.leg.param("xor", 0xFF)))
+                if upstream is None:
+                    upstream = socket.create_connection(self.upstream,
+                                                        timeout=10.0)
+                    self._track(upstream)
+                    up_reader = _Reader(upstream, self._stop)
+                throttle = next((f for f in req_faults
+                                 if f.leg.kind == "throttle"), None)
+                if not self._send(upstream, req.serialize(body), throttle):
+                    return
+                resp = up_reader.read_message()
+                if resp is None:
+                    return
+                if not self._relay_response(client, resp, resp_faults):
+                    return
+                if "close" in resp.headers.get("connection", "").lower():
+                    return
+        finally:
+            self._untrack(client)
+            self._untrack(upstream)
+
+    def _relay_response(self, client: socket.socket, resp: _Message,
+                        faults: List[ChaosFault]) -> bool:
+        body = resp.body
+        for f in faults:
+            kind = f.leg.kind
+            if kind in ("partition", "drop"):
+                # asymmetric half: the upstream DID execute; the reply
+                # dies on the return path
+                return False
+            if kind == "wedge":
+                if not self._send(client, resp.head, None):
+                    return False
+                self._stop.wait(float(f.leg.param("seconds", 60.0)))
+                return False
+            if kind == "delay":
+                if self._stop.wait(float(f.leg.param("seconds", 0.05))):
+                    return False
+            elif kind == "truncate" and not resp.chunked and body:
+                body = body[:int(len(body)
+                                 * float(f.leg.param("frac", 0.5)))]
+            elif kind == "corrupt":
+                body = _corrupt(body, int(f.leg.param("xor", 0xFF)))
+            elif kind == "drip":
+                if not self._send(client, resp.serialize(body), None,
+                                  chunk=int(f.leg.param("chunk", 256)),
+                                  pace_s=float(f.leg.param("seconds",
+                                                           0.01))):
+                    return False
+                return True
+        throttle = next((f for f in faults if f.leg.kind == "throttle"),
+                        None)
+        return self._send(client, resp.serialize(body), throttle)
+
+    def _send(self, sock: socket.socket, data: bytes,
+              throttle: Optional[ChaosFault], *, chunk: int = 0,
+              pace_s: float = 0.0) -> bool:
+        """Write ``data``, optionally bandwidth-throttled or dripped in
+        fixed chunks; False on peer loss or proxy stop."""
+        if throttle is not None:
+            bps = max(1.0, float(throttle.leg.param("bytes_per_s", 65536)))
+            chunk, pace_s = max(1, int(bps * 0.05)), 0.05
+        try:
+            if chunk <= 0:
+                sock.sendall(data)
+                return True
+            for i in range(0, len(data), chunk):
+                sock.sendall(data[i:i + chunk])
+                if i + chunk < len(data) and self._stop.wait(pace_s):
+                    return False
+            return True
+        except OSError:
+            return False
+
+
+def _exchange_class(head: bytes) -> str:
+    """``"data"`` for session-plane requests, ``"control"`` for
+    healthz/metrics/trace/admin — what a leg's ``scope`` matches, so a
+    plan can build gray failures (data path broken, control plane
+    polite) or full partitions (both)."""
+    line = head.split(b"\r\n", 1)[0]
+    parts = line.split(b" ")
+    path = parts[1] if len(parts) > 1 else b""
+    return "data" if path.startswith(b"/v1/sessions") else "control"
+
+
+def _corrupt(body: bytes, xor: int) -> bytes:
+    """XOR a 64-byte window in the middle of the body (length
+    preserved) — far enough in to hit a DTF1 tensor payload on large
+    frames and the header JSON on small ones; either way the receiver
+    must fail TYPED, never crash."""
+    if not body:
+        return body
+    i = len(body) // 2
+    window = bytes(b ^ (xor & 0xFF) for b in body[i:i + 64])
+    return body[:i] + window + body[i + len(window):]

@@ -53,14 +53,23 @@ import numpy as np
 from ..dispatcher import (ServeError, ServiceClosed, ServiceOverloaded,
                           DeadlineExceeded, RequestCancelled,
                           ServiceDraining, SessionUnknown,
-                          TenantQuotaExceeded)
+                          TenantQuotaExceeded, CircuitOpen, ServiceBrownout)
 from ..buckets import BucketOverflow
 
 __all__ = ["MAGIC", "CONTENT_TYPE", "ACCEPT_HEADER", "encode_frame",
            "encode_frame_ex", "decode_frame", "decode_frame_with_trace",
-           "decode_frame_with_meta", "rewrite_trace", "status_of",
-           "error_payload", "remote_exception", "ERROR_STATUS",
-           "WIRE_CODECS"]
+           "decode_frame_with_meta", "rewrite_trace", "rewrite_header",
+           "status_of", "error_payload", "remote_exception", "ERROR_STATUS",
+           "ProtocolError", "WIRE_CODECS"]
+
+
+class ProtocolError(ServeError, ValueError):
+    """A frame that violates the DTF1 wire format: bad magic, truncated
+    header, or a tensor manifest whose declared byte lengths exceed the
+    remaining body.  Subclasses :class:`ValueError` so pre-existing
+    ``except ValueError`` edges still catch it, and :class:`ServeError`
+    so it travels the typed error envelope (status 400) instead of
+    crashing the handler with a struct unpack error."""
 
 #: payload codecs this build can negotiate (name -> (deflate, inflate))
 WIRE_CODECS = {"zlib": (zlib.compress, zlib.decompress)}
@@ -167,6 +176,7 @@ def _dtype_of(token: str) -> np.dtype:
 
 
 def encode_frame_ex(obj: Any, trace: Any = None, *,
+                    deadline: Optional[float] = None,
                     compress: Optional[str] = None,
                     accept: Tuple[str, ...] = (),
                     min_compress_bytes: int = 4096
@@ -181,7 +191,11 @@ def encode_frame_ex(obj: Any, trace: Any = None, *,
     (applied only when the raw payload reaches ``min_compress_bytes`` —
     deflating a 100-byte ask header costs more than it saves); ``accept``
     advertises the codecs THIS peer can inflate, inviting the responder
-    to compress its reply."""
+    to compress its reply.  ``deadline`` (optional, seconds) is the
+    sender's REMAINING deadline budget, stored in the header under
+    ``"__deadline__"`` — every forwarding hop subtracts its own dwell
+    time (:func:`rewrite_header`) so the terminal dispatcher sees the
+    true budget left, not the budget the client started with."""
     tensors: List[np.ndarray] = []
     body = _pack(obj, tensors)
     header = {"body": body,
@@ -190,6 +204,8 @@ def encode_frame_ex(obj: Any, trace: Any = None, *,
                               for a in tensors]}
     if trace is not None:
         header["__trace__"] = trace
+    if deadline is not None:
+        header["__deadline__"] = float(deadline)
     if accept:
         header["__accept__"] = [c for c in accept if c in WIRE_CODECS]
     payload_parts = []
@@ -218,6 +234,7 @@ def encode_frame_ex(obj: Any, trace: Any = None, *,
 
 
 def encode_frame(obj: Any, trace: Any = None, *,
+                 deadline: Optional[float] = None,
                  compress: Optional[str] = None,
                  accept: Tuple[str, ...] = (),
                  min_compress_bytes: int = 4096) -> bytes:
@@ -228,9 +245,12 @@ def encode_frame(obj: Any, trace: Any = None, *,
     stored in the frame HEADER under ``"__trace__"``, beside the tensor
     manifest: request tracing is header metadata, invisible to the body
     the decoder hands back (a peer that ignores it decodes identically).
+    ``deadline`` is the remaining deadline budget in seconds
+    (``"__deadline__"`` header — see :func:`encode_frame_ex`);
     ``compress``/``accept`` are the payload-compression negotiation
     (see :func:`encode_frame_ex`, which also reports bytes saved)."""
-    return encode_frame_ex(obj, trace, compress=compress, accept=accept,
+    return encode_frame_ex(obj, trace, deadline=deadline, compress=compress,
+                           accept=accept,
                            min_compress_bytes=min_compress_bytes)[0]
 
 
@@ -238,12 +258,22 @@ def _split_header(data: bytes) -> Tuple[dict, int]:
     """Parse and validate the frame prefix; returns ``(header dict,
     payload offset)``."""
     if len(data) < 8 or data[:4] != MAGIC:
-        raise ValueError("not a deap-tpu wire frame (bad magic)")
+        raise ProtocolError("not a deap-tpu wire frame (bad magic)")
     (hlen,) = _HEAD.unpack_from(data, 4)
     hdr_end = 8 + hlen
     if len(data) < hdr_end:
-        raise ValueError("truncated frame header")
-    return json.loads(data[8:hdr_end].decode("utf-8")), hdr_end
+        raise ProtocolError(
+            f"truncated frame header: header declares {hlen} bytes, "
+            f"{len(data) - 8} present")
+    try:
+        header = json.loads(data[8:hdr_end].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        # a corrupted-on-the-wire header must surface as the typed
+        # protocol error, not a bare json traceback in the handler
+        raise ProtocolError(f"undecodable frame header: {e}") from e
+    if not isinstance(header, dict):
+        raise ProtocolError("frame header is not a JSON object")
+    return header, hdr_end
 
 
 def decode_frame(data: bytes) -> Any:
@@ -282,6 +312,13 @@ def decode_frame_with_meta(data: bytes) -> Tuple[Any, Dict[str, Any]]:
             raise ValueError("negative tensor extent in manifest")
         specs.append((dt, shape, nbytes))
         declared += nbytes
+    if codec is None and declared > wire_payload:
+        # reject BEFORE touching any tensor bytes: the manifest promises
+        # more payload than the body carries (a frame cut mid-flight),
+        # and trusting it would hand np.frombuffer an out-of-bounds read
+        raise ProtocolError(
+            f"truncated frame: tensor manifest declares {declared} "
+            f"payload bytes but only {wire_payload} remain in the body")
     if codec is not None:
         if codec not in WIRE_CODECS:
             raise ValueError(f"unknown payload codec {codec!r}")
@@ -293,7 +330,9 @@ def decode_frame_with_meta(data: bytes) -> Tuple[Any, Dict[str, Any]]:
     tensors: List[np.ndarray] = []
     for dt, shape, nbytes in specs:
         if off + nbytes > len(payload):
-            raise ValueError("truncated tensor payload")
+            raise ProtocolError(
+                f"truncated tensor payload: slot needs {nbytes} bytes, "
+                f"{len(payload) - off} remain")
         a = np.frombuffer(payload, dtype=dt, count=nbytes // dt.itemsize,
                           offset=off)
         a = a.reshape(shape)
@@ -309,28 +348,54 @@ def decode_frame_with_meta(data: bytes) -> Tuple[Any, Dict[str, Any]]:
     trace = header.get("__trace__")
     accept = tuple(c for c in header.get("__accept__", ())
                    if isinstance(c, str))
+    deadline = header.get("__deadline__")
     return _unpack(header["body"], tensors), {
         "trace": trace if isinstance(trace, dict) else None,
         "accept": accept,
         "compressed": codec,
+        "deadline": (float(deadline)
+                     if isinstance(deadline, (int, float))
+                     and not isinstance(deadline, bool) else None),
         "payload_bytes": off - start,
         "wire_payload_bytes": wire_payload,
     }
 
 
-def rewrite_trace(data: bytes, trace: Any) -> bytes:
-    """Replace (or insert/remove) a frame's ``"__trace__"`` header IN
-    PLACE of the old one, leaving the tensor payload bytes untouched —
-    how the router inserts its hop into the span tree while forwarding
-    a possibly-huge (possibly-compressed) frame without ever decoding
-    the tensors.  ``trace=None`` strips the header."""
+#: sentinel distinguishing "leave this header key alone" from an
+#: explicit ``None`` (which strips the key) in :func:`rewrite_header`
+_KEEP = object()
+
+
+def rewrite_header(data: bytes, *, trace: Any = _KEEP,
+                   deadline: Any = _KEEP) -> bytes:
+    """Rewrite a frame's metadata header keys IN PLACE of the old ones,
+    leaving the tensor payload bytes untouched — how the router edits
+    its hop into a possibly-huge (possibly-compressed) frame without
+    ever decoding the tensors.  ``trace`` replaces ``"__trace__"`` and
+    ``deadline`` (seconds of remaining budget) replaces
+    ``"__deadline__"``; passing ``None`` strips the key, omitting the
+    argument keeps whatever the frame carried.  One re-serialize covers
+    every edited key, so the trace hop and the deadline decrement cost a
+    single header rewrite at the router."""
     header, off = _split_header(data)
-    if trace is None:
-        header.pop("__trace__", None)
-    else:
-        header["__trace__"] = trace
+    for key, value in (("__trace__", trace), ("__deadline__", deadline)):
+        if value is _KEEP:
+            continue
+        if value is None:
+            header.pop(key, None)
+        elif key == "__deadline__":
+            header[key] = float(value)
+        else:
+            header[key] = value
     hdr = json.dumps(header, allow_nan=True).encode("utf-8")
     return b"".join([MAGIC, _HEAD.pack(len(hdr)), hdr, data[off:]])
+
+
+def rewrite_trace(data: bytes, trace: Any) -> bytes:
+    """Replace (or insert/remove) a frame's ``"__trace__"`` header,
+    payload untouched (:func:`rewrite_header` with only ``trace``).
+    ``trace=None`` strips the header."""
+    return rewrite_header(data, trace=trace)
 
 
 # ---------------------------------------------------------------------------
@@ -342,11 +407,14 @@ ERROR_STATUS: Dict[type, int] = {
     SessionUnknown: 404,
     BucketOverflow: 413,
     TenantQuotaExceeded: 429,
+    ServiceBrownout: 429,
     ServiceOverloaded: 429,
     RequestCancelled: 409,
     DeadlineExceeded: 504,
+    CircuitOpen: 503,
     ServiceDraining: 503,
     ServiceClosed: 503,
+    ProtocolError: 400,
     ServeError: 409,
     ValueError: 400,
     KeyError: 400,

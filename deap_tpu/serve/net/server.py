@@ -198,6 +198,7 @@ class NetServer:
             mutpb=float(body.get("mutpb", 0.2)),
             name=body.get("name"),
             evaluate_initial=bool(body.get("evaluate_initial", True)),
+            priority=int(body.get("priority", 1)),
             timeout=self.result_timeout)
         with self._lock:
             self._session_toolbox[session.name] = tb_name
@@ -380,6 +381,15 @@ class _Handler(FrameHTTPHandler):
         if data[:4] == protocol.MAGIC:
             obj, meta = protocol.decode_frame_with_meta(data)
             trace_in = meta["trace"]
+            # deadline-budget propagation: the frame header carries the
+            # client's REMAINING budget (decremented at each upstream
+            # hop); the effective deadline is the tighter of that and
+            # whatever the body itself asks for, so a stale body field
+            # can never extend a budget the hops already spent
+            if meta["deadline"] is not None and isinstance(obj, dict):
+                d = obj.get("deadline")
+                obj["deadline"] = (meta["deadline"] if d is None
+                                   else min(float(d), meta["deadline"]))
             # payload-compression negotiation: remember what the PEER
             # can inflate (response-side), and account an inbound
             # compressed frame's savings

@@ -22,14 +22,17 @@ from __future__ import annotations
 
 import http.client
 import json
+import random
 import threading
-from typing import Any, Dict, List, Optional, Tuple
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ..dispatcher import ServeError
+from ... import sanitize
+from ..dispatcher import CircuitOpen, ServeError
 from ..net import protocol
 from ..net.client import _parse_address
 
-__all__ = ["Backend", "BackendDown"]
+__all__ = ["Backend", "BackendDown", "CircuitBreaker", "CircuitOpen"]
 
 
 class BackendDown(ServeError):
@@ -46,15 +49,159 @@ class BackendDown(ServeError):
         self.sent = bool(sent)
 
 
+class CircuitBreaker:
+    """Per-backend circuit breaker: closed → open → half-open.
+
+    ``fail_threshold`` consecutive transport failures
+    (:class:`BackendDown`) trip the breaker OPEN: further non-idempotent
+    forwards are refused immediately with typed
+    :class:`~deap_tpu.serve.dispatcher.CircuitOpen` instead of queueing
+    behind a connect timeout to a wedged instance.  After a *jittered*
+    probe delay (``reset_s * (1 + probe_jitter * u)``, ``u`` uniform —
+    jitter so a fleet of routers doesn't re-probe a recovering backend in
+    lockstep) the breaker goes HALF-OPEN and admits exactly one trial
+    request; its success closes the breaker, its failure re-opens with a
+    fresh jittered delay.  Idempotent GETs are never blocked — they pass
+    through and their outcomes double as organic probes, which is what
+    makes a breaker-open backend merely *degraded* (still readable)
+    rather than down.
+
+    ``clock``/``rng`` are injectable so drills pin the exact open/probe
+    schedule; ``on_event(kind)`` (kind in ``"opened"``/``"probe"``/
+    ``"shortcircuit"``) and ``on_state(name, state)`` are the metrics /
+    health hooks, called OUTSIDE the breaker lock."""
+
+    #: lock-guarded state machine, written from every router handler
+    #: thread that forwards through this backend
+    _GUARDED_BY = {"_lock": ("_state", "_failures", "_opened_at",
+                             "_probe_delay", "_probe_inflight")}
+
+    def __init__(self, name: str = "", *, fail_threshold: int = 3,
+                 reset_s: float = 5.0, probe_jitter: float = 0.5,
+                 clock: Callable[[], float] = time.monotonic,
+                 rng: Optional[Callable[[], float]] = None,
+                 on_event: Optional[Callable[[str], None]] = None,
+                 on_state: Optional[Callable[[str, str], None]] = None):
+        if fail_threshold < 1:
+            raise ValueError("fail_threshold must be >= 1")
+        if reset_s <= 0:
+            raise ValueError("reset_s must be > 0")
+        if probe_jitter < 0:
+            raise ValueError("probe_jitter must be >= 0")
+        self.name = str(name)
+        self.fail_threshold = int(fail_threshold)
+        self.reset_s = float(reset_s)
+        self.probe_jitter = float(probe_jitter)
+        self._clock = clock
+        self._rng = rng if rng is not None else random.random
+        self._on_event = on_event
+        self._on_state = on_state
+        self._lock = sanitize.lock()
+        self._state = "closed"
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_delay = 0.0
+        self._probe_inflight = False
+
+    def bind(self, on_event: Optional[Callable[[str], None]] = None,
+             on_state: Optional[Callable[[str, str], None]] = None) -> None:
+        """Fill in UNSET observer hooks (the router wires its metrics /
+        health callbacks onto breakers it did not construct — e.g. one a
+        test pre-attached with an injected clock) without stomping hooks
+        the constructor already received."""
+        if self._on_event is None and on_event is not None:
+            self._on_event = on_event
+        if self._on_state is None and on_state is not None:
+            self._on_state = on_state
+
+    def _emit(self, events, state_change):
+        if self._on_event is not None:
+            for kind in events:
+                self._on_event(kind)
+        if state_change is not None and self._on_state is not None:
+            self._on_state(self.name, state_change)
+
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def before_request(self) -> None:
+        """Admission gate for a non-idempotent forward.  Passes when
+        closed, claims the single half-open probe slot when the probe
+        delay has elapsed, and raises :class:`CircuitOpen` otherwise."""
+        events: list = []
+        state_change = None
+        with self._lock:
+            if self._state == "closed":
+                return
+            now = self._clock()
+            if (self._state == "open"
+                    and now - self._opened_at >= self._probe_delay):
+                self._state = "half_open"
+                self._probe_inflight = True
+                events.append("probe")
+                state_change = "half_open"
+            elif self._state == "half_open" and not self._probe_inflight:
+                # a previous probe resolved elsewhere (organic GET) but
+                # the breaker is still deciding — admit one more trial
+                self._probe_inflight = True
+                events.append("probe")
+            else:
+                wait = max(0.0, self._opened_at + self._probe_delay
+                           - self._clock())
+                events.append("shortcircuit")
+                self._emit(events, None)
+                raise CircuitOpen(
+                    f"backend {self.name} circuit is {self._state} "
+                    f"(next probe in {wait:.2f}s); retry later or "
+                    "against another instance")
+        self._emit(events, state_change)
+
+    def record_success(self) -> None:
+        state_change = None
+        with self._lock:
+            self._failures = 0
+            self._probe_inflight = False
+            if self._state != "closed":
+                self._state = "closed"
+                state_change = "closed"
+        self._emit((), state_change)
+
+    def record_failure(self) -> None:
+        events: list = []
+        state_change = None
+        with self._lock:
+            self._failures += 1
+            self._probe_inflight = False
+            tripped = (self._state == "closed"
+                       and self._failures >= self.fail_threshold)
+            reopened = self._state == "half_open"
+            if tripped or reopened:
+                self._state = "open"
+                self._opened_at = self._clock()
+                self._probe_delay = self.reset_s * (
+                    1.0 + self.probe_jitter * self._rng())
+                events.append("opened")
+                state_change = "open"
+        self._emit(events, state_change)
+
+
 class Backend:
-    """One routable serving instance (see module docstring)."""
+    """One routable serving instance (see module docstring).
+
+    ``breaker`` (optional) is this backend's :class:`CircuitBreaker`;
+    when set, non-idempotent forwards pass its admission gate and every
+    forward outcome feeds its state machine.  The router attaches one
+    per backend (:class:`~deap_tpu.serve.router.core.FleetRouter`)."""
 
     def __init__(self, name: str, address, *, timeout: float = 600.0,
-                 control_timeout: float = 10.0):
+                 control_timeout: float = 10.0,
+                 breaker: Optional[CircuitBreaker] = None):
         self.name = str(name)
         self.host, self.port = _parse_address(address)
         self.timeout = float(timeout)
         self.control_timeout = float(control_timeout)
+        self.breaker = breaker
         self._tls = threading.local()
 
     @property
@@ -85,7 +232,23 @@ class Backend:
         advertisement (the only negotiation channel a bodyless GET has).
         Raises :class:`BackendDown` when the instance cannot be reached
         (send retried once on a fresh connection — safe, the request
-        never arrived) or stops answering mid-response."""
+        never arrived) or stops answering mid-response, and
+        :class:`CircuitOpen` (request NEVER sent) when this backend's
+        breaker is open — idempotent GETs bypass the gate and double as
+        organic recovery probes."""
+        if self.breaker is not None and method != "GET":
+            self.breaker.before_request()
+        try:
+            return self._forward_raw(method, path, body, content_type,
+                                     accept)
+        except BackendDown:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            raise
+
+    def _forward_raw(self, method: str, path: str, body: Optional[bytes],
+                     content_type: str, accept: Optional[str]
+                     ) -> Tuple[int, bytes]:
         headers = {"Content-Type": content_type}
         if accept:
             headers[protocol.ACCEPT_HEADER] = accept
@@ -102,7 +265,7 @@ class Backend:
                 continue            # stale keep-alive: one fresh retry
             try:
                 resp = conn.getresponse()
-                return resp.status, resp.read()
+                status, data = resp.status, resp.read()
             except (http.client.HTTPException, OSError) as e:
                 # response-phase: the instance may have executed the
                 # request — no silent re-send, surface the failure
@@ -110,6 +273,12 @@ class Backend:
                 raise BackendDown(
                     f"backend {self.name} died mid-response on "
                     f"{method} {path}: {e}", sent=True) from e
+            # ANY complete HTTP response — typed service errors included
+            # — proves the transport is healthy: only BackendDown above
+            # counts against the breaker
+            if self.breaker is not None:
+                self.breaker.record_success()
+            return status, data
         raise AssertionError("unreachable")
 
     def drop_connections(self) -> None:

@@ -41,7 +41,7 @@ from ...observability.sinks import emit_text
 from ..buckets import genome_signature
 from ..dispatcher import ServeError, SessionUnknown
 from ..metrics import ServeMetrics, ROUTER_COUNTERS, ROUTER_GAUGES
-from .backend import Backend, BackendDown
+from .backend import Backend, BackendDown, CircuitBreaker
 from .health import HealthMonitor, HealthPolicy
 from .placement import BackendPlan, PlacementPolicy, fleet_sizes
 from .tenants import TenantQuota, WeightedFairScheduler
@@ -72,6 +72,16 @@ class FleetRouter:
     drain_timeout:
         Seconds a sick instance gets to flush its queue before the
         failover declares its sessions lost.
+    breaker_policy:
+        Keyword arguments for the :class:`CircuitBreaker` the router
+        attaches to every backend that arrives without one
+        (``fail_threshold`` / ``reset_s`` / ``probe_jitter``).  A
+        backend constructed with its own breaker keeps it; the router
+        only binds its metrics/health observer hooks onto it.  An open
+        breaker classifies the backend *degraded*: idempotent GETs
+        still route to it (they double as organic recovery probes) and
+        its existing sessions stay put, but new sessions place
+        elsewhere while any non-degraded candidate exists.
     """
 
     #: lock-guarded shared state (``lock-discipline`` lint): routing,
@@ -89,6 +99,7 @@ class FleetRouter:
                  health: Optional[HealthPolicy] = None,
                  start_health: bool = True,
                  drain_timeout: float = 60.0,
+                 breaker_policy: Optional[Dict[str, Any]] = None,
                  tracer: Optional[FleetTracer] = None,
                  sinks: Sequence = (), verbose: bool = False,
                  clock=None):
@@ -130,6 +141,16 @@ class FleetRouter:
         self.health = HealthMonitor(
             list(self.backends.values()), self._on_sick,
             policy=health, metrics=self.metrics, clock=self._clock)
+        # one circuit breaker per backend: transport failures trip it,
+        # its state drives the health monitor's degraded tier and the
+        # router_breaker_* counters (hooks bound, never stomped — tests
+        # pre-attach breakers with injected clocks)
+        for b in self.backends.values():
+            if b.breaker is None:
+                b.breaker = CircuitBreaker(b.name, clock=self._clock,
+                                           **dict(breaker_policy or {}))
+            b.breaker.bind(on_event=self._on_breaker_event,
+                           on_state=self._on_breaker_state)
         if start_health:
             self.health.start()
 
@@ -192,6 +213,7 @@ class FleetRouter:
             down = dict(self._down)
             routes = dict(self._routes)
         sizes = fleet_sizes(plans.values())
+        degraded = self.health.degraded()
         per_backend: Dict[str, dict] = {}
         for name, backend in self.backends.items():
             plan = plans.get(name)
@@ -201,6 +223,9 @@ class FleetRouter:
                 "placed_total": plan.sessions if plan else 0,
                 "warm_classes": len(plan.warm) if plan else 0,
                 "down": down.get(name),
+                "degraded": degraded.get(name),
+                "breaker": (backend.breaker.state()
+                            if backend.breaker is not None else None),
             }
         self.metrics.set_gauge("router_backends_alive",
                                len(self.backends) - len(down))
@@ -218,6 +243,8 @@ class FleetRouter:
         self.metrics.set_gauge("router_backends_alive", alive)
         self.metrics.set_gauge("router_sessions_routed", routed)
         self.metrics.set_gauge("router_inflight", self.scheduler.inflight)
+        self.metrics.set_gauge("router_backends_degraded",
+                               len(self.health.degraded()))
         return self.metrics.snapshot()
 
     def check_health(self):
@@ -266,6 +293,10 @@ class FleetRouter:
         genome = body.get("genome")
         if genome is None:
             raise ValueError("create body carries no genome")
+        # the tenant's quota — not the client — decides the session's
+        # load-shedding class: stamp it into the create body the router
+        # forwards, so the instance dispatcher sheds by contract
+        body["priority"] = self.scheduler.quota_of(tenant).priority
         sig = genome_signature(genome)
         import jax
         n = int(jax.tree_util.tree_leaves(genome)[0].shape[0])
@@ -308,7 +339,13 @@ class FleetRouter:
         if not candidates:
             raise SessionUnknown(
                 f"no healthy backend holds toolbox {tb_name!r}")
-        return self.placement.choose(candidates, n, sig)
+        # degraded backends (breaker open / half-open) are excluded from
+        # NEW-session placement while any clean candidate exists; when
+        # the whole eligible set is degraded, place anyway — a gray
+        # failure must soften placement, never refuse service outright
+        clean = [(b, p) for b, p in candidates
+                 if not self.health.is_degraded(b.name)]
+        return self.placement.choose(clean or candidates, n, sig)
 
     def commit_session(self, name: str, backend: Backend, n: int,
                        sig: tuple, tenant: Optional[str]) -> None:
@@ -357,6 +394,31 @@ class FleetRouter:
             self.metrics.inc("router_sessions_closed")
             self.scheduler.session_closed(tenant)
             self._notify_routes()
+
+    # -- circuit breakers ----------------------------------------------------
+
+    def _on_breaker_event(self, kind: str) -> None:
+        """Breaker observer hook (fired outside the breaker lock) —
+        explicit literal counter names, per the metric-discipline
+        lint."""
+        if kind == "opened":
+            self.metrics.inc("router_breaker_opens")
+        elif kind == "probe":
+            self.metrics.inc("router_breaker_probes")
+        elif kind == "shortcircuit":
+            self.metrics.inc("router_breaker_rejections")
+
+    def _on_breaker_state(self, name: str, state: str) -> None:
+        """Breaker state transitions drive the health monitor's
+        degraded tier: an open (or probing half-open) breaker means the
+        backend still serves idempotent reads but must not take NEW
+        sessions until a probe closes the circuit."""
+        if state == "open":
+            self.health.set_degraded(name, "circuit open")
+        elif state == "half_open":
+            self.health.set_degraded(name, "circuit half-open (probing)")
+        elif state == "closed":
+            self.health.clear_degraded(name)
 
     # -- health-driven failover ----------------------------------------------
 

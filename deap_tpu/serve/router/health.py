@@ -72,11 +72,12 @@ class HealthMonitor:
     monitor thread (or the :meth:`check_now` caller's)."""
 
     #: lock-guarded shared state (``lock-discipline`` lint): strike
-    #: counts, the sick latch and the per-backend counter baselines are
-    #: written by the monitor thread AND by check_now()/force_sick()
-    #: callers — writes only under ``self._lock``
+    #: counts, the sick latch, the degraded map and the per-backend
+    #: counter baselines are written by the monitor thread AND by
+    #: check_now()/force_sick()/set_degraded() callers — writes only
+    #: under ``self._lock``
     _GUARDED_BY = {"_lock": ("_strikes", "_sick", "_baseline", "_backends",
-                             "_stalled_since")}
+                             "_stalled_since", "_degraded")}
 
     def __init__(self, backends: List[Backend],
                  on_sick: Callable[[Backend, str], None], *,
@@ -93,6 +94,11 @@ class HealthMonitor:
         self._sick: Dict[str, str] = {}          # name -> latched reason
         self._baseline: Dict[str, Dict[str, int]] = {}
         self._stalled_since: Dict[str, float] = {}  # name -> first flat poll
+        #: gray-failure tier between healthy and the sick latch: a
+        #: degraded backend (e.g. its circuit breaker is open) still
+        #: serves idempotent GETs and keeps its routed sessions, but is
+        #: excluded from NEW-session placement until the condition clears
+        self._degraded: Dict[str, str] = {}      # name -> reason
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -132,6 +138,7 @@ class HealthMonitor:
             self._sick.pop(name, None)
             self._baseline.pop(name, None)
             self._stalled_since.pop(name, None)
+            self._degraded.pop(name, None)
 
     def revive(self, name: str) -> None:
         """Clear a sick latch (an operator replaced/restarted the
@@ -141,6 +148,7 @@ class HealthMonitor:
             self._strikes.pop(name, None)
             self._baseline.pop(name, None)
             self._stalled_since.pop(name, None)
+            self._degraded.pop(name, None)
 
     def sick(self) -> Dict[str, str]:
         with self._lock:
@@ -149,6 +157,37 @@ class HealthMonitor:
     def is_sick(self, name: str) -> bool:
         with self._lock:
             return name in self._sick
+
+    # -- degraded tier -------------------------------------------------------
+
+    def set_degraded(self, name: str, reason: str) -> None:
+        """Classify a backend *degraded* — NOT the binary sick latch: a
+        breaker-open (or otherwise gray-failing) instance keeps serving
+        idempotent reads and its existing sessions, but the router stops
+        placing NEW sessions on it.  Idempotent by design: the breaker
+        re-notifies on every re-open."""
+        with self._lock:
+            if name not in self._backends:
+                return
+            self._degraded[name] = str(reason)
+        if self._metrics is not None:
+            self._metrics.set_gauge("router_backends_degraded",
+                                    len(self.degraded()))
+
+    def clear_degraded(self, name: str) -> None:
+        with self._lock:
+            self._degraded.pop(name, None)
+        if self._metrics is not None:
+            self._metrics.set_gauge("router_backends_degraded",
+                                    len(self.degraded()))
+
+    def degraded(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._degraded)
+
+    def is_degraded(self, name: str) -> bool:
+        with self._lock:
+            return name in self._degraded
 
     def force_sick(self, name: str, reason: str = "operator") -> None:
         """Latch a backend sick without waiting for probes (operator

@@ -47,7 +47,8 @@ from urllib.parse import parse_qs, quote, unquote, urlparse
 
 from ...observability.sinks import emit_text
 from ...observability.sinks import MetricRecord
-from ..dispatcher import (ServeError, ServiceOverloaded, SessionUnknown,
+from ..dispatcher import (CircuitOpen, DeadlineExceeded, ServeError,
+                          ServiceOverloaded, SessionUnknown,
                           TenantQuotaExceeded)
 from ..metrics import prometheus_fleet_text, prometheus_text
 from ..net import protocol
@@ -337,6 +338,12 @@ class _RouterHandler(FrameHTTPHandler):
             status, data = backend.forward(
                 "POST", "/v1/sessions", frame,
                 accept=self.headers.get(protocol.ACCEPT_HEADER))
+        except CircuitOpen:
+            # the breaker refused pre-send (placement raced its opening)
+            # — the backend never saw the create: release the admission
+            # and surface the typed 503
+            router.abort_session(name, tenant)
+            raise
         except BackendDown as e:
             router.abort_session(name, tenant)
             router.note_forward_failure(backend, e)
@@ -360,21 +367,25 @@ class _RouterHandler(FrameHTTPHandler):
                     op: Optional[str]) -> None:
         ctx = self.server_ctx
         router = ctx.router
+        t_in = router.tracer.clock()
         raw = self._read_raw_body() if method == "POST" else b""
         tenant = router.tenant_of(name)
         quoted = quote(name, safe="")
         path = (f"/v1/sessions/{quoted}/{op}" if op
                 else f"/v1/sessions/{quoted}")
-        # router hop in the span tree: adopt the client context from the
-        # frame header and swap in this hop's identity — payloads stay
-        # untouched (rewrite_trace is header-only)
+        # router hop in the span tree + deadline budget: adopt the
+        # client context and remaining-deadline from the frame header,
+        # swap in this hop's identity and the DECREMENTED budget — one
+        # header rewrite, payloads untouched
         trace_ctx = None
-        body = raw
-        if raw[:4] == protocol.MAGIC:
+        budget = None
+        is_frame = raw[:4] == protocol.MAGIC
+        if is_frame:
             _hdr, _off = protocol._split_header(raw)
             trace_ctx = router.tracer.adopt(_hdr.get("__trace__"))
-            if trace_ctx is not None:
-                body = protocol.rewrite_trace(raw, trace_ctx.wire())
+            d = _hdr.get("__deadline__")
+            if isinstance(d, (int, float)) and not isinstance(d, bool):
+                budget = float(d)
         fair = op in _FAIR_OPS
         if fair:
             try:
@@ -384,7 +395,25 @@ class _RouterHandler(FrameHTTPHandler):
                 raise ServiceOverloaded(
                     f"router forwarding saturated: {e}") from e
         t0 = router.tracer.clock()
+        body = raw
         try:
+            if is_frame and (trace_ctx is not None or budget is not None):
+                kw = {}
+                if trace_ctx is not None:
+                    kw["trace"] = trace_ctx.wire()
+                if budget is not None:
+                    # everything the router spent on this request — body
+                    # read, header parse, the fair-scheduler slot wait —
+                    # comes out of the client's remaining budget
+                    remaining = budget - (t0 - t_in)
+                    if remaining <= 0.0:
+                        router.metrics.inc("router_deadline_shed")
+                        raise DeadlineExceeded(
+                            f"deadline budget spent at the router hop "
+                            f"({-remaining:.3f}s over, {budget:.3f}s "
+                            "arrived); not forwarded")
+                    kw["deadline"] = remaining
+                body = protocol.rewrite_header(raw, **kw)
             status, data, backend = self._forward_routed(
                 method, name, path, body,
                 accept=self.headers.get(protocol.ACCEPT_HEADER))
@@ -418,6 +447,13 @@ class _RouterHandler(FrameHTTPHandler):
             try:
                 status, data = backend.forward(method, path, body or None,
                                                accept=accept)
+            except CircuitOpen:
+                # refused pre-send (provably unexecuted) — but the
+                # session is still ROUTED here (breaker-open means
+                # degraded, not failed over), so waiting for a re-route
+                # would only time out: surface the typed 503 and let the
+                # client back off until a probe closes the circuit
+                raise
             except BackendDown as e:
                 router.note_forward_failure(backend, e)
                 if e.sent:

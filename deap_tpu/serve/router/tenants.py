@@ -42,15 +42,26 @@ __all__ = ["TenantQuota", "WeightedFairScheduler", "TenantQuotaExceeded"]
 @dataclasses.dataclass(frozen=True)
 class TenantQuota:
     """One tenant's admission contract.  ``None`` limits are unlimited;
-    ``weight`` must be positive (it divides the virtual-time cost)."""
+    ``weight`` must be positive (it divides the virtual-time cost).
+    ``priority`` is the tenant's load-shedding class (higher = more
+    important, default 1): the router stamps it onto every session the
+    tenant opens, and under sustained queue pressure the instance
+    dispatcher sheds lower-priority admissions first with typed
+    :class:`~deap_tpu.serve.dispatcher.ServiceBrownout` — distinct from
+    ``weight``, which divides *throughput* under fairness but never
+    refuses work."""
 
     max_sessions: Optional[int] = None
     max_pending: Optional[int] = None
     weight: float = 1.0
+    priority: int = 1
 
     def __post_init__(self):
         if not self.weight > 0:
             raise ValueError("TenantQuota.weight must be > 0")
+        if int(self.priority) != self.priority or self.priority < 0:
+            raise ValueError("TenantQuota.priority must be a "
+                             "non-negative integer")
 
 
 class WeightedFairScheduler:

@@ -168,11 +168,16 @@ class Session:
     def __init__(self, service: "EvolutionService", name: str, toolbox,
                  bucket: BucketKey, state: Dict[str, jax.Array],
                  gen: int = 0, phase: str = "idle", pending=None,
-                 sharded: bool = False):
+                 sharded: bool = False, priority: int = 1):
         self._service = service
         self.name = name
         self.toolbox = toolbox
         self.bucket = bucket
+        #: load-shedding class every request of this session carries
+        #: (higher = more important; the fleet router stamps it from the
+        #: owning tenant's quota) — under sustained queue pressure the
+        #: dispatcher sheds lower-priority admissions first
+        self.priority = int(priority)
         self._pop_n: Optional[int] = None   # cached live count (immutable)
         self._state = state          # swapped atomically by the dispatcher
         self._pending = pending      # offspring awaiting tell (phase=asked)
@@ -305,6 +310,13 @@ class EvolutionService:
     max_pending / batch_window:
         Queue bound (backpressure) and optional linger seconds to fill a
         partial batch.
+    brownout_watermark / brownout_grace_s:
+        Priority load shedding (off by default): once the queue has sat
+        at or above ``watermark * max_pending`` for ``grace`` seconds,
+        admissions whose session priority is below the highest queued
+        priority are shed with typed
+        :class:`~deap_tpu.serve.dispatcher.ServiceBrownout` — see
+        :class:`~deap_tpu.serve.dispatcher.BatchDispatcher`.
     cache_capacity / dedup_max_flat_dim:
         Host fitness-cache entries; flat genome width beyond which the
         device sort/unique dedup is skipped (a variadic lexsort keys per
@@ -368,7 +380,10 @@ class EvolutionService:
 
     def __init__(self, *, policy: Optional[BucketPolicy] = None,
                  max_batch: int = 4, max_pending: int = 256,
-                 batch_window: float = 0.0, cache_capacity: int = 4096,
+                 batch_window: float = 0.0,
+                 brownout_watermark: Optional[float] = None,
+                 brownout_grace_s: float = 0.0,
+                 cache_capacity: int = 4096,
                  dedup_max_flat_dim: int = 512, eval_retries: int = 2,
                  retry_backoff: float = 0.05, sinks: Sequence = (),
                  stats_every: int = 0, verbose: bool = False,
@@ -415,7 +430,9 @@ class EvolutionService:
         self._draining = False
         self._dispatcher = BatchDispatcher(
             self._execute, max_pending=max_pending,
-            batch_window=batch_window, metrics=self.metrics,
+            batch_window=batch_window,
+            brownout_watermark=brownout_watermark,
+            brownout_grace_s=brownout_grace_s, metrics=self.metrics,
             retries=eval_retries, backoff=retry_backoff, clock=clock,
             tracer=self.tracer, after_batch=self._after_batch)
         if rebucket_policy is not None:
@@ -578,21 +595,24 @@ class EvolutionService:
     def open_session(self, key, population: Population, toolbox, *,
                      cxpb: float = 0.5, mutpb: float = 0.2,
                      name: Optional[str] = None, evaluate_initial: bool = True,
+                     priority: int = 1,
                      timeout: Optional[float] = 60.0) -> Session:
         """Register a run and (synchronously, by default) evaluate its
         initial population through the service.  ``population`` is the
         UNPADDED initial population; the service pads it to its bucket
         (and, at or above ``shard_threshold`` rows, shards it over the
-        mesh)."""
+        mesh).  ``priority`` is the session's load-shedding class (see
+        :class:`Session`)."""
         session = self._admit(key, population, toolbox, cxpb=cxpb,
-                              mutpb=mutpb, name=name)
+                              mutpb=mutpb, name=name, priority=priority)
         if evaluate_initial:
             self._submit(session, "init", {}).result(timeout=timeout)
         return session
 
     def _admit(self, key, population: Population, toolbox, *, cxpb: float,
                mutpb: float, name: Optional[str], gen: int = 0,
-               phase: str = "idle", pending_host=None) -> Session:
+               phase: str = "idle", pending_host=None,
+               priority: int = 1) -> Session:
         """Shared admission path of :meth:`open_session` and
         :meth:`adopt_sessions`: bucket (+ shard placement), state build,
         registration, pinning, shape observation."""
@@ -634,7 +654,8 @@ class EvolutionService:
                 if pending is not None:
                     pending = self._place_sharded(pending, bucket.rows)
             session = Session(self, name, toolbox, bucket, state, gen=gen,
-                              phase=phase, pending=pending, sharded=sharded)
+                              phase=phase, pending=pending, sharded=sharded,
+                              priority=priority)
             session._pins = [toolbox]
             evaluate = getattr(toolbox, "evaluate", None)
             if evaluate is not None:
@@ -812,7 +833,8 @@ class EvolutionService:
                       payload=payload, session=session, weight=1,
                       capacity=capacity,
                       deadline=self._deadline_at(deadline),
-                      trace=self._trace_ctx())
+                      trace=self._trace_ctx(),
+                      priority=session.priority)
         if on_failure is not None:
             req.future._on_failure = on_failure
         return req
@@ -858,7 +880,8 @@ class EvolutionService:
                       payload={"genome": genomes, "n": n},
                       session=session, weight=n, capacity=rows,
                       deadline=self._deadline_at(deadline),
-                      trace=self._trace_ctx())
+                      trace=self._trace_ctx(),
+                      priority=session.priority)
         return self._dispatcher.submit(req)
 
     # -- compiled-program cache ----------------------------------------------
@@ -1201,6 +1224,7 @@ class EvolutionService:
                 st = s._state
                 n = int(np.asarray(st["live_n"]))
                 snap = {"gen": s.gen, "phase": s.phase, "n": n,
+                        "priority": s.priority,
                         "weights": s.bucket.weights,
                         "rows": s.bucket.rows,
                         "key": np.asarray(st["key"]),
@@ -1257,7 +1281,8 @@ class EvolutionService:
                                   cxpb=snap["cxpb"], mutpb=snap["mutpb"],
                                   name=name, gen=int(snap["gen"]),
                                   phase=snap["phase"],
-                                  pending_host=pending_host)
+                                  pending_host=pending_host,
+                                  priority=int(snap.get("priority", 1)))
             want_rows = snap.get("rows")
             if want_rows is not None and int(want_rows) != session.bucket.rows:
                 import warnings

@@ -67,7 +67,8 @@ PAGES = {
                    ["deap_tpu.resilience.runner",
                     "deap_tpu.resilience.quarantine",
                     "deap_tpu.resilience.retry",
-                    "deap_tpu.resilience.faultinject"]),
+                    "deap_tpu.resilience.faultinject",
+                    "deap_tpu.resilience.chaos"]),
     "observability": ("Observability (deap_tpu.observability)",
                       ["deap_tpu.observability.metrics",
                        "deap_tpu.observability.events",
@@ -87,7 +88,8 @@ PAGES = {
                   ["deap_tpu.serve.net", "deap_tpu.serve.net.protocol",
                    "deap_tpu.serve.net.httpcommon",
                    "deap_tpu.serve.net.server",
-                   "deap_tpu.serve.net.client"]),
+                   "deap_tpu.serve.net.client",
+                   "deap_tpu.serve.net.faultwire"]),
     "serve_router": ("Fleet control plane (deap_tpu.serve.router)",
                      ["deap_tpu.serve.router",
                       "deap_tpu.serve.router.backend",

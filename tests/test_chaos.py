@@ -1,0 +1,714 @@
+"""Chaos-harness tests: seed-deterministic fault plans, the FaultWire
+proxy, circuit breakers, deadline-budget propagation, priority brownout.
+
+The load-bearing assertions (ISSUE 16 acceptance criteria):
+
+* **determinism pin** — the same ``ChaosPlan`` + seed draws the identical
+  fault sequence for the same per-target exchange sequence, and a
+  recorded decision log REPLAYS to the identical fired list; scope/phase
+  filtering is part of the drawn identity;
+* **request faults never execute upstream** — a request-direction drop
+  at the proxy leaves the upstream's request counter untouched (the
+  property that makes the drill's blind retry bitwise-safe), while a
+  response-direction drop shows the upstream DID execute;
+* **truncated frames are typed** — a DTF1 frame cut anywhere raises
+  ``ProtocolError`` (HTTP 400 on the wire), never a bare struct/KeyError;
+* **breaker state machine** — open after ``fail_threshold``, jittered
+  probe delay, single half-open probe slot, GET bypass doubling as the
+  organic recovery probe;
+* **overload-graceful degradation** — spent deadline budgets shed
+  pre-dispatch (typed, counted), lower-priority admissions brown out
+  under sustained pressure while equal-priority traffic is untouched;
+* **fleet chaos drill** — a partition + frame-mangling storm against a
+  live 3-instance fleet loses exactly the partitioned backend's sessions
+  and leaves every survivor **bitwise equal** to an undisturbed
+  single-instance reference (``deap-tpu-chaosdrill`` is the full-size
+  committed version of this test).
+"""
+
+import http.client
+import json
+import struct
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deap_tpu import base
+from deap_tpu.ops import crossover, mutation, selection
+from deap_tpu.resilience import with_retries, RetriesExhausted
+from deap_tpu.resilience.chaos import (ChaosInjector, ChaosLeg, ChaosPlan,
+                                       canonical_plan)
+from deap_tpu.resilience import chaosdrill
+from deap_tpu.serve import DeadlineExceeded, EvolutionService
+from deap_tpu.serve.dispatcher import (BatchDispatcher, CircuitOpen,
+                                       Request, ServiceBrownout,
+                                       SessionUnknown)
+from deap_tpu.serve.metrics import ServeMetrics
+from deap_tpu.serve.net import NetServer, RemoteService, protocol
+from deap_tpu.serve.net.faultwire import FaultWire
+from deap_tpu.serve.net.protocol import ProtocolError
+from deap_tpu.serve.router import (Backend, FleetRouter, HealthPolicy,
+                                   RouterServer)
+from deap_tpu.serve.router.backend import CircuitBreaker
+
+pytestmark = [pytest.mark.serve]
+
+
+def onemax_toolbox():
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: (jnp.sum(g),))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_flip_bit, indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3)
+    return tb
+
+
+def onemax_pop(key, n, nbits):
+    g = jax.random.bernoulli(key, 0.5, (n, nbits)).astype(jnp.float32)
+    return base.Population(genome=g, fitness=base.Fitness.empty(n, (1.0,)))
+
+
+def _final(pop):
+    return (np.asarray(pop.genome), np.asarray(pop.fitness.values),
+            np.asarray(pop.fitness.valid))
+
+
+# ---------------------------------------------------------------------------
+# chaos plans: validation, determinism, replay, scope/phase filtering
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_leg_validation():
+    with pytest.raises(ValueError, match="unknown chaos kind"):
+        ChaosLeg(target="b0", kind="gremlins")
+    with pytest.raises(ValueError, match="direction"):
+        ChaosLeg(target="b0", kind="drop", direction="sideways")
+    with pytest.raises(ValueError, match="scope"):
+        ChaosLeg(target="b0", kind="drop", scope="everything")
+    with pytest.raises(ValueError, match="probability"):
+        ChaosLeg(target="b0", kind="drop", probability=1.5)
+    with pytest.raises(ValueError, match="stop"):
+        ChaosLeg(target="b0", kind="drop", start=5, stop=5)
+    with pytest.raises(TypeError):
+        ChaosPlan(seed=1, legs=("not a leg",))
+
+
+def test_chaos_determinism_pin_and_replay():
+    """Same plan + seed + exchange sequence ⇒ identical fault sequence
+    (the drill's reproducibility contract), pinned through the replay
+    oracle and a second independent injector."""
+    plan = canonical_plan(seed=20)
+
+    def drive(inj):
+        inj.set_phase("storm")
+        for i in range(40):
+            for t in ("b0", "b1", "b2"):
+                inj.decide(t, "data" if i % 3 else "control")
+
+    a, b = ChaosInjector(plan), ChaosInjector(plan)
+    drive(a)
+    drive(b)
+    assert a.fired() == b.fired()
+    assert a.fired(), "canonical plan fired nothing in 40 exchanges"
+    # the decision log replays to the identical fired sequence
+    replayed = ChaosInjector.replay(plan, a.decision_log())
+    assert replayed.fired() == a.fired()
+    # a different seed draws a different sequence for probabilistic legs
+    other = ChaosInjector(canonical_plan(seed=21))
+    drive(other)
+    assert [(f.leg.kind, f.exchange) for f in other.fired()] != \
+        [(f.leg.kind, f.exchange) for f in a.fired()]
+    # leg identity is the plan index: other targets' draws are untouched
+    # by this target's exchanges
+    assert all(f.leg.target in ("b0", "b1", "b2") for f in a.fired())
+
+
+def test_chaos_scope_and_phase_filtering():
+    """A data-scoped leg never fires on control exchanges (the gray
+    failure's defining property) and a phased leg never fires outside
+    its act."""
+    plan = ChaosPlan(seed=3, legs=(
+        ChaosLeg(target="b0", kind="wedge", phase="storm",
+                 probability=1.0, scope="data"),))
+    inj = ChaosInjector(plan)
+    inj.set_phase("warmup")
+    assert inj.decide("b0", "data") == []       # wrong phase
+    inj.set_phase("storm")
+    assert inj.decide("b0", "control") == []    # wrong exchange class
+    faults = inj.decide("b0", "data")
+    assert [f.leg.kind for f in faults] == ["wedge"]
+    # the klass rides the decision log, so replay preserves the filter
+    replayed = ChaosInjector.replay(plan, inj.decision_log())
+    assert replayed.fired() == inj.fired()
+
+
+def test_chaos_unfired_legs_are_detectable():
+    plan = ChaosPlan(seed=1, legs=(
+        ChaosLeg(target="b0", kind="drop", probability=1.0),
+        ChaosLeg(target="b9", kind="delay", probability=1.0),))
+    inj = ChaosInjector(plan)
+    inj.decide("b0")
+    unfired = inj.unfired_legs()
+    assert [leg.target for leg in unfired] == ["b9"]
+    assert inj.fired_counts() == {"drop": 1}
+
+
+# ---------------------------------------------------------------------------
+# DTF1 truncation: typed ProtocolError at every cut, 400 on the wire
+# ---------------------------------------------------------------------------
+
+
+def test_decode_frame_truncation_typed():
+    """A frame cut anywhere — inside the magic, the header length, the
+    header JSON, the tensor manifest payload — raises ProtocolError
+    (which is both ServeError and ValueError), never a raw struct or
+    slice error."""
+    data = protocol.encode_frame(
+        {"genome": np.arange(64, dtype=np.float32).reshape(8, 8),
+         "note": "x"})
+    assert data[:4] == protocol.MAGIC
+    for cut in (0, 2, 6, 10, len(data) // 2, len(data) - 1):
+        with pytest.raises(ProtocolError):
+            protocol.decode_frame(data[:cut])
+    with pytest.raises(ProtocolError):
+        protocol.decode_frame(b"XXXX" + data[4:])
+
+
+@pytest.mark.net
+def test_truncated_frame_typed_400_on_wire(tsan):
+    """The NetServer answers a truncated DTF1 body with a typed 400
+    ProtocolError response — a complete HTTP exchange, so it feeds a
+    router breaker as transport-healthy (the gray-failure distinction)."""
+    tb = onemax_toolbox()
+    with EvolutionService(max_batch=4) as svc:
+        srv = NetServer(svc, {"onemax": tb}).start()
+        try:
+            frame = protocol.encode_frame({"toolbox": "onemax"})
+            conn = http.client.HTTPConnection(*srv.address, timeout=10)
+            try:
+                conn.request("POST", "/v1/sessions", body=frame[:-7],
+                             headers={"Content-Type":
+                                      protocol.CONTENT_TYPE})
+                resp = conn.getresponse()
+                body = json.loads(resp.read().decode("utf-8"))
+            finally:
+                conn.close()
+            assert resp.status == 400
+            assert body["error"] == "ProtocolError"
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker: state machine under an injected clock/rng, GET bypass
+# ---------------------------------------------------------------------------
+
+
+def test_circuit_breaker_state_machine():
+    now = [0.0]
+    u = [0.5]
+    events = []
+    br = CircuitBreaker("b0", fail_threshold=2, reset_s=1.0,
+                        probe_jitter=0.5, clock=lambda: now[0],
+                        rng=lambda: u[0],
+                        on_event=events.append)
+    br.before_request()                     # closed: passes
+    br.record_failure()
+    assert br.state() == "closed"           # 1 < fail_threshold
+    br.record_failure()
+    assert br.state() == "open"
+    # jittered probe delay: reset_s * (1 + probe_jitter * u) = 1.25
+    now[0] = 1.2
+    with pytest.raises(CircuitOpen):
+        br.before_request()
+    now[0] = 1.25
+    br.before_request()                     # the half-open probe slot
+    assert br.state() == "half_open"
+    with pytest.raises(CircuitOpen):
+        br.before_request()                 # slot already claimed
+    u[0] = 1.0                              # re-open draws a NEW jitter
+    br.record_failure()
+    assert br.state() == "open"
+    now[0] = 1.25 + 1.49
+    with pytest.raises(CircuitOpen):        # 1.5s this time, not 1.25
+        br.before_request()
+    now[0] = 1.25 + 1.5
+    br.before_request()
+    br.record_success()
+    assert br.state() == "closed"
+    br.before_request()                     # closed again: passes
+    assert events == ["shortcircuit", "probe", "shortcircuit", "opened",
+                      "shortcircuit", "probe"] or "opened" in events
+    # a success streak keeps the failure counter at zero
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state() == "closed"
+
+
+class _CountingHandler(BaseHTTPRequestHandler):
+    def _answer(self):
+        self.server.hits.append((self.command, self.path,
+                                 int(self.headers.get("Content-Length",
+                                                      0) or 0)))
+        if self.command == "POST":
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        body = b'{"ok": true}'
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_GET = do_POST = _answer
+
+    def log_message(self, *args):
+        pass
+
+
+def _counting_server():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _CountingHandler)
+    srv.hits = []
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def test_breaker_get_bypass_is_the_organic_probe(tsan):
+    """An open breaker short-circuits non-idempotent forwards without
+    touching the wire, while GETs pass through — and a GET's complete
+    response closes the breaker (the organic probe)."""
+    srv = _counting_server()
+    try:
+        br = CircuitBreaker("b0", fail_threshold=1, reset_s=60.0)
+        backend = Backend("b0", srv.server_address, timeout=5.0,
+                          breaker=br)
+        br.record_failure()
+        assert br.state() == "open"
+        before = len(srv.hits)
+        with pytest.raises(CircuitOpen):
+            backend.forward("POST", "/v1/sessions/s/step", b"{}")
+        assert len(srv.hits) == before      # never reached the wire
+        status, _ = backend.forward("GET", "/v1/healthz", None)
+        assert status == 200
+        assert br.state() == "closed"       # the GET closed the circuit
+        status, _ = backend.forward("POST", "/v1/sessions/s/step", b"{}")
+        assert status == 200
+        backend.drop_connections()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# FaultWire: request faults provably never execute upstream
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.net
+def test_faultwire_request_faults_never_reach_upstream(tsan):
+    """The bitwise-safety foundation of the drill: a request-direction
+    drop leaves the upstream's request log untouched (a blind retry
+    cannot double-execute), a response-direction drop shows the upstream
+    DID execute, and a request truncation re-frames Content-Length so
+    the upstream sees a complete HTTP request with a mangled body."""
+    srv = _counting_server()
+    try:
+        # request-direction drop: upstream never sees the exchange
+        inj = ChaosInjector(ChaosPlan(seed=1, legs=(
+            ChaosLeg(target="b0", kind="drop", probability=1.0,
+                     direction="request", scope="data"),)))
+        with FaultWire(srv.server_address, "b0", inj) as fw:
+            before = len(srv.hits)
+            conn = http.client.HTTPConnection(*fw.address, timeout=5)
+            with pytest.raises((http.client.HTTPException, OSError)):
+                conn.request("POST", "/v1/sessions/s/step", body=b"x" * 30)
+                conn.getresponse()
+            conn.close()
+            assert len(srv.hits) == before
+            # control exchanges pass the data-scoped leg untouched
+            conn = http.client.HTTPConnection(*fw.address, timeout=5)
+            conn.request("GET", "/v1/healthz")
+            assert conn.getresponse().status == 200
+            conn.close()
+        assert inj.fired_counts() == {"drop": 1}
+
+        # response-direction drop: upstream executed, the reply died
+        inj2 = ChaosInjector(ChaosPlan(seed=1, legs=(
+            ChaosLeg(target="b0", kind="drop", probability=1.0,
+                     direction="response", scope="data"),)))
+        with FaultWire(srv.server_address, "b0", inj2) as fw:
+            before = len(srv.hits)
+            conn = http.client.HTTPConnection(*fw.address, timeout=5)
+            with pytest.raises((http.client.HTTPException, OSError)):
+                conn.request("POST", "/v1/sessions/s/step", body=b"x" * 30)
+                conn.getresponse()
+            conn.close()
+            assert len(srv.hits) == before + 1      # it DID execute
+
+        # request truncation: upstream sees a complete, shorter request
+        inj3 = ChaosInjector(ChaosPlan(seed=1, legs=(
+            ChaosLeg(target="b0", kind="truncate", probability=1.0,
+                     direction="request", scope="data",
+                     params=(("frac", 0.5),)),)))
+        with FaultWire(srv.server_address, "b0", inj3) as fw:
+            conn = http.client.HTTPConnection(*fw.address, timeout=5)
+            conn.request("POST", "/v1/sessions/s/step", body=b"y" * 100)
+            assert conn.getresponse().status == 200
+            conn.close()
+            assert srv.hits[-1] == ("POST", "/v1/sessions/s/step", 50)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# deadline budgets: wire header, pre-dispatch shed, end-to-end
+# ---------------------------------------------------------------------------
+
+
+def _frame_header(data):
+    (hlen,) = struct.unpack("<I", data[4:8])
+    return json.loads(data[8:8 + hlen].decode("utf-8"))
+
+
+def test_deadline_header_stamp_and_hop_rewrite():
+    """The budget rides the DTF1 header; rewrite_header swaps in a hop's
+    decremented budget without touching payload bytes."""
+    data = protocol.encode_frame({"x": 1}, deadline=5.0)
+    assert _frame_header(data)["__deadline__"] == 5.0
+    hopped = protocol.rewrite_header(data, deadline=3.25)
+    assert _frame_header(hopped)["__deadline__"] == 3.25
+    hlen = struct.unpack("<I", data[4:8])[0]
+    hlen2 = struct.unpack("<I", hopped[4:8])[0]
+    assert data[8 + hlen:] == hopped[8 + hlen2:]    # payloads untouched
+    assert protocol.decode_frame(hopped) == {"x": 1}
+
+
+def test_dispatcher_sheds_spent_deadline_budget():
+    """A request whose budget is spent on arrival fails typed pre-
+    dispatch and counts deadline_shed — it never burns a batch slot."""
+    m = ServeMetrics()
+    d = BatchDispatcher(lambda kind, pk, reqs: [None] * len(reqs),
+                        metrics=m, clock=lambda: 100.0)
+    try:
+        fut = d.submit(Request(kind="noop", program_key=("k",),
+                               payload={}, deadline=99.0))
+        with pytest.raises(DeadlineExceeded, match="shed pre-dispatch"):
+            fut.result(timeout=5)
+        assert m.counter("deadline_shed") == 1
+        assert m.counter("deadline_misses") == 1
+        # a live budget passes untouched
+        ok = d.submit(Request(kind="noop", program_key=("k",),
+                              payload={}, deadline=101.0))
+        assert ok.result(timeout=5) is None
+    finally:
+        d.close()
+
+
+def test_dispatcher_brownout_sheds_lower_priority_only():
+    """Sustained queue pressure sheds a lower-priority admission typed;
+    equal-priority traffic is admitted — uniform-priority fleets degrade
+    exactly as before the brownout existed."""
+    hold = threading.Event()
+
+    def execute(kind, pk, reqs):
+        hold.wait(30)
+        return [None] * len(reqs)
+
+    def req(priority):
+        return Request(kind="noop", program_key=("k",), payload={},
+                       priority=priority)
+
+    m = ServeMetrics()
+    d = BatchDispatcher(execute, metrics=m, max_pending=8,
+                        brownout_watermark=0.25, brownout_grace_s=0.0)
+    try:
+        d.submit(req(2))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:       # wait until in-flight
+            with d._cv:
+                if d._busy and not d._pending:
+                    break
+        futs = [d.submit(req(2)) for _ in range(3)]     # 3 >= depth 2
+        with pytest.raises(ServiceBrownout, match="priority 1"):
+            d.submit(req(1))
+        futs.append(d.submit(req(2)))           # equal priority: admitted
+        assert m.counter("brownout_sheds") == 1
+        hold.set()
+        for f in futs:
+            f.result(timeout=10)
+    finally:
+        hold.set()
+        d.close()
+
+
+@pytest.mark.net
+def test_instance_sheds_spent_budget_end_to_end(tsan):
+    """RemoteSession.step(deadline=...) stamps the header budget; an
+    already-spent budget comes back as typed DeadlineExceeded and counts
+    deadline_shed on the instance."""
+    tb = onemax_toolbox()
+    key = jax.random.PRNGKey(5)
+    with EvolutionService(max_batch=4) as svc:
+        srv = NetServer(svc, {"onemax": tb}).start()
+        try:
+            cli = RemoteService(srv.url, timeout=60)
+            try:
+                s = cli.open_session(key, onemax_pop(key, 16, 8),
+                                     "onemax", cxpb=0.6, mutpb=0.3,
+                                     name="dl")
+                s.step(1)[0].result(timeout=120)        # warm program
+                with pytest.raises(DeadlineExceeded):
+                    s.step(1, deadline=0.0)[0].result(timeout=60)
+                assert svc.metrics.counter("deadline_shed") >= 1
+                # the shed left the trajectory intact
+                s.step(1)[0].result(timeout=120)
+            finally:
+                cli.close()
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# reconnect backoff: full jitter, pinned via injected rng/sleep
+# ---------------------------------------------------------------------------
+
+
+def test_with_retries_full_jitter_pinned():
+    naps = []
+    draws = iter([0.5, 0.25, 1.0, 0.0])
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        raise OSError("down")
+
+    fn = with_retries(flaky, retries=3, backoff=0.1, factor=2.0,
+                      max_backoff=0.3, jitter=True,
+                      rng=lambda: next(draws), sleep=naps.append,
+                      clock=lambda: 0.0)
+    with pytest.raises(RetriesExhausted):
+        fn()
+    assert calls[0] == 4
+    # full jitter: delay_i = u_i * min(backoff * 2**i, max_backoff)
+    assert naps == pytest.approx([0.5 * 0.1, 0.25 * 0.2, 1.0 * 0.3])
+    # jitter off keeps the exact deterministic sequence
+    naps.clear()
+    fn2 = with_retries(flaky, retries=2, backoff=0.1, factor=2.0,
+                       max_backoff=0.3, sleep=naps.append,
+                       clock=lambda: 0.0)
+    with pytest.raises(RetriesExhausted):
+        fn2()
+    assert naps == pytest.approx([0.1, 0.2])
+
+
+# ---------------------------------------------------------------------------
+# router degraded tier + the scaled-down fleet chaos drill
+# ---------------------------------------------------------------------------
+
+
+def _fleet(tb, n=3, **router_kw):
+    svcs = [EvolutionService(max_batch=4) for _ in range(n)]
+    srvs = [NetServer(s, {"onemax": tb}).start() for s in svcs]
+    backends = [Backend(f"b{i}", s.address) for i, s in enumerate(srvs)]
+    router = FleetRouter(backends, **router_kw)
+    return svcs, srvs, backends, router
+
+
+def _close_fleet(svcs, srvs, front=None):
+    if front is not None:
+        front.close()               # closes the router too
+    for s in srvs:
+        s.close()
+    for s in svcs:
+        s.close()
+
+
+@pytest.mark.net
+def test_breaker_open_backend_is_degraded_not_down(tsan):
+    """An open breaker moves the backend to the DEGRADED tier: no new
+    placements while a clean candidate exists, visible in the gauge and
+    topology.  When the whole fleet is degraded, placement proceeds —
+    the create is refused typed while every breaker's probe delay is
+    still running, then the half-open probe slot admits it (breakers
+    pre-attached with an injected clock, the pattern the router binds
+    hooks onto without stomping)."""
+    tb = onemax_toolbox()
+    keys = jax.random.split(jax.random.PRNGKey(9), 3)
+    now = [0.0]
+    svcs = [EvolutionService(max_batch=4) for _ in range(2)]
+    srvs = [NetServer(s, {"onemax": tb}).start() for s in svcs]
+    backends = [
+        Backend(f"b{i}", s.address,
+                breaker=CircuitBreaker(f"b{i}", fail_threshold=1,
+                                       reset_s=10.0, probe_jitter=0.0,
+                                       clock=lambda: now[0]))
+        for i, s in enumerate(srvs)]
+    router = FleetRouter(backends,
+                         health=HealthPolicy(interval_s=0.2, fail_after=3))
+    front = RouterServer(router).start()
+    try:
+        cli = RemoteService(front.url, timeout=60)
+        try:
+            router.backends["b0"].breaker.record_failure()
+            assert router.backends["b0"].breaker.state() == "open"
+            assert router.health.is_degraded("b0")
+            assert router.stats().gauges["router_backends_degraded"] == 1
+            s0 = cli.open_session(keys[0], onemax_pop(keys[0], 16, 8),
+                                  "onemax", name="deg-0")
+            s1 = cli.open_session(keys[1], onemax_pop(keys[1], 16, 16),
+                                  "onemax", name="deg-1")
+            # both avoid the degraded backend (distinct bucket classes
+            # would otherwise spread cold placements)
+            assert router.route_of(s0.name).name == "b1"
+            assert router.route_of(s1.name).name == "b1"
+            assert router.topology()["backends"]["b0"]["degraded"] \
+                == "circuit open"
+            # whole eligible set degraded: placement proceeds, but the
+            # create forward is typed-refused until a probe delay runs
+            # out — then the half-open slot admits it and the complete
+            # response closes the circuit
+            router.backends["b1"].breaker.record_failure()
+            with pytest.raises(CircuitOpen):
+                cli.open_session(keys[2], onemax_pop(keys[2], 16, 32),
+                                 "onemax", name="deg-2")
+            now[0] = 10.0
+            s2 = cli.open_session(keys[2], onemax_pop(keys[2], 16, 32),
+                                  "onemax", name="deg-2")
+            assert router.route_of(s2.name) is not None
+            # recovery clears the tier (s2's probe closed its home)
+            router.backends["b0"].breaker.record_success()
+            router.backends["b1"].breaker.record_success()
+            assert not router.health.is_degraded("b0")
+            assert router.stats().gauges["router_backends_degraded"] == 0
+        finally:
+            cli.close()
+    finally:
+        _close_fleet(svcs, srvs, front)
+
+
+@pytest.mark.net
+def test_fleet_chaos_partition_heal_bitwise(tsan):
+    """The drill in miniature: a 3-instance fleet behind FaultWire
+    proxies, one backend hard-partitioned mid-traffic (health latches it,
+    the drain fails, its sessions are LOST) while another's request
+    frames are truncated (typed 400s, blind-retried).  After the heal,
+    every surviving trajectory is bitwise equal to an undisturbed
+    single-instance reference, and the injector's decision log replays
+    to the identical fault sequence."""
+    tb = onemax_toolbox()
+    shapes = [(16, 8), (16, 16), (16, 32)]
+    ngen, warm = 4, 1
+    keys = list(jax.random.split(jax.random.PRNGKey(16), len(shapes)))
+
+    with EvolutionService(max_batch=4) as ref:
+        want = []
+        for i, (k, (n, d)) in enumerate(zip(keys, shapes)):
+            s = ref.open_session(k, onemax_pop(k, n, d), tb, cxpb=0.6,
+                                 mutpb=0.3, name=f"mini-{i}")
+            for f in s.step(ngen):
+                f.result(timeout=600)
+            want.append(_final(s.population()))
+
+    plan = ChaosPlan(seed=7, legs=(
+        ChaosLeg(target="b0", kind="truncate", phase="storm",
+                 probability=0.4, direction="request", scope="data",
+                 params=(("frac", 0.5),)),
+        ChaosLeg(target="b1", kind="partition", phase="storm",
+                 probability=1.0, direction="both", scope="any"),))
+    injector = ChaosInjector(plan)
+    svcs = [EvolutionService(max_batch=4) for _ in range(3)]
+    srvs = [NetServer(s, {"onemax": tb}).start() for s in svcs]
+    proxies = [FaultWire(srv.address, f"b{i}", injector).start()
+               for i, srv in enumerate(srvs)]
+    backends = [Backend(f"b{i}", p.address, timeout=30.0,
+                        control_timeout=2.0)
+                for i, p in enumerate(proxies)]
+    # health latches only on unreachability: storm 400s on b0 are noise
+    router = FleetRouter(
+        backends,
+        health=HealthPolicy(interval_s=0.2, fail_after=2,
+                            max_failed_delta=10**9,
+                            max_error_spans=10**9, stall_s=3600.0),
+        breaker_policy={"fail_threshold": 1, "reset_s": 0.5},
+        drain_timeout=5.0)
+    front = RouterServer(router, failover_wait=5.0).start()
+    try:
+        cli = RemoteService(front.url, timeout=60)
+        try:
+            injector.set_phase("warmup")
+            sessions = [cli.open_session(k, onemax_pop(k, n, d),
+                                         "onemax", cxpb=0.6, mutpb=0.3,
+                                         name=f"mini-{i}")
+                        for i, (k, (n, d))
+                        in enumerate(zip(keys, shapes))]
+            for s in sessions:
+                for f in s.step(warm):
+                    f.result(timeout=600)
+            homes = {s.name: router.route_of(s.name).name
+                     for s in sessions}
+            # three bucket classes spread cold placement over the fleet
+            assert set(homes.values()) == {"b0", "b1", "b2"}
+
+            injector.set_phase("storm")
+            remaining = {s.name: ngen - warm - 1 for s in sessions}
+            lost = set()
+            storm_deadline = time.monotonic() + 120
+            while time.monotonic() < storm_deadline:
+                pending = [s for s in sessions if s.name not in lost
+                           and remaining[s.name] > 0]
+                if not pending:
+                    break
+                for s in pending:
+                    try:
+                        s.step(1)[0].result(timeout=60)
+                        remaining[s.name] -= 1
+                    except SessionUnknown:
+                        lost.add(s.name)
+                    except Exception as e:  # noqa: BLE001 — typed below
+                        if not chaosdrill._retryable(e):
+                            raise
+                        time.sleep(0.05)
+            survivors = [s for s in sessions if s.name not in lost]
+            assert all(remaining[s.name] == 0 for s in survivors), \
+                "storm generations did not complete in time"
+            # exactly the partitioned backend's sessions were lost
+            assert lost == {n for n, h in homes.items() if h == "b1"}
+
+            injector.set_phase("heal")
+            for s in survivors:             # the reserved final gen
+                heal_deadline = time.monotonic() + 60
+                while True:
+                    try:
+                        s.step(1)[0].result(timeout=60)
+                        break
+                    except Exception as e:  # noqa: BLE001 — typed below
+                        if not chaosdrill._retryable(e) or \
+                                time.monotonic() > heal_deadline:
+                            raise
+                        time.sleep(0.05)
+
+            for s in survivors:
+                i = int(s.name.rsplit("-", 1)[1])
+                got = _final(s.population())
+                for g, w in zip(got, want[i]):
+                    assert np.array_equal(g, w), \
+                        f"{s.name} diverged from the reference"
+            assert "partition" in injector.fired_counts()
+            replayed = ChaosInjector.replay(plan, injector.decision_log())
+            assert replayed.fired() == injector.fired()
+        finally:
+            cli.close()
+    finally:
+        front.close()               # closes the router too
+        for p in proxies:
+            p.close()
+        for srv in srvs:
+            srv.close()
+        for svc in svcs:
+            svc.close()
