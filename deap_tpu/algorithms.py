@@ -370,9 +370,13 @@ def ea_ask(key, population: Population, toolbox, cxpb: float, mutpb: float,
         from .ops.generation_pallas import fused_ea_step
         return fused_ea_step(key, population, toolbox, cxpb, mutpb,
                              live=live)
+    if engine == "streamed":
+        from .bigpop.engine import streamed_ea_ask
+        return streamed_ea_ask(key, population, toolbox, cxpb, mutpb,
+                               live=live)
     if engine != "xla":
         raise ValueError(f"unknown toolbox.generation_engine {engine!r}: "
-                         "expected 'xla' or 'megakernel'")
+                         "expected 'xla', 'megakernel' or 'streamed'")
     key, k_sel, k_var = jax.random.split(key, 3)
     idx = toolbox.select(k_sel, population.fitness, population.size)
     if live is None:
@@ -434,10 +438,18 @@ def ea_step(key, population: Population, toolbox, cxpb: float, mutpb: float,
     dispatches through :func:`ea_ask`'s fused-kernel route (which is
     already reevaluate-all — the flag is redundant there) followed by a
     full evaluation."""
-    if getattr(toolbox, "generation_engine", "xla") == "megakernel":
+    engine = getattr(toolbox, "generation_engine", "xla")
+    if engine == "megakernel":
         key, off = ea_ask(key, population, toolbox, cxpb, mutpb, live=live)
         off, nevals = ea_tell(toolbox, off, live=live)
         return key, off, nevals
+    if engine == "streamed":
+        # host-driven sliced pipeline: one fused call keeps device genome
+        # residency O(slice) through evaluation too (ask+tell would
+        # device-materialize the offspring in between)
+        from .bigpop.engine import streamed_ea_step
+        return streamed_ea_step(key, population, toolbox, cxpb, mutpb,
+                                live=live)
     if reevaluate_all:
         if live is not None:
             raise ValueError("reevaluate_all is incompatible with a live "
@@ -683,7 +695,22 @@ def ea_simple(key, population: Population, toolbox, cxpb: float, mutpb: float,
     counters (nevals, operator invocations, quarantine hits) and fitness
     gauges accumulate as array ops and flush to the telemetry's sinks every
     ``flush_every`` generations.  ``None`` (default) compiles the identical
-    program as before the buffer existed."""
+    program as before the buffer existed.
+
+    A toolbox declaring ``generation_engine = "streamed"`` routes the
+    whole loop through :func:`deap_tpu.bigpop.streamed_ea_simple` — a
+    host-driven sliced pipeline cannot live inside this ``lax.scan``, so
+    the dispatch happens here rather than in :func:`ea_step` (bitwise
+    the same trajectory; in-scan knobs are rejected typed)."""
+    if getattr(toolbox, "generation_engine", "xla") == "streamed":
+        from .bigpop.engine import streamed_ea_simple
+        if reevaluate_all or stream_every:
+            raise ValueError("the streamed engine does not support "
+                             "reevaluate_all/stream_every (host loop, "
+                             "no in-scan callbacks)")
+        return streamed_ea_simple(key, population, toolbox, cxpb, mutpb,
+                                  ngen, stats=stats, halloffame=halloffame,
+                                  verbose=verbose, telemetry=telemetry)
     smode = _resolve_stream_mode(stream_every, stream_mode)
     sinks = telemetry.sinks if telemetry is not None else None
     key, k0 = jax.random.split(key)

@@ -42,7 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["ProgramEntry", "Lowered", "INVENTORY", "entries", "get_entry",
            "lower_entry", "require_mesh", "build_ga_scan",
-           "build_megakernel_scan", "N_DEV"]
+           "build_megakernel_scan", "build_streamed_slice", "N_DEV"]
 
 #: mesh width every sharded entry lowers at (tests/conftest.py and the
 #: analyze CLI both stand up this many virtual CPU devices)
@@ -304,6 +304,42 @@ def build_megakernel_scan(pop: int = 256, dim: int = DIM, ngen: int = 2,
         key.dtype, jax.dtypes.prng_key) else key, genome, values)
 
 
+def build_streamed_slice(pop: int = POP, dim: int = DIM,
+                         slice_rows: int = 16, variant: int = 0):
+    """One per-slice device program of the streamed (out-of-core)
+    generation engine (:mod:`deap_tpu.bigpop.engine`), deliberately
+    built at pop > slice_rows: the genome-sized operands are the
+    ``slice_rows``-row parent upload, while everything pop-sized in the
+    argument list is a plan tensor (coin flips, cut points, key data) —
+    bytes the committed memory budget shows staying O(pop)-*small*.
+    The budget's ``peak_bytes`` is therefore the device-residency
+    proof: O(slice) genome, never O(pop).  Public for the same reason
+    as :func:`build_ga_scan` — the inventory lowers the SAME program
+    ``StreamedEngine.slice_program`` dispatches."""
+    from ..base import Fitness, Population
+    from ..bigpop.engine import StreamedEngine
+    from ..bigpop.host import HostPopulation
+    tb = _ga_toolbox()
+    key = jax.random.PRNGKey(19 + variant)
+    genome = jax.random.uniform(jax.random.fold_in(key, 1), (pop, dim),
+                                jnp.float32, -5.12, 5.12)
+    population = Population(
+        genome, Fitness(values=jnp.zeros((pop, 1), jnp.float32),
+                        valid=jnp.ones((pop,), bool), weights=(-1.0,)))
+    host = HostPopulation.from_population(population, tb)
+    eng = StreamedEngine(tb, host, slice_rows=slice_rows)
+    plan = eng.plan(key, 0.6 + 0.1 * variant, 0.3 - 0.1 * variant)
+    a, b = 0, slice_rows
+    parents = jnp.asarray(host.gather(np.asarray(plan["idx"])[a:b]))
+    fn = eng.slice_program(slice_rows, with_eval=True, live=False)
+    args = (parents, jnp.int32(a),
+            plan["do_cx"][a // 2:b // 2], plan["cx_a"][a // 2:b // 2],
+            plan["cx_b"][a // 2:b // 2], plan["do_mut"][a:b],
+            plan["kd_cx"], plan["kd_mask"], plan["kd_noise"],
+            jnp.zeros((b - a,), bool), parents)
+    return fn, args
+
+
 def _build_session_step(variant: int = 0):
     """One serve session's step program, un-vmapped (the per-state form
     every slot/sharded executable wraps)."""
@@ -484,6 +520,19 @@ INVENTORY: Tuple[ProgramEntry, ...] = (
         doc="fused generation scan with bf16 genome residency (f32 "
             "fitness accumulation + f32 mutation arithmetic); the "
             "dtype-traffic pass audits the narrow-storage contract"),
+    ProgramEntry(
+        name="ga_generation_streamed",
+        anchor="deap_tpu/bigpop/engine.py",
+        build=build_streamed_slice, budget=True,
+        donate_waiver="the staged parent slice is re-passed as the "
+                      "passthrough rows operand (one buffer, two "
+                      "operands -- donation would alias a live read), "
+                      "and slices drain to host immediately; footprint "
+                      "is bounded by slice size by construction",
+        doc="one device slice of the out-of-core streamed generation "
+            "(pop=64 streamed as slice_rows=16 uploads): genome "
+            "operands are O(slice), plan tensors O(pop)-small -- the "
+            "committed peak_bytes is the device-residency proof"),
     ProgramEntry(
         name="ea_step_session", anchor="deap_tpu/algorithms.py",
         build=_build_session_step, donate_waiver=_SERVE_WAIVER,

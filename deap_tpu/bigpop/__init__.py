@@ -1,0 +1,35 @@
+"""Out-of-core evolution: host-streamed populations beyond HBM.
+
+The resident executors (single-device, megakernel, pop-sharded) all
+require the full genome matrix in device memory; this package removes
+that ceiling.  A :class:`HostPopulation` keeps the genome chunked in
+host RAM (``GenomeStorage``-dtype-aware: int8 streams at 1/4 the f32
+bytes) and a :class:`StreamedEngine` runs each generation as a sliced
+prefetch/compute/drain pipeline, with selection on a device-resident
+fitness table.  A streamed run is bitwise identical to a resident run
+at the same pop/key — see :mod:`deap_tpu.bigpop.engine`.
+
+Entry points: ``toolbox.generation_engine = "streamed"`` routes
+:func:`deap_tpu.algorithms.ea_ask` / :func:`~deap_tpu.algorithms.ea_step`
+through :func:`streamed_ea_ask` / :func:`streamed_ea_step`, and
+:func:`~deap_tpu.algorithms.ea_simple` through
+:func:`streamed_ea_simple` (the host loop — also usable directly as
+``run_resumable``'s ``loop=``); :func:`run_streamed_resumable` adds
+mid-generation (between-slice) checkpoint/resume.
+"""
+
+from .host import HostPopulation, DEFAULT_CHUNK_ROWS
+from .engine import (StreamedEngine, GenerationResult, streamed_params,
+                     streamed_ea_ask, streamed_ea_step, streamed_ea_simple,
+                     DEFAULT_SLICE_ROWS)
+from .runner import run_streamed_resumable
+from .slicedprng import (check_prng_compat, sliced_bits, sliced_uniform,
+                         sliced_normal, sliced_bernoulli)
+
+__all__ = [
+    "HostPopulation", "DEFAULT_CHUNK_ROWS", "StreamedEngine",
+    "GenerationResult", "streamed_params", "streamed_ea_ask",
+    "streamed_ea_step", "streamed_ea_simple", "DEFAULT_SLICE_ROWS",
+    "run_streamed_resumable", "check_prng_compat", "sliced_bits",
+    "sliced_uniform", "sliced_normal", "sliced_bernoulli",
+]

@@ -1,0 +1,634 @@
+"""The streamed generation engine: out-of-core evolution beyond HBM.
+
+One generation at population N runs as a *sliced pipeline* over a
+:class:`~deap_tpu.bigpop.host.HostPopulation`: while slice *k* is being
+varied/evaluated on device, slice *k+1*'s parent rows are in flight
+host→HBM (``device_put`` behind jax's async dispatch) and slice *k−1*'s
+results are draining HBM→host — device peak genome residency stays
+O(slice), not O(pop).
+
+Bitwise contract (the acceptance oracle, pinned by
+``tests/test_bigpop.py``): a streamed generation at pop=N is **bitwise
+identical** to the resident :func:`deap_tpu.algorithms.ea_step` at the
+same pop/key — f32, bf16 and int8 genome storage alike.  Three facts
+make that possible:
+
+* every *decision-sized* tensor of the resident path — tournament
+  winners, crossover coin flips and cut points, the mutation row mask,
+  the key-split chain — is O(pop) small even at 10⁸ rows, so the
+  **generation plan** computes them whole-pop on device from a
+  device-resident fitness table, reusing the registered operators
+  themselves (``toolbox.select`` runs unmodified — streaming tournament
+  selection via the same :func:`~deap_tpu.ops.selection.tournament_positions`
+  law, both tie-break modes);
+* the only genome-sized draws (``mut_gaussian``'s Bernoulli mask and
+  normal noise, ``cx_uniform``'s swap mask) regenerate slice-exactly in
+  O(slice) via :mod:`~deap_tpu.bigpop.slicedprng`;
+* slice boundaries are **even**, so the adjacent crossover pairs
+  ``(2p, 2p+1)`` never span a boundary, and evaluation is a per-row
+  ``vmap`` — row-decomposable by construction.
+
+The engine supports the serve layer's ask/tell split and the ``live``
+prefix-mask padding contract, mirroring the resident semantics row for
+row.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from functools import partial
+from typing import Callable, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import Fitness, Population
+from ..ops import crossover, mutation
+from ..ops.crossover import _two_cut_points
+from ..ops.generation_pallas import GenomeStorage, storage_of
+from .host import HostPopulation
+from . import slicedprng as sprng
+
+__all__ = ["StreamedEngine", "GenerationResult", "streamed_params",
+           "streamed_ea_ask", "streamed_ea_step", "streamed_ea_simple",
+           "DEFAULT_SLICE_ROWS"]
+
+#: default device slice — even (adjacent pairs never span a boundary),
+#: big enough to amortize dispatch, small enough that three slices
+#: (prefetch + compute + drain) are a sliver of HBM at any dim
+DEFAULT_SLICE_ROWS = 8192
+
+_SUPPORTED_MATE = ("cx_two_point", "cx_one_point", "cx_uniform")
+_SUPPORTED_MUTATE = ("mut_gaussian", "mut_flip_bit")
+
+
+def streamed_params(toolbox) -> dict:
+    """Extract (and validate) the streamed engine's operator
+    configuration from a toolbox.  Selection is unrestricted — every
+    ``sel_*`` consumes only the fitness table, which stays device
+    resident — but mate/mutate must be operators whose genome-sized
+    randomness the slice programs know how to regenerate, registered
+    with keyword parameters only (same rule as the batched dispatch and
+    the megakernel)."""
+    from ..algorithms import _batched_form
+
+    def base_fn(tool):
+        return getattr(tool, "func", tool)
+
+    mate_kind = getattr(base_fn(toolbox.mate), "__name__", "?")
+    if base_fn(toolbox.mate) not in (crossover.cx_two_point,
+                                     crossover.cx_one_point,
+                                     crossover.cx_uniform):
+        raise ValueError("streamed generation supports mate in "
+                         f"{_SUPPORTED_MATE}; got {mate_kind}")
+    mut_kind = getattr(base_fn(toolbox.mutate), "__name__", "?")
+    if base_fn(toolbox.mutate) not in (mutation.mut_gaussian,
+                                       mutation.mut_flip_bit):
+        raise ValueError("streamed generation supports mutate in "
+                         f"{_SUPPORTED_MUTATE}; got {mut_kind}")
+    for name in ("mate", "mutate"):
+        if _batched_form(getattr(toolbox, name)) is None:
+            raise ValueError(
+                f"streamed generation: toolbox.{name} does not dispatch "
+                "to its batched form (positional frozen args, or a "
+                "wrapping decorator); the resident path would fan out "
+                "per-row keys, which the slice regeneration does not "
+                "reproduce — register keyword parameters only")
+    if getattr(toolbox, "quarantine", None) is not None:
+        raise ValueError("streamed generation does not support "
+                         "toolbox.quarantine (it rewrites fitness from "
+                         "the whole population); clear it or use the "
+                         "resident engine")
+    if hasattr(toolbox, "evaluate_population"):
+        raise ValueError("streamed generation needs a per-individual "
+                         "toolbox.evaluate (a population-level "
+                         "evaluate_population would need the whole "
+                         "genome on device)")
+    if not hasattr(toolbox, "evaluate"):
+        raise ValueError("streamed generation needs toolbox.evaluate")
+    mate_kw = dict(getattr(toolbox.mate, "keywords", {}))
+    mut_kw = dict(getattr(toolbox.mutate, "keywords", {}))
+    return {"mate": mate_kind, "mutate": mut_kind,
+            "mate_kw": mate_kw, "mut_kw": mut_kw}
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    """Outcome of one (possibly interrupted) streamed generation."""
+
+    completed: bool
+    key: Optional[jax.Array] = None       # advanced key (completed only)
+    nevals: int = 0
+    cursor: int = 0                       # next slice index (preempted)
+    staged_rows: Optional[np.ndarray] = None   # child rows [0, bounds[cursor])
+    staged_vals: Optional[np.ndarray] = None   # their eval values
+    final_valid: Optional[np.ndarray] = None   # ask-time offspring validity
+
+
+class StreamedEngine:
+    """Runs streamed generations over a :class:`HostPopulation`.
+
+    The engine is deterministic state-free between calls: everything a
+    generation needs is (key, host store) — which is what a mid-flight
+    checkpoint snapshots (host chunks + the slice cursor; see
+    :mod:`deap_tpu.bigpop.runner`)."""
+
+    def __init__(self, toolbox, host: HostPopulation, *,
+                 slice_rows: Optional[int] = None):
+        sprng.check_prng_compat()
+        self.toolbox = toolbox
+        self.host = host
+        self.params = streamed_params(toolbox)
+        self.storage = storage_of(toolbox) or GenomeStorage()
+        if np.dtype(host.genome_dtype) != np.dtype(self.storage.jax_dtype):
+            raise ValueError(
+                f"host store dtype {host.genome_dtype} does not match the "
+                f"toolbox genome storage {self.storage.dtype!r}")
+        n = host.size
+        s = slice_rows or min(DEFAULT_SLICE_ROWS, n + (n % 2))
+        if s % 2:
+            raise ValueError(f"slice_rows={s} must be even: adjacent "
+                             "crossover pairs must never span a slice "
+                             "boundary")
+        self.slice_rows = int(s)
+        self._bounds = [(a, min(a + self.slice_rows, n))
+                        for a in range(0, n, self.slice_rows)]
+        self._plan_cache = {}
+        self._slice_cache = {}
+        self._eval_cache = {}
+
+    @property
+    def n_slices(self) -> int:
+        return len(self._bounds)
+
+    # -- the generation plan (whole-pop small tensors) -----------------------
+
+    def _plan_fn(self, live: bool) -> Callable:
+        if live in self._plan_cache:
+            return self._plan_cache[live]
+        toolbox, params = self.toolbox, self.params
+        n = self.host.size
+        n2 = n // 2
+        dim = self.host.dim
+        weights = self.host.weights
+
+        def plan(key, values, valid, cxpb, mutpb, live_n):
+            key_out, k_sel, k_var = jax.random.split(key, 3)
+            fit = Fitness(values=values, valid=valid, weights=weights)
+            idx = toolbox.select(k_sel, fit, n)
+            if live:
+                ln = jnp.maximum(live_n, 1)
+                idx = jnp.where(idx < ln, idx, idx % ln)
+            k_cx, k_cxkeys, k_mut, k_mutkeys = jax.random.split(k_var, 4)
+            do_cx = jax.random.bernoulli(k_cx, cxpb, (n2,))
+            do_mut = jax.random.bernoulli(k_mut, mutpb, (n,))
+            out = {"key": key_out, "idx": idx.astype(jnp.int32),
+                   "do_cx": do_cx, "do_mut": do_mut}
+            if params["mate"] == "cx_two_point":
+                lo, hi = _two_cut_points(k_cxkeys, dim, shape=(n2, 1))
+                out["cx_a"], out["cx_b"] = lo, hi
+            elif params["mate"] == "cx_one_point":
+                point = jax.random.randint(k_cxkeys, (n2, 1), 1, dim)
+                out["cx_a"] = point
+                out["cx_b"] = jnp.zeros((n2, 1), point.dtype)
+            else:                                    # cx_uniform
+                out["cx_a"] = jnp.zeros((n2, 1), jnp.int32)
+                out["cx_b"] = jnp.zeros((n2, 1), jnp.int32)
+            out["kd_cx"] = sprng.key_data(k_cxkeys)
+            if params["mutate"] == "mut_gaussian":
+                k_mask, k_noise = jax.random.split(k_mutkeys)
+                out["kd_mask"] = sprng.key_data(k_mask)
+                out["kd_noise"] = sprng.key_data(k_noise)
+            else:                                    # mut_flip_bit
+                out["kd_mask"] = sprng.key_data(k_mutkeys)
+                out["kd_noise"] = sprng.key_data(k_mutkeys)
+            touched = jnp.repeat(do_cx, 2, total_repeat_length=2 * n2)
+            if n % 2:
+                touched = jnp.concatenate(
+                    [touched, jnp.zeros((n - 2 * n2,), bool)])
+            touched = touched | do_mut
+            values_sel = values[idx]
+            valid_sel = valid[idx]
+            if live:
+                lmask = jnp.arange(n) < ln
+                touched = touched & lmask
+                valid_ask = jnp.where(lmask, valid_sel & ~touched, False)
+                values_base = jnp.where(lmask[:, None], values_sel, values)
+                invalid = lmask & ~valid_ask
+                final_valid = lmask
+            else:
+                valid_ask = valid_sel & ~touched
+                values_base = values_sel
+                invalid = ~valid_ask
+                final_valid = jnp.ones((n,), bool)
+            out.update(valid_ask=valid_ask, values_base=values_base,
+                       invalid=invalid, final_valid=final_valid,
+                       nevals=jnp.sum(invalid))
+            return out
+
+        fn = jax.jit(plan)
+        self._plan_cache[live] = fn
+        return fn
+
+    # -- the per-slice device program ----------------------------------------
+
+    def _widen(self, x):
+        st = self.storage
+        return st.to_compute(x) if st.is_narrow else x
+
+    def _narrow(self, x):
+        st = self.storage
+        return st.to_storage(x) if st.is_narrow else x
+
+    def slice_program(self, s: int, with_eval: bool = True,
+                      live: bool = False) -> Callable:
+        """The raw (unjitted) per-slice device program — public so the
+        analysis inventory (``ga_generation_streamed``) lowers the SAME
+        program the pipeline dispatches.  Its genome-sized operands are
+        the ``s``-row parent slice (plus the passthrough rows on the
+        live path); everything else is the plan's O(pop)-small tensors —
+        which is the device-residency claim the committed memory budget
+        pins."""
+        from ..algorithms import _norm_eval
+
+        params = self.params
+        n, dim = self.host.size, self.host.dim
+        n2 = n // 2
+        p = s // 2                      # pairs fully inside this slice
+        mate, mut = params["mate"], params["mutate"]
+        cx_indpb = params["mate_kw"].get("indpb", 0.5)
+        mu = params["mut_kw"].get("mu", 0.0)
+        sigma = params["mut_kw"].get("sigma", 1.0)
+        indpb = params["mut_kw"].get("indpb", 0.05)
+        evaluate = getattr(self.toolbox, "evaluate", None)
+        norm_eval = _norm_eval(evaluate) if with_eval else None
+
+        def f(parents, row0, do_cx_s, cx_a, cx_b, do_mut_s,
+              kd_cx, kd_mask, kd_noise, live_s, orig_s):
+            g = self._widen(parents)
+            ga, gb = g[0:2 * p:2], g[1:2 * p:2]
+            if mate == "cx_two_point":
+                col = jnp.arange(dim)[None, :]
+                mask = (col >= cx_a) & (col < cx_b)
+            elif mate == "cx_one_point":
+                mask = jnp.arange(dim)[None, :] >= cx_a
+            else:                                     # cx_uniform
+                mask = sprng.sliced_bernoulli(
+                    kd_cx, cx_indpb, (n2, dim),
+                    jnp.asarray(row0, jnp.uint32) // jnp.uint32(2), p)
+            ca = jnp.where(mask, gb, ga)
+            cb = jnp.where(mask, ga, gb)
+            dc = do_cx_s[:, None]
+            ga = jnp.where(dc, ca, ga)
+            gb = jnp.where(dc, cb, gb)
+            paired = jnp.stack([ga, gb], 1).reshape((2 * p,) + g.shape[1:])
+            g = paired if s == 2 * p else jnp.concatenate(
+                [paired, g[2 * p:]], 0)
+            if mut == "mut_gaussian":
+                mmask = sprng.sliced_bernoulli(kd_mask, indpb, (n, dim),
+                                               row0, s)
+                noise = mu + sigma * sprng.sliced_normal(kd_noise, (n, dim),
+                                                         row0, s)
+                mutated = jnp.where(mmask, g + noise, g)
+            else:                                     # mut_flip_bit
+                mmask = sprng.sliced_bernoulli(kd_mask, indpb, (n, dim),
+                                               row0, s)
+                mutated = jnp.where(mmask, 1 - g, g)
+            g = jnp.where(do_mut_s[:, None], mutated, g)
+            child = self._narrow(g) if self.storage.is_narrow else g
+            if live:
+                child = jnp.where(live_s[:, None], child, orig_s)
+            if not with_eval:
+                return child, jnp.zeros((0,), jnp.float32)
+            vals = jax.vmap(norm_eval)(self._widen(child))
+            return child, vals
+
+        return f
+
+    def _slice_fn(self, s: int, with_eval: bool, live: bool) -> Callable:
+        ck = (s, with_eval, live)
+        if ck in self._slice_cache:
+            return self._slice_cache[ck]
+        fn = jax.jit(self.slice_program(s, with_eval, live))
+        self._slice_cache[ck] = fn
+        return fn
+
+    def _eval_fn(self, s: int) -> Callable:
+        if s in self._eval_cache:
+            return self._eval_cache[s]
+        from ..algorithms import _norm_eval
+        norm_eval = _norm_eval(self.toolbox.evaluate)
+
+        def f(rows):
+            return jax.vmap(norm_eval)(self._widen(rows))
+
+        fn = jax.jit(f)
+        self._eval_cache[s] = fn
+        return fn
+
+    # -- generation execution ------------------------------------------------
+
+    def _staging(self) -> np.ndarray:
+        return np.empty((self.host.size, self.host.dim),
+                        self.host.genome_dtype)
+
+    def plan(self, key, cxpb, mutpb, live_n: Optional[int] = None) -> dict:
+        """Compute the whole-pop generation plan (device dict)."""
+        live = live_n is not None
+        ln = jnp.int32(live_n if live else self.host.size)
+        values, valid = self.host.fitness_arrays()
+        return self._plan_fn(live)(key, jnp.asarray(values),
+                                   jnp.asarray(valid),
+                                   jnp.float32(cxpb), jnp.float32(mutpb),
+                                   ln)
+
+    def run_generation(self, key, cxpb: float, mutpb: float, *,
+                       with_eval: bool = True,
+                       live_n: Optional[int] = None,
+                       start_slice: int = 0,
+                       staged_rows: Optional[np.ndarray] = None,
+                       staged_vals: Optional[np.ndarray] = None,
+                       slice_hook: Optional[Callable[[int], bool]] = None,
+                       apply: bool = True) -> GenerationResult:
+        """Run one generation as the sliced prefetch/compute/drain
+        pipeline.  ``slice_hook(k)`` (if given) is polled before each
+        slice past the first; returning True stops the generation
+        between slices and hands back a cursor + the drained prefix (the
+        preemption path).  ``start_slice``/``staged_*`` resume such an
+        interrupted generation — together with the same ``key`` this is
+        bit-exact, because the plan is a pure function of (key, fitness
+        table).  ``apply=False`` leaves the host store untouched and
+        returns the built offspring in the result (the ask half)."""
+        host = self.host
+        n, dim = host.size, host.dim
+        live = live_n is not None
+        plan = self.plan(key, cxpb, mutpb, live_n)
+        idx_np = np.asarray(plan["idx"])
+        nobj = host.values.shape[1]
+
+        child = self._staging()
+        vals = np.empty((n, nobj), np.float32) if with_eval else None
+        if start_slice:
+            a0 = self._bounds[start_slice][0]
+            child[:a0] = staged_rows
+            if with_eval:
+                vals[:a0] = staged_vals
+
+        def stage_in(k):
+            a, b = self._bounds[k]
+            parents = jax.device_put(host.gather(idx_np[a:b]))
+            p0, p1 = a // 2, a // 2 + (b - a) // 2
+            extras = (plan["do_cx"][p0:p1], plan["cx_a"][p0:p1],
+                      plan["cx_b"][p0:p1], plan["do_mut"][a:b])
+            if live:
+                lv = jnp.arange(a, b) < jnp.int32(max(live_n, 1))
+                orig = jax.device_put(host.rows(a, b))
+            else:
+                lv = jnp.zeros((b - a,), bool)
+                orig = parents
+            return parents, extras, lv, orig
+
+        inflight: deque = deque()
+
+        def drain_one():
+            k, (dev_child, dev_vals) = inflight.popleft()
+            a, b = self._bounds[k]
+            child[a:b] = np.asarray(dev_child)
+            if with_eval:
+                vals[a:b] = np.asarray(dev_vals)
+
+        nxt = stage_in(start_slice)
+        for k in range(start_slice, len(self._bounds)):
+            if slice_hook is not None and k > start_slice \
+                    and slice_hook(k):
+                while inflight:
+                    drain_one()
+                a = self._bounds[k][0]
+                return GenerationResult(
+                    completed=False, cursor=k, staged_rows=child[:a].copy(),
+                    staged_vals=vals[:a].copy() if with_eval else None)
+            parents, extras, lv, orig = nxt
+            a, b = self._bounds[k]
+            fn = self._slice_fn(b - a, with_eval, live)
+            out = fn(parents, jnp.int32(a), *extras,
+                     plan["kd_cx"], plan["kd_mask"], plan["kd_noise"],
+                     lv, orig)
+            inflight.append((k, out))
+            if k + 1 < len(self._bounds):
+                nxt = stage_in(k + 1)          # host→HBM while k computes
+            if len(inflight) > 1:
+                drain_one()                    # HBM→host one behind
+        while inflight:
+            drain_one()
+
+        values_base = np.asarray(plan["values_base"])
+        invalid = np.asarray(plan["invalid"])
+        if with_eval:
+            final_values = np.where(invalid[:, None], vals, values_base)
+            final_valid = np.asarray(plan["final_valid"])
+        else:
+            final_values = values_base
+            final_valid = np.asarray(plan["valid_ask"])
+        nevals = int(np.asarray(plan["nevals"]))
+
+        result = GenerationResult(completed=True, key=plan["key"],
+                                  nevals=nevals)
+        if apply:
+            R = host.chunk_rows
+            host.swap_genome([child[i:i + R] for i in range(0, n, R)])
+            host.set_fitness(final_values, final_valid)
+        else:
+            result.staged_rows = child
+            result.staged_vals = final_values
+            result.cursor = len(self._bounds)
+            result.final_valid = final_valid
+        return result
+
+    def step(self, key, cxpb: float, mutpb: float, *,
+             live_n: Optional[int] = None, **kw):
+        """One full generation (ask + fused per-slice evaluation),
+        applied to the host store.  Returns ``(key, nevals)``."""
+        res = self.run_generation(key, cxpb, mutpb, with_eval=True,
+                                  live_n=live_n, **kw)
+        if not res.completed:
+            return res
+        return res.key, res.nevals
+
+    def evaluate_initial(self, live_n: Optional[int] = None) -> int:
+        """Sliced equivalent of the loop's generation-0
+        :func:`~deap_tpu.algorithms.evaluate_population`: evaluate every
+        row, assign where invalid (and live).  Returns ``nevals``."""
+        host = self.host
+        n = host.size
+        values, valid = host.fitness_arrays()
+        lmask = (np.arange(n) < max(live_n, 1)) if live_n is not None \
+            else np.ones((n,), bool)
+        invalid = lmask & ~valid
+        vals = np.empty((n, values.shape[1]), np.float32)
+        inflight: deque = deque()
+        for a, b in self._bounds:
+            dev = self._eval_fn(b - a)(jax.device_put(host.rows(a, b)))
+            inflight.append((a, b, dev))
+            if len(inflight) > 1:
+                a0, b0, d0 = inflight.popleft()
+                vals[a0:b0] = np.asarray(d0)
+        while inflight:
+            a0, b0, d0 = inflight.popleft()
+            vals[a0:b0] = np.asarray(d0)
+        host.set_fitness(np.where(invalid[:, None], vals, values),
+                         valid | invalid if live_n is None
+                         else (valid | invalid) & lmask)
+        return int(invalid.sum())
+
+    # -- ask / tell (the serve protocol) -------------------------------------
+
+    def ask(self, key, cxpb: float, mutpb: float, *,
+            live_n: Optional[int] = None):
+        """Selection + variation without evaluation.  Returns ``(key,
+        pending)`` where ``pending`` holds the offspring rows and their
+        carried fitness; the host store is untouched until :meth:`tell`."""
+        res = self.run_generation(key, cxpb, mutpb, with_eval=False,
+                                  live_n=live_n, apply=False)
+        pending = {"rows": res.staged_rows, "values": res.staged_vals,
+                   "valid": res.final_valid,     # type: ignore[attr-defined]
+                   "live_n": live_n}
+        return res.key, pending
+
+    def tell(self, pending: dict, values=None) -> int:
+        """Complete an :meth:`ask`: assign externally computed ``values``
+        (full ``(pop, nobj)``, pad rows ignored) — or evaluate the
+        pending rows slice-wise when ``values`` is None — then swap the
+        offspring into the host store.  Returns ``nevals``."""
+        host = self.host
+        n = host.size
+        live_n = pending["live_n"]
+        lmask = (np.arange(n) < max(live_n, 1)) if live_n is not None \
+            else np.ones((n,), bool)
+        valid = np.asarray(pending["valid"])
+        invalid = lmask & ~valid
+        rows = pending["rows"]
+        if values is None:
+            vals = np.empty_like(pending["values"])
+            for a, b in self._bounds:
+                vals[a:b] = np.asarray(
+                    self._eval_fn(b - a)(jax.device_put(rows[a:b])))
+        else:
+            vals = np.asarray(values, np.float32)
+            if vals.ndim == 1:
+                vals = vals[:, None]
+        final_values = np.where(invalid[:, None], vals, pending["values"])
+        R = host.chunk_rows
+        host.swap_genome([rows[i:i + R] for i in range(0, n, R)])
+        host.set_fitness(final_values, lmask)
+        return int(invalid.sum())
+
+
+# ---------------------------------------------------------------------------
+# Population-level wrappers (the `generation_engine="streamed"` routing)
+# ---------------------------------------------------------------------------
+
+
+def _live_count(live) -> Optional[int]:
+    if live is None:
+        return None
+    return int(np.asarray(live).sum())
+
+
+def _require_concrete(population: Population) -> None:
+    leaves = jax.tree_util.tree_leaves(population.genome)
+    if any(isinstance(x, jax.core.Tracer) for x in leaves):
+        raise ValueError(
+            "the streamed generation engine is host-driven (it moves "
+            "slices through HBM from host RAM) and cannot run under "
+            "jit/vmap/scan — call ea_step/ea_ask eagerly, or use "
+            "streamed_ea_simple / run_streamed_resumable as the loop")
+
+
+def streamed_ea_ask(key, population: Population, toolbox, cxpb, mutpb, *,
+                    live=None, slice_rows: Optional[int] = None):
+    """Streamed form of the :func:`~deap_tpu.algorithms.ea_ask` half:
+    host-materializes the population, streams selection+variation, and
+    returns ``(key, offspring)`` with untouched-row fitness carried and
+    touched rows invalid — bitwise identical to the resident ask.
+    Host-driven: not traceable under jit (the serve layer dispatches
+    streamed sessions on a dedicated host path)."""
+    _require_concrete(population)
+    host = HostPopulation.from_population(population, toolbox)
+    eng = StreamedEngine(toolbox, host, slice_rows=slice_rows)
+    key, pending = eng.ask(key, cxpb, mutpb, live_n=_live_count(live))
+    off = Population(
+        jnp.asarray(pending["rows"]),
+        Fitness(values=jnp.asarray(pending["values"]),
+                valid=jnp.asarray(pending["valid"]),
+                weights=population.fitness.weights))
+    return key, off
+
+
+def streamed_ea_step(key, population: Population, toolbox, cxpb, mutpb, *,
+                     live=None, slice_rows: Optional[int] = None):
+    """Streamed form of one full :func:`~deap_tpu.algorithms.ea_step`
+    generation (fused per-slice evaluation).  Returns ``(key,
+    population, nevals)`` — bitwise identical to the resident step."""
+    _require_concrete(population)
+    host = HostPopulation.from_population(population, toolbox)
+    eng = StreamedEngine(toolbox, host, slice_rows=slice_rows)
+    key, nevals = eng.step(key, cxpb, mutpb, live_n=_live_count(live))
+    return key, host.to_population(), nevals
+
+
+def streamed_ea_simple(key, population, toolbox, cxpb: float, mutpb: float,
+                       ngen: int, stats=None, halloffame=None,
+                       verbose: bool = False,
+                       slice_rows: Optional[int] = None, telemetry=None):
+    """Streamed ``ea_simple``-family loop: same signature, same key
+    schedule, bitwise-identical trajectory — usable directly as the
+    ``loop=`` of :func:`deap_tpu.resilience.run_resumable`.  ``stats``/
+    ``halloffame`` device-materialize the population once per generation
+    (monitoring at out-of-core scale should sample instead); telemetry
+    is not supported on the streamed path."""
+    if telemetry is not None:
+        raise ValueError("streamed_ea_simple does not support telemetry")
+    from ..algorithms import _hof_setup, _record
+    from ..utils.support import Logbook
+
+    if isinstance(population, HostPopulation):
+        host = population
+    else:
+        host = HostPopulation.from_population(population, toolbox)
+    eng = StreamedEngine(toolbox, host, slice_rows=slice_rows)
+    key, _k0 = jax.random.split(key)          # ea_simple's unused k0
+    nevals0 = eng.evaluate_initial()
+
+    def materialize():
+        return host.to_population()
+
+    def fmt(rec):
+        return {k: (v.item() if hasattr(v, "item") and np.ndim(v) == 0
+                    else v) for k, v in rec.items()}
+
+    hof_state = hof_upd = None
+    pop0 = materialize() if (stats is not None or halloffame is not None) \
+        else None
+    if halloffame is not None:
+        hof_state, hof_upd = _hof_setup(halloffame, pop0)
+        hof_state = hof_upd(hof_state, pop0)
+    logbook = Logbook()
+    logbook.header = ["gen", "nevals"] + (stats.fields if stats else [])
+    logbook.record(gen=0, **fmt(_record(stats, pop0, nevals0)))
+    for gen in range(1, ngen + 1):
+        key, nevals = eng.step(key, cxpb, mutpb)
+        rec = {"nevals": nevals}
+        if stats is not None or halloffame is not None:
+            pop = materialize()
+            rec = _record(stats, pop, nevals)
+            if halloffame is not None:
+                hof_state = hof_upd(hof_state, pop)
+        logbook.record(gen=gen, **fmt(rec))
+        if verbose:
+            from ..observability.sinks import emit_text
+            emit_text(logbook.stream)
+    if halloffame is not None:
+        halloffame.state = hof_state
+    return materialize(), logbook

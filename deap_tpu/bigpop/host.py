@@ -1,0 +1,201 @@
+"""Host-resident chunked population store.
+
+A :class:`HostPopulation` keeps the genome matrix in host RAM as a list
+of row chunks in the toolbox's *storage* dtype (``GenomeStorage``-aware:
+int8 genomes occupy — and stream — 1/4 the bytes of f32), while the
+O(pop)-small per-row tensors (fitness values, validity) stay whole.
+Only one genome *slice* ever lives in device memory at a time; the
+:class:`~deap_tpu.bigpop.engine.StreamedEngine` moves slices through
+HBM with a prefetch/compute/drain pipeline.
+
+The store is the shared mutable state of a streamed serve session (the
+dispatcher thread writes generation results while client threads read
+``population()`` snapshots), so every row/fitness mutation happens under
+a sanitizer-factory lock with a declared ``_GUARDED_BY`` contract — the
+same static/runtime race discipline as the serve fleet.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import sanitize
+from ..base import Fitness, Population
+from ..ops.generation_pallas import GenomeStorage, storage_of
+
+__all__ = ["HostPopulation", "DEFAULT_CHUNK_ROWS"]
+
+#: default rows per host chunk — large enough that chunk crossings are
+#: rare at default slice sizes, small enough that a chunk is an
+#: allocator-friendly unit (64Mi f32 genes at dim=100)
+DEFAULT_CHUNK_ROWS = 1 << 16
+
+
+class HostPopulation:
+    """Chunked host store of one population: genome rows in storage
+    dtype, fitness values/valid whole (they are small even at 10⁸ rows).
+
+    ``weights`` is the objective-weights tuple; ``storage`` the genome
+    residency declaration (``None`` → f32).  All row indices are in the
+    single flat ``[0, size)`` space — chunking is a storage detail.
+    """
+
+    _GUARDED_BY = {"_lock": ("_chunks", "values", "valid")}
+
+    def __init__(self, chunks, values, valid, weights: tuple, *,
+                 storage: Optional[GenomeStorage] = None,
+                 chunk_rows: int = DEFAULT_CHUNK_ROWS):
+        # np.asarray over a jax array yields a read-only buffer view;
+        # the store must own writable rows (set_rows is the drain path)
+        self._chunks = [c if isinstance(c, np.ndarray) and c.flags.writeable
+                        else np.array(c) for c in chunks]
+        self.values = np.asarray(values, np.float32)
+        self.valid = np.asarray(valid, bool)
+        self.weights = tuple(weights)
+        self.storage = storage or GenomeStorage()
+        self.chunk_rows = int(chunk_rows)
+        self._lock = sanitize.lock()
+        if any(len(c) != self.chunk_rows for c in self._chunks[:-1]):
+            raise ValueError("all chunks but the last must hold exactly "
+                             f"chunk_rows={self.chunk_rows} rows")
+        if sum(len(c) for c in self._chunks) != len(self.values):
+            raise ValueError("genome rows and fitness rows disagree")
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_population(cls, population: Population, toolbox=None, *,
+                        storage: Optional[GenomeStorage] = None,
+                        chunk_rows: int = DEFAULT_CHUNK_ROWS
+                        ) -> "HostPopulation":
+        """Host-materialize a device :class:`Population` (genome must be
+        a single 2-D array leaf, already in storage dtype)."""
+        g = population.genome
+        if not hasattr(g, "shape") or g.ndim != 2:
+            raise ValueError("HostPopulation needs a single 2-D array "
+                             "genome (pop, dim)")
+        if storage is None and toolbox is not None:
+            storage = storage_of(toolbox)
+        g = np.asarray(g)
+        chunks = [g[i:i + chunk_rows] for i in range(0, len(g), chunk_rows)] \
+            or [g]
+        return cls(chunks, np.asarray(population.fitness.values),
+                   np.asarray(population.fitness.valid),
+                   population.fitness.weights, storage=storage,
+                   chunk_rows=chunk_rows)
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        with self._lock:
+            return len(self.values)
+
+    @property
+    def dim(self) -> int:
+        with self._lock:
+            return self._chunks[0].shape[1]
+
+    @property
+    def genome_dtype(self) -> np.dtype:
+        with self._lock:
+            return self._chunks[0].dtype
+
+    @property
+    def genome_nbytes(self) -> int:
+        with self._lock:
+            return sum(c.nbytes for c in self._chunks)
+
+    def fitness_arrays(self):
+        """Snapshot (values, valid) — the device-resident table the
+        streamed selection plan consumes."""
+        with self._lock:
+            return self.values.copy(), self.valid.copy()
+
+    # -- row access ----------------------------------------------------------
+
+    def rows(self, lo: int, hi: int) -> np.ndarray:
+        """Contiguous genome rows ``[lo, hi)`` (copy)."""
+        with self._lock:
+            return self._rows_locked(lo, hi)
+
+    def _rows_locked(self, lo: int, hi: int) -> np.ndarray:
+        R = self.chunk_rows
+        c0, c1 = lo // R, (hi - 1) // R
+        if c0 == c1:
+            return self._chunks[c0][lo - c0 * R:hi - c0 * R].copy()
+        parts = []
+        for c in range(c0, c1 + 1):
+            a = max(lo, c * R) - c * R
+            b = min(hi, c * R + len(self._chunks[c])) - c * R
+            parts.append(self._chunks[c][a:b])
+        return np.concatenate(parts, axis=0)
+
+    def gather(self, idx: np.ndarray) -> np.ndarray:
+        """Genome rows at ``idx`` (any order, repeats allowed) — the
+        host half of the streamed parent gather."""
+        idx = np.asarray(idx)
+        with self._lock:
+            if len(self._chunks) == 1:
+                return self._chunks[0][idx]
+            # plain Lock held: read shape/dtype off the chunk directly,
+            # not via the self-locking properties
+            out = np.empty((len(idx), self._chunks[0].shape[1]),
+                           self._chunks[0].dtype)
+            R = self.chunk_rows
+            cid = idx // R
+            for c, chunk in enumerate(self._chunks):
+                m = cid == c
+                if m.any():
+                    out[m] = chunk[idx[m] - c * R]
+            return out
+
+    # -- mutation (engine/driver only) ---------------------------------------
+
+    def set_rows(self, lo: int, rows: np.ndarray) -> None:
+        """Overwrite genome rows ``[lo, lo+len(rows))``."""
+        with self._lock:
+            R = self.chunk_rows
+            off = 0
+            while off < len(rows):
+                c = (lo + off) // R
+                a = (lo + off) - c * R
+                n = min(len(self._chunks[c]) - a, len(rows) - off)
+                self._chunks[c][a:a + n] = rows[off:off + n]
+                off += n
+
+    def set_fitness(self, values: np.ndarray, valid: np.ndarray) -> None:
+        with self._lock:
+            self.values = np.asarray(values, np.float32)
+            self.valid = np.asarray(valid, bool)
+
+    def swap_genome(self, chunks) -> None:
+        """Adopt a fully-built next-generation chunk list (the engine's
+        double-buffered child store)."""
+        chunks = [np.asarray(c) for c in chunks]
+        if sum(len(c) for c in chunks) != self.size:
+            raise ValueError("replacement chunk list has wrong row count")
+        with self._lock:
+            self._chunks = chunks
+
+    def clone_chunks(self):
+        """Deep copy of the genome chunk list (checkpoint snapshots)."""
+        with self._lock:
+            return [c.copy() for c in self._chunks]
+
+    # -- materialization -----------------------------------------------------
+
+    def to_population(self) -> Population:
+        """Device-materialize the whole store (test/interop scale only:
+        this is the O(pop) residency the engine otherwise avoids)."""
+        with self._lock:
+            g = np.concatenate(self._chunks, axis=0) \
+                if len(self._chunks) > 1 else self._chunks[0]
+            return Population(
+                jnp.asarray(g),
+                Fitness(values=jnp.asarray(self.values),
+                        valid=jnp.asarray(self.valid),
+                        weights=self.weights))

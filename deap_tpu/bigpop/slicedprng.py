@@ -1,0 +1,123 @@
+"""Slice-exact regeneration of threefry draw batches.
+
+The out-of-core engine's bitwise oracle (a streamed run at pop=N must
+equal a resident run at pop=N) hinges on one primitive: the resident
+variation path draws its genome-sized randomness — the ``mut_gaussian``
+Bernoulli mask and normal noise, ``(pop, dim)`` each — from ONE key via
+``jax.random``, and a streamed slice must reproduce *rows a..b of that
+exact batch* without ever materializing the ``(pop, dim)`` draw.
+
+That is possible because threefry is counter-based.  For a 32-bit draw
+of ``total`` elements, :func:`jax.random.uniform` (and everything built
+on it) generates ``bits[i]`` by splitting the flat counter range
+``[0, total)`` into two halves and applying the ``threefry2x32`` block
+cipher lane-wise to counter *pairs*::
+
+    half = (total + total % 2) // 2          # odd sizes pad one counter 0
+    (out1[t], out2[t]) = threefry2x32(key, (t, half + t))   t < half
+    bits[i] = out1[i]         if i <  half
+    bits[i] = out2[i - half]  if i >= half
+
+so any index range regenerates in O(range) work and memory through the
+public :func:`jax.extend.random.threefry_2x32` — no private jax API, no
+whole-batch draw.  The float conversions below mirror
+``jax._src.random`` bit for bit (mantissa-stuffing uniform, erf_inv
+normal, ``u < p`` Bernoulli); ``tests/test_bigpop.py`` pins every one
+of them against the whole-batch ``jax.random`` draws, so a jax upgrade
+that changes the counter layout fails loudly instead of silently
+breaking the streamed/resident equivalence.
+
+This layout holds for the default ``threefry2x32`` PRNG with
+``jax_threefry_partitionable`` off — :func:`check_prng_compat` verifies
+both at engine-construction time.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+import jax.extend as jex
+
+__all__ = [
+    "check_prng_compat", "key_data", "sliced_bits", "sliced_uniform",
+    "sliced_normal", "sliced_bernoulli",
+]
+
+
+def check_prng_compat() -> None:
+    """Raise unless the runtime PRNG matches the counter layout this
+    module regenerates (default threefry2x32, non-partitionable)."""
+    impl = getattr(jax.random.key(0).dtype, "_impl", None)
+    name = getattr(impl, "name", "threefry2x32")
+    if name != "threefry2x32":
+        raise RuntimeError(
+            f"streamed generation requires the threefry2x32 PRNG "
+            f"(default); the active key implementation is {name!r}")
+    if jax.config.jax_threefry_partitionable:
+        raise RuntimeError(
+            "streamed generation requires jax_threefry_partitionable=False "
+            "(the partitionable layout derives bits from a different "
+            "counter scheme; slice regeneration would not be bitwise)")
+
+
+def key_data(key) -> jax.Array:
+    """Canonical ``uint32[2]`` data of a typed or raw PRNG key."""
+    key = jnp.asarray(key)
+    if jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key):
+        return jax.random.key_data(key)
+    return key.astype(jnp.uint32)
+
+
+def sliced_bits(kd: jax.Array, total: int, start, length: int) -> jax.Array:
+    """``bits[start:start+length]`` of the 32-bit draw
+    ``jax.random.bits(key, (total,))`` — ``total``/``length`` static,
+    ``start`` may be a traced scalar."""
+    odd = total % 2
+    half = (total + odd) // 2
+    i = jnp.asarray(start, jnp.uint32) + jnp.arange(length, dtype=jnp.uint32)
+    t = jnp.where(i < half, i, i - half)
+    c2 = half + t
+    # the odd-size pad lane draws counter 0, not `total`
+    c2 = jnp.where(c2 < total, c2, 0).astype(jnp.uint32)
+    out = jex.random.threefry_2x32(kd, jnp.concatenate([t, c2]))
+    o1, o2 = out[:length], out[length:]
+    return jnp.where(i < half, o1, o2)
+
+
+def _bits_to_uniform(bits: jax.Array, minval, maxval) -> jax.Array:
+    """The exact f32 mantissa-stuffing conversion of
+    ``jax._src.random._uniform``."""
+    fb = (bits >> jnp.uint32(9)) | jnp.uint32(0x3F800000)
+    f = lax.bitcast_convert_type(fb, jnp.float32) - np.float32(1.0)
+    minval = lax.convert_element_type(minval, jnp.float32)
+    maxval = lax.convert_element_type(maxval, jnp.float32)
+    return lax.max(minval, f * (maxval - minval) + minval)
+
+
+def sliced_uniform(kd, shape, row_start, rows: int,
+                   minval=0.0, maxval=1.0) -> jax.Array:
+    """Rows ``[row_start, row_start+rows)`` of
+    ``jax.random.uniform(key, shape, minval=..., maxval=...)`` for a 1-D
+    or 2-D ``shape`` (f32)."""
+    if len(shape) == 1:
+        bits = sliced_bits(kd, shape[0], row_start, rows)
+        return _bits_to_uniform(bits, minval, maxval)
+    n, dim = shape
+    bits = sliced_bits(kd, n * dim,
+                       jnp.asarray(row_start, jnp.uint32) * jnp.uint32(dim),
+                       rows * dim)
+    return _bits_to_uniform(bits, minval, maxval).reshape(rows, dim)
+
+
+def sliced_normal(kd, shape, row_start, rows: int) -> jax.Array:
+    """Rows of ``jax.random.normal(key, shape, float32)``."""
+    lo = np.nextafter(np.float32(-1.0), np.float32(0.0))
+    u = sliced_uniform(kd, shape, row_start, rows, minval=lo, maxval=1.0)
+    return np.array(np.sqrt(2), np.float32) * lax.erf_inv(u)
+
+
+def sliced_bernoulli(kd, p, shape, row_start, rows: int) -> jax.Array:
+    """Rows of ``jax.random.bernoulli(key, p, shape)``."""
+    return sliced_uniform(kd, shape, row_start, rows) < p

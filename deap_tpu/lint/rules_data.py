@@ -44,6 +44,7 @@ def bench_json_targets(repo: Path) -> List[Tuple[str, Path]]:
                 "BENCH_CHAOS.json": "chaos",
                 "BENCH_PROFILE.json": "profile",
                 "BENCH_MEGAKERNEL.json": "megakernel",
+                "BENCH_OOC.json": "ooc",
                 "BENCH_PROBE_GA.json": "probe_ga"}
     for p in sorted(repo.glob("BENCH_*.json")):
         out.append((_SPECIAL.get(p.name, "bench"), p))
@@ -257,6 +258,63 @@ def _schema_errors(kind: str, doc) -> List[str]:
                     and not (0.0 <= float(frac) <= 1.0):
                 errors.append("result.bf16_traffic_savings_frac must lie "
                               "in [0, 1] (a fraction of argument traffic)")
+    elif kind == "ooc":
+        # BENCH_OOC.json: the out-of-core crossover study from
+        # tools/bench_ooc.py — resident-vs-streamed gens/sec across a
+        # population sweep.  The committed artifact doubles as the
+        # bitwise proof: a streamed generation at pop=N must equal the
+        # resident generation at pop=N bit for bit, so
+        # ``bitwise_identical`` anything but true must not be committed
+        require("cmd", str, "a string")
+        res = doc.get("result")
+        if not isinstance(res, dict):
+            errors.append("key 'result' must be an object")
+        else:
+            legs = res.get("legs")
+            if not isinstance(legs, list) or not legs:
+                errors.append("result.legs must be a non-empty list of "
+                              "per-population legs")
+            else:
+                for i, leg in enumerate(legs):
+                    if not isinstance(leg, dict):
+                        errors.append(f"result.legs[{i}] must be an object")
+                        continue
+                    pop = leg.get("pop")
+                    if isinstance(pop, bool) or not isinstance(pop, int) \
+                            or pop < 1:
+                        errors.append(f"result.legs[{i}].pop must be a "
+                                      "positive integer")
+                    sg = leg.get("streamed_gens_per_sec")
+                    if isinstance(sg, bool) \
+                            or not isinstance(sg, (int, float)) \
+                            or not math.isfinite(float(sg)) or sg <= 0:
+                        errors.append(f"result.legs[{i}]."
+                                      "streamed_gens_per_sec must be a "
+                                      "finite positive number")
+                    rg = leg.get("resident_gens_per_sec")
+                    if rg is not None and (
+                            isinstance(rg, bool)
+                            or not isinstance(rg, (int, float))
+                            or not math.isfinite(float(rg)) or rg <= 0):
+                        errors.append(f"result.legs[{i}]."
+                                      "resident_gens_per_sec must be a "
+                                      "finite positive number, or null "
+                                      "when the resident run does not "
+                                      "fit device memory")
+            if res.get("bitwise_identical") is not True:
+                errors.append("result.bitwise_identical must be true -- "
+                              "the committed artifact is the "
+                              "streamed==resident proof; anything else "
+                              "means a streamed generation diverged and "
+                              "must not be committed")
+            xover = res.get("crossover_pop")
+            if xover is not None and (isinstance(xover, bool)
+                                      or not isinstance(xover, int)
+                                      or xover < 1):
+                errors.append("result.crossover_pop must be a positive "
+                              "integer (smallest benched pop where "
+                              "streamed beats resident) or null when "
+                              "streamed never wins on this host")
     elif kind == "probe_ga":
         # BENCH_PROBE_GA.json: the committed stage-budget report from
         # tools/pallas_probe_ga.py --json — per-probe marginal walls +

@@ -31,7 +31,7 @@ __all__ = ["FACTORY_SCOPE_PREFIXES", "FACTORY_SCOPE_MODULES",
 
 #: repo-relative prefixes/modules whose lock construction must route
 #: through deap_tpu.sanitize — the sanitizer's instrumented surface
-FACTORY_SCOPE_PREFIXES = ("deap_tpu/serve/",)
+FACTORY_SCOPE_PREFIXES = ("deap_tpu/serve/", "deap_tpu/bigpop/")
 FACTORY_SCOPE_MODULES = ("deap_tpu/observability/fleettrace.py",)
 
 #: serve subpackages the scope walk must find modules under (the same

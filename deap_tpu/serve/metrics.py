@@ -45,7 +45,8 @@ SERVE_COUNTERS = (
     "requests", "completed", "failed", "cancelled", "deadline_misses",
     "rejected", "batches", "retries", "compiles", "compiles_step",
     "compiles_init", "compiles_ask", "compiles_tell", "compiles_evaluate",
-    "steps", "steps_sharded", "evaluations", "cache_hits", "cache_misses",
+    "steps", "steps_sharded", "steps_streamed", "evaluations",
+    "cache_hits", "cache_misses",
     "cache_evictions", "cache_nan_skipped", "cache_purged", "dedup_rows",
     "quarantined", "rebuckets", "rebuckets_auto", "rebucket_policy_errors",
     "deadline_shed", "brownout_sheds",
@@ -88,8 +89,8 @@ ROUTER_GAUGES = (
 #: ``meta["programs"]`` table and the labelled Prometheus series — a
 #: program key must never become part of a metric NAME).
 SERVE_GAUGES = (
-    "queue_depth", "sessions", "sharded_sessions", "slot_occupancy",
-    "row_occupancy", "pad_waste",
+    "queue_depth", "sessions", "sharded_sessions", "sessions_streamed",
+    "slot_occupancy", "row_occupancy", "pad_waste",
     "profile_programs", "profile_flops_total",
     "profile_bytes_accessed_total", "profile_peak_bytes_max",
 )

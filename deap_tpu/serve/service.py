@@ -168,7 +168,8 @@ class Session:
     def __init__(self, service: "EvolutionService", name: str, toolbox,
                  bucket: BucketKey, state: Dict[str, jax.Array],
                  gen: int = 0, phase: str = "idle", pending=None,
-                 sharded: bool = False, priority: int = 1):
+                 sharded: bool = False, streamed: bool = False,
+                 priority: int = 1):
         self._service = service
         self.name = name
         self.toolbox = toolbox
@@ -187,6 +188,10 @@ class Session:
         #: population placed pop-axis-sharded over the service mesh and
         #: stepped by a dedicated whole-mesh program (no slot-packing)
         self.sharded = bool(sharded)
+        #: generation dispatched through the out-of-core streamed engine
+        #: (:mod:`deap_tpu.bigpop`): host-driven sliced pipeline, no
+        #: compiled slot program, capacity-1 dispatch like sharded
+        self.streamed = bool(streamed)
         #: objects pinned on this session's behalf (toolbox, evaluators) —
         #: captured at open/adopt time, released exactly once at close, so
         #: re-registering toolbox attributes mid-run can never skew the
@@ -484,6 +489,9 @@ class EvolutionService:
         self.metrics.set_gauge(
             "sharded_sessions",
             sum(1 for s in live.values() if s.sharded))
+        self.metrics.set_gauge(
+            "sessions_streamed",
+            sum(1 for s in live.values() if s.streamed))
         self.metrics.set_gauge("pad_waste", pad_waste_of(self))
         # always written: after a live `profiler.enabled = False` the
         # gauges must read zero, not freeze at the last enabled-state
@@ -621,7 +629,9 @@ class EvolutionService:
         if self._draining:
             raise ServiceDraining("service is draining for failover")
         bucket = self.policy.bucket_for(population)
-        sharded = (self.shard_threshold is not None
+        streamed = getattr(toolbox, "generation_engine", "xla") == "streamed"
+        sharded = (not streamed
+                   and self.shard_threshold is not None
                    and population.size >= self.shard_threshold)
         if sharded:
             bucket = dataclasses.replace(
@@ -655,7 +665,7 @@ class EvolutionService:
                     pending = self._place_sharded(pending, bucket.rows)
             session = Session(self, name, toolbox, bucket, state, gen=gen,
                               phase=phase, pending=pending, sharded=sharded,
-                              priority=priority)
+                              streamed=streamed, priority=priority)
             session._pins = [toolbox]
             evaluate = getattr(toolbox, "evaluate", None)
             if evaluate is not None:
@@ -820,11 +830,16 @@ class EvolutionService:
             raise ServiceDraining("service is draining for failover")
         if session.closed:
             raise ServiceClosed(f"session {session.name!r} is closed")
-        if session.sharded:
+        if session.streamed:
+            # a streamed session's generation runs the host-driven sliced
+            # pipeline — nothing to co-batch, dispatch one at a time
+            program_key: tuple = ("streamed", id(session.toolbox),
+                                  session.bucket)
+            capacity = 1
+        elif session.sharded:
             # a sharded session owns the whole mesh for its dispatch: its
             # program is not vmapped over slots, so it never co-batches
-            program_key: tuple = ("sharded", id(session.toolbox),
-                                  session.bucket)
+            program_key = ("sharded", id(session.toolbox), session.bucket)
             capacity = 1
         else:
             program_key = (id(session.toolbox), session.bucket)
@@ -942,11 +957,15 @@ class EvolutionService:
         healed = self._heal_stale_keys(program_key, requests)
         if healed is not None:
             return healed
+        if program_key and program_key[0] == "streamed":
+            return self._exec_streamed(kind, program_key, requests)
         if program_key and program_key[0] == "sharded":
             return self._exec_sharded(kind, program_key, requests)
         return self._exec_slots(kind, program_key, requests)
 
     def _current_key(self, session: Session) -> tuple:
+        if session.streamed:
+            return ("streamed", id(session.toolbox), session.bucket)
         if session.sharded:
             return ("sharded", id(session.toolbox), session.bucket)
         return (id(session.toolbox), session.bucket)
@@ -968,7 +987,10 @@ class EvolutionService:
         out: Dict[int, Any] = {}
         for cur, reqs in groups.items():
             kind = reqs[0].kind
-            if cur[0] == "sharded":
+            if cur[0] == "streamed":
+                # streamed dispatch is strictly one request at a time
+                sub = [self._exec_streamed(kind, cur, [r])[0] for r in reqs]
+            elif cur[0] == "sharded":
                 # sharded dispatch is strictly one request at a time
                 sub = [self._exec_sharded(kind, cur, [r])[0] for r in reqs]
             else:
@@ -1037,6 +1059,91 @@ class EvolutionService:
                               attrs={"rows": rows, "sharded": True})
             self.tracer.phase("device_execute", req.trace, t_dev0, t_dev1,
                               attrs={"kind": kind, **(prof_attrs or {})})
+        self._maybe_emit_stats()
+        return results
+
+    def _exec_streamed(self, kind: str, program_key: tuple,
+                       requests: List[Request]) -> list:
+        """Dispatch one streamed (out-of-core) session's request through
+        the host-driven sliced pipeline (:mod:`deap_tpu.bigpop`).  There
+        is no compiled slot program — the engine's own plan/slice
+        programs keep device genome residency O(slice) — so the
+        ``compiles*`` counters never move here; ``steps_streamed``
+        counts the generations instead.  Capacity 1: ``requests`` is
+        always a single request, like the sharded path."""
+        from ..algorithms import ea_tell
+        from ..bigpop.engine import (StreamedEngine, streamed_ea_ask,
+                                     streamed_ea_step)
+        from ..bigpop.host import HostPopulation
+        [req] = requests
+        s = req.session
+        state = s._state
+        weights = s.bucket.weights
+        rows = s.bucket.rows
+        live = np.arange(rows) < int(np.asarray(state["live_n"]))
+        pop = Population(state["genome"],
+                         Fitness(values=state["values"],
+                                 valid=state["valid"], weights=weights))
+        t_dev0 = self._clock()
+        if kind == "step":
+            key, out, nevals = streamed_ea_step(
+                state["key"], pop, s.toolbox, state["cxpb"],
+                state["mutpb"], live=live)
+            s._state = {**state, "key": _as_raw_key(key),
+                        "genome": out.genome,
+                        "values": out.fitness.values,
+                        "valid": out.fitness.valid}
+            s.gen += 1
+            self.metrics.inc("steps")
+            self.metrics.inc("steps_streamed")
+            self.metrics.inc_tenant(s.name, "steps")
+            results = [{"gen": s.gen, "nevals": int(np.asarray(nevals))}]
+        elif kind == "init":
+            host = HostPopulation.from_population(pop, s.toolbox)
+            eng = StreamedEngine(s.toolbox, host)
+            nevals = eng.evaluate_initial(live_n=int(live.sum()))
+            out = host.to_population()
+            s._state = {**state, "values": out.fitness.values,
+                        "valid": out.fitness.valid}
+            results = [{"gen": s.gen, "nevals": int(nevals)}]
+        elif kind == "ask":
+            key, off = streamed_ea_ask(
+                state["key"], pop, s.toolbox, state["cxpb"],
+                state["mutpb"], live=live)
+            s._state = {**state, "key": _as_raw_key(key)}
+            s._pending = (off.genome, off.fitness.values, off.fitness.valid)
+            results = [_host(unpad_rows(off.genome, s.pop_size))]
+        elif kind == "tell":
+            if s._pending is None:
+                raise ServeError(
+                    f"session {s.name!r} has no pending offspring (its "
+                    "ask() may have failed) — re-ask before telling")
+            pg, pv, pvalid = s._pending
+            vals = self._pad_values(req.payload["values"], rows,
+                                    s.bucket.nobj)
+            # with externally computed values the tell half is O(pop)-small
+            # fitness math — no genome-sized compute, resident ea_tell is
+            # exact here
+            out, nevals = ea_tell(
+                s.toolbox, Population(pg, Fitness(pv, pvalid, weights)),
+                vals, live=jnp.asarray(live))
+            s._state = {**state, "genome": out.genome,
+                        "values": out.fitness.values,
+                        "valid": out.fitness.valid}
+            with s._phase_lock:
+                s._pending = None
+                s.phase = "idle"
+            s.gen += 1
+            results = [{"gen": s.gen, "nevals": int(np.asarray(nevals))}]
+        else:
+            raise ServeError(f"unknown streamed request kind {kind!r}")
+        t_dev1 = self._clock()
+        prof_attrs = self.profiler.observe_execute(kind, program_key,
+                                                   t_dev1 - t_dev0)
+        if req.trace is not None and self.tracer.enabled:
+            self.tracer.phase("device_execute", req.trace, t_dev0, t_dev1,
+                              attrs={"kind": kind, "streamed": True,
+                                     **(prof_attrs or {})})
         self._maybe_emit_stats()
         return results
 

@@ -82,6 +82,9 @@ PAGES = {
                "deap_tpu.serve.buckets", "deap_tpu.serve.cache",
                "deap_tpu.serve.metrics", "deap_tpu.serve.rebucket",
                "deap_tpu.serve.top"]),
+    "bigpop": ("Out-of-core streamed evolution (deap_tpu.bigpop)",
+               ["deap_tpu.bigpop.host", "deap_tpu.bigpop.engine",
+                "deap_tpu.bigpop.slicedprng", "deap_tpu.bigpop.runner"]),
     "perf": ("Perf-regression ledger (deap_tpu.perfledger)",
              ["deap_tpu.perfledger"]),
     "serve_net": ("Network frontend (deap_tpu.serve.net)",

@@ -28,6 +28,7 @@ import http.client
 import json
 import socket
 import threading
+import time
 
 import numpy as np
 import jax
@@ -164,9 +165,19 @@ def test_loopback_step_produces_complete_span_tree():
                         if s["name"].endswith("/step")]
         assert len(client_steps) == 1
         tid = client_steps[0]["trace_id"]
-        tail = cli.trace_tail(trace_id=tid)
-        assert tail["enabled"] is True
-        spans = tail["spans"]
+        # the server records the http.* request span in the handler's
+        # finally -- AFTER the response bytes are on the wire -- so on a
+        # loaded host the client can tail the ring before it lands; poll
+        # until the request span is visible (bounded, normally instant)
+        deadline = time.monotonic() + 10.0
+        while True:
+            tail = cli.trace_tail(trace_id=tid)
+            assert tail["enabled"] is True
+            spans = tail["spans"]
+            if any(s["name"].startswith("http.") for s in spans) \
+                    or time.monotonic() >= deadline:
+                break
+            time.sleep(0.05)
         assert spans and all(s["trace_id"] == tid for s in spans)
 
         # request span: child of the client hop, covers everything

@@ -27,6 +27,11 @@ Pallas probes (what the hardware does when we schedule it):
   dmagather     per-row make_async_copy gathers from an HBM-resident
                 genome (the in-kernel form of `grow`), W copies in flight
 
+Host-link probes (what the out-of-core engine streams over):
+  hoststream    slice-sized host->device uploads and device->host drains
+                (the bigpop pipeline's DMA legs, f32 and int8 storage)
+                vs a device-resident row gather of the same traffic
+
 Timing: every probe runs its op k and 2k times inside one jitted
 ``lax.scan`` with a data dependence between iterations (no CSE/hoisting),
 reports the marginal (t2k - tk)/k, and carries the t2k/tk linearity ratio
@@ -500,6 +505,70 @@ def probe_dmagather(rows=512, window=16):
            eff_gbps=round(POP * LANE * 4 * 2 / sec / 1e9, 1))
 
 
+def probe_hoststream(rows=8192):
+    """Host-pinned-buffer streaming legs of the out-of-core engine
+    (deap_tpu/bigpop): slice-sized host->device uploads and
+    device->host drains — the DMA legs the streamed pipeline overlaps
+    with compute — against a device-resident row gather moving the same
+    traffic.  Runs both storage dtypes, so the artifact shows the 4x
+    byte advantage an int8 ``GenomeStorage`` store streams at."""
+
+    def timed(fn, k=4):
+        fn()                                      # warm (alloc, paths)
+        t0 = time.perf_counter()
+        for _ in range(k):
+            fn()
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(2 * k):
+            fn()
+        t2 = time.perf_counter() - t0
+        marginal.last_walls = (t1, t2, k)
+        return (t2 - t1) / k, t2 / t1
+
+    rng = np.random.default_rng(0)
+    for tag, make_host in (
+            ("f32", lambda: rng.random((POP, LANE), np.float32)),
+            ("int8", lambda: rng.integers(-127, 128, (POP, LANE),
+                                          np.int8))):
+        host = make_host()
+        gb = host.nbytes / 1e9                    # one full-pop pass
+
+        def pass_h2d(host=host):
+            last = None
+            for a in range(0, POP, rows):
+                last = jax.device_put(host[a:a + rows])
+            return np.asarray(last[-1:, -1:])     # force completion
+
+        sec, r = timed(pass_h2d)
+        report(f"hoststream_h2d_{tag}_rows{rows}", sec, r,
+               eff_gbps=round(gb / sec, 1))
+
+        dev = jnp.asarray(host)
+
+        def pass_d2h(dev=dev):
+            out = None
+            for a in range(0, POP, rows):
+                out = np.asarray(dev[a:a + rows])
+            return out
+
+        sec, r = timed(pass_d2h)
+        report(f"hoststream_d2h_{tag}_rows{rows}", sec, r,
+               eff_gbps=round(gb / sec, 1))
+
+        # device-resident comparison: the gather the resident engine
+        # does instead of streaming (reads + writes one pop of rows)
+        idx = jnp.asarray(rng.integers(0, POP, POP).astype(np.int32))
+        gather = jax.jit(lambda g, p: g[p])
+
+        def pass_gather(dev=dev, idx=idx, gather=gather):
+            return np.asarray(gather(dev, idx)[-1:, -1:])
+
+        sec, r = timed(pass_gather)
+        report(f"hoststream_devgather_{tag}", sec, r,
+               eff_gbps=round(gb * 2 / sec, 1))
+
+
 PROBES = {
     "sort": probe_sort,
     "gidx": probe_gidx,
@@ -511,6 +580,7 @@ PROBES = {
     "rast": probe_rast,
     "lookup": probe_lookup,
     "dmagather": probe_dmagather,
+    "hoststream": probe_hoststream,
 }
 
 
