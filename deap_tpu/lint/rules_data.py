@@ -430,6 +430,27 @@ def _schema_errors(kind: str, doc) -> List[str]:
                     or not math.isfinite(float(v)) or v < 0:
                 errors.append(f"key '{key}' must be a finite non-negative "
                               "number")
+        # the elastic leg (tools/bench_fleet.py --elastic): autoscaler
+        # scale-out/in walls, live-migration downtime and the
+        # fleet-rebalance wall — the perfgate rows fleet_migration_s /
+        # fleet_rebalance_s read these paths, so they must be present
+        # and finite in every committed artifact
+        elastic = doc.get("elastic")
+        if not isinstance(elastic, dict):
+            errors.append("key 'elastic' must be an object (run "
+                          "tools/bench_fleet.py with --elastic)")
+        else:
+            for key in ("scale_out_s", "migration_downtime_s",
+                        "rebalance_s", "scale_in_s"):
+                v = elastic.get(key)
+                if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                        or not math.isfinite(float(v)) or v < 0:
+                    errors.append(f"elastic[{key!r}] must be a finite "
+                                  "non-negative number")
+            m = elastic.get("migrations")
+            if isinstance(m, bool) or not isinstance(m, int) or m < 1:
+                errors.append("elastic['migrations'] must be a positive "
+                              "integer")
     elif kind == "multichip":
         if not isinstance(doc.get("rc"), int) or isinstance(doc.get("rc"),
                                                             bool):

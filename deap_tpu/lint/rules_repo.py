@@ -80,7 +80,7 @@ def _check_bare_print(ctx: LintContext) -> Iterable[Finding]:
 
 #: subpackages of deap_tpu/serve/ the walk MUST find modules under — a
 #: rename/move fails the gate instead of silently shrinking its scope
-REQUIRED_SLEEP_SUBPACKAGES = ("net", "router")
+REQUIRED_SLEEP_SUBPACKAGES = ("net", "router", "autoscale")
 
 
 def _time_sleep_spellings(tree: ast.AST) -> Tuple[Set[str], Set[str]]:
@@ -478,6 +478,8 @@ METRIC_REGISTRY_TUPLES = {
     "ROUTER_COUNTERS": ("inc",),
     "SERVE_GAUGES": ("set_gauge",),
     "ROUTER_GAUGES": ("set_gauge",),
+    "AUTOSCALE_COUNTERS": ("inc",),
+    "AUTOSCALE_GAUGES": ("set_gauge",),
     "TENANT_COUNTERS": ("inc_tenant",),
 }
 

@@ -36,7 +36,7 @@ FACTORY_SCOPE_MODULES = ("deap_tpu/observability/fleettrace.py",)
 
 #: serve subpackages the scope walk must find modules under (the same
 #: lost-coverage contract as no-blocking-sleep's REQUIRED_SUBPACKAGES)
-REQUIRED_FACTORY_SUBPACKAGES = ("net", "router")
+REQUIRED_FACTORY_SUBPACKAGES = ("net", "router", "autoscale")
 
 #: threading constructors the factory replaces (Event carries no mutual
 #: exclusion to check and stays stdlib)

@@ -239,8 +239,11 @@ class FleetTracer:
 
     #: lock-guarded shared state (``lock-discipline`` lint + runtime
     #: sanitizer): the span ring and dump rate-limit state are shared
-    #: between every recording thread and the trace-tail reader
-    _GUARDED_BY = {"_lock": ("_ring", "_dropped", "_last_dump")}
+    #: between every recording thread and the trace-tail reader.  The
+    #: guard is a Condition so :meth:`wait_for_span` can block on span
+    #: arrival instead of polling the tail (no-blocking-sleep
+    #: discipline); :meth:`record` notifies under the same lock.
+    _GUARDED_BY = {"_cv": ("_ring", "_dropped", "_last_dump")}
 
     def __init__(self, *, capacity: int = 2048, enabled: bool = True,
                  sinks=(), clock=time.monotonic,
@@ -251,7 +254,7 @@ class FleetTracer:
         self.clock = clock
         self.sinks = list(sinks)
         self.dump_min_interval_s = float(dump_min_interval_s)
-        self._lock = sanitize.lock()
+        self._cv = sanitize.condition()
         self._ring: "deque[SpanRecord]" = deque(maxlen=int(capacity))
         self._dropped = 0
         self._last_dump: Optional[float] = None
@@ -286,10 +289,11 @@ class FleetTracer:
             return None
         rec = SpanRecord(ctx.trace_id, ctx.span_id, ctx.parent_id,
                          name, float(t0), float(t1), dict(attrs or {}))
-        with self._lock:
+        with self._cv:
             if len(self._ring) == self._ring.maxlen:
                 self._dropped += 1
             self._ring.append(rec)
+            self._cv.notify_all()
         return rec
 
     def phase(self, name: str, parent: Optional[TraceContext],
@@ -325,7 +329,7 @@ class FleetTracer:
                trace_id: Optional[str] = None) -> List[dict]:
         """The most recent ``n`` span dicts (oldest first), optionally
         restricted to one trace."""
-        with self._lock:
+        with self._cv:
             spans = list(self._ring)
         if trace_id is not None:
             spans = [s for s in spans if s.trace_id == trace_id]
@@ -337,12 +341,31 @@ class FleetTracer:
     @property
     def dropped(self) -> int:
         """Spans that fell off the ring since construction."""
-        with self._lock:
+        with self._cv:
             return self._dropped
 
     def clear(self) -> None:
-        with self._lock:
+        with self._cv:
             self._ring.clear()
+
+    def wait_for_span(self, prefix: str, *,
+                      trace_id: Optional[str] = None,
+                      timeout: Optional[float] = None) -> bool:
+        """Block until the ring holds a span whose name starts with
+        ``prefix`` (optionally within one trace); True when one is
+        present, False on timeout.  A Condition wait on the recording
+        lock, not a poll — the test tail that previously bounded-polled
+        :meth:`recent` waits here instead (no-blocking-sleep
+        discipline).  Note the ring is bounded: the predicate scans what
+        is CURRENTLY buffered, so wait for spans the tail could still
+        hold."""
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: any(
+                    s.name.startswith(prefix)
+                    and (trace_id is None or s.trace_id == trace_id)
+                    for s in self._ring),
+                timeout=timeout)
 
     def dump(self, reason: str, sinks=None, *,
              force: bool = False) -> List[dict]:
@@ -354,7 +377,7 @@ class FleetTracer:
         if not self.enabled:
             return []
         now = self.clock()
-        with self._lock:
+        with self._cv:
             if (not force and self._last_dump is not None
                     and now - self._last_dump < self.dump_min_interval_s):
                 return []

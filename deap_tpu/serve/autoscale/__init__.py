@@ -1,0 +1,46 @@
+"""Elastic fleet: autoscaler, live session migration, cache fabric.
+
+The router tier (:mod:`deap_tpu.serve.router`) made a *static* set of
+instances one fault-tolerant fleet; this package makes the set
+**elastic** — capacity follows load, sessions follow capacity, and
+cache hits follow sessions:
+
+* :mod:`~deap_tpu.serve.autoscale.policy` —
+  :class:`FleetSignals` / :class:`AutoscalePolicy`: the pure decision
+  model (thresholds, min/max bounds) with hysteresis and cooldown kept
+  in the controller;
+* :mod:`~deap_tpu.serve.autoscale.controller` —
+  :class:`Autoscaler`: the Event-wait control loop sampling fleet
+  telemetry (queue depth, pad waste, sheds, roofline ``phase_split``)
+  and actuating spawn/drain through an injected
+  :class:`InstanceProvider`.  Scale-out instances are **predictively
+  pre-warmed** with the fleet-merged bucket grid before any traffic
+  routes to them;
+* :mod:`~deap_tpu.serve.autoscale.migrate` —
+  :func:`migrate_session`: live per-session migration — quiesce one
+  session at a dispatch boundary, snapshot, restore on a
+  bucket-affine target, atomically rewrite the route, leave a
+  single-session 307 redirect.  The migrated trajectory is
+  bitwise-equal to the one an undisturbed session would produce;
+* :mod:`~deap_tpu.serve.autoscale.fabric` —
+  :class:`CacheFabric`: bounded digest-exchange gossip sharing
+  content-addressed :class:`~deap_tpu.serve.cache.FitnessCache` hits
+  across instances over the ordinary DTF1 wire.
+
+Everything here composes wire surfaces the fleet already exposes
+(drain/restore, ``/v1/metrics``, ``/v1/profile``, ``/v1/admin/*``) —
+the package adds no new protocol, only the control loops above it.
+"""
+
+from .controller import (Autoscaler, CallbackProvider,  # noqa: F401
+                         InstanceProvider)
+from .fabric import CacheFabric  # noqa: F401
+from .migrate import MigrationError, migrate_session  # noqa: F401
+from .policy import AutoscalePolicy, FleetSignals  # noqa: F401
+
+__all__ = [
+    "Autoscaler", "InstanceProvider", "CallbackProvider",
+    "AutoscalePolicy", "FleetSignals",
+    "migrate_session", "MigrationError",
+    "CacheFabric",
+]

@@ -1,0 +1,304 @@
+"""The autoscaler control loop — elastic capacity for one fleet.
+
+:class:`Autoscaler` closes the loop between the fleet's existing
+telemetry and its existing elasticity primitives:
+
+* **signals in** — each tick samples every live instance's
+  ``/v1/metrics`` (dispatcher ``queue_depth``, bucket ``pad_waste``,
+  overload/deadline shed counters) and ``/v1/profile`` (the roofline
+  ``phase_split`` compute fraction) into one
+  :class:`~deap_tpu.serve.autoscale.policy.FleetSignals` record;
+* **decisions** — the pure
+  :class:`~deap_tpu.serve.autoscale.policy.AutoscalePolicy` classifies
+  the sample; the controller supplies the temporal smoothing
+  (consecutive-tick streaks, post-event cooldown) so one noisy sample
+  never flaps the fleet;
+* **actuation out** — scale-out spawns an instance through the
+  injected :class:`InstanceProvider`, **pre-warms** it with the
+  fleet-merged bucket grid the router's placement layer already tracks
+  (``rebucket(sizes=...)`` — so the first session migrated or placed
+  onto it lands in a bucket compiled before its traffic arrives, zero
+  unplanned steady-state recompiles) and only then routes to it;
+  scale-in reuses PR 9's drain→restore failover to move every session
+  off the victim bitwise, then forgets and disposes it.
+
+The loop is an Event-wait (``stop.wait(interval)``) — the
+``no-blocking-sleep`` lint holds for this subpackage; stopping
+interrupts immediately.  Tests drive :meth:`tick` directly with
+``start()`` never called and an injected clock: the controller is then
+fully deterministic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Optional
+
+from ... import sanitize
+from ...observability.sinks import emit_text
+from ..dispatcher import ServeError
+from ..router.backend import Backend, BackendDown
+from .policy import AutoscalePolicy, FleetSignals
+
+__all__ = ["Autoscaler", "InstanceProvider", "CallbackProvider"]
+
+
+class InstanceProvider:
+    """Where instances come from and go to.  The autoscaler never
+    constructs servers itself — deployments inject a provider that
+    spawns a real process/container and returns a
+    :class:`~deap_tpu.serve.router.backend.Backend` handle; tests
+    inject in-process NetServers."""
+
+    def spawn(self) -> Backend:
+        raise NotImplementedError
+
+    def dispose(self, backend: Backend) -> None:
+        raise NotImplementedError
+
+
+class CallbackProvider(InstanceProvider):
+    """Adapter: two callables as a provider."""
+
+    def __init__(self, spawn: Callable[[], Backend],
+                 dispose: Callable[[Backend], None]):
+        self._spawn = spawn
+        self._dispose = dispose
+
+    def spawn(self) -> Backend:
+        return self._spawn()
+
+    def dispose(self, backend: Backend) -> None:
+        self._dispose(backend)
+
+
+class Autoscaler:
+    """Scale a :class:`~deap_tpu.serve.router.core.FleetRouter`'s
+    backend set between ``policy.min_instances`` and
+    ``policy.max_instances`` (see module docstring)."""
+
+    #: lock-guarded shared state (``lock-discipline`` lint): streak and
+    #: cooldown bookkeeping plus the last sample/decision, written by
+    #: the loop thread and read by ``describe()`` on handler threads
+    _GUARDED_BY = {"_lock": ("_streak_out", "_streak_in", "_last_event_t",
+                             "_last_signals", "_last_decision",
+                             "_shed_seen")}
+
+    def __init__(self, router, provider: InstanceProvider, *,
+                 policy: Optional[AutoscalePolicy] = None,
+                 fabric=None, clock=None, verbose: bool = False):
+        import time
+        self.router = router
+        self.provider = provider
+        self.policy = policy if policy is not None else AutoscalePolicy()
+        self.fabric = fabric
+        self.verbose = bool(verbose)
+        self._clock = clock if clock is not None else time.monotonic
+        self._lock = sanitize.lock()
+        self._stop = sanitize.event()
+        self._thread: Optional[threading.Thread] = None
+        self._streak_out = 0
+        self._streak_in = 0
+        self._last_event_t = float("-inf")
+        self._last_signals: Optional[FleetSignals] = None
+        self._last_decision = "hold"
+        self._shed_seen = 0
+        router.attach_autoscaler(self)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="deap-tpu-autoscaler", daemon=True)
+            self._thread.start()
+        if self.fabric is not None:
+            self.fabric.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        if self.fabric is not None:
+            self.fabric.stop()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.policy.interval_s):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — loop must survive
+                self.router.metrics.inc("autoscale_errors")
+                emit_text(f"[autoscale] tick failed: {e!r}",
+                          self.router.sinks)
+
+    # -- signals -------------------------------------------------------------
+
+    def sample(self) -> FleetSignals:
+        """One fleet-wide sample.  Shed counters are cumulative on the
+        instances; this converts them to a since-last-tick delta (the
+        one impure part of sampling — each call advances the
+        watermark)."""
+        backends = self.router.healthy()
+        qd: list = []
+        pw: list = []
+        busy: list = []
+        shed_total = 0
+        for b in backends:
+            try:
+                rec = b.metrics()
+            except (BackendDown, ServeError, OSError, ValueError):
+                continue
+            g = rec.get("gauges") or {}
+            c = rec.get("counters") or {}
+            qd.append(float(g.get("queue_depth", 0.0) or 0.0))
+            pw.append(float(g.get("pad_waste", 0.0) or 0.0))
+            for k in ("rejected", "deadline_shed", "brownout_sheds"):
+                shed_total += int(c.get(k, 0) or 0)
+            try:
+                prof = b.profile()
+            except (BackendDown, ServeError, OSError, ValueError):
+                prof = None
+            for p in ((prof or {}).get("programs") or {}).values():
+                frac = (p.get("phase_split") or {}).get("compute_frac")
+                if frac is not None:
+                    busy.append(float(frac))
+        sessions = int(self.router.stats().gauges.get(
+            "router_sessions_routed", 0))
+        with self._lock:
+            delta = max(0, shed_total - self._shed_seen)
+            self._shed_seen = shed_total
+        return FleetSignals(
+            instances=len(backends),
+            queue_depth=sum(qd) / len(qd) if qd else 0.0,
+            pad_waste=sum(pw) / len(pw) if pw else 0.0,
+            sessions=sessions,
+            shed_delta=delta,
+            device_busy_frac=max(busy) if busy else 0.0)
+
+    # -- the control loop body -----------------------------------------------
+
+    def tick(self) -> dict:
+        """One sample → classify → (maybe) act round.  Serialized by
+        construction: either the started loop thread calls this, or a
+        test driver does — never both."""
+        signals = self.sample()
+        decision = self.policy.classify(signals)
+        now = self._clock()
+        act = None
+        with self._lock:
+            self._last_signals = signals
+            self._last_decision = decision
+            if decision == "out":
+                self._streak_out += 1
+                self._streak_in = 0
+            elif decision == "in":
+                self._streak_in += 1
+                self._streak_out = 0
+            else:
+                self._streak_out = 0
+                self._streak_in = 0
+            cooling = (now - self._last_event_t) < self.policy.cooldown_s
+            if not cooling:
+                if decision == "out" \
+                        and self._streak_out >= self.policy.out_streak:
+                    act = "out"
+                    self._streak_out = 0
+                elif decision == "in" \
+                        and self._streak_in >= self.policy.in_streak:
+                    act = "in"
+                    self._streak_in = 0
+        self.router.metrics.set_gauge("autoscale_last_decision_queue_depth",
+                                      signals.queue_depth)
+        self.router.metrics.set_gauge("autoscale_instances",
+                                      signals.instances)
+        if act == "out":
+            self.scale_out()
+        elif act == "in":
+            self.scale_in()
+        return {"signals": signals.as_dict(), "decision": decision,
+                "acted": act}
+
+    # -- actuation -----------------------------------------------------------
+
+    def scale_out(self) -> Backend:
+        """Spawn, predictively pre-warm, then route: the instance joins
+        the fleet already carrying the fleet-merged bucket grid, so
+        nothing placed onto it recompiles in steady state."""
+        backend = self.provider.spawn()
+        grid = self.router.live_fleet_rows()
+        if grid:
+            try:
+                backend.rebucket(sizes=list(grid), warm=())
+                self.router.metrics.inc("autoscale_prewarms")
+            except (BackendDown, ServeError, OSError) as e:
+                # a cold instance still serves (it just compiles on
+                # first traffic) — pre-warm failure must not strand the
+                # spawned capacity outside the fleet
+                emit_text(f"[autoscale] pre-warm of {backend.name} "
+                          f"failed ({e}); joining cold",
+                          self.router.sinks)
+        self.router.add_backend(backend)
+        self.router.metrics.inc("autoscale_scale_out_events")
+        self._note_event()
+        self.router.metrics.set_gauge("autoscale_instances",
+                                      len(self.router.healthy()))
+        emit_text(f"[autoscale] scaled out: {backend.name}",
+                  self.router.sinks)
+        return backend
+
+    def scale_in(self) -> Optional[str]:
+        """Drain the least-loaded instance through the failover path
+        (sessions move bitwise to the survivors), then forget and
+        dispose it.  None when no instance can be removed."""
+        victim = self._pick_victim()
+        if victim is None:
+            return None
+        self.router.failover(victim, reason="scale-in")
+        self.router.remove_backend(victim.name)
+        if self.fabric is not None:
+            self.fabric.forget_backend(victim.name)
+        self.provider.dispose(victim)
+        self.router.metrics.inc("autoscale_scale_in_events")
+        self._note_event()
+        self.router.metrics.set_gauge("autoscale_instances",
+                                      len(self.router.healthy()))
+        emit_text(f"[autoscale] scaled in: {victim.name}",
+                  self.router.sinks)
+        return victim.name
+
+    def _pick_victim(self) -> Optional[Backend]:
+        healthy = self.router.healthy()
+        if len(healthy) <= self.policy.min_instances:
+            return None
+        topo = self.router.topology()["backends"]
+        load = {b.name: topo.get(b.name, {}).get("sessions", 0)
+                for b in healthy}
+        return min(healthy, key=lambda b: (load[b.name], b.name))
+
+    def _note_event(self) -> None:
+        with self._lock:
+            self._last_event_t = self._clock()
+
+    # -- introspection -------------------------------------------------------
+
+    def describe(self) -> dict:
+        """The ``autoscale`` section of
+        :meth:`FleetRouter.topology` — policy, streaks, cooldown and
+        the last sample."""
+        now = self._clock()
+        with self._lock:
+            remaining = self.policy.cooldown_s - (now - self._last_event_t)
+            return {
+                "policy": dataclasses.asdict(self.policy),
+                "running": self._thread is not None,
+                "decision": self._last_decision,
+                "streak_out": self._streak_out,
+                "streak_in": self._streak_in,
+                "cooldown_remaining_s": round(max(0.0, remaining), 3),
+                "signals": (None if self._last_signals is None
+                            else self._last_signals.as_dict()),
+            }

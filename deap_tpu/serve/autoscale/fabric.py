@@ -1,0 +1,135 @@
+"""Cross-instance fitness-cache fabric — bounded digest exchange.
+
+Every instance's :class:`~deap_tpu.serve.cache.FitnessCache` journals
+its *local* inserts under a portable content address
+(``"toolbox|signature"`` namespace string + blake2b row digest — see
+``FitnessCache.export_since``).  The fabric is a router-side gossip
+pump: each round it pulls the journal tail from every live instance
+(``POST /v1/admin/cache/export``, cursor round-tripped so a busy
+instance streams its backlog across rounds) and pushes the gathered
+entries to every *other* instance (``POST /v1/admin/cache/import``).
+
+Duplicate evaluations of an identical genome row on *different*
+instances then hit cache fleet-wide: imports land in a bounded
+side-table the receiving cache consults on a local miss
+(``cache_fabric_hits``), never evicting local entries and never
+re-journaled (no gossip echo).
+
+Everything rides the ordinary DTF1 wire — digests are raw bytes in the
+frame header's ``__bytes__`` envelope, fitness rows are plain float
+lists — so the fabric inherits TLS, compression negotiation and the
+typed error envelopes for free.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ... import sanitize
+from ...observability.sinks import emit_text
+from ..dispatcher import ServeError
+from ..router.backend import BackendDown
+
+__all__ = ["CacheFabric"]
+
+
+class CacheFabric:
+    """Periodic cache-journal exchange across a
+    :class:`~deap_tpu.serve.router.core.FleetRouter`'s live backends.
+
+    ``start()`` runs rounds on ``interval_s`` (Event-wait loop — the
+    stop signal interrupts immediately, there is no polling sleep);
+    tests and single-threaded drivers call :meth:`sync_now` directly
+    and never start the thread.
+    """
+
+    #: lock-guarded shared state (``lock-discipline`` lint): per-backend
+    #: journal cursors, read/written by the pump thread and sync_now
+    #: callers
+    _GUARDED_BY = {"_lock": ("_cursors",)}
+
+    def __init__(self, router, *, interval_s: float = 1.0,
+                 limit: int = 256, verbose: bool = False):
+        self.router = router
+        self.interval_s = float(interval_s)
+        self.limit = int(limit)
+        self.verbose = bool(verbose)
+        self._lock = sanitize.lock()
+        self._stop = sanitize.event()
+        self._thread: Optional[threading.Thread] = None
+        self._cursors: Dict[str, int] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "CacheFabric":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="deap-tpu-cache-fabric", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sync_now()
+            except Exception as e:  # noqa: BLE001 — pump must survive
+                self.router.metrics.inc("autoscale_errors")
+                emit_text(f"[autoscale] cache-fabric round failed: {e!r}",
+                          self.router.sinks)
+
+    # -- one exchange round --------------------------------------------------
+
+    def sync_now(self) -> dict:
+        """One full exchange round: pull every live backend's journal
+        tail, push the union to every other backend.  Returns
+        ``{"exported": n, "admitted": n}``."""
+        backends = self.router.healthy()
+        gathered: List[Tuple[str, List[dict]]] = []
+        for b in backends:
+            with self._lock:
+                since = self._cursors.get(b.name, 0)
+            try:
+                out = b.cache_export(since, limit=self.limit)
+            except (BackendDown, ServeError, OSError):
+                continue
+            seq = int(out.get("seq", since))
+            if seq < since:
+                # the instance restarted (fresh journal, lower seq) or a
+                # new instance reused the name: rewind so its backlog is
+                # picked up from the top next round instead of never
+                seq = 0
+            with self._lock:
+                self._cursors[b.name] = seq
+            entries = out.get("entries") or []
+            if entries:
+                gathered.append((b.name, entries))
+        admitted = 0
+        for src, entries in gathered:
+            for b in backends:
+                if b.name == src:
+                    continue
+                try:
+                    admitted += int(b.cache_import(entries))
+                except (BackendDown, ServeError, OSError):
+                    continue
+        self.router.metrics.inc("cache_fabric_syncs")
+        exported = sum(len(e) for _, e in gathered)
+        if self.verbose and exported:
+            emit_text(f"[autoscale] cache fabric: {exported} entries "
+                      f"from {len(gathered)} instances, {admitted} "
+                      f"admissions", self.router.sinks)
+        return {"exported": exported, "admitted": admitted}
+
+    def forget_backend(self, name: str) -> None:
+        """Drop the cursor of a scaled-in instance (its name may be
+        reused by a future spawn with a fresh journal)."""
+        with self._lock:
+            self._cursors.pop(name, None)

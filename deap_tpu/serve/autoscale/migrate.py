@@ -1,0 +1,122 @@
+"""Live per-session migration — move ONE hot session between
+instances, mid-run, bitwise-exactly.
+
+The protocol composes three surfaces the fleet already has:
+
+1. **quiesce + export** (``POST /v1/admin/migrate`` on the source) —
+   the source flips the session's ``migrating`` flag under the
+   dispatcher's queue lock, waits for its in-flight batch and queued
+   requests to drain at a *dispatch boundary*, snapshots it in the
+   versioned drain wire form, and forgets it.  Every other session on
+   the source keeps serving untouched; new work for the migrating
+   session is typed-rejected (``ServiceDraining``) and the router's
+   forwarder retries it onto the new home once the route commits.
+2. **restore** (``POST /v1/admin/restore`` on the target) — the same
+   adoption path failover uses; the snapshot carries the raw PRNG key,
+   genome, fitness values and bucket rows, so the continuation
+   trajectory on the target is bitwise-equal to the trajectory the
+   session would have produced had it never moved (slot-packing
+   guarantees a slot's result depends only on that slot).
+3. **route commit** — :meth:`FleetRouter.reroute_session` rewrites the
+   routing table atomically and wakes blocked forwarders; the source is
+   left with a *single-session* 307 redirect so clients pointed
+   directly at it follow the move without a router in the path.
+
+Failure containment: if the target rejects or dies mid-restore, the
+snapshot is restored **back onto the source** and the routing table is
+never touched — the migration aborts to exactly the pre-call state
+(modulo the quiesce pause the session observed).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...observability.sinks import emit_text
+from ..buckets import genome_signature
+from ..dispatcher import ServeError
+from ..router.backend import Backend, BackendDown
+
+__all__ = ["migrate_session", "MigrationError"]
+
+
+class MigrationError(ServeError):
+    """A migration that could not complete; the session's state is
+    back on the source (rolled back) unless chained context says
+    otherwise."""
+
+
+def migrate_session(router, name: str, *,
+                    target: Optional[Backend] = None,
+                    timeout: float = 30.0,
+                    prewarm: bool = False) -> dict:
+    """Live-migrate session ``name`` to ``target`` (bucket-affinity
+    chosen when None).  Returns a summary dict; raises
+    :class:`MigrationError` after rolling the session back onto its
+    source on any target-side failure.
+
+    ``prewarm`` runs a ``rebucket(warm=("step",))`` on the target after
+    the route commits, so the migrated session's next step hits an
+    already-compiled program instead of paying its compile inline.
+    """
+    source = router.route_of(name)
+    if target is not None and target.name == source.name:
+        raise ValueError(f"session {name!r} is already on {target.name}")
+    clock = router.tracer.clock
+    t0 = clock()
+    # -- quiesce + export (the downtime window opens here) --------------------
+    snap = source.migrate(name, timeout=timeout)
+    try:
+        if target is None:
+            target = router.pick_migration_target(
+                snap, exclude=(source.name,))
+            if target is None:
+                raise MigrationError(
+                    f"no healthy backend can adopt {name!r} "
+                    f"(toolbox {snap.get('toolbox')!r})")
+        resp = target.restore({name: snap})
+        if name not in (resp.get("restored") or ()):
+            raise MigrationError(
+                f"target {target.name} skipped {name!r}: "
+                f"{(resp.get('skipped') or {}).get(name)}")
+    except BaseException as e:
+        # roll back: the source exported (and forgot) the session, so
+        # put the snapshot straight back — route never moved, nothing
+        # to rewrite.  A rollback failure is the one state-losing shape
+        # and is surfaced chained for the operator.
+        router.metrics.inc("autoscale_migration_failures")
+        try:
+            source.restore({name: snap})
+        except (BackendDown, ServeError, OSError) as rb:
+            raise MigrationError(
+                f"migration of {name!r} failed ({e}) AND rollback onto "
+                f"{source.name} failed ({rb}); session lost") from e
+        emit_text(f"[autoscale] migration of {name!r} to "
+                  f"{'?' if target is None else target.name} failed "
+                  f"({e}); rolled back onto {source.name}", router.sinks)
+        if isinstance(e, MigrationError):
+            raise
+        raise MigrationError(
+            f"migration of {name!r} failed; rolled back: {e}") from e
+    # -- route commit (downtime window closes at the notify) ------------------
+    n = int(snap.get("n", 1))
+    router.reroute_session(name, target, n, genome_signature(snap["genome"]))
+    seconds = clock() - t0
+    # single-session redirect on the source: direct clients follow the
+    # move via 307 without re-pointing every other session (best effort
+    # — the source may be mid-teardown on the scale-in path)
+    try:
+        source.set_redirect(target.url, session=name)
+    except (BackendDown, ServeError, OSError):
+        pass
+    if prewarm:
+        try:
+            target.rebucket(warm=("step",))
+        except (BackendDown, ServeError, OSError):
+            pass
+    router.metrics.inc("autoscale_migrations")
+    router.metrics.set_gauge("autoscale_migration_downtime_s", seconds)
+    emit_text(f"[autoscale] migrated {name!r} {source.name} -> "
+              f"{target.name} in {seconds:.3f}s", router.sinks)
+    return {"session": name, "source": source.name,
+            "target": target.name, "seconds": seconds}

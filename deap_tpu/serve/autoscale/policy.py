@@ -1,0 +1,103 @@
+"""Autoscaling decision model — pure, clock-free, trivially testable.
+
+The :class:`Autoscaler` loop samples the fleet into one
+:class:`FleetSignals` record per tick and asks the frozen
+:class:`AutoscalePolicy` to classify it.  ``classify`` is a *pure
+pressure classifier*: it looks at one instantaneous sample and says
+whether the fleet is under pressure (``"out"``), idle enough to shrink
+(``"in"``) or neither (``"hold"``).  All the *temporal* smoothing —
+consecutive-tick streaks (hysteresis) and the post-event cooldown — is
+the controller's job, so this module needs no clock and a unit test
+needs no threads.
+
+Signals, per the fleet's existing observability surface:
+
+* ``queue_depth`` — mean dispatcher backlog across live instances
+  (the primary load signal; one saturated dispatcher queue is the
+  first externally-visible symptom of an undersized fleet);
+* ``pad_waste`` — mean padded-row waste fraction (bucket pressure:
+  high waste with high load means the grid is mis-sized, which
+  rebucketing fixes better than scaling — so waste *dampens* scale-out
+  rather than driving it);
+* ``shed_delta`` — overload/deadline sheds observed since the previous
+  tick (any shed is pressure, whatever the queue average says);
+* ``device_busy_frac`` — the roofline model's compute fraction from the
+  profiler's ``phase_split`` (an instance can be compute-bound with a
+  short queue when steps are long);
+* ``sessions`` / ``instances`` — fleet shape, for the min/max bounds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["FleetSignals", "AutoscalePolicy"]
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSignals:
+    """One tick's fleet-wide sample (means across live instances)."""
+
+    instances: int
+    queue_depth: float = 0.0
+    pad_waste: float = 0.0
+    sessions: int = 0
+    shed_delta: int = 0
+    device_busy_frac: float = 0.0
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """Thresholds and bounds for the elastic fleet.
+
+    ``out_streak`` / ``in_streak`` are the hysteresis widths: the
+    controller must see that many *consecutive* ticks classified the
+    same way before acting, and scale-in deliberately needs a longer
+    streak than scale-out (adding capacity late sheds traffic; removing
+    it early causes a migrate-back flap).  ``cooldown_s`` suppresses
+    any further scaling event — in either direction — after one fires,
+    so a migration-induced queue blip never triggers a second event.
+    """
+
+    min_instances: int = 1
+    max_instances: int = 4
+    queue_high: float = 8.0
+    queue_low: float = 1.0
+    busy_high: float = 0.85
+    out_streak: int = 2
+    in_streak: int = 3
+    cooldown_s: float = 30.0
+    interval_s: float = 1.0
+
+    def __post_init__(self):
+        if self.min_instances < 1:
+            raise ValueError("min_instances must be >= 1")
+        if self.max_instances < self.min_instances:
+            raise ValueError("max_instances < min_instances")
+        if self.queue_low > self.queue_high:
+            raise ValueError("queue_low > queue_high")
+        if self.out_streak < 1 or self.in_streak < 1:
+            raise ValueError("streaks must be >= 1")
+
+    def classify(self, s: FleetSignals) -> str:
+        """``"out"`` / ``"in"`` / ``"hold"`` for one sample.  Bounds
+        dominate: a fleet outside ``[min, max]`` always moves toward
+        the band regardless of load."""
+        if s.instances < self.min_instances:
+            return "out"
+        if s.instances > self.max_instances:
+            return "in"
+        pressure = (s.queue_depth >= self.queue_high
+                    or s.shed_delta > 0
+                    or s.device_busy_frac >= self.busy_high)
+        if pressure and s.instances < self.max_instances:
+            return "out"
+        idle = (s.queue_depth <= self.queue_low
+                and s.shed_delta == 0
+                and s.device_busy_frac < self.busy_high)
+        if idle and s.instances > self.min_instances:
+            return "in"
+        return "hold"

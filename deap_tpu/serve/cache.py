@@ -96,17 +96,40 @@ class FitnessCache:
     Thread-safe (the dispatcher thread writes; stats readers poll)."""
 
     #: lock-guarded shared state (``lock-discipline`` lint pass): the
-    #: LRU map is written by the dispatcher thread and read by any
-    #: client/stats thread — every mutation must hold ``self._lock``
-    _GUARDED_BY = {"_lock": ("_entries",)}
+    #: LRU maps, alias table and insert journal are written by the
+    #: dispatcher thread and read by any client/stats/fabric thread —
+    #: every mutation must hold ``self._lock``
+    _GUARDED_BY = {"_lock": ("_entries", "_aliases", "_journal",
+                             "_journal_seq", "_fabric")}
 
-    def __init__(self, capacity: int = 4096, metrics=None):
+    def __init__(self, capacity: int = 4096, metrics=None, *,
+                 journal_capacity: int = 1024):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self.capacity = int(capacity)
         self._metrics = metrics
         self._lock = sanitize.lock()
         self._entries: "collections.OrderedDict[tuple, np.ndarray]" = \
+            collections.OrderedDict()
+        #: evaluator id → stable cross-instance name (the toolbox
+        #: registry name) — the translation that makes a namespace
+        #: portable on the cache-fabric wire.  ``id()`` values are
+        #: process-local AND the ``sig`` element of a namespace holds a
+        #: PyTreeDef (not wire-serializable), so exported entries are
+        #: re-keyed ``(name|str(sig), nobj, digest)``.
+        self._aliases: Dict[int, str] = {}
+        #: bounded journal of LOCAL inserts ``(seq, namespace, digest,
+        #: values)`` — the fabric's digest-exchange source.  Imported
+        #: entries are never journaled, so two instances exchanging
+        #: digests can never echo each other's rows back and forth.
+        self._journal: "collections.deque[tuple]" = collections.deque(
+            maxlen=int(journal_capacity))
+        self._journal_seq = 0
+        #: imported cross-instance entries, LRU-bounded separately from
+        #: the main table and keyed by PORTABLE namespace — a fabric row
+        #: is a hint from another instance, never allowed to evict
+        #: locally computed fitness
+        self._fabric: "collections.OrderedDict[tuple, np.ndarray]" = \
             collections.OrderedDict()
 
     def __len__(self) -> int:
@@ -120,22 +143,44 @@ class FitnessCache:
     def lookup(self, namespace, digests: List[bytes]
                ) -> List[Optional[np.ndarray]]:
         """Per-digest hit values (``None`` on miss); hits are refreshed to
-        most-recently-used and counted."""
+        most-recently-used and counted.  A miss falls through to the
+        fabric table of imported cross-instance entries (when the
+        namespace has a portable alias): a genome evaluated on another
+        instance of the fleet is a hit here too (``cache_fabric_hits``),
+        and the consumed hint is promoted into the main table."""
         out: List[Optional[np.ndarray]] = []
-        hits = misses = 0
+        hits = misses = fabric_hits = evicted = 0
         with self._lock:
+            portable = self._portable_locked(namespace)
             for d in digests:
                 k = (namespace, d)
                 v = self._entries.get(k)
-                if v is None:
-                    misses += 1
-                    out.append(None)
-                else:
+                if v is not None:
                     hits += 1
                     self._entries.move_to_end(k)
                     out.append(v)
+                    continue
+                fv = None if portable is None else \
+                    self._fabric.get(portable + (d,))
+                if fv is None:
+                    misses += 1
+                    out.append(None)
+                    continue
+                # promotion goes through _entries directly, NOT
+                # insert(): a consumed fabric hint must never enter the
+                # local journal (re-exporting it would echo rows around
+                # the fleet forever)
+                fabric_hits += 1
+                hits += 1
+                self._entries[k] = fv
+                while len(self._entries) > self.capacity:
+                    self._entries.popitem(last=False)
+                    evicted += 1
+                out.append(fv)
         self._inc("cache_hits", hits)
         self._inc("cache_misses", misses)
+        self._inc("cache_fabric_hits", fabric_hits)
+        self._inc("cache_evictions", evicted)
         return out
 
     def insert(self, namespace, digests: List[bytes],
@@ -155,7 +200,10 @@ class FitnessCache:
                 if k in self._entries:
                     self._entries.move_to_end(k)
                     continue
-                self._entries[k] = np.array(v, copy=True)
+                row = np.array(v, copy=True)
+                self._entries[k] = row
+                self._journal_seq += 1
+                self._journal.append((self._journal_seq, namespace, d, row))
                 inserted += 1
                 while len(self._entries) > self.capacity:
                     self._entries.popitem(last=False)
@@ -167,6 +215,85 @@ class FitnessCache:
     def contains(self, namespace, digest: bytes) -> bool:
         with self._lock:
             return (namespace, digest) in self._entries
+
+    # -- cross-instance fabric ------------------------------------------------
+
+    def _portable_locked(self, namespace) -> Optional[tuple]:
+        """Wire-stable rendering of a local ``(evaluator_id, sig, nobj)``
+        namespace: ``("<alias>|<str(sig)>", nobj)``, or ``None`` when the
+        evaluator has no registered alias (unaliased namespaces stay
+        instance-local — nothing anonymous ever crosses the wire)."""
+        if not (isinstance(namespace, tuple) and len(namespace) == 3):
+            return None
+        eid, sig, nobj = namespace
+        alias = self._aliases.get(eid)
+        if alias is None:
+            return None
+        return (f"{alias}|{sig}", int(nobj))
+
+    def register_namespace_alias(self, evaluator_id: int,
+                                 name: str) -> None:
+        """Bind ``evaluator_id`` to a stable cross-instance ``name`` (the
+        toolbox registry name the fleet agrees on).  Only aliased
+        namespaces participate in the fabric exchange; the alias dies
+        with the namespace at :meth:`purge_namespace` (``id()`` recycling
+        must not resurrect it for an unrelated evaluator)."""
+        with self._lock:
+            self._aliases[int(evaluator_id)] = str(name)
+
+    @property
+    def journal_seq(self) -> int:
+        """Sequence number of the newest local insert (export cursor)."""
+        with self._lock:
+            return self._journal_seq
+
+    def export_since(self, seq: int, limit: int = 256
+                     ) -> Tuple[List[dict], int]:
+        """Local inserts journaled after cursor ``seq``, re-keyed to
+        their portable namespaces, newest cursor second.  Bounded by
+        ``limit`` (the fabric round-trips the cursor, so a busy instance
+        streams its backlog across exchanges instead of one giant
+        frame).  Unaliased inserts are skipped but still advance the
+        cursor — they can never become exportable retroactively."""
+        out: List[dict] = []
+        last = int(seq)
+        with self._lock:
+            for s, ns, d, v in self._journal:
+                if s <= seq:
+                    continue
+                if len(out) >= max(1, int(limit)):
+                    break
+                last = s
+                portable = self._portable_locked(ns)
+                if portable is None:
+                    continue
+                out.append({"ns": portable[0], "nobj": portable[1],
+                            "digest": d, "values": [float(x) for x in v]})
+        self._inc("cache_fabric_exports", len(out))
+        return out, last
+
+    def import_entries(self, entries: List[dict]) -> int:
+        """Admit another instance's exported entries into the fabric
+        table (LRU-bounded at ``capacity``, separate from the main
+        table).  Non-finite rows are dropped exactly like local inserts
+        — a quarantined evaluation must never become content-addressable
+        by riding in over the wire.  Returns rows admitted."""
+        admitted = 0
+        with self._lock:
+            for e in entries:
+                values = np.asarray(e["values"], np.float32)
+                if values.ndim != 1 or not np.all(np.isfinite(values)):
+                    continue
+                k = (str(e["ns"]), int(e["nobj"]), bytes(e["digest"]))
+                if k in self._fabric:
+                    self._fabric.move_to_end(k)
+                    continue
+                self._fabric[k] = values
+                admitted += 1
+                while len(self._fabric) > self.capacity:
+                    self._fabric.popitem(last=False)
+        self._inc("cache_fabric_imports", admitted)
+        return admitted
 
     def purge_namespace(self, evaluator_id: int) -> int:
         """Drop every entry whose namespace belongs to ``evaluator_id``
@@ -182,6 +309,15 @@ class FitnessCache:
                      and k[0][0] == evaluator_id]
             for k in stale:
                 del self._entries[k]
+            # the portable alias dies with the namespace: a recycled id
+            # must not export a successor evaluator's rows under the
+            # dead one's fleet-wide name
+            self._aliases.pop(evaluator_id, None)
+            self._journal = collections.deque(
+                (e for e in self._journal if not (
+                    isinstance(e[1], tuple) and e[1]
+                    and e[1][0] == evaluator_id)),
+                maxlen=self._journal.maxlen)
         self._inc("cache_purged", len(stale))
         return len(stale)
 

@@ -255,7 +255,8 @@ class BatchDispatcher:
     #: flags, and the batch counter are shared between every client
     #: thread and the dispatch worker
     _GUARDED_BY = {"_cv": ("_pending", "_closed", "_draining", "_paused",
-                           "_busy", "_batches", "_pressure_since")}
+                           "_busy", "_batches", "_pressure_since",
+                           "_inflight")}
 
     def __init__(self, execute: Callable[[str, tuple, List[Request]], list],
                  *, max_pending: int = 256, batch_window: float = 0.0,
@@ -312,6 +313,12 @@ class BatchDispatcher:
         self._paused = False
         self._busy = False
         self._batches = 0
+        #: ``id(session)`` of every session with a request in the batch
+        #: the worker currently has in flight — the single-session
+        #: quiesce predicate (live migration) waits on this, never on
+        #: the global ``_busy`` flag, so one hot session can reach a
+        #: dispatch boundary while its neighbors keep streaming batches
+        self._inflight: set = set()
         #: clock at which queue depth first reached the brownout
         #: watermark; ``None`` while below it
         self._pressure_since: Optional[float] = None
@@ -352,6 +359,7 @@ class BatchDispatcher:
                 # drain wait — the failover snapshot sits at a boundary
                 # every client observed
                 raise ServiceDraining("service is draining for failover")
+            self._check_migrating_locked(requests)
             if any(r.deadline is not None and now > r.deadline
                    for r in requests):
                 # deadline-budget shed: the remaining budget that rode in
@@ -406,6 +414,9 @@ class BatchDispatcher:
                     # promised the pending queue can only shrink
                     raise ServiceDraining(
                         "service is draining for failover")
+                # a migration quiesce that landed while this submission
+                # was blocked: same atomicity promise, per session
+                self._check_migrating_locked(requests)
             self._pending.extend(requests)
             if self._metrics is not None:
                 self._metrics.inc("requests", len(requests))
@@ -476,6 +487,44 @@ class BatchDispatcher:
         with self._cv:
             self._draining = bool(value)
             self._cv.notify_all()
+
+    def _check_migrating_locked(self, requests: List[Request]) -> None:
+        """Reject (``ServiceDraining``) any request for a session whose
+        ``migrating`` flag is up (holds ``_cv``).  The flag flips under
+        this same lock (:meth:`set_session_migrating`), so the drain
+        atomicity promise holds per session: once the flip returns, that
+        session's pending work can only shrink — the migration snapshot
+        sits at a boundary every one of its clients observed."""
+        for r in requests:
+            if r.session is not None and getattr(
+                    r.session, "migrating", False):
+                raise ServiceDraining(
+                    f"session {getattr(r.session, 'name', '?')!r} "
+                    "is migrating")
+
+    def set_session_migrating(self, session, value: bool = True) -> None:
+        """Flip one session's ``migrating`` flag under the queue lock —
+        atomic with respect to in-flight :meth:`submit` calls, exactly
+        like :meth:`set_draining` but scoped to one session.  Neighbor
+        sessions keep submitting and dispatching throughout."""
+        with self._cv:
+            session.migrating = bool(value)
+            self._cv.notify_all()
+
+    def wait_session_idle(self, session,
+                          timeout: Optional[float] = None) -> bool:
+        """Block until ``session`` has nothing queued and nothing in the
+        worker's in-flight batch (or ``timeout`` elapses; True on idle).
+        With the session's ``migrating`` flag already up this is the
+        single-session quiesce point: after it returns True the
+        session's device state is at a dispatch boundary and can be
+        snapshotted without pausing the dispatcher."""
+        sid = id(session)
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: sid not in self._inflight
+                and not any(r.session is session for r in self._pending),
+                timeout=timeout)
 
     def pause(self) -> None:
         """Stop dispatching new batches (in-flight one completes) —
@@ -631,11 +680,14 @@ class BatchDispatcher:
                 if not batch:
                     continue
                 self._busy = True
+                self._inflight = {id(r.session) for r in batch
+                                  if r.session is not None}
             try:
                 self._dispatch(batch)
             finally:
                 with self._cv:
                     self._busy = False
+                    self._inflight = set()
                     self._batches += 1
                     self._cv.notify_all()
             if self._after_batch is not None:

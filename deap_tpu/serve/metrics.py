@@ -38,6 +38,7 @@ from ..observability.sinks import MetricRecord, emit_record
 
 __all__ = ["ServeMetrics", "SERVE_COUNTERS", "SERVE_GAUGES", "NET_COUNTERS",
            "ROUTER_COUNTERS", "ROUTER_GAUGES", "TENANT_COUNTERS",
+           "AUTOSCALE_COUNTERS", "AUTOSCALE_GAUGES",
            "prometheus_text", "prometheus_fleet_text"]
 
 #: Counters the service maintains (cumulative over the service lifetime).
@@ -82,6 +83,27 @@ ROUTER_GAUGES = (
     "router_backends_alive", "router_sessions_routed",
     "router_inflight", "router_failover_recovery_s",
     "router_backends_degraded",
+)
+
+#: Counters of the elastic-fleet layer (deap_tpu.serve.autoscale): the
+#: autoscaler control loop, per-session live migration, and the
+#: cross-instance fitness-cache fabric.  The router's ServeMetrics
+#: store is constructed with these as extras (the autoscaler and fabric
+#: run beside the router); the fabric's per-instance counters
+#: (``cache_fabric_hits``/``cache_fabric_imports``/…) are maintained by
+#: each instance's own FitnessCache through its ordinary metrics tap.
+AUTOSCALE_COUNTERS = (
+    "autoscale_scale_out_events", "autoscale_scale_in_events",
+    "autoscale_migrations", "autoscale_migration_failures",
+    "autoscale_errors", "autoscale_prewarms",
+    "cache_fabric_hits", "cache_fabric_exports", "cache_fabric_imports",
+    "cache_fabric_syncs",
+)
+
+#: Gauges of the elastic-fleet layer (last-value).
+AUTOSCALE_GAUGES = (
+    "autoscale_instances", "autoscale_migration_downtime_s",
+    "autoscale_last_decision_queue_depth",
 )
 
 #: Gauges (last-value).  The ``profile_*`` family is the device-phase

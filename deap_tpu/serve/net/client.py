@@ -52,17 +52,38 @@ from . import protocol
 __all__ = ["RemoteService", "RemoteSession"]
 
 
-def _parse_address(address) -> Tuple[str, int]:
+def _parse_url(address) -> Tuple[str, str, int]:
+    """``(scheme, host, port)`` of an address — tuple/list, bare
+    ``host:port`` (scheme defaults to http), or an http(s) URL."""
     if isinstance(address, (tuple, list)):
-        return str(address[0]), int(address[1])
+        return "http", str(address[0]), int(address[1])
     addr = str(address)
-    if addr.startswith("http://"):
-        addr = addr[len("http://"):]
+    scheme = "http"
+    for s in ("http", "https"):
+        prefix = f"{s}://"
+        if addr.startswith(prefix):
+            scheme, addr = s, addr[len(prefix):]
+            break
     addr = addr.rstrip("/")
     host, _, port = addr.rpartition(":")
     if not host:
         raise ValueError(f"address {address!r} needs host:port")
-    return host, int(port)
+    return scheme, host, int(port)
+
+
+def _parse_address(address) -> Tuple[str, int]:
+    return _parse_url(address)[1:]
+
+
+def _make_connection(host: str, port: int, *, timeout: float,
+                     ssl_context=None) -> http.client.HTTPConnection:
+    """One client connection; an ``ssl.SSLContext`` switches it to TLS
+    (``HTTPSConnection`` — the context's verify mode/CA set governs how
+    the server certificate is checked)."""
+    if ssl_context is not None:
+        return http.client.HTTPSConnection(host, port, timeout=timeout,
+                                           context=ssl_context)
+    return http.client.HTTPConnection(host, port, timeout=timeout)
 
 
 class _Worker:
@@ -80,8 +101,10 @@ class _Worker:
                  request_timeout: Optional[float] = None,
                  retry_budget: int = 2, backoff: float = 0.05,
                  max_backoff: float = 2.0,
-                 rng: Optional[Callable[[], float]] = None):
+                 rng: Optional[Callable[[], float]] = None,
+                 ssl_context=None):
         self._host, self._port, self._timeout = host, port, timeout
+        self._ssl_context = ssl_context
         #: per-request response deadline (socket timeout on the ordered
         #: connection): a hung backend fails the ONE waiting future with
         #: typed DeadlineExceeded instead of blocking this worker thread
@@ -145,8 +168,8 @@ class _Worker:
         if self._conn is None:
             t = (self._request_timeout if self._request_timeout is not None
                  else self._timeout)
-            self._conn = http.client.HTTPConnection(
-                self._host, self._port, timeout=t)
+            self._conn = _make_connection(self._host, self._port, timeout=t,
+                                          ssl_context=self._ssl_context)
         return self._conn
 
     def _backoff_wait(self, delay: float) -> None:
@@ -304,8 +327,18 @@ class RemoteService:
                  compress: Optional[str] = None,
                  follow_redirects: bool = True,
                  retry_budget: int = 2,
-                 tracer: Optional[FleetTracer] = None):
-        self.host, self.port = _parse_address(address)
+                 tracer: Optional[FleetTracer] = None,
+                 ssl_context=None):
+        scheme, self.host, self.port = _parse_url(address)
+        #: TLS client side: an ``ssl.SSLContext`` governs certificate
+        #: verification for every connection (ordered worker, per-call
+        #: syncs, the metrics stream).  An ``https://`` address with no
+        #: explicit context gets the stdlib default (system CAs,
+        #: hostname verification on).
+        if ssl_context is None and scheme == "https":
+            import ssl as _ssl
+            ssl_context = _ssl.create_default_context()
+        self.ssl_context = ssl_context
         self.timeout = float(timeout)
         self.request_timeout = (None if request_timeout is None
                                 else float(request_timeout))
@@ -322,7 +355,8 @@ class RemoteService:
             capacity=1024)
         self._worker = _Worker(self.host, self.port, self.timeout,
                                request_timeout=self.request_timeout,
-                               retry_budget=retry_budget)
+                               retry_budget=retry_budget,
+                               ssl_context=ssl_context)
         self._closed = False
 
     # -- plumbing ------------------------------------------------------------
@@ -351,8 +385,9 @@ class RemoteService:
         """Out-of-band request on a fresh connection (never queues behind
         the ordered worker); follows at most one failover redirect."""
         for _hop in range(2):
-            conn = http.client.HTTPConnection(self.host, self.port,
-                                              timeout=self.timeout)
+            conn = _make_connection(self.host, self.port,
+                                    timeout=self.timeout,
+                                    ssl_context=self.ssl_context)
             try:
                 return _request(conn, method, path, obj,
                                 compress=self.compress)
@@ -459,8 +494,8 @@ class RemoteService:
         """Tail the server's metrics stream: yields a
         :class:`MetricRecord` per service activity wave (chunked ND-JSON
         under the hood)."""
-        conn = http.client.HTTPConnection(self.host, self.port,
-                                          timeout=self.timeout)
+        conn = _make_connection(self.host, self.port, timeout=self.timeout,
+                                ssl_context=self.ssl_context)
         try:
             conn.request("GET", f"/v1/metrics?stream=1&max={int(max_records)}"
                                 f"&timeout={float(timeout)}")

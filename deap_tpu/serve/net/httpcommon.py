@@ -18,13 +18,23 @@ ServeMetrics` instance the byte counters land on), and may override
 from __future__ import annotations
 
 import json
-from http.server import BaseHTTPRequestHandler
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence, Tuple
 
 from ...observability.sinks import emit_text
 from . import protocol
 
-__all__ = ["FrameHTTPHandler"]
+__all__ = ["FleetHTTPServer", "FrameHTTPHandler"]
+
+
+class FleetHTTPServer(ThreadingHTTPServer):
+    """Both frontends' HTTP server class.  The stdlib default listen
+    backlog (5) drops connections with ECONNRESET the moment a fleet
+    loadgen points a few dozen clients at one frontend; the backlog must
+    cover at least the largest client pool a bench drives."""
+
+    daemon_threads = True
+    request_queue_size = 128
 
 
 class FrameHTTPHandler(BaseHTTPRequestHandler):

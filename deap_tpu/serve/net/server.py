@@ -36,7 +36,18 @@ noted)::
     POST   /v1/admin/redirect               failover step 3: record where
                                             drained sessions now live (a
                                             typed redirect for stale
-                                            clients)
+                                            clients; {"session": name}
+                                            scopes it to one migrated
+                                            session)
+    POST   /v1/admin/migrate                live-migration source side:
+                                            quiesce + export exactly one
+                                            session (neighbors keep
+                                            serving)
+    POST   /v1/admin/cache/export           fitness-cache fabric: local
+                                            inserts after a cursor, in
+                                            portable namespaces
+    POST   /v1/admin/cache/import           admit another instance's
+                                            exported cache entries
 
 Cross-instance failover is drain → ship the frame → restore: the snapshot
 carries each session's toolbox *name*, bucket rows and raw PRNG key, so
@@ -49,7 +60,6 @@ from __future__ import annotations
 
 import json
 import threading
-from http.server import ThreadingHTTPServer
 from typing import Any, Dict, Optional, Sequence
 from urllib.parse import parse_qs, unquote, urlparse
 
@@ -63,7 +73,7 @@ from ...observability.sinks import emit_text
 from ..dispatcher import ServiceDraining, SessionUnknown
 from ..metrics import prometheus_text
 from . import protocol
-from .httpcommon import FrameHTTPHandler
+from .httpcommon import FleetHTTPServer, FrameHTTPHandler
 
 __all__ = ["NetServer"]
 
@@ -90,14 +100,17 @@ class NetServer:
 
     #: lock-guarded shared state (``lock-discipline`` lint pass): the
     #: session→toolbox name map is written by concurrent HTTP handler
-    #: threads (create/close/restore), and the failover redirect target
-    #: by the admin endpoint — writes only under ``self._lock``
-    _GUARDED_BY = {"_lock": ("_session_toolbox", "_redirect")}
+    #: threads (create/close/restore), and the failover/migration
+    #: redirect targets by the admin endpoint — writes only under
+    #: ``self._lock``
+    _GUARDED_BY = {"_lock": ("_session_toolbox", "_redirect",
+                             "_session_redirects")}
 
     def __init__(self, service, toolboxes: Dict[str, Any], *,
                  host: str = "127.0.0.1", port: int = 0,
                  result_timeout: float = 600.0, sinks: Sequence = (),
-                 compress_min_bytes: int = 4096, verbose: bool = False):
+                 compress_min_bytes: int = 4096, verbose: bool = False,
+                 ssl_context=None):
         self.service = service
         self.toolboxes = dict(toolboxes)
         self.result_timeout = float(result_timeout)
@@ -114,15 +127,37 @@ class NetServer:
         #: SessionUnknown error envelopes so direct clients follow the
         #: failover transparently
         self._redirect: Optional[str] = None
+        #: per-session redirects (live migration leaves one behind for
+        #: exactly the migrated session; its neighbors keep serving
+        #: here, so the instance-wide target must stay unset)
+        self._session_redirects: Dict[str, str] = {}
         self._lock = sanitize.lock()
+        # cross-instance cache fabric: evaluators become portable under
+        # their registry toolbox's name (every instance of the fleet
+        # holding the same registry agrees on it)
+        for tb_name, tb in self.toolboxes.items():
+            self._register_cache_alias(tb_name, tb)
         net = self
 
         class Handler(_Handler):
             server_ctx = net
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self._httpd.daemon_threads = True
+        self._httpd = FleetHTTPServer((host, port), Handler)
+        #: TLS termination: an ``ssl.SSLContext`` wraps the listening
+        #: socket (every accepted connection handshakes before HTTP) and
+        #: flips :attr:`url` to https so redirects/topology advertise
+        #: the scheme peers must speak
+        self._ssl_context = ssl_context
+        if ssl_context is not None:
+            self._httpd.socket = ssl_context.wrap_socket(
+                self._httpd.socket, server_side=True)
         self._thread: Optional[threading.Thread] = None
+
+    def _register_cache_alias(self, tb_name: str, toolbox) -> None:
+        evaluate = getattr(toolbox, "evaluate", None)
+        if evaluate is not None:
+            self.service.cache.register_namespace_alias(
+                id(evaluate), tb_name)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -157,7 +192,8 @@ class NetServer:
     @property
     def url(self) -> str:
         host, port = self.address
-        return f"http://{host}:{port}"
+        scheme = "https" if self._ssl_context is not None else "http"
+        return f"{scheme}://{host}:{port}"
 
     # -- session helpers -----------------------------------------------------
 
@@ -200,8 +236,15 @@ class NetServer:
             evaluate_initial=bool(body.get("evaluate_initial", True)),
             priority=int(body.get("priority", 1)),
             timeout=self.result_timeout)
+        # the evaluator may have been registered on the toolbox after
+        # construction (or re-created after a purge) — keep its fabric
+        # alias current at every admission
+        self._register_cache_alias(tb_name, toolbox)
         with self._lock:
             self._session_toolbox[session.name] = tb_name
+            # a re-created name supersedes any migration leftover: the
+            # session lives HERE now, a stale redirect would bounce it
+            self._session_redirects.pop(session.name, None)
         return {"name": session.name, "gen": session.gen,
                 "pop": session.pop_size, "rows": session.bucket.rows,
                 "sharded": session.sharded}
@@ -297,9 +340,13 @@ class NetServer:
                 f"instance's registry (skipped: {skipped})")
         restored = self.service.adopt_sessions(
             {n: snaps[n] for n in toolboxes}, toolboxes)
+        for name in restored:
+            self._register_cache_alias(snaps[name].get("toolbox"),
+                                       toolboxes[name])
         with self._lock:
             for name in restored:
                 self._session_toolbox[name] = snaps[name].get("toolbox")
+                self._session_redirects.pop(name, None)
         if self.verbose:
             emit_text(f"[serve.net] restored {sorted(restored)} "
                       f"skipped {sorted(skipped)}", self.sinks)
@@ -316,23 +363,86 @@ class NetServer:
                 "programs": prof.profiles()}
 
     def h_rebucket(self, body: dict) -> dict:
+        sizes = body.get("sizes")
         return self.service.rebucket(
             max_buckets=int(body.get("max_buckets", 8)),
-            warm=tuple(body.get("warm", ("step",))))
+            warm=tuple(body.get("warm", ("step",))),
+            sizes=None if sizes is None else [int(r) for r in sizes])
+
+    def h_migrate(self, body: dict) -> dict:
+        """``POST /v1/admin/migrate`` — live-migration source side:
+        quiesce exactly one session at a dispatch boundary
+        (:meth:`~deap_tpu.serve.service.EvolutionService.export_session`)
+        and hand back its snapshot in the drain wire form (toolbox name
+        included, so ``/v1/admin/restore`` on the target consumes it
+        verbatim).  Every other session keeps serving untouched."""
+        name = body["name"]
+        with self._lock:
+            tb_name = self._session_toolbox.get(name)
+        if tb_name is None:
+            # in-process / checkpoint-restored session: reverse-map the
+            # toolbox object exactly like h_drain
+            sess = self.service.sessions().get(name)
+            if sess is not None:
+                rev = {id(tb): tn for tn, tb in self.toolboxes.items()}
+                tb_name = rev.get(id(sess.toolbox))
+        snap = self.service.export_session(
+            name, timeout=body.get("timeout", 30.0))
+        snap["toolbox"] = tb_name
+        with self._lock:
+            self._session_toolbox.pop(name, None)
+        if self.verbose:
+            emit_text(f"[serve.net] exported session {name!r} for "
+                      "migration", self.sinks)
+        return {"session": snap, "name": name}
+
+    def h_cache_export(self, body: dict) -> dict:
+        """``POST /v1/admin/cache/export`` — the fabric's pull side:
+        locally inserted fitness rows journaled after cursor ``since``,
+        re-keyed to portable (toolbox-name) namespaces.  Bounded by
+        ``limit``; the new cursor rides back for the next exchange."""
+        entries, seq = self.service.cache.export_since(
+            int(body.get("since", 0)), int(body.get("limit", 256)))
+        return {"entries": entries, "seq": seq}
+
+    def h_cache_import(self, body: dict) -> dict:
+        """``POST /v1/admin/cache/import`` — admit another instance's
+        exported entries into this instance's fabric table."""
+        return {"admitted":
+                self.service.cache.import_entries(body["entries"])}
 
     def h_redirect(self, body: dict) -> dict:
         """Failover step 3 (optional): record where the drained sessions
         now live, so clients still pointed HERE get a typed redirect in
         the error envelope instead of a dead end.  ``{"url": null}``
-        clears it."""
+        clears it.  With ``"session"`` in the body the redirect applies
+        to that ONE session (what live migration leaves behind); it
+        shadows the instance-wide target for that session's paths."""
         url = body.get("url")
+        session = body.get("session")
         with self._lock:
-            self._redirect = None if url is None else str(url)
-        return {"location": url}
+            if session is None:
+                self._redirect = None if url is None else str(url)
+            elif url is None:
+                self._session_redirects.pop(str(session), None)
+            else:
+                self._session_redirects[str(session)] = str(url)
+        return {"location": url, "session": session}
 
     @property
     def redirect_location(self) -> Optional[str]:
         with self._lock:
+            return self._redirect
+
+    def redirect_for(self, session: Optional[str]) -> Optional[str]:
+        """The redirect a stale client of ``session`` should follow: the
+        session's own migration target when one is recorded, else the
+        instance-wide drain target."""
+        with self._lock:
+            if session is not None:
+                url = self._session_redirects.get(session)
+                if url is not None:
+                    return url
             return self._redirect
 
 
@@ -450,8 +560,10 @@ class _Handler(FrameHTTPHandler):
         net.service.metrics.inc("net_errors")
         # a drained instance that knows its replacement attaches the
         # typed redirect (draining rejections AND post-drain lookup
-        # misses — the two shapes a stale client sees after failover)
-        location = (net.redirect_location
+        # misses — the two shapes a stale client sees after failover);
+        # a migrated session's OWN redirect wins over the instance-wide
+        # one, so one hot tenant's move never bounces its neighbors
+        location = (net.redirect_for(getattr(self, "_session_name", None))
                     if isinstance(exc, (ServiceDraining, SessionUnknown))
                     else None)
         self._send_error_envelope(exc, location=location)
@@ -469,6 +581,7 @@ class _Handler(FrameHTTPHandler):
         self._body_consumed = False
         self._trace_ctx = None
         self._trace_t0 = 0.0
+        self._session_name = None
         # per-request negotiation state: a keep-alive connection serves
         # many requests, and a stale accept list would compress a reply
         # for a peer that did not advertise on THIS request.  The HTTP
@@ -501,14 +614,16 @@ class _Handler(FrameHTTPHandler):
                 # names arrive percent-encoded (clients quote arbitrary
                 # session names into the path)
                 if len(rest) == 2:
+                    self._session_name = unquote(rest[1])
                     if method == "GET":
                         return self._send_obj(
-                            net.h_get_session(unquote(rest[1])))
+                            net.h_get_session(self._session_name))
                     if method == "DELETE":
                         return self._send_obj(
-                            net.h_close_session(unquote(rest[1])))
+                            net.h_close_session(self._session_name))
                 if method == "POST" and len(rest) == 3:
                     name, op = unquote(rest[1]), rest[2]
+                    self._session_name = name
                     fn = {"step": net.h_step, "ask": net.h_ask,
                           "tell": net.h_tell,
                           "evaluate": net.h_evaluate}.get(op)
@@ -517,7 +632,14 @@ class _Handler(FrameHTTPHandler):
             if method == "POST" and rest[:1] == ["admin"] and len(rest) == 2:
                 fn = {"drain": net.h_drain, "restore": net.h_restore,
                       "rebucket": net.h_rebucket,
-                      "redirect": net.h_redirect}.get(rest[1])
+                      "redirect": net.h_redirect,
+                      "migrate": net.h_migrate}.get(rest[1])
+                if fn is not None:
+                    return self._send_obj(fn(self._body()))
+            if (method == "POST" and rest[:2] == ["admin", "cache"]
+                    and len(rest) == 3):
+                fn = {"export": net.h_cache_export,
+                      "import": net.h_cache_import}.get(rest[2])
                 if fn is not None:
                     return self._send_obj(fn(self._body()))
             raise SessionUnknown(f"unknown path {url.path!r}")

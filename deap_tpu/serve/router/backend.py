@@ -30,7 +30,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ... import sanitize
 from ..dispatcher import CircuitOpen, ServeError
 from ..net import protocol
-from ..net.client import _parse_address
+from ..net.client import _make_connection, _parse_url
 
 __all__ = ["Backend", "BackendDown", "CircuitBreaker", "CircuitOpen"]
 
@@ -196,9 +196,18 @@ class Backend:
 
     def __init__(self, name: str, address, *, timeout: float = 600.0,
                  control_timeout: float = 10.0,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 ssl_context=None):
         self.name = str(name)
-        self.host, self.port = _parse_address(address)
+        scheme, self.host, self.port = _parse_url(address)
+        #: TLS toward the instance: an ``ssl.SSLContext`` (verify mode /
+        #: CA set included) applied to every forwarding and control
+        #: connection; an https address with no context gets the stdlib
+        #: default (system CAs)
+        if ssl_context is None and scheme == "https":
+            import ssl as _ssl
+            ssl_context = _ssl.create_default_context()
+        self.ssl_context = ssl_context
         self.timeout = float(timeout)
         self.control_timeout = float(control_timeout)
         self.breaker = breaker
@@ -206,7 +215,8 @@ class Backend:
 
     @property
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        scheme = "https" if self.ssl_context is not None else "http"
+        return f"{scheme}://{self.host}:{self.port}"
 
     def __repr__(self) -> str:
         return f"Backend({self.name!r}, {self.url})"
@@ -219,8 +229,9 @@ class Backend:
             conn.close()
             conn = None
         if conn is None:
-            conn = http.client.HTTPConnection(self.host, self.port,
-                                              timeout=self.timeout)
+            conn = _make_connection(self.host, self.port,
+                                    timeout=self.timeout,
+                                    ssl_context=self.ssl_context)
             self._tls.conn = conn
         return conn
 
@@ -293,9 +304,10 @@ class Backend:
 
     def _control(self, method: str, path: str, obj: Any = None,
                  timeout: Optional[float] = None) -> Any:
-        conn = http.client.HTTPConnection(
+        conn = _make_connection(
             self.host, self.port,
-            timeout=self.control_timeout if timeout is None else timeout)
+            timeout=self.control_timeout if timeout is None else timeout,
+            ssl_context=self.ssl_context)
         try:
             body = None if obj is None else protocol.encode_frame(obj)
             try:
@@ -353,5 +365,53 @@ class Backend:
                              {"sessions": snapshot},
                              timeout=timeout)
 
-    def set_redirect(self, url: Optional[str]) -> None:
-        self._control("POST", "/v1/admin/redirect", {"url": url})
+    def set_redirect(self, url: Optional[str],
+                     session: Optional[str] = None) -> None:
+        """Record the failover redirect on the instance; with
+        ``session`` it applies to that ONE session (the tombstone live
+        migration leaves at the source)."""
+        body: Dict[str, Any] = {"url": url}
+        if session is not None:
+            body["session"] = session
+        self._control("POST", "/v1/admin/redirect", body)
+
+    def migrate(self, name: str, timeout: float = 30.0) -> dict:
+        """Live-migration source call: quiesce + export exactly one
+        session; returns its snapshot (drain wire form, toolbox name
+        included)."""
+        out = self._control("POST", "/v1/admin/migrate",
+                            {"name": name, "timeout": float(timeout)},
+                            timeout=timeout + self.control_timeout)
+        return out["session"]
+
+    def rebucket(self, *, sizes: Optional[List[int]] = None,
+                 max_buckets: int = 8,
+                 warm: Tuple[str, ...] = ("step",),
+                 timeout: float = 60.0) -> dict:
+        """Bucket-grid refit on the instance; ``sizes`` installs an
+        explicit grid (the autoscaler's predictive pre-warm — a fresh
+        instance has no histogram to derive one from)."""
+        body: Dict[str, Any] = {"max_buckets": int(max_buckets),
+                                "warm": list(warm)}
+        if sizes is not None:
+            body["sizes"] = [int(r) for r in sizes]
+        return self._control("POST", "/v1/admin/rebucket", body,
+                             timeout=timeout)
+
+    def profile(self) -> dict:
+        """The instance's per-program device-phase profile table
+        (roofline ``phase_split`` signals ride here)."""
+        return self._control("GET", "/v1/profile")
+
+    def cache_export(self, since: int, limit: int = 256) -> dict:
+        """Pull the instance's fitness-cache journal after cursor
+        ``since`` (portable namespaces); ``{"entries", "seq"}``."""
+        return self._control("POST", "/v1/admin/cache/export",
+                             {"since": int(since), "limit": int(limit)})
+
+    def cache_import(self, entries: List[dict]) -> int:
+        """Push exported entries into the instance's fabric table;
+        returns rows admitted."""
+        out = self._control("POST", "/v1/admin/cache/import",
+                            {"entries": list(entries)})
+        return int(out["admitted"])

@@ -40,7 +40,8 @@ from ...observability.fleettrace import FleetTracer
 from ...observability.sinks import emit_text
 from ..buckets import genome_signature
 from ..dispatcher import ServeError, SessionUnknown
-from ..metrics import ServeMetrics, ROUTER_COUNTERS, ROUTER_GAUGES
+from ..metrics import (ServeMetrics, ROUTER_COUNTERS, ROUTER_GAUGES,
+                       AUTOSCALE_COUNTERS, AUTOSCALE_GAUGES)
 from .backend import Backend, BackendDown, CircuitBreaker
 from .health import HealthMonitor, HealthPolicy
 from .placement import BackendPlan, PlacementPolicy, fleet_sizes
@@ -118,8 +119,9 @@ class FleetRouter:
         self.scheduler = WeightedFairScheduler(
             max_inflight=max_inflight, quotas=quotas, default=default_quota)
         self.drain_timeout = float(drain_timeout)
-        self.metrics = ServeMetrics(extra_counters=ROUTER_COUNTERS,
-                                    extra_gauges=ROUTER_GAUGES)
+        self.metrics = ServeMetrics(
+            extra_counters=ROUTER_COUNTERS + AUTOSCALE_COUNTERS,
+            extra_gauges=ROUTER_GAUGES + AUTOSCALE_GAUGES)
         self.tracer = (tracer if tracer is not None
                        else FleetTracer(clock=self._clock))
         self.sinks = list(sinks)
@@ -151,6 +153,8 @@ class FleetRouter:
                                            **dict(breaker_policy or {}))
             b.breaker.bind(on_event=self._on_breaker_event,
                            on_state=self._on_breaker_state)
+        # elastic control loop (attach_autoscaler) — None on static fleets
+        self.autoscaler = None
         if start_health:
             self.health.start()
 
@@ -230,9 +234,11 @@ class FleetRouter:
         self.metrics.set_gauge("router_backends_alive",
                                len(self.backends) - len(down))
         self.metrics.set_gauge("router_sessions_routed", len(routes))
+        autoscale = (self.autoscaler.describe()
+                     if self.autoscaler is not None else None)
         return {"backends": per_backend, "sessions": len(routes),
                 "fleet_sizes": list(sizes) if sizes else None,
-                "sick": down}
+                "sick": down, "autoscale": autoscale}
 
     def stats(self):
         """Router-level :class:`MetricRecord` (the RouterServer's
@@ -256,6 +262,116 @@ class FleetRouter:
         with self._lock:
             plans = list(self._plans.values())
         return fleet_sizes(plans, **kw)
+
+    def live_fleet_rows(self) -> Tuple[int, ...]:
+        """Union of the bucket-row classes the fleet is actually running
+        (every plan's warm set).  This — not :meth:`derive_fleet_sizes`,
+        which proposes an *ideal* grid for a coordinated whole-fleet
+        rebucket — is the grid a scale-out target must be pre-warmed
+        with: restore re-buckets under the TARGET's policy, so only the
+        rows already in service keep migration/failover bitwise and
+        compile-free."""
+        with self._lock:
+            rows = {r for plan in self._plans.values()
+                    for (r, _sig) in plan.warm}
+        return tuple(sorted(rows))
+
+    # -- elastic fleet (autoscale) --------------------------------------------
+
+    def attach_autoscaler(self, autoscaler) -> None:
+        """Register the :class:`~deap_tpu.serve.autoscale.Autoscaler`
+        driving this fleet so :meth:`topology` can report its state."""
+        self.autoscaler = autoscaler
+
+    def add_backend(self, backend: Backend) -> None:
+        """Adopt a freshly-spawned instance into the fleet: register it,
+        give it an empty placement plan, put it under health probing and
+        attach/bind a circuit breaker.  The scale-out path — new
+        sessions may place on it the moment this returns."""
+        if backend.breaker is None:
+            backend.breaker = CircuitBreaker(backend.name, clock=self._clock)
+        backend.breaker.bind(on_event=self._on_breaker_event,
+                             on_state=self._on_breaker_state)
+        with self._lock:
+            if backend.name in self.backends:
+                raise ValueError(
+                    f"duplicate backend name {backend.name!r}")
+            self.backends[backend.name] = backend
+            self._plans[backend.name] = BackendPlan()
+            self._down.pop(backend.name, None)
+        self.health.add_backend(backend)
+        emit_text(f"[router] backend {backend.name} joined the fleet "
+                  f"({backend.url})", self.sinks)
+        self._notify_routes()
+
+    def remove_backend(self, name: str) -> Backend:
+        """Forget a drained instance (the scale-in path).  The caller
+        must have moved its sessions first (:meth:`failover` does);
+        removing a backend that still routes sessions raises."""
+        with self._lock:
+            backend = self.backends.get(name)
+            if backend is None:
+                raise ValueError(f"no backend named {name!r}")
+            if len(self.backends) == 1:
+                raise ValueError("refusing to remove the last backend")
+            still = sorted(s for s, bn in self._routes.items()
+                           if bn == name)
+            if still:
+                raise ValueError(
+                    f"backend {name!r} still routes sessions {still}; "
+                    "drain it first")
+            del self.backends[name]
+            self._plans.pop(name, None)
+            self._down.pop(name, None)
+            self._toolboxes_of.pop(name, None)
+        self.health.remove_backend(name)
+        backend.drop_connections()
+        emit_text(f"[router] backend {name} left the fleet", self.sinks)
+        self._notify_routes()
+        return backend
+
+    def revive(self, name: str) -> None:
+        """Operator action: clear a failed-over backend's down-mark
+        after the instance was restarted or replaced.  It rejoins
+        placement (and the autoscaler's healthy count) immediately;
+        health probing resumes with a clean slate.  ``failover`` only
+        ever retires — without this the fleet can never regrow onto a
+        recovered instance short of remove+re-add."""
+        with self._lock:
+            if name not in self.backends:
+                raise ValueError(f"no backend named {name!r}")
+            self._down.pop(name, None)
+        self.health.revive(name)
+        emit_text(f"[router] backend {name} revived", self.sinks)
+        self._notify_routes()
+
+    def pick_migration_target(self, snap: dict, *,
+                              exclude: Sequence[str] = ()
+                              ) -> Optional[Backend]:
+        """Bucket-affinity placement for one exported session snapshot
+        (the live-migration target choice — same scoring as the
+        failover restore path)."""
+        return self._pick_restore_target(snap, set(exclude))
+
+    def reroute_session(self, name: str, target: Backend, n: int,
+                        sig: tuple) -> None:
+        """Atomically rewrite one session's route onto ``target`` (the
+        live-migration commit): the placement plans move with it and
+        every forwarder blocked in :meth:`wait_rerouted` wakes.  Between
+        the source's export and this commit the session is routed at the
+        source but rejects work with the migration redirect — the
+        forwarder retry path bridges that window."""
+        rows = self.placement.bucket_rows(n)
+        with self._lock:
+            old = self._routes.get(name)
+            if old is None:
+                raise SessionUnknown(
+                    f"no session named {name!r} routed in this fleet")
+            self._routes[name] = target.name
+            if old in self._plans:
+                self._plans[old].forget_session()
+            self._plans[target.name].observe_placement(n, rows, sig)
+        self._notify_routes()
 
     # -- toolbox registry model ----------------------------------------------
 

@@ -41,7 +41,6 @@ from __future__ import annotations
 
 import json
 import threading
-from http.server import ThreadingHTTPServer
 from typing import Optional, Sequence, Tuple
 from urllib.parse import parse_qs, quote, unquote, urlparse
 
@@ -52,7 +51,7 @@ from ..dispatcher import (CircuitOpen, DeadlineExceeded, ServeError,
                           TenantQuotaExceeded)
 from ..metrics import prometheus_fleet_text, prometheus_text
 from ..net import protocol
-from ..net.httpcommon import FrameHTTPHandler
+from ..net.httpcommon import FleetHTTPServer, FrameHTTPHandler
 from .backend import Backend, BackendDown
 from .core import FleetRouter
 
@@ -74,7 +73,7 @@ class RouterServer:
     def __init__(self, router: FleetRouter, *, host: str = "127.0.0.1",
                  port: int = 0, failover_wait: float = 30.0,
                  acquire_timeout: float = 60.0, sinks: Sequence = (),
-                 verbose: bool = False):
+                 verbose: bool = False, ssl_context=None):
         self.router = router
         self.failover_wait = float(failover_wait)
         self.acquire_timeout = float(acquire_timeout)
@@ -85,8 +84,14 @@ class RouterServer:
         class Handler(_RouterHandler):
             server_ctx = ctx
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self._httpd.daemon_threads = True
+        self._httpd = FleetHTTPServer((host, port), Handler)
+        # TLS termination: same shape as NetServer — wrap the listening
+        # socket once; every accepted connection then handshakes before
+        # the HTTP layer sees a byte
+        self._ssl_context = ssl_context
+        if ssl_context is not None:
+            self._httpd.socket = ssl_context.wrap_socket(
+                self._httpd.socket, server_side=True)
         self._thread: Optional[threading.Thread] = None
 
     # -- lifecycle -----------------------------------------------------------
@@ -124,7 +129,8 @@ class RouterServer:
     @property
     def url(self) -> str:
         host, port = self.address
-        return f"http://{host}:{port}"
+        scheme = "https" if self._ssl_context is not None else "http"
+        return f"{scheme}://{host}:{port}"
 
 
 class _RouterHandler(FrameHTTPHandler):
@@ -467,10 +473,25 @@ class _RouterHandler(FrameHTTPHandler):
                                      timeout=ctx.failover_wait)
                 continue
             router.metrics.inc("router_forwards")
-            if status < 400 or not _is_draining_envelope(data):
+            if status < 400:
                 return status, data, backend
-            # typed draining rejection: the op never executed; wait for
-            # the failover to move the session, then retry
+            err = _envelope_error(data)
+            retryable = err == "ServiceDraining"
+            if err == "SessionUnknown":
+                # a live migration's export can beat its route-table
+                # commit: the source already exported (and forgot) the
+                # session while the routing table still points there.
+                # Provably unexecuted — wait for the commit, retry on
+                # the new home.  A session the router itself no longer
+                # routes is a genuine 404 and surfaces as-is.
+                try:
+                    retryable = router.route_of(name) is backend
+                except SessionUnknown:
+                    retryable = False
+            if not retryable:
+                return status, data, backend
+            # typed rejection (draining / mid-migration): the op never
+            # executed; wait for the re-route to commit, then retry
             last_exc = None
             if not router.wait_rerouted(name, backend.name,
                                         timeout=ctx.failover_wait):
@@ -494,11 +515,14 @@ def _strip_redirect(data: bytes) -> bytes:
     return json.dumps(doc).encode("utf-8")
 
 
-def _is_draining_envelope(data: bytes) -> bool:
+def _envelope_error(data: bytes) -> Optional[str]:
+    """The typed error class name from a JSON error envelope, or None
+    for frames / unparsable bodies."""
     if data[:4] == protocol.MAGIC or not data:
-        return False
+        return None
     try:
         doc = json.loads(data.decode("utf-8"))
     except (ValueError, UnicodeDecodeError):
-        return False
-    return doc.get("error") == "ServiceDraining"
+        return None
+    err = doc.get("error")
+    return err if isinstance(err, str) else None

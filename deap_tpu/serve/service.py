@@ -64,7 +64,7 @@ from .buckets import (BucketPolicy, BucketKey, ShapeHistogram, pad_rows,
                       unpad_rows, pad_population, genome_signature)
 from .cache import FitnessCache, flatten_rows, row_digests, rep_indices
 from .dispatcher import (BatchDispatcher, Request, ServeFuture, ServeError,
-                         ServiceClosed, ServiceDraining)
+                         ServiceClosed, ServiceDraining, SessionUnknown)
 from .metrics import ServeMetrics
 
 __all__ = ["EvolutionService", "Session", "build_slot_program"]
@@ -185,6 +185,12 @@ class Session:
         self.gen = int(gen)
         self.phase = phase           # idle | asked
         self.closed = False
+        #: live-migration quiesce flag: flipped ONLY under the
+        #: dispatcher's queue lock (``set_session_migrating``), checked
+        #: there at submit — while up, this session's submissions are
+        #: rejected (``ServiceDraining``) and its pending work can only
+        #: shrink; every other session keeps flowing
+        self.migrating = False
         #: population placed pop-axis-sharded over the service mesh and
         #: stepped by a dedicated whole-mesh program (no slot-packing)
         self.sharded = bool(sharded)
@@ -1240,7 +1246,10 @@ class EvolutionService:
     def _exec_evaluate(self, program_key: tuple,
                        requests: List[Request]) -> list:
         evaluate_id, sig, rows, nobj = program_key
-        evaluate = self._refs[evaluate_id]
+        with self._lock:
+            # the ref is pinned by the requests' sessions, but the dict
+            # itself is shared with open/close on the API threads
+            evaluate = self._refs[evaluate_id]
         genomes = [r.payload["genome"] for r in requests]
         counts = [r.payload["n"] for r in requests]
         total = sum(counts)
@@ -1328,25 +1337,69 @@ class EvolutionService:
         out: Dict[str, dict] = {}
         with self.quiesce():
             for name, s in self.sessions().items():
-                st = s._state
-                n = int(np.asarray(st["live_n"]))
-                snap = {"gen": s.gen, "phase": s.phase, "n": n,
-                        "priority": s.priority,
-                        "weights": s.bucket.weights,
-                        "rows": s.bucket.rows,
-                        "key": np.asarray(st["key"]),
-                        "genome": _host(unpad_rows(st["genome"], n)),
-                        "values": np.asarray(st["values"][:n]),
-                        "valid": np.asarray(st["valid"][:n]),
-                        "cxpb": float(np.asarray(st["cxpb"])),
-                        "mutpb": float(np.asarray(st["mutpb"]))}
-                if s._pending is not None:
-                    pg, pv, pvalid = s._pending
-                    snap["pending"] = {"genome": _host(unpad_rows(pg, n)),
-                                       "values": np.asarray(pv[:n]),
-                                       "valid": np.asarray(pvalid[:n])}
-                out[name] = snap
+                out[name] = self._snapshot_one(s)
         return out
+
+    @staticmethod
+    def _snapshot_one(s: Session) -> dict:
+        """One session's host snapshot (the versioned wire/checkpoint
+        form).  The caller must hold the session at a dispatch boundary
+        — either the global :meth:`quiesce` or the single-session
+        migration quiesce (``migrating`` flag + ``wait_session_idle``)."""
+        st = s._state
+        n = int(np.asarray(st["live_n"]))
+        snap = {"gen": s.gen, "phase": s.phase, "n": n,
+                "priority": s.priority,
+                "weights": s.bucket.weights,
+                "rows": s.bucket.rows,
+                "key": np.asarray(st["key"]),
+                "genome": _host(unpad_rows(st["genome"], n)),
+                "values": np.asarray(st["values"][:n]),
+                "valid": np.asarray(st["valid"][:n]),
+                "cxpb": float(np.asarray(st["cxpb"])),
+                "mutpb": float(np.asarray(st["mutpb"]))}
+        if s._pending is not None:
+            pg, pv, pvalid = s._pending
+            snap["pending"] = {"genome": _host(unpad_rows(pg, n)),
+                               "values": np.asarray(pv[:n]),
+                               "valid": np.asarray(pvalid[:n])}
+        return snap
+
+    def export_session(self, name: str, *,
+                       timeout: Optional[float] = 30.0) -> dict:
+        """Live-migration step 1 of 2: quiesce exactly ONE session at a
+        dispatch boundary, snapshot it, and detach it from this instance
+        — without draining, pausing, or otherwise disturbing its
+        neighbors.
+
+        The session's ``migrating`` flag flips under the dispatcher's
+        queue lock, so every later submission for it is rejected with
+        :class:`~deap_tpu.serve.dispatcher.ServiceDraining` (the same
+        provably-not-executed contract a drain gives: the caller re-sends
+        to wherever the route now points).  Already-queued requests
+        execute to completion first — the snapshot sits at a request
+        boundary every client of this session observed, so adopting it
+        elsewhere continues the trajectory bit-for-bit when bucket
+        policies match.  Raises on timeout with the flag rolled back
+        (the session keeps serving here)."""
+        with self._lock:
+            s = self._sessions.get(name)
+        if s is None:
+            raise SessionUnknown(f"no session named {name!r}")
+        self._dispatcher.set_session_migrating(s, True)
+        try:
+            if not self._dispatcher.wait_session_idle(s, timeout=timeout):
+                raise ServeError(
+                    f"session {name!r} did not reach a dispatch boundary "
+                    f"within {timeout}s — migration aborted, the session "
+                    "keeps serving on this instance")
+            snap = self._snapshot_one(s)
+        except BaseException:
+            self._dispatcher.set_session_migrating(s, False)
+            raise
+        s.closed = True
+        self._forget(s)
+        return snap
 
     def checkpoint(self, path, **io_kwargs) -> None:
         """Persist every live session through the resilient checkpoint
@@ -1405,7 +1458,8 @@ class EvolutionService:
     # -- adaptive bucket grid ------------------------------------------------
 
     def rebucket(self, *, max_buckets: int = 8,
-                 warm: Sequence[str] = ("step",)) -> dict:
+                 warm: Sequence[str] = ("step",),
+                 sizes: Optional[Sequence[int]] = None) -> dict:
         """Re-derive the bucket grid from the observed request-shape
         histogram at a quiesce point.
 
@@ -1422,7 +1476,15 @@ class EvolutionService:
         through the ordinary compile-event tap (``compiles*`` counters +
         in-trace events), so the recompile budget of a rebucket is exactly
         observable.  Returns a summary dict (old/new sizes, moved
-        sessions, compiles spent)."""
+        sessions, compiles spent).
+
+        ``sizes`` (optional) installs an EXPLICIT grid instead of
+        deriving one from this instance's histogram — the predictive
+        pre-warm path: a freshly scaled-out instance has observed no
+        traffic (``derive_policy`` raises on an empty histogram), so the
+        autoscaler pushes the fleet-merged grid the router's placement
+        layer already tracks, and the first migrated-in session lands in
+        a bucket compiled before its traffic arrives."""
         bad = [k for k in warm if k not in ("step", "init", "ask")]
         if bad:
             raise ValueError(f"cannot pre-warm kinds {bad!r} (tell needs a "
@@ -1430,9 +1492,18 @@ class EvolutionService:
         with self.quiesce():
             before = self.metrics.counter("compiles")
             old_sizes = self.policy.sizes
-            policy = self.shapes.derive_policy(
-                max_buckets=max_buckets, min_rows=self.policy.min_rows,
-                max_rows=self.policy.max_rows)
+            if sizes is not None:
+                if not sizes or any(int(r) < 1 for r in sizes):
+                    raise ValueError(f"explicit bucket sizes {sizes!r} must "
+                                     "be a non-empty list of positive rows")
+                policy = BucketPolicy(
+                    sizes=tuple(sorted(int(r) for r in sizes)),
+                    min_rows=self.policy.min_rows,
+                    max_rows=self.policy.max_rows, grow_beyond=True)
+            else:
+                policy = self.shapes.derive_policy(
+                    max_buckets=max_buckets, min_rows=self.policy.min_rows,
+                    max_rows=self.policy.max_rows)
             moved = []
             sessions = self.sessions()
             for name, s in sessions.items():

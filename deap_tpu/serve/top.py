@@ -196,7 +196,8 @@ class FleetTop:
             doc["router"] = {"url": self.router,
                              "sessions": topology.get("sessions"),
                              "sick": topology.get("sick") or {},
-                             "fleet_sizes": topology.get("fleet_sizes")}
+                             "fleet_sizes": topology.get("fleet_sizes"),
+                             "autoscale": topology.get("autoscale")}
         doc["throughput"] = self._throughput(doc["fleet"]["counters"])
         return doc
 
@@ -384,6 +385,18 @@ def render_screen(doc: Dict[str, Any], clear: bool = False) -> str:
         lines.append(f"router {router.get('url')}  routed-sessions "
                      f"{router.get('sessions')}  sick "
                      f"{sorted(sick) if sick else 'none'}")
+        scale = router.get("autoscale")
+        if scale:
+            pol = scale.get("policy") or {}
+            sig = scale.get("signals") or {}
+            lines.append(
+                f"autoscale {scale.get('decision', 'hold')}  instances "
+                f"{_fmt(sig.get('instances', '?'))} "
+                f"[{pol.get('min_instances', '?')}-"
+                f"{pol.get('max_instances', '?')}]  queue "
+                f"{_fmt(sig.get('queue_depth', 0))}  cooldown "
+                f"{_fmt(scale.get('cooldown_remaining_s', 0))}s  "
+                f"fabric-hits {counters.get('cache_fabric_hits', 0)}")
     lines.append(
         f"fleet  steps {counters.get('steps', 0)}  requests "
         f"{counters.get('requests', 0)}  completed "

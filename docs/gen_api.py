@@ -102,6 +102,12 @@ PAGES = {
                       "deap_tpu.serve.router.core",
                       "deap_tpu.serve.router.server",
                       "deap_tpu.serve.router.cli"]),
+    "serve_autoscale": ("Elastic fleet (deap_tpu.serve.autoscale)",
+                        ["deap_tpu.serve.autoscale",
+                         "deap_tpu.serve.autoscale.policy",
+                         "deap_tpu.serve.autoscale.controller",
+                         "deap_tpu.serve.autoscale.migrate",
+                         "deap_tpu.serve.autoscale.fabric"]),
     "support": ("Observability & persistence (deap_tpu.utils)",
                 ["deap_tpu.utils.support", "deap_tpu.utils.checkpoint",
                  "deap_tpu.utils.compilecache"]),

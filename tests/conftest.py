@@ -27,6 +27,7 @@ pytest_plugins = ("deap_tpu.sanitize.pytest_plugin",)
 _THREAD_LEAK_MODULES = frozenset({
     "test_serve", "test_serve_net", "test_serve_router", "test_fleettrace",
     "test_sanitize", "test_serve_top", "test_profiling", "test_chaos",
+    "test_autoscale",
 })
 
 

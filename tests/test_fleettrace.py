@@ -28,7 +28,6 @@ import http.client
 import json
 import socket
 import threading
-import time
 
 import numpy as np
 import jax
@@ -167,17 +166,14 @@ def test_loopback_step_produces_complete_span_tree():
         tid = client_steps[0]["trace_id"]
         # the server records the http.* request span in the handler's
         # finally -- AFTER the response bytes are on the wire -- so on a
-        # loaded host the client can tail the ring before it lands; poll
-        # until the request span is visible (bounded, normally instant)
-        deadline = time.monotonic() + 10.0
-        while True:
-            tail = cli.trace_tail(trace_id=tid)
-            assert tail["enabled"] is True
-            spans = tail["spans"]
-            if any(s["name"].startswith("http.") for s in spans) \
-                    or time.monotonic() >= deadline:
-                break
-            time.sleep(0.05)
+        # loaded host the client can tail the ring before it lands;
+        # condition-wait on the server tracer's ring until it does
+        # (bounded, normally instant)
+        assert svc.tracer.wait_for_span("http.", trace_id=tid,
+                                        timeout=10.0)
+        tail = cli.trace_tail(trace_id=tid)
+        assert tail["enabled"] is True
+        spans = tail["spans"]
         assert spans and all(s["trace_id"] == tid for s in spans)
 
         # request span: child of the client hop, covers everything

@@ -92,7 +92,7 @@ def test_no_blocking_sleep_coverage_pin(tmp_path):
     _write(tmp_path, "deap_tpu/__init__.py", "")
     _write(tmp_path, "deap_tpu/serve/mod.py", "x = 1\n")
     r = _findings(tmp_path, "no-blocking-sleep")
-    assert len(r.findings) == 2           # net/ and router/ both lost
+    assert len(r.findings) == 3       # net/, router/, autoscale/ lost
     assert all("lost coverage" in f.message for f in r.findings)
     r2 = run_lint(repo=tmp_path, select=["no-blocking-sleep"],
                   paths=[tmp_path / "deap_tpu" / "serve"])
@@ -105,7 +105,7 @@ def test_no_blocking_sleep_coverage_pin_whole_tree_gone(tmp_path):
     _write(tmp_path, "deap_tpu/__init__.py", "")
     _write(tmp_path, "deap_tpu/serving/mod.py", "x = 1\n")   # renamed
     r = _findings(tmp_path, "no-blocking-sleep")
-    assert len(r.findings) == 3   # serve/, serve/net/, serve/router/
+    assert len(r.findings) == 4   # serve/ + net/, router/, autoscale/
     assert all("lost coverage" in f.message for f in r.findings)
 
 
@@ -1130,6 +1130,7 @@ def test_sanitizer_factory_fires_on_raw_ctors(tmp_path):
     _write(tmp_path, "deap_tpu/__init__.py", "")
     _write(tmp_path, "deap_tpu/serve/net/__init__.py", "")
     _write(tmp_path, "deap_tpu/serve/router/__init__.py", "")
+    _write(tmp_path, "deap_tpu/serve/autoscale/__init__.py", "")
     _write(tmp_path, "deap_tpu/observability/fleettrace.py", "x = 1\n")
     _write(tmp_path, "deap_tpu/serve/raw.py", """\
         import threading
@@ -1162,12 +1163,13 @@ def test_sanitizer_factory_coverage_pin(tmp_path):
     vanished fleettrace.py) fails the gate instead of silently shrinking
     the sanitizer's instrumented surface."""
     _write(tmp_path, "deap_tpu/__init__.py", "")
-    _write(tmp_path, "deap_tpu/serve/mod.py", "x = 1\n")   # net/, router/
+    _write(tmp_path, "deap_tpu/serve/mod.py", "x = 1\n")   # subpackages
     r = _findings(tmp_path, "sanitizer-factory")           # and tracer gone
     lost = " ".join(f.message for f in r.findings)
-    assert len(r.findings) == 3, render_text(r)
+    assert len(r.findings) == 4, render_text(r)
     assert "deap_tpu/serve/net/" in lost
     assert "deap_tpu/serve/router/" in lost
+    assert "deap_tpu/serve/autoscale/" in lost
     assert "fleettrace.py" in lost
     # fixture repos without a deap_tpu package stay clean
     clean = _findings(tmp_path / "nowhere", "sanitizer-factory")

@@ -24,6 +24,15 @@ committed artifact (``BENCH_FLEET.json``, schema-gated by the
    ``freeloader`` tenant with a tiny session quota also over-subscribes,
    counting typed ``TenantQuotaExceeded`` rejections.
 
+With ``--elastic`` a fourth phase drives the autoscale subsystem end to
+end: the :class:`~deap_tpu.serve.autoscale.Autoscaler` tick path scales
+the fleet out by one pre-warmed instance, a hot session is
+live-migrated onto it (downtime measured by the migration path itself),
+``--rebalance`` more sessions follow in bulk, one cache-fabric
+digest-exchange round runs, and the fleet scales back in through
+drain→restore.  The committed artifact's ``elastic`` object feeds the
+``fleet_migration_s`` / ``fleet_rebalance_s`` perfgate rows.
+
     python tools/bench_fleet.py                          # CPU demo scale
     python tools/bench_fleet.py --sessions 1000 --backends 3 \\
         --out BENCH_FLEET.json
@@ -87,7 +96,7 @@ def _tenant_steps(backends, prefixes):
 
 def run_bench(sessions, n_backends, pop, dim, gens, max_batch, clients,
               max_inflight, fair_sessions, fair_gens, fair_inflight,
-              weights, seed):
+              weights, seed, elastic=False, rebalance_k=8):
     import jax
     from deap_tpu.serve import EvolutionService
     from deap_tpu.serve.net import RemoteService, NetServer
@@ -267,6 +276,109 @@ def run_bench(sessions, n_backends, pop, dim, gens, max_batch, clients,
             except TenantQuotaExceeded:
                 rejections += 1
         report["quota_rejections"] = rejections
+
+        # -- phase 4 (--elastic): autoscale + live migration ---------------
+        elastic_ok = True
+        if elastic:
+            from deap_tpu.serve.autoscale import (Autoscaler,
+                                                  AutoscalePolicy,
+                                                  CacheFabric,
+                                                  CallbackProvider,
+                                                  migrate_session)
+            # the failover drill retired its victim (a drained instance
+            # is terminal — its service stays draining); size the
+            # elastic bounds off the surviving healthy fleet
+            base_fleet = len(router.healthy())
+            spawned = []
+
+            def spawn():
+                svc = EvolutionService(max_batch=max_batch,
+                                       max_pending=1024)
+                srv = NetServer(svc, {"onemax": tb}).start()
+                svcs.append(svc)       # closed with the fleet
+                srvs.append(srv)
+                b = Backend(f"b{n_backends + len(spawned)}", srv.url)
+                spawned.append(b.name)
+                return b
+
+            # thresholds at zero force "out" below max / "in" at max, so
+            # the real tick() path acts on the first sample each way
+            scaler = Autoscaler(
+                router, CallbackProvider(spawn, lambda b: None),
+                policy=AutoscalePolicy(
+                    min_instances=base_fleet,
+                    max_instances=base_fleet + 1,
+                    queue_high=0.0, queue_low=0.0,
+                    out_streak=1, in_streak=1, cooldown_s=0.0))
+            fabric = CacheFabric(router)
+
+            t0 = time.monotonic()
+            acted = scaler.tick()["acted"]
+            scale_out_s = time.monotonic() - t0
+            elastic_ok = acted == "out"
+            new_name = spawned[0]
+
+            # one hot migration, timed by the migration path itself ...
+            hot = live[0]
+            out = migrate_session(router, hot.name,
+                                  target=router.backends[new_name])
+            hot.step(1)[0].result(timeout=600)
+            # ... then a bulk rebalance of rebalance_k more sessions
+            t0 = time.monotonic()
+            moved = 0
+            for h in live[1:]:
+                if moved >= rebalance_k:
+                    break
+                if router.route_of(h.name).name == new_name:
+                    continue
+                migrate_session(router, h.name,
+                                target=router.backends[new_name])
+                moved += 1
+            rebalance_s = time.monotonic() - t0
+            for h in live[1:1 + moved]:
+                h.step(1)[0].result(timeout=600)
+
+            # cache fabric: seed the journal with an explicit evaluate on
+            # the migrated session's instance, exchange one round, then
+            # replay the same rows on a session homed elsewhere — the
+            # replay must land as cross-instance fabric hits
+            probe = _population(jax.random.PRNGKey(seed + 30_000),
+                                pop, dim).genome
+            hot.evaluate(probe).result(timeout=600)
+            sync = fabric.sync_now()
+            other = next(h for h in live[1:]
+                         if router.route_of(h.name).name
+                         != router.route_of(hot.name).name)
+            other.evaluate(probe).result(timeout=600)
+            fabric_hits = sum(
+                int(b.metrics()["counters"].get("cache_fabric_hits", 0))
+                for b in list(router.backends.values()))
+
+            t0 = time.monotonic()
+            acted_in = scaler.tick()["acted"]
+            scale_in_s = time.monotonic() - t0
+            elastic_ok = elastic_ok and acted_in == "in" \
+                and len(router.healthy()) == base_fleet
+            hot.step(1)[0].result(timeout=600)   # served post-drain
+
+            counters = router.stats().counters
+            report["elastic"] = {
+                "scale_out_s": round(scale_out_s, 3),
+                "migration_downtime_s": round(out["seconds"], 3),
+                "rebalance_s": round(rebalance_s, 3),
+                "scale_in_s": round(scale_in_s, 3),
+                "migrations": 1 + moved,
+                "rebalanced_sessions": moved,
+                "fabric_exported": int(sync["exported"]),
+                "fabric_admitted": int(sync["admitted"]),
+                "fabric_hits": fabric_hits,
+                "autoscale_counters": {
+                    k: v for k, v in counters.items()
+                    if v and (k.startswith("autoscale_")
+                              or k.startswith("cache_fabric_"))}}
+            elastic_ok = elastic_ok and moved >= 1 and fabric_hits >= 1 \
+                and 0.0 <= out["seconds"] < 60.0 and rebalance_s < 300.0
+
         report["router_counters"] = {
             k: v for k, v in router.stats().counters.items()
             if v and k.startswith("router_")}
@@ -280,7 +392,8 @@ def run_bench(sessions, n_backends, pop, dim, gens, max_batch, clients,
         report["ok"] = (not errors and len(live) == sessions
                         and rejections == 3
                         and 0.0 < report["failover_recovery_s"] < 120.0
-                        and 0.2 <= report["tenant_fairness_ratio"] <= 5.0)
+                        and 0.2 <= report["tenant_fairness_ratio"] <= 5.0
+                        and elastic_ok)
         report["rc"] = 0 if report["ok"] else 1
     finally:
         for cli in pool:
@@ -316,6 +429,14 @@ def main(argv=None) -> int:
                          "the weighted shares are observable)")
     ap.add_argument("--weights", default="3,1",
                     help="gold,silver weighted-fair weights")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run the autoscale leg: scale the fleet out "
+                         "through the Autoscaler tick path, live-migrate "
+                         "a hot session plus a --rebalance bulk move onto "
+                         "the new instance, one cache-fabric exchange "
+                         "round, then scale back in (drain-restore)")
+    ap.add_argument("--rebalance", type=int, default=8,
+                    help="sessions bulk-migrated in the elastic leg")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
@@ -327,7 +448,8 @@ def main(argv=None) -> int:
                        args.gens, args.max_batch, args.clients,
                        args.max_inflight, args.fair_sessions,
                        args.fair_gens, args.fair_inflight, weights,
-                       args.seed)
+                       args.seed, elastic=args.elastic,
+                       rebalance_k=args.rebalance)
     report["wall_s"] = round(time.monotonic() - t0, 3)
     report["backend"] = jax.default_backend()
     report["devices"] = len(jax.devices())
