@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .base import Population, Fitness
+from .engines import resolve_engine
 from .utils.support import (Logbook, HallOfFame, ParetoFront,
                             hof_update, pareto_update)
 from .observability import events as _events
@@ -65,6 +66,20 @@ def _where_rows(mask, new, old):
         m = mask.reshape(mask.shape + (1,) * (a.ndim - 1))
         return jnp.where(m, a, b)
     return jax.tree_util.tree_map(w, new, old)
+
+
+def _is_nsga2_select(toolbox) -> bool:
+    """Does the toolbox select with the NSGA-II law (plain or sharded)?
+    Drives the megakernel engine's algorithm-head dispatch in
+    :func:`ea_ask` — an NSGA-II toolbox keeps its registered selection
+    (the Pallas dominance kernel on TPU) and fuses only the variation."""
+    sel = getattr(toolbox, "select", None)
+    base = getattr(sel, "func", sel)
+    from .ops.emo import sel_nsga2
+    if base is sel_nsga2:
+        return True
+    from .parallel.emo_sharded import sel_nsga2_sharded
+    return base is sel_nsga2_sharded
 
 
 # ---------------------------------------------------------------------------
@@ -286,10 +301,21 @@ def var_or(key, population: Population, toolbox, lambda_: int,
     """Vectorized varOr (reference algorithms.py:192-245): each of
     ``lambda_`` children comes from crossover (p=cxpb, keeping the first
     child of two random distinct parents), mutation (p=mutpb, on a random
-    parent) or reproduction.  All children are returned unevaluated."""
+    parent) or reproduction.  All children are returned unevaluated.
+
+    A toolbox declaring ``generation_engine = "megakernel"`` routes the
+    variation through the fused OR-choice kernel
+    (:func:`deap_tpu.ops.generation_pallas.fused_var_or`): the choice
+    mask and every parent-index draw follow this function's exact key
+    law (reproduction rows bitwise-identical), while the crossover and
+    mutation arithmetic run in one tiled pass — which is how the
+    mu±lambda loops inherit the megakernel."""
     assert cxpb + mutpb <= 1.0, (
         "The sum of the crossover and mutation probabilities must be smaller "
         "or equal to 1.0.")
+    if resolve_engine(toolbox) in ("megakernel", "megakernel_sharded"):
+        from .ops.generation_pallas import fused_var_or
+        return fused_var_or(key, population, toolbox, lambda_, cxpb, mutpb)
     n = population.size
     g = population.genome
     k_choice, k_p1, k_p2, k_cx, k_pm, k_mut, k_pr = jax.random.split(key, 7)
@@ -362,21 +388,39 @@ def ea_ask(key, population: Population, toolbox, cxpb: float, mutpb: float,
     winner indices stay bitwise-identical to this path, variation runs
     in one tiled kernel with its own deterministic in-kernel stream, and
     every produced row comes back invalid (reevaluate-all semantics).
-    The routing happens here — the one choke point — so ``ea_step``,
-    ``ea_simple``'s scan body, and the serving layer's step/ask programs
-    all inherit the engine from the toolbox."""
-    engine = getattr(toolbox, "generation_engine", "xla")
+    A megakernel toolbox whose ``select`` is ``sel_nsga2`` (or its
+    sharded form) routes to the NSGA-II fused head instead
+    (:func:`~deap_tpu.ops.generation_pallas.fused_nsga2_step`), and
+    ``"megakernel_sharded"`` (or ``"megakernel"`` plus a declared
+    ``generation_mesh``) to the mesh-sharded kernel
+    (:func:`deap_tpu.ops.generation_sharded.fused_ea_step_sharded`).
+    Engine strings resolve through ONE registry
+    (:func:`deap_tpu.engines.resolve_engine` — the single typed
+    rejection site), and the routing happens here — the one choke point
+    — so ``ea_step``, ``ea_simple``'s scan body, and the serving
+    layer's step/ask programs all inherit the engine from the
+    toolbox."""
+    engine = resolve_engine(toolbox)
     if engine == "megakernel":
+        if _is_nsga2_select(toolbox):
+            from .ops.generation_pallas import fused_nsga2_step
+            return fused_nsga2_step(key, population, toolbox, cxpb, mutpb,
+                                    live=live)
         from .ops.generation_pallas import fused_ea_step
         return fused_ea_step(key, population, toolbox, cxpb, mutpb,
                              live=live)
+    if engine == "megakernel_sharded":
+        if _is_nsga2_select(toolbox):
+            from .ops.generation_pallas import fused_nsga2_step
+            return fused_nsga2_step(key, population, toolbox, cxpb, mutpb,
+                                    live=live)
+        from .ops.generation_sharded import fused_ea_step_sharded
+        return fused_ea_step_sharded(key, population, toolbox, cxpb, mutpb,
+                                     live=live)
     if engine == "streamed":
         from .bigpop.engine import streamed_ea_ask
         return streamed_ea_ask(key, population, toolbox, cxpb, mutpb,
                                live=live)
-    if engine != "xla":
-        raise ValueError(f"unknown toolbox.generation_engine {engine!r}: "
-                         "expected 'xla', 'megakernel' or 'streamed'")
     key, k_sel, k_var = jax.random.split(key, 3)
     idx = toolbox.select(k_sel, population.fitness, population.size)
     if live is None:
@@ -434,12 +478,12 @@ def ea_step(key, population: Population, toolbox, cxpb: float, mutpb: float,
     nevals)``; bitwise identical to a generation of :func:`ea_simple` under
     the same key.
 
-    With ``toolbox.generation_engine = "megakernel"`` the generation
-    dispatches through :func:`ea_ask`'s fused-kernel route (which is
-    already reevaluate-all — the flag is redundant there) followed by a
-    full evaluation."""
-    engine = getattr(toolbox, "generation_engine", "xla")
-    if engine == "megakernel":
+    With ``toolbox.generation_engine = "megakernel"`` (or the sharded
+    form) the generation dispatches through :func:`ea_ask`'s
+    fused-kernel routes (already reevaluate-all — the flag is redundant
+    there) followed by a full evaluation."""
+    engine = resolve_engine(toolbox)
+    if engine in ("megakernel", "megakernel_sharded"):
         key, off = ea_ask(key, population, toolbox, cxpb, mutpb, live=live)
         off, nevals = ea_tell(toolbox, off, live=live)
         return key, off, nevals
@@ -702,7 +746,7 @@ def ea_simple(key, population: Population, toolbox, cxpb: float, mutpb: float,
     host-driven sliced pipeline cannot live inside this ``lax.scan``, so
     the dispatch happens here rather than in :func:`ea_step` (bitwise
     the same trajectory; in-scan knobs are rejected typed)."""
-    if getattr(toolbox, "generation_engine", "xla") == "streamed":
+    if resolve_engine(toolbox) == "streamed":
         from .bigpop.engine import streamed_ea_simple
         if reevaluate_all or stream_every:
             raise ValueError("the streamed engine does not support "

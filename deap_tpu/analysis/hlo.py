@@ -83,15 +83,20 @@ def callback_targets(txt: str) -> List[str]:
             if any(m in t.lower() for m in _CALLBACK_MARKERS)]
 
 
-_ALIAS_RE = re.compile(r"%arg(\d+):[^,)]*?\{[^}]*tf\.aliasing_output")
+_ALIAS_RE = re.compile(
+    r"%arg(\d+):[^,)]*?\{[^}]*(?:tf\.aliasing_output|jax\.buffer_donor)")
 _PARAM_RE = re.compile(r"%arg(\d+):")
 
 
 def aliased_parameters(txt: str) -> Set[int]:
     """Flat parameter indices of the lowered module's ``@main`` that
-    carry a donation marker (``tf.aliasing_output``) — i.e. the inputs
-    jax actually lowered as donated.  A declared ``donate_argnums`` that
-    produces no marker here never took effect."""
+    carry a donation marker — i.e. the inputs jax actually lowered as
+    donated.  Unsharded donations lower as a fixed input→output alias
+    (``tf.aliasing_output``); donations of arguments with a committed
+    sharding lower as ``jax.buffer_donor`` (the runtime picks the
+    aliasing per shard — same donation contract, different spelling).
+    A declared ``donate_argnums`` that produces no marker of either
+    kind never took effect."""
     main = _main_signature(txt)
     return {int(i) for i in _ALIAS_RE.findall(main)}
 

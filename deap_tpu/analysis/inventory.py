@@ -42,7 +42,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["ProgramEntry", "Lowered", "INVENTORY", "entries", "get_entry",
            "lower_entry", "require_mesh", "build_ga_scan",
-           "build_megakernel_scan", "build_streamed_slice", "N_DEV"]
+           "build_megakernel_scan", "build_megakernel_sharded_scan",
+           "build_mupl_megakernel_scan", "build_nsga2_megakernel_scan",
+           "build_streamed_slice", "N_DEV"]
 
 #: mesh width every sharded entry lowers at (tests/conftest.py and the
 #: analyze CLI both stand up this many virtual CPU devices)
@@ -304,6 +306,147 @@ def build_megakernel_scan(pop: int = 256, dim: int = DIM, ngen: int = 2,
         key.dtype, jax.dtypes.prng_key) else key, genome, values)
 
 
+def build_megakernel_sharded_scan(pop: int = 256, dim: int = DIM,
+                                  ngen: int = 2, variant: int = 0,
+                                  gather: str | None = None):
+    """The mesh-sharded fused-generation whole-run scan
+    (:mod:`deap_tpu.ops.generation_sharded`): each generation exchanges
+    the compacted fitness table + genome rows in exactly two
+    all-gathers (zero psums — the committed collective budget), resolves
+    tournament winners against the replicated rank table, and varies at
+    global row coordinates.  Public and parameterized so the bench
+    driver (``tools/bench_megakernel.py``, sharded leg) and the
+    inventory entry lower the SAME program."""
+    from .. import benchmarks
+    from ..ops.generation_pallas import GenomeStorage, pad_dim
+    from ..ops.generation_sharded import fused_generation_sharded
+    mesh = require_mesh()
+    storage = GenomeStorage()
+    dpad = pad_dim(dim) if jax.default_backend() == "tpu" else dim
+
+    def eval_rows(g):
+        return jax.vmap(lambda x: benchmarks.rastrigin(x)[0])(
+            g[:, :dim])[:, None]
+
+    def generation(carry, _):
+        key, g, fv = carry
+        key, k_sel, k_var = jax.random.split(key, 3)
+        g2, _ = fused_generation_sharded(
+            k_sel, k_var, g, -fv, mesh=mesh, dim=dim, cxpb=0.9, mutpb=0.5,
+            mut_sigma=0.3, indpb=0.05, tournsize=3, storage=storage,
+            gather=gather)
+        fv2 = eval_rows(g2)
+        return (key, g2, fv2), jnp.min(fv2)
+
+    def run(key, genome, values):
+        return lax.scan(generation, (key, genome, values), None,
+                        length=ngen)
+
+    key = jax.random.PRNGKey(variant)
+    g0 = jax.random.uniform(jax.random.fold_in(key, 1), (pop, dpad),
+                            jnp.float32, -5.12, 5.12)
+    g0 = g0.at[:, dim:].set(0.0)
+    values = eval_rows(g0)
+    sh = NamedSharding(mesh, P("pop", None))
+    return run, (jax.random.key_data(key) if jax.dtypes.issubdtype(
+        key.dtype, jax.dtypes.prng_key) else key,
+        jax.device_put(g0, sh), jax.device_put(values, sh))
+
+
+def build_mupl_megakernel_scan(pop: int = POP, dim: int = DIM,
+                               ngen: int = 2, variant: int = 0,
+                               engine: str = "megakernel"):
+    """The (mu+lambda) generation scan with the megakernel ``var_or``
+    engine: the OR-choice mask and parent indices follow the exact
+    traced ``var_or`` key law while crossover+mutation arithmetic run
+    as one fused tile pass
+    (:func:`deap_tpu.ops.generation_pallas.fused_var_or`).
+    ``engine="xla"`` builds the traced reference form — the bench
+    driver times both legs of the SAME loop body."""
+    from .. import base, benchmarks
+    from ..algorithms import var_or
+    from ..ops import crossover, mutation, selection
+    tb = base.Toolbox()
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=0.3,
+                indpb=0.05)
+    tb.register("select", selection.sel_best)
+    tb.generation_engine = engine
+    lambda_ = pop
+
+    def eval_rows(g):
+        return jax.vmap(lambda x: benchmarks.rastrigin(x)[0])(g)[:, None]
+
+    def generation(carry, _):
+        key, g, fv = carry
+        key, k_var, k_sel = jax.random.split(key, 3)
+        parents = base.Population(
+            g, base.Fitness(values=fv, valid=jnp.ones(pop, bool),
+                            weights=(-1.0,)))
+        off = var_or(k_var, parents, tb, lambda_, 0.6, 0.3)
+        off_vals = eval_rows(off.genome)
+        off = base.Population(
+            off.genome, base.Fitness(values=off_vals,
+                                     valid=jnp.ones(lambda_, bool),
+                                     weights=(-1.0,)))
+        pool = parents.concat(off)
+        idx = tb.select(k_sel, pool.fitness, pop)
+        new = pool.take(idx)
+        return (key, new.genome, new.fitness.values), jnp.min(off_vals)
+
+    def run(key, genome, values):
+        return lax.scan(generation, (key, genome, values), None,
+                        length=ngen)
+
+    key = jax.random.PRNGKey(23 + variant)
+    genome = jax.random.uniform(jax.random.fold_in(key, 1), (pop, dim),
+                                jnp.float32, -5.12, 5.12)
+    values = eval_rows(genome)
+    return run, (jax.random.key_data(key) if jax.dtypes.issubdtype(
+        key.dtype, jax.dtypes.prng_key) else key, genome, values)
+
+
+def build_nsga2_megakernel_scan(pop: int = POP, dim: int = DIM,
+                                ngen: int = 2, variant: int = 0):
+    """The NSGA-II generation scan under the megakernel engine:
+    selection stays ``sel_nsga2`` (feeding the Pallas dominance kernel
+    on TPU) and the variation runs as the fused tile pass
+    (:func:`deap_tpu.ops.generation_pallas.fused_nsga2_step` —
+    ``ea_step``'s algorithm-head dispatch)."""
+    from .. import base
+    from ..algorithms import ea_step
+    from ..ops import crossover, mutation
+    from ..ops.emo import sel_nsga2
+    tb = base.Toolbox()
+    tb.register("evaluate",
+                lambda g: (jnp.sum(g * g), jnp.sum((g - 1.0) ** 2)))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=0.3,
+                indpb=0.05)
+    tb.register("select", sel_nsga2, front_chunk=32)
+    tb.generation_engine = "megakernel"
+
+    def generation(carry, _):
+        key, g, v, valid = carry
+        pop_obj = base.Population(
+            g, base.Fitness(values=v, valid=valid, weights=(-1.0, -1.0)))
+        key, new, nevals = ea_step(key, pop_obj, tb, 0.9, 0.5)
+        return ((key, new.genome, new.fitness.values, new.fitness.valid),
+                nevals)
+
+    def run(key, genome, values, valid):
+        return lax.scan(generation, (key, genome, values, valid), None,
+                        length=ngen)
+
+    key = jax.random.PRNGKey(29 + variant)
+    genome = jax.random.uniform(jax.random.fold_in(key, 1), (pop, dim),
+                                jnp.float32, -1.0, 1.0)
+    values = jnp.zeros((pop, 2), jnp.float32)
+    valid = jnp.zeros((pop,), bool)
+    return run, (jax.random.key_data(key) if jax.dtypes.issubdtype(
+        key.dtype, jax.dtypes.prng_key) else key, genome, values, valid)
+
+
 def build_streamed_slice(pop: int = POP, dim: int = DIM,
                          slice_rows: int = 16, variant: int = 0):
     """One per-slice device program of the streamed (out-of-core)
@@ -520,6 +663,32 @@ INVENTORY: Tuple[ProgramEntry, ...] = (
         doc="fused generation scan with bf16 genome residency (f32 "
             "fitness accumulation + f32 mutation arithmetic); the "
             "dtype-traffic pass audits the narrow-storage contract"),
+    ProgramEntry(
+        name="ga_generation_megakernel_sharded",
+        anchor="deap_tpu/ops/generation_sharded.py",
+        build=build_megakernel_sharded_scan, mesh=True,
+        donate=(0, 1, 2), budget=True, storage_dtype="float32",
+        doc="mesh-sharded fused generation scan (pop=256 over 8 "
+            "devices): compacted fitness table + genome rows exchanged "
+            "in exactly two all-gathers per generation (zero psums -- "
+            "the committed collective budget); winner indices "
+            "bitwise-equal to the XLA sharded path"),
+    ProgramEntry(
+        name="mupl_generation_megakernel",
+        anchor="deap_tpu/ops/generation_pallas.py",
+        build=build_mupl_megakernel_scan, donate=(0, 1, 2), budget=True,
+        storage_dtype="float32",
+        doc="(mu+lambda) generation scan with var_or routed through "
+            "the fused variation kernel (OR-choice mask follows the "
+            "exact traced var_or key law)"),
+    ProgramEntry(
+        name="nsga2_generation_megakernel",
+        anchor="deap_tpu/ops/generation_pallas.py",
+        build=build_nsga2_megakernel_scan, donate=(0, 1, 2, 3),
+        budget=True, storage_dtype="float32",
+        doc="NSGA-II generation scan under the megakernel engine: "
+            "sel_nsga2 selection head feeding the fused variation "
+            "pass (ea_step's algorithm-head dispatch)"),
     ProgramEntry(
         name="ga_generation_streamed",
         anchor="deap_tpu/bigpop/engine.py",

@@ -546,6 +546,16 @@ def _require_concrete(population: Population) -> None:
             "streamed_ea_simple / run_streamed_resumable as the loop")
 
 
+def _validate_engine(toolbox) -> None:
+    """Registry-typed validation at the streamed entry points: a toolbox
+    that declares both the streamed engine and a ``generation_mesh`` is
+    a contradiction (host round-trips cannot target a mesh program) and
+    rejects here through :func:`deap_tpu.engines.resolve_engine` — the
+    same single rejection site every other engine route uses."""
+    from ..engines import resolve_engine
+    resolve_engine(toolbox)
+
+
 def streamed_ea_ask(key, population: Population, toolbox, cxpb, mutpb, *,
                     live=None, slice_rows: Optional[int] = None):
     """Streamed form of the :func:`~deap_tpu.algorithms.ea_ask` half:
@@ -554,6 +564,7 @@ def streamed_ea_ask(key, population: Population, toolbox, cxpb, mutpb, *,
     touched rows invalid — bitwise identical to the resident ask.
     Host-driven: not traceable under jit (the serve layer dispatches
     streamed sessions on a dedicated host path)."""
+    _validate_engine(toolbox)
     _require_concrete(population)
     host = HostPopulation.from_population(population, toolbox)
     eng = StreamedEngine(toolbox, host, slice_rows=slice_rows)
@@ -571,6 +582,7 @@ def streamed_ea_step(key, population: Population, toolbox, cxpb, mutpb, *,
     """Streamed form of one full :func:`~deap_tpu.algorithms.ea_step`
     generation (fused per-slice evaluation).  Returns ``(key,
     population, nevals)`` — bitwise identical to the resident step."""
+    _validate_engine(toolbox)
     _require_concrete(population)
     host = HostPopulation.from_population(population, toolbox)
     eng = StreamedEngine(toolbox, host, slice_rows=slice_rows)
@@ -590,6 +602,7 @@ def streamed_ea_simple(key, population, toolbox, cxpb: float, mutpb: float,
     is not supported on the streamed path."""
     if telemetry is not None:
         raise ValueError("streamed_ea_simple does not support telemetry")
+    _validate_engine(toolbox)
     from ..algorithms import _hof_setup, _record
     from ..utils.support import Logbook
 

@@ -236,7 +236,8 @@ def _schema_errors(kind: str, doc) -> List[str]:
         if not isinstance(res, dict):
             errors.append("key 'result' must be an object")
         else:
-            for leg in ("xla_f32", "mega_f32", "mega_bf16"):
+            for leg in ("xla_f32", "mega_f32", "mega_bf16",
+                        "sharded_f32", "mupl_xla_f32", "mupl_f32"):
                 sub = res.get(leg)
                 if not isinstance(sub, dict):
                     errors.append(f"result.{leg} must be an object with "
@@ -247,7 +248,28 @@ def _schema_errors(kind: str, doc) -> List[str]:
                         or not math.isfinite(float(pg)) or pg <= 0:
                     errors.append(f"result.{leg}.per_gen_ms must be a "
                                   "finite positive number")
-            for key in ("speedup_mega_f32", "bf16_traffic_savings_frac"):
+            # the sharded leg doubles as the cross-device proof: its
+            # committed run re-verifies winner indices + genome bits
+            # against the single-device fused path in-process, so
+            # anything but true means the sharded generation diverged
+            # and must not be committed (the bench-ooc discipline)
+            sharded = res.get("sharded_f32")
+            if isinstance(sharded, dict):
+                if sharded.get("bitwise_identical") is not True:
+                    errors.append("result.sharded_f32.bitwise_identical "
+                                  "must be true -- the committed sharded "
+                                  "leg is the device-count-invariance "
+                                  "proof; anything else means the "
+                                  "sharded generation diverged and must "
+                                  "not be committed")
+                nd = sharded.get("n_devices")
+                if isinstance(nd, bool) or not isinstance(nd, int) \
+                        or nd < 2:
+                    errors.append("result.sharded_f32.n_devices must be "
+                                  "an integer >= 2 (a sharded leg timed "
+                                  "on one device is not a sharded leg)")
+            for key in ("speedup_mega_f32", "bf16_traffic_savings_frac",
+                        "speedup_sharded_f32", "speedup_mupl_f32"):
                 v = res.get(key)
                 if isinstance(v, bool) or not isinstance(v, (int, float)) \
                         or not math.isfinite(float(v)):

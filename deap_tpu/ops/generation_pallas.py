@@ -70,7 +70,9 @@ from ..base import lex_sort_indices
 from .selection import tournament_positions
 
 __all__ = ["GenomeStorage", "STORAGE_DTYPES", "fused_generation",
-           "fused_ea_step", "megakernel_params", "pad_dim", "LANE"]
+           "fused_ea_step", "fused_var_or", "fused_nsga2_step",
+           "megakernel_params", "megakernel_variation_params", "pad_dim",
+           "LANE"]
 
 LANE = 128
 #: tile-row candidates, largest first; all are multiples of the int8
@@ -232,13 +234,22 @@ def _narrow_tile(v: jax.Array, sdt, scale: float) -> jax.Array:
 def _vary_tile(v: jax.Array, seed: jax.Array, row_base, dim: int,
                knobs, hw_rng: bool) -> jax.Array:
     """Crossover + mutation on one gathered f32 tile ``v`` of shape
-    (R, dim_pad).  Pairing is halves-in-tile (row i mates row i + R/2):
-    winners are iid draws, so any fixed pairing is distributionally
-    identical to the reference's adjacent pairing (the same argument as
-    ``vary_genome(pairing="halves")``).  ``knobs`` is the SMEM scalar
-    vector [cxpb, mutpb, mut_mu, mut_sigma, indpb].  Draw order is
-    fixed; every draw folds the per-call seed with a distinct draw id,
-    so streams never collide across draws, tiles, or generations."""
+    (R, dim_pad).  Pairing is blocked on the fixed 32-row quantum (row
+    i mates row ``i ^ 16`` within its 32-row block of ABSOLUTE rows) —
+    NOT on whatever tile the executor happens to stream — so the mating
+    plan, like the coordinate-hashed counter stream, is a pure function
+    of global row indices: the trajectory is invariant to the ``rows=``
+    tiling and to the device count (a mesh shard is just a
+    32-row-quantum slice of the same global plan).  At R == 32 this IS
+    the historical halves-in-tile law, bit for bit.  Winners are iid
+    draws, so any fixed pairing is distributionally identical to the
+    reference's adjacent pairing (the same argument as
+    ``vary_genome(pairing="halves")``); tiles that don't hold the 32
+    quantum (explicit odd ``rows=``) keep the tile-local halves law.
+    ``knobs`` is the SMEM scalar vector [cxpb, mutpb, mut_mu,
+    mut_sigma, indpb].  Draw order is fixed; every draw folds the
+    per-call seed with a distinct draw id, so streams never collide
+    across draws, tiles, or generations."""
     R, dpad = v.shape
     half = R // 2
     cxpb, mutpb = knobs[0], knobs[1]
@@ -259,28 +270,43 @@ def _vary_tile(v: jax.Array, seed: jax.Array, row_base, dim: int,
         def draw(d, shape):
             return _uniform_tile(useed, d, shape, row_base)
 
-    cols = lax.broadcasted_iota(jnp.int32, (half, dpad), 1)
-
-    # --- two-point crossover on (i, i + R/2) pairs -----------------------
-    # the counter hash is COORDINATE-based: a (half, 8) draw grid holds
-    # the identical values at lanes 0..2 as a (half, LANE) one would, so
-    # narrow per-row draws cost 8 lanes of hashing, not 128
-    u_pair = draw(1, (half, 8))             # lanes 0..2 consumed
-    do_cx = u_pair[:, 0:1] < cxpb
+    # --- two-point crossover -------------------------------------------
+    # the counter hash is COORDINATE-based: a narrow 8-lane draw grid
+    # holds the identical values at lanes 0..2 as a full-LANE one would,
+    # so per-row draws cost 8 lanes of hashing, not 128
+    if R % 32 == 0:
+        # 32-row-quantum pairing, computed blockwise: fold the tile to
+        # (R/32, 32, dpad) so the a-rows (first 16 of each block) and
+        # their partners are static slices — same half-size swap grids
+        # as the historical form, no full-tile partner materialization.
+        # The a-row draw coordinates are rows {b*32 + j : j < 16} of a
+        # (R, 8) grid; the coordinate hash makes the b-row halves of
+        # that grid dead lanes, not extra entropy.
+        nb_ = R // 32
+        u_all = draw(1, (R, 8))             # lanes 0..2 consumed
+        u_pair = u_all.reshape(nb_, 32, 8)[:, :16]
+        cols = lax.broadcasted_iota(jnp.int32, (nb_, 16, dpad), 2)
+        vb = v.reshape(nb_, 32, dpad)
+        ga, gb = vb[:, :16], vb[:, 16:]
+    else:
+        # legacy tile-local halves pairing for off-quantum tiles
+        u_pair = draw(1, (half, 8))         # lanes 0..2 consumed
+        cols = lax.broadcasted_iota(jnp.int32, (half, dpad), 1)
+        ga, gb = v[:half], v[half:]
+    do_cx = u_pair[..., 0:1] < cxpb
     # reference _two_cut_points law: c1 ∈ [1, dim], c2 ∈ [1, dim-1]
     # bumped past c1, then ordered
-    c1 = 1 + jnp.floor(u_pair[:, 1:2] * dim).astype(jnp.int32)
+    c1 = 1 + jnp.floor(u_pair[..., 1:2] * dim).astype(jnp.int32)
     c1 = jnp.minimum(c1, dim)
-    c2 = 1 + jnp.floor(u_pair[:, 2:3] * (dim - 1)).astype(jnp.int32)
+    c2 = 1 + jnp.floor(u_pair[..., 2:3] * (dim - 1)).astype(jnp.int32)
     c2 = jnp.minimum(c2, dim - 1)
     c2 = jnp.where(c2 >= c1, c2 + 1, c2)
     lo = jnp.minimum(c1, c2)
     hi = jnp.maximum(c1, c2)
     swap = do_cx & (cols >= lo) & (cols < hi)
-    ga, gb = v[:half], v[half:]
     na = jnp.where(swap, gb, ga)
     nb = jnp.where(swap, ga, gb)
-    v = jnp.concatenate([na, nb], axis=0)
+    v = jnp.concatenate([na, nb], axis=-2).reshape(R, dpad)
 
     # --- Gaussian mutation (per-row gate, per-gene mask + noise) ---------
     # ONE uniform grid serves both the per-gene Bernoulli mask and the
@@ -302,6 +328,48 @@ def _vary_tile(v: jax.Array, seed: jax.Array, row_base, dim: int,
     return jnp.where(do_mut & gene & (cols_full < dim), v + noise, v)
 
 
+def _var_or_tile(a: jax.Array, b: jax.Array, code: jax.Array,
+                 seed: jax.Array, row_base, dim: int, knobs) -> jax.Array:
+    """The OR-choice variation on one f32 tile — the kernel half of
+    :func:`fused_var_or`.  ``a`` (R, dim_pad) holds each row's primary
+    parent (p1 for crossover rows, the mutation parent for mutation
+    rows, the reproduction parent otherwise), ``b`` the crossover
+    partner, ``code`` (R, 1) int32 the per-row choice (0=cx, 1=mut,
+    2=repro) drawn OUTSIDE by the exact ``var_or`` law — so the choice
+    mask and all parent indices stay bitwise-identical to the traced
+    path, and only the operator arithmetic moves into the kernel.
+
+    ``knobs`` = [mut_mu, mut_sigma, indpb].  Unlike the var_and tile
+    there is no pairing and no per-row mutation gate (the row-level
+    choice IS the gate, matching ``mut_gaussian`` applied per chosen
+    row).  Draw ids 4 (cut pair) and 5 (gene grid) keep the stream
+    disjoint from the var_and tile's ids 1..3 under a shared seed."""
+    R, dpad = a.shape
+    mu, sigma, indpb = knobs[0], knobs[1], knobs[2]
+    useed = lax.bitcast_convert_type(seed, jnp.uint32)
+    cols = lax.broadcasted_iota(jnp.int32, (R, dpad), 1)
+
+    # --- two-point crossover, first child kept (per-row cut pair) --------
+    u_cut = _uniform_tile(useed, 4, (R, 8), row_base)
+    c1 = 1 + jnp.floor(u_cut[:, 0:1] * dim).astype(jnp.int32)
+    c1 = jnp.minimum(c1, dim)
+    c2 = 1 + jnp.floor(u_cut[:, 1:2] * (dim - 1)).astype(jnp.int32)
+    c2 = jnp.minimum(c2, dim - 1)
+    c2 = jnp.where(c2 >= c1, c2 + 1, c2)
+    lo = jnp.minimum(c1, c2)
+    hi = jnp.maximum(c1, c2)
+    v = jnp.where((code == 0) & (cols >= lo) & (cols < hi), b, a)
+
+    # --- Gaussian mutation (per-gene mask + noise from one grid) ---------
+    u_gene = _uniform_tile(useed, 5, (R, dpad), row_base)
+    gene = u_gene < indpb
+    un = jnp.clip(u_gene * (1.0 / indpb),
+                  jnp.float32(2.0 ** -25), jnp.float32(1.0 - 2.0 ** -25))
+    z = jnp.float32(1.4142135623730951) * lax.erf_inv(2.0 * un - 1.0)
+    noise = mu + sigma * z
+    return jnp.where((code == 1) & gene & (cols < dim), v + noise, v)
+
+
 # ---------------------------------------------------------------------------
 # the megakernel
 # ---------------------------------------------------------------------------
@@ -310,19 +378,29 @@ def _vary_tile(v: jax.Array, seed: jax.Array, row_base, dim: int,
 @functools.partial(jax.jit, static_argnames=(
     "dim", "tournsize", "rows", "window", "storage_dtype", "scale",
     "hw_rng", "interpret"))
-def _megakernel_dma(order, pos, seed, knobs, genome, *, dim: int,
-                    tournsize: int, rows: int, window: int,
+def _megakernel_dma(order, pos, seed, knobs, genome, row_base0=None, *,
+                    dim: int, tournsize: int, rows: int, window: int,
                     storage_dtype: str, scale: float, hw_rng: bool,
                     interpret: bool):
     """The one-pass form: winner resolution against the VMEM-resident
     rank table, per-row DMA genome gather from HBM, fused variation,
-    one output tile written.  Returns ``(new_genome, winner_idx)``."""
+    one output tile written.  Returns ``(new_genome, winner_idx)``.
+
+    ``pos`` may cover fewer rows than ``genome`` (``out_n = len(pos)``):
+    the sharded form resolves only its own shard's positions against the
+    full replicated table.  ``row_base0`` offsets the PRNG row
+    coordinates (the shard's global first row), keeping the draw stream
+    bitwise-identical to the single-device kernel over the same global
+    rows; ``None`` means base 0 without an extra SMEM operand."""
     del tournsize      # consumed by the position law outside
     pop, dpad = genome.shape
+    out_n = pos.shape[0]
     tab_rows = pop // LANE
     sdt = jnp.dtype(storage_dtype)
+    base = (jnp.zeros((1,), jnp.int32) if row_base0 is None
+            else jnp.asarray(row_base0, jnp.int32).reshape(1))
 
-    def kernel(pos_ref, order_ref, seed_ref, knobs_ref, g_ref,
+    def kernel(pos_ref, order_ref, seed_ref, knobs_ref, base_ref, g_ref,
                out_ref, widx_ref, parents, sems):
         lanes1 = lax.broadcasted_iota(jnp.int32, (1, LANE), 1)
 
@@ -356,18 +434,19 @@ def _megakernel_dma(order, pos, seed, knobs, genome, *, dim: int,
         lax.fori_loop(rows - window, rows, drain, 0, unroll=False)
 
         v = _widen_tile(parents[:], sdt, scale)
-        row_base = (pl.program_id(0) * rows).astype(jnp.uint32)
+        row_base = (pl.program_id(0) * rows + base_ref[0]).astype(jnp.uint32)
         v = _vary_tile(v, seed_ref[0], row_base, dim, knobs_ref, hw_rng)
         out_ref[:] = _narrow_tile(v, sdt, scale)
 
     return pl.pallas_call(
         kernel,
-        grid=(pop // rows,),
+        grid=(out_n // rows,),
         in_specs=[
             pl.BlockSpec((rows, 1), lambda g: (g, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((tab_rows, LANE), lambda g: (0, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.ANY),
@@ -379,32 +458,37 @@ def _megakernel_dma(order, pos, seed, knobs, genome, *, dim: int,
                          memory_space=pltpu.SMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((pop, dpad), sdt),
-            jax.ShapeDtypeStruct((pop, 1), jnp.int32),
+            jax.ShapeDtypeStruct((out_n, dpad), sdt),
+            jax.ShapeDtypeStruct((out_n, 1), jnp.int32),
         ],
         scratch_shapes=[pltpu.VMEM((rows, dpad), sdt),
                         pltpu.SemaphoreType.DMA((window,))],
         interpret=interpret,
     )(pos[:, None], order.reshape(tab_rows, LANE), seed.reshape(1),
-      knobs, genome)
+      knobs, base, genome)
 
 
 @functools.partial(jax.jit, static_argnames=(
     "dim", "rows", "storage_dtype", "scale"))
-def _megakernel_xla_exec(parents, seed, knobs, *, dim: int, rows: int,
-                         storage_dtype: str, scale: float):
+def _megakernel_xla_exec(parents, seed, knobs, row_base0=None, *,
+                         dim: int, rows: int, storage_dtype: str,
+                         scale: float):
     """The fused variation evaluated as plain traced XLA ops: the SAME
     tile function, vmapped over the tile axis with the same per-tile
     row bases, so the output is bitwise-identical to the Pallas
     executor (test-pinned).  This is the non-TPU execution engine — the
     Pallas interpreter emulates refs per grid step and measured ~6x
     slower than XLA's own fusion of the identical op graph, while on
-    TPU the hand-scheduled kernel is the point."""
+    TPU the hand-scheduled kernel is the point.  ``row_base0`` offsets
+    the global row coordinates (a shard's first row), matching the
+    sharded kernel's draw stream."""
     sdt = jnp.dtype(storage_dtype)
     pop, dpad = parents.shape
     v = _widen_tile(parents, sdt, scale)
     tiles = v.reshape(pop // rows, rows, dpad)
     row_bases = jnp.arange(pop // rows, dtype=jnp.uint32) * jnp.uint32(rows)
+    if row_base0 is not None:
+        row_bases = row_bases + jnp.asarray(row_base0, jnp.uint32)
     out = jax.vmap(lambda t, rb: _vary_tile(t, seed, rb, dim, knobs,
                                             False))(tiles, row_bases)
     return _narrow_tile(out.reshape(pop, dpad), sdt, scale)
@@ -412,20 +496,23 @@ def _megakernel_xla_exec(parents, seed, knobs, *, dim: int, rows: int,
 
 @functools.partial(jax.jit, static_argnames=(
     "dim", "rows", "storage_dtype", "scale", "hw_rng", "interpret"))
-def _megakernel_host(parents, seed, knobs, *, dim: int, rows: int,
-                     storage_dtype: str, scale: float, hw_rng: bool,
-                     interpret: bool):
+def _megakernel_host(parents, seed, knobs, row_base0=None, *, dim: int,
+                     rows: int, storage_dtype: str, scale: float,
+                     hw_rng: bool, interpret: bool):
     """The host-gather form: winners already gathered (XLA's gather —
     measured the best row-gather engine on the bench chip, and the only
     compiled one under the interpreter); the kernel runs the fused
     variation pass only.  Identical draw stream to the DMA form, so the
-    two outputs are bitwise-equal."""
+    two outputs are bitwise-equal.  ``row_base0`` offsets the global
+    row coordinates for the sharded form."""
     pop, dpad = parents.shape
     sdt = jnp.dtype(storage_dtype)
+    base = (jnp.zeros((1,), jnp.int32) if row_base0 is None
+            else jnp.asarray(row_base0, jnp.int32).reshape(1))
 
-    def kernel(seed_ref, knobs_ref, p_ref, out_ref):
+    def kernel(seed_ref, knobs_ref, base_ref, p_ref, out_ref):
         v = _widen_tile(p_ref[:], sdt, scale)
-        row_base = (pl.program_id(0) * rows).astype(jnp.uint32)
+        row_base = (pl.program_id(0) * rows + base_ref[0]).astype(jnp.uint32)
         v = _vary_tile(v, seed_ref[0], row_base, dim, knobs_ref, hw_rng)
         out_ref[:] = _narrow_tile(v, sdt, scale)
 
@@ -435,6 +522,7 @@ def _megakernel_host(parents, seed, knobs, *, dim: int, rows: int,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((rows, dpad), lambda g: (g, 0),
                          memory_space=pltpu.VMEM),
         ],
@@ -442,7 +530,57 @@ def _megakernel_host(parents, seed, knobs, *, dim: int, rows: int,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((pop, dpad), sdt),
         interpret=interpret,
-    )(seed.reshape(1), knobs, parents)
+    )(seed.reshape(1), knobs, base, parents)
+
+
+@functools.partial(jax.jit, static_argnames=("dim", "rows"))
+def _var_or_xla_exec(a, b, code, seed, knobs, *, dim: int, rows: int):
+    """:func:`_var_or_tile` as plain traced XLA ops (the non-TPU engine
+    and the bitwise oracle for the Pallas executor — same contract as
+    :func:`_megakernel_xla_exec`)."""
+    n, dpad = a.shape
+    at = a.reshape(n // rows, rows, dpad)
+    bt = b.reshape(n // rows, rows, dpad)
+    ct = code.reshape(n // rows, rows, 1)
+    row_bases = jnp.arange(n // rows, dtype=jnp.uint32) * jnp.uint32(rows)
+    out = jax.vmap(lambda ta, tb, tc, rb: _var_or_tile(
+        ta, tb, tc, seed, rb, dim, knobs))(at, bt, ct, row_bases)
+    return out.reshape(n, dpad)
+
+
+@functools.partial(jax.jit, static_argnames=("dim", "rows", "interpret"))
+def _var_or_pallas(a, b, code, seed, knobs, *, dim: int, rows: int,
+                   interpret: bool):
+    """:func:`_var_or_tile` as a tiled Pallas pass.  The per-row choice
+    rides in a VMEM int32 lane-broadcast plane (the choice participates
+    in vectorized selects, so scalar memory is the wrong home for it).
+    Bitwise-equal to :func:`_var_or_xla_exec` — test-pinned."""
+    n, dpad = a.shape
+    code2d = jnp.broadcast_to(code.astype(jnp.int32)[:, None], (n, LANE))
+
+    def kernel(seed_ref, knobs_ref, code_ref, a_ref, b_ref, out_ref):
+        row_base = (pl.program_id(0) * rows).astype(jnp.uint32)
+        out_ref[:] = _var_or_tile(a_ref[:], b_ref[:], code_ref[:, 0:1],
+                                  seed_ref[0], row_base, dim, knobs_ref)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n // rows,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((rows, LANE), lambda g: (g, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, dpad), lambda g: (g, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, dpad), lambda g: (g, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rows, dpad), lambda g: (g, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, dpad), jnp.float32),
+        interpret=interpret,
+    )(seed.reshape(1), knobs, code2d, a, b)
 
 
 def fused_generation(k_sel, k_var, genome, wvalues, *, dim: int,
@@ -558,28 +696,25 @@ def fused_generation(k_sel, k_var, genome, wvalues, *, dim: int,
 # ---------------------------------------------------------------------------
 
 
-def megakernel_params(toolbox) -> dict:
-    """Extract (and validate) the megakernel's operator parameters from
-    a toolbox.  The fused kernel hard-codes the flagship operator set —
-    ``sel_tournament`` (rank positions), ``cx_two_point``, and
-    ``mut_gaussian`` — so a toolbox registered with anything else raises
-    here instead of silently running different operators."""
-    from . import crossover, mutation, selection as sel_mod
+def megakernel_variation_params(toolbox) -> dict:
+    """Validate the toolbox's VARIATION operators against the fused tile
+    kernel and return its mutation knobs.  The kernel hard-codes
+    ``cx_two_point`` + ``mut_gaussian``; selection is deliberately NOT
+    constrained here — the mu±lambda loops (``sel_best`` et al.) and the
+    NSGA-II head bring their own selection law, while the GA flagship
+    adds the tournament checks in :func:`megakernel_params`."""
+    from . import crossover, mutation
 
     def base_fn(tool):
         return getattr(tool, "func", tool)
 
-    if base_fn(toolbox.select) is not sel_mod.sel_tournament:
-        raise ValueError("megakernel generation needs "
-                         "select=sel_tournament (rank-position law); got "
-                         f"{getattr(base_fn(toolbox.select), '__name__', '?')}")
     if base_fn(toolbox.mate) is not crossover.cx_two_point:
         raise ValueError("megakernel generation needs mate=cx_two_point; "
                          f"got {getattr(base_fn(toolbox.mate), '__name__', '?')}")
     if base_fn(toolbox.mutate) is not mutation.mut_gaussian:
         raise ValueError("megakernel generation needs mutate=mut_gaussian; "
                          f"got {getattr(base_fn(toolbox.mutate), '__name__', '?')}")
-    for name in ("select", "mate", "mutate"):
+    for name in ("mate", "mutate"):
         if getattr(getattr(toolbox, name), "args", ()):
             # positional frozen args are ambiguous (same rule as the
             # algorithms-layer batched dispatch): silently substituting
@@ -588,8 +723,34 @@ def megakernel_params(toolbox) -> dict:
                 f"megakernel generation: toolbox.{name} froze positional "
                 "arguments; register operator parameters as keywords "
                 "(tournsize=, mu=, sigma=, indpb=)")
-    sel_kw = dict(getattr(toolbox.select, "keywords", {}))
     mut_kw = dict(getattr(toolbox.mutate, "keywords", {}))
+    return {"mut_mu": mut_kw.get("mu", 0.0),
+            "mut_sigma": mut_kw.get("sigma", 0.3),
+            "indpb": mut_kw.get("indpb", 0.05)}
+
+
+def megakernel_params(toolbox) -> dict:
+    """Extract (and validate) the megakernel's operator parameters from
+    a toolbox.  The fused kernel hard-codes the flagship operator set —
+    ``sel_tournament`` (rank positions), ``cx_two_point``, and
+    ``mut_gaussian`` — so a toolbox registered with anything else raises
+    here instead of silently running different operators."""
+    from . import selection as sel_mod
+
+    def base_fn(tool):
+        return getattr(tool, "func", tool)
+
+    if base_fn(toolbox.select) is not sel_mod.sel_tournament:
+        raise ValueError("megakernel generation needs "
+                         "select=sel_tournament (rank-position law); got "
+                         f"{getattr(base_fn(toolbox.select), '__name__', '?')}")
+    params = megakernel_variation_params(toolbox)
+    if getattr(toolbox.select, "args", ()):
+        raise ValueError(
+            "megakernel generation: toolbox.select froze positional "
+            "arguments; register operator parameters as keywords "
+            "(tournsize=, mu=, sigma=, indpb=)")
+    sel_kw = dict(getattr(toolbox.select, "keywords", {}))
     if sel_kw.get("tie_break", "random") != "rank":
         # the kernel resolves winners from the deterministic rank table
         # (no per-call tie jitter); honoring the bitwise-index contract
@@ -599,10 +760,8 @@ def megakernel_params(toolbox) -> dict:
             "register select=sel_tournament with tie_break='rank' (the "
             "default tie_break='random' jitters ties per call, which the "
             "fused kernel does not implement)")
-    return {"tournsize": int(sel_kw.get("tournsize", 3)),
-            "mut_mu": mut_kw.get("mu", 0.0),
-            "mut_sigma": mut_kw.get("sigma", 0.3),
-            "indpb": mut_kw.get("indpb", 0.05)}
+    params["tournsize"] = int(sel_kw.get("tournsize", 3))
+    return params
 
 
 def fused_ea_step(key, population, toolbox, cxpb, mutpb, *, live=None,
@@ -660,3 +819,160 @@ def fused_ea_step(key, population, toolbox, cxpb, mutpb, *, live=None,
         fit = _dc.replace(fit, values=jnp.where(
             live[:, None], fit.values, population.fitness.values))
     return key, Population(new_genome, fit)
+
+
+def fused_var_or(key, population, toolbox, lambda_: int, cxpb, mutpb, *,
+                 vary_exec: Optional[str] = None,
+                 interpret: Optional[bool] = None):
+    """The megakernel form of :func:`deap_tpu.algorithms.var_or` — the
+    engine behind ``ea_mu_plus_lambda``/``ea_mu_comma_lambda`` when the
+    toolbox declares ``generation_engine = "megakernel"``.
+
+    The OR-choice law is reproduced EXACTLY: the key splits seven ways
+    in ``var_or``'s order, the choice mask (``u < cxpb`` etc.) and all
+    four parent-index draws come from the same ``jax.random`` streams —
+    so which rows crossover/mutate/reproduce and which parents they
+    read are bitwise-identical to the traced path (reproduction rows
+    are bitwise-identical outright).  Only the operator ARITHMETIC
+    moves into the fused tile pass (:func:`_var_or_tile`): one gather
+    of the primary parent per row instead of three, one fused
+    cx+mut+select kernel instead of three materialized operator
+    outputs, drawing the kernel's own deterministic counter stream
+    (seeded from the same ``k_cx``/``k_mut`` the traced operators
+    would consume).  Two bitwise-equal executors, same contract as
+    :func:`fused_generation`: ``vary_exec="pallas"`` (the kernel) or
+    ``"xla"`` (the tile function as traced ops; default off-TPU)."""
+    from ..base import Fitness, Population
+
+    assert cxpb + mutpb <= 1.0, (
+        "The sum of the crossover and mutation probabilities must be smaller "
+        "or equal to 1.0.")
+    genome = population.genome
+    if not isinstance(genome, jax.Array) or genome.ndim != 2:
+        raise ValueError("megakernel var_or needs a single 2-D array "
+                         "genome (pop, dim)")
+    params = megakernel_variation_params(toolbox)
+    storage = storage_of(toolbox) or GenomeStorage()
+    if genome.dtype != storage.jax_dtype:
+        raise ValueError(f"genome dtype {genome.dtype} != declared "
+                         f"storage {storage.dtype}")
+    n = population.size
+    dim = genome.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if vary_exec is None:
+        vary_exec = "xla" if interpret else "pallas"
+    if vary_exec not in ("pallas", "xla"):
+        raise ValueError(f"vary_exec {vary_exec!r}: expected 'pallas' "
+                         "or 'xla'")
+    rows = _pick_rows(lambda_)
+
+    # --- the exact var_or choice/index law (algorithms.var_or) ----------
+    k_choice, k_p1, k_p2, k_cx, k_pm, k_mut, k_pr = jax.random.split(key, 7)
+    u = jax.random.uniform(k_choice, (lambda_,))
+    use_cx = u < cxpb
+    use_mut = (u >= cxpb) & (u < cxpb + mutpb)
+    i1 = jax.random.randint(k_p1, (lambda_,), 0, n)
+    off = jax.random.randint(k_p2, (lambda_,), 1, n)
+    i2 = (i1 + off) % n                                  # distinct partner
+    im = jax.random.randint(k_pm, (lambda_,), 0, n)
+    ir = jax.random.randint(k_pr, (lambda_,), 0, n)
+    code = jnp.where(use_cx, 0, jnp.where(use_mut, 1, 2)).astype(jnp.int32)
+    ia = jnp.where(use_cx, i1, jnp.where(use_mut, im, ir))
+
+    a = storage.to_compute(genome.at[ia].get(mode="promise_in_bounds"))
+    b = storage.to_compute(genome.at[i2].get(mode="promise_in_bounds"))
+    # both operator keys fold into the kernel seed: the fused stream
+    # consumes the same trajectory inputs the traced operators would
+    seed = _seed_from_key(k_cx) ^ _seed_from_key(k_mut)
+    knobs = jnp.stack([jnp.asarray(v, jnp.float32) for v in
+                       (params["mut_mu"], params["mut_sigma"],
+                        params["indpb"])])
+
+    dpad = dim if vary_exec == "xla" else pad_dim(dim)
+    if dpad != dim:
+        pad = jnp.zeros((lambda_, dpad - dim), jnp.float32)
+        a = jnp.concatenate([a, pad], axis=1)
+        b = jnp.concatenate([b, pad], axis=1)
+    if vary_exec == "xla":
+        child = _var_or_xla_exec(a, b, code, seed, knobs, dim=dim,
+                                 rows=rows)
+    else:
+        child = _var_or_pallas(a, b, code, seed, knobs, dim=dim, rows=rows,
+                               interpret=interpret)
+    if dpad != dim:
+        child = child[:, :dim]
+    child = storage.to_storage(child) if storage.is_narrow \
+        else child.astype(genome.dtype)
+    fit = Fitness.empty(lambda_, population.fitness.weights,
+                        population.fitness.values.dtype)
+    return Population(genome=child, fitness=fit)
+
+
+def fused_nsga2_step(key, population, toolbox, cxpb, mutpb, *, live=None,
+                     vary_exec: Optional[str] = None):
+    """The megakernel form of an NSGA-II generation — ``ea_ask`` routes
+    here when ``generation_engine = "megakernel"`` and the registered
+    ``select`` is ``sel_nsga2`` (or its sharded form).  Selection stays
+    the registered toolbox law — on TPU its dominance counts come from
+    the Pallas dominance kernel (:mod:`deap_tpu.ops.dominance_pallas`)
+    — and the variation runs as ONE fused var_and tile pass over the
+    selected parents (same pairing, knobs, and draw stream as the GA
+    megakernel), instead of the operator chain's per-stage
+    materializations.  Reevaluate-all semantics, live-prefix contract,
+    and key-split order all match :func:`fused_ea_step`."""
+    import dataclasses as _dc
+
+    from ..base import Fitness, Population
+
+    genome = population.genome
+    if not isinstance(genome, jax.Array) or genome.ndim != 2:
+        raise ValueError("megakernel generation needs a single 2-D array "
+                         "genome (pop, dim)")
+    params = megakernel_variation_params(toolbox)
+    storage = storage_of(toolbox) or GenomeStorage()
+    pop, dim = genome.shape
+    interpret = jax.default_backend() != "tpu"
+    if vary_exec is None:
+        vary_exec = "xla" if interpret else "pallas"
+    rows = _pick_rows(pop)
+
+    key, k_sel, k_var = jax.random.split(key, 3)
+    idx = toolbox.select(k_sel, population.fitness, pop)
+    live_n = None
+    if live is not None:
+        live = jnp.asarray(live, bool)
+        live_n = jnp.maximum(jnp.sum(live.astype(jnp.int32)), 1)
+        idx = jnp.where(idx < live_n, idx, idx % live_n)
+    parents = genome.at[idx].get(mode="promise_in_bounds")
+
+    seed = _seed_from_key(k_var)
+    knobs = jnp.stack([jnp.asarray(v, jnp.float32) for v in
+                       (cxpb, mutpb, params["mut_mu"],
+                        params["mut_sigma"], params["indpb"])])
+    dpad = dim if vary_exec == "xla" else pad_dim(dim)
+    if dpad != dim:
+        pad = jnp.zeros((pop, dpad - dim), parents.dtype)
+        parents = jnp.concatenate([parents, pad], axis=1)
+    if vary_exec == "xla":
+        varied = _megakernel_xla_exec(parents, seed, knobs, dim=dim,
+                                      rows=rows,
+                                      storage_dtype=storage.dtype,
+                                      scale=storage.scale)
+    else:
+        varied = _megakernel_host(parents, seed, knobs, dim=dim, rows=rows,
+                                  storage_dtype=storage.dtype,
+                                  scale=storage.scale, hw_rng=False,
+                                  interpret=interpret)
+    if dpad != dim:
+        varied = varied[:, :dim]
+    if live is not None:
+        varied = jnp.where(jnp.arange(pop)[:, None] < live_n, varied,
+                           genome)
+
+    fit = Fitness.empty(pop, population.fitness.weights,
+                        population.fitness.values.dtype)
+    if live is not None:
+        fit = _dc.replace(fit, values=jnp.where(
+            live[:, None], fit.values, population.fitness.values))
+    return key, Population(varied, fit)

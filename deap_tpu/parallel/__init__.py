@@ -17,4 +17,5 @@ from .multihost import (initialize_cluster, cluster_mesh,
                         distribute_population, fetch_global,
                         process_index, process_count)  # noqa: F401
 from .emo_sharded import (nondominated_ranks_sharded, sel_nsga2_sharded,
-                          dominance_counts_sharded)  # noqa: F401
+                          dominance_counts_sharded,
+                          shard_map_compat)  # noqa: F401

@@ -80,8 +80,14 @@ else:
     from jax.experimental.shard_map import shard_map as _xshard_map
     _shard_map = _partial(_xshard_map, check_rep=False)
 
+#: the version-portable shard_map entry point — shared by every sharded
+#: kernel in the framework (here and the sharded megakernel of
+#: :mod:`deap_tpu.ops.generation_sharded`), so the 0.4.x/0.6+ shimming
+#: lives in exactly one place
+shard_map_compat = _shard_map
+
 __all__ = ["nondominated_ranks_sharded", "sel_nsga2_sharded",
-           "dominance_counts_sharded"]
+           "dominance_counts_sharded", "shard_map_compat"]
 
 
 def _pad_rows(x: jax.Array, target: int, fill) -> jax.Array:

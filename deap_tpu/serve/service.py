@@ -635,7 +635,10 @@ class EvolutionService:
         if self._draining:
             raise ServiceDraining("service is draining for failover")
         bucket = self.policy.bucket_for(population)
-        streamed = getattr(toolbox, "generation_engine", "xla") == "streamed"
+        # registry-typed admission: unknown engine strings and invalid
+        # engine/mesh combos reject HERE, before any device state builds
+        from ..engines import resolve_engine
+        streamed = resolve_engine(toolbox) == "streamed"
         sharded = (not streamed
                    and self.shard_threshold is not None
                    and population.size >= self.shard_threshold)
@@ -775,17 +778,29 @@ class EvolutionService:
 
     def _sharded_toolbox(self, toolbox):
         """The toolbox a sharded session's programs trace: identical to
-        the tenant's, except an NSGA-II ``select`` is swapped for
-        :func:`deap_tpu.parallel.sel_nsga2_sharded` on the service mesh
-        (bitwise index-identical to the single-device ``nd="peel"`` path,
-        pinned by tests) so big-mesh tenants get distributed
-        multi-objective selection without touching their toolbox."""
+        the tenant's, except
+
+        * an NSGA-II ``select`` is swapped for
+          :func:`deap_tpu.parallel.sel_nsga2_sharded` on the service mesh
+          (bitwise index-identical to the single-device ``nd="peel"``
+          path, pinned by tests), and
+        * a declared ``generation_engine = "megakernel"`` with the
+          flagship tournament select is promoted to
+          ``"megakernel_sharded"`` targeting the service mesh, so the
+          session's step/ask programs trace the mesh-sharded fused
+          generation (:mod:`deap_tpu.ops.generation_sharded`) instead of
+          replicating the single-device kernel under GSPMD —
+
+        so big-mesh tenants get the distributed paths without touching
+        their toolbox."""
         oid = id(toolbox)
         shadow = self._sharded_tbs.get(oid)
         if shadow is None:
             shadow = toolbox
             sel = getattr(toolbox, "select", None)
+            from ..engines import resolve_engine
             from ..ops.emo import sel_nsga2
+            from ..ops.selection import sel_tournament
             from ..parallel.emo_sharded import sel_nsga2_sharded
             if getattr(sel, "func", sel) is sel_nsga2:
                 shadow = copy.copy(toolbox)
@@ -793,6 +808,12 @@ class EvolutionService:
                       if k in ("front_chunk",)}
                 shadow.register("select", sel_nsga2_sharded,
                                 mesh=self.mesh(), **kw)
+            if (resolve_engine(toolbox) == "megakernel"
+                    and getattr(sel, "func", sel) is sel_tournament):
+                if shadow is toolbox:
+                    shadow = copy.copy(toolbox)
+                shadow.generation_engine = "megakernel_sharded"
+                shadow.generation_mesh = self.mesh()
             self._sharded_tbs[oid] = shadow
         return shadow
 

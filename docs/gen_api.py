@@ -43,6 +43,11 @@ PAGES = {
         "Fused generation megakernel & genome storage "
         "(deap_tpu.ops.generation_pallas)",
         ["deap_tpu.ops.generation_pallas"]),
+    "ops.generation_sharded": (
+        "Mesh-sharded fused generation (deap_tpu.ops.generation_sharded)",
+        ["deap_tpu.ops.generation_sharded"]),
+    "engines": ("Generation engine registry (deap_tpu.engines)",
+                ["deap_tpu.engines"]),
     "ops.migration": ("Island migration (deap_tpu.ops.migration)",
                       ["deap_tpu.ops.migration"]),
     "ops.constraint": ("Constraint handling (deap_tpu.ops.constraint)",

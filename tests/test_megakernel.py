@@ -400,3 +400,304 @@ def test_megakernel_vs_xla_convergence_parity():
     end_m, end_x = float(np.asarray(best_m)[-1]), \
         float(np.asarray(best_x)[-1])
     assert end_m < 3.0 * max(end_x, 1e-3) and end_x < 3.0 * max(end_m, 1e-3)
+
+
+# ---------------------------------------------------------------------------
+# engine registry: ONE resolution + rejection site
+# ---------------------------------------------------------------------------
+
+
+def _pop_mesh():
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:8]), ("pop",))
+
+
+def test_engine_registry_resolution_and_typed_rejections():
+    """Every ``toolbox.generation_engine`` string resolves through the
+    one registry (``deap_tpu.engines``): aliases fold, a declared mesh
+    promotes megakernel to its sharded form, and every invalid
+    combination — unknown string, sharded engine without a mesh,
+    streamed engine WITH a mesh — raises the typed error from that one
+    site instead of a per-call-site string check."""
+    from deap_tpu.engines import EngineError, engine_names, resolve_engine
+    assert set(engine_names()) >= {"xla", "megakernel",
+                                   "megakernel_sharded", "streamed"}
+    tb = _mega_toolbox()
+    assert resolve_engine(tb) == "megakernel"
+    tb.generation_engine = "scan"                # historical alias
+    assert resolve_engine(tb) == "xla"
+    del tb.generation_engine
+    assert resolve_engine(tb) == "xla"           # undeclared default
+
+    tb.generation_engine = "megakernel"
+    tb.generation_mesh = _pop_mesh()             # mesh promotes
+    assert resolve_engine(tb) == "megakernel_sharded"
+
+    tb.generation_engine = "streamed"            # streamed forbids mesh
+    with pytest.raises(EngineError, match="generation_engine"):
+        resolve_engine(tb)
+
+    tb2 = _mega_toolbox()
+    tb2.generation_engine = "megakernel_sharded"  # sharded needs mesh
+    with pytest.raises(EngineError, match="generation_mesh"):
+        resolve_engine(tb2)
+
+    tb3 = _mega_toolbox()
+    tb3.generation_engine = "warp-drive"
+    with pytest.raises(ValueError, match="generation_engine"):
+        resolve_engine(tb3)
+    assert issubclass(EngineError, ValueError)   # old excepts keep working
+
+
+def test_streamed_entry_points_use_registry_rejection():
+    """The bigpop streamed entry points reject through the same
+    registry: a streamed toolbox that also declares a generation mesh
+    is refused with the typed error before any host plan builds."""
+    from deap_tpu.bigpop.engine import streamed_ea_ask
+    from deap_tpu.engines import EngineError
+    tb = _mega_toolbox()
+    tb.generation_engine = "streamed"
+    tb.generation_mesh = _pop_mesh()
+    key = jax.random.PRNGKey(0)
+    genome = jnp.zeros((64, 8), jnp.float32)
+    pop = Population(genome, Fitness.empty(64, (-1.0,)))
+    with pytest.raises(EngineError, match="generation_engine"):
+        streamed_ea_ask(key, pop, tb, 0.6, 0.3)
+
+
+# ---------------------------------------------------------------------------
+# mesh-sharded fused generation (the tentpole)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_fused_bitwise_identical_to_xla_and_single_device(small_pop):
+    """THE sharded index-identity pin: at the same keys and ``rows``
+    tiling, the mesh-sharded fused generation resolves winner indices
+    bitwise-equal to ``sel_tournament(tie_break="rank")`` AND produces
+    the single-device fused generation's output genome bit for bit —
+    device count is a pure layout choice."""
+    from deap_tpu.ops.generation_sharded import fused_generation_sharded
+    key, genome, fit = small_pop
+    k_sel, k_var = jax.random.split(key)
+    w = fit.masked_wvalues()
+    idx_xla = selection.sel_tournament(k_sel, fit, POP, tournsize=3,
+                                       tie_break="rank")
+    kw = dict(dim=DIM, cxpb=0.9, mutpb=0.5, rows=32)
+    g_one, i_one = fused_generation(k_sel, k_var, genome, w,
+                                    gather="host", vary_exec="xla", **kw)
+    g_sh, i_sh = fused_generation_sharded(k_sel, k_var, genome, w,
+                                          mesh=_pop_mesh(), **kw)
+    assert np.array_equal(np.asarray(i_sh), np.asarray(idx_xla))
+    assert np.array_equal(np.asarray(i_sh), np.asarray(i_one))
+    assert np.array_equal(
+        np.asarray(g_sh).view(np.uint32), np.asarray(g_one).view(np.uint32))
+
+
+def test_sharded_fused_validates_divisibility_and_live_combo(small_pop):
+    """Named errors, not wrong answers: a population that does not tile
+    the mesh is refused at the op layer (the step API pads instead),
+    and the dma gather refuses the live-masked composition."""
+    from deap_tpu.ops.generation_sharded import fused_generation_sharded
+    key, genome, fit = small_pop
+    k_sel, k_var = jax.random.split(key)
+    w = fit.masked_wvalues()
+    with pytest.raises(ValueError, match="divide"):
+        fused_generation_sharded(k_sel, k_var, genome[:252], w[:252],
+                                 mesh=_pop_mesh(), dim=DIM, cxpb=0.9,
+                                 mutpb=0.5)
+    with pytest.raises(ValueError, match="gather='host'"):
+        fused_generation_sharded(k_sel, k_var, genome, w,
+                                 mesh=_pop_mesh(), dim=DIM, cxpb=0.9,
+                                 mutpb=0.5, gather="dma", live_n=100)
+
+
+def test_sharded_step_non_divisible_pop_follows_live_remap_law():
+    """A pop that does not tile the mesh rides the live-prefix
+    protocol: rows pad to the n_devices x 32 quantum with -inf fitness,
+    and every winner index follows the exact ``idx % live_n`` remap of
+    the XLA live path — pinned here with noop variation (cxpb=mutpb=0),
+    where the step must reduce to the selection gather."""
+    from deap_tpu.algorithms import ea_ask
+    from deap_tpu.base import lex_sort_indices
+    from deap_tpu.ops.selection import tournament_positions
+    pop, dim = 328, 8
+    key = jax.random.PRNGKey(77)
+    genome = jax.random.uniform(jax.random.fold_in(key, 1), (pop, dim),
+                                jnp.float32, -5.12, 5.12)
+    values = jax.vmap(lambda x: jnp.sum(x ** 2))(genome)[:, None]
+    fit = Fitness(values=values, valid=jnp.ones(pop, bool),
+                  weights=(-1.0,))
+    tb = _mega_toolbox()
+    tb.generation_mesh = _pop_mesh()             # promotes to sharded
+    _, off = ea_ask(key, Population(genome, fit), tb, 0.0, 0.0)
+
+    # replay the law by hand: pad to the 8*32-row quantum with -inf,
+    # rank globally, draw the inverse-CDF positions under the step's
+    # own k_sel, remap pad winners into the live prefix
+    _, k_sel, _ = jax.random.split(key, 3)
+    pop_pad = 512
+    wv = jnp.concatenate([fit.masked_wvalues(),
+                          jnp.full((pop_pad - pop, 1), -jnp.inf)], axis=0)
+    order = lex_sort_indices(wv, descending=True).astype(jnp.int32)
+    widx = order[tournament_positions(k_sel, pop_pad, pop_pad, 3)]
+    widx = jnp.where(widx < pop, widx, widx % pop)
+    assert np.array_equal(np.asarray(off.genome),
+                          np.asarray(genome[widx[:pop]]))
+    assert not bool(np.asarray(off.fitness.valid).any())
+
+
+def test_ea_step_routes_megakernel_sharded_end_to_end():
+    """``generation_engine = "megakernel"`` plus a declared mesh drives
+    one ``ea_step`` generation through the sharded kernel with the same
+    reevaluate-all contract as the single-device engine."""
+    tb = _mega_toolbox()
+    tb.generation_mesh = _pop_mesh()
+    key = jax.random.PRNGKey(5)
+    genome = jax.random.uniform(key, (256, DIM), jnp.float32, -5.12, 5.12)
+    pop = Population(genome, Fitness.empty(256, (-1.0,)))
+    from deap_tpu.algorithms import evaluate_population
+    pop, _ = evaluate_population(tb, pop)
+    _, off, nevals = ea_step(key, pop, tb, 0.9, 0.5)
+    assert off.genome.shape == (256, DIM)
+    assert int(nevals) == 256                 # reevaluate-all semantics
+    assert bool(np.asarray(off.fitness.valid).all())
+    assert np.isfinite(np.asarray(off.genome)).all()
+
+
+# ---------------------------------------------------------------------------
+# var_or through the fused kernel (mu±lambda routing)
+# ---------------------------------------------------------------------------
+
+
+def test_fused_var_or_reproduces_the_choice_law_bitwise():
+    """``var_or`` on a megakernel toolbox keeps the traced OR-choice
+    law exactly: with cxpb=mutpb=0 every row reproduces and the fused
+    output equals the traced output bit for bit; at mixed probabilities
+    the reproduction rows stay bitwise-equal and every crossover row's
+    genes come from its two (key-law) parents."""
+    from deap_tpu.algorithms import var_or
+    tb = _mega_toolbox()
+    tbx = _mega_toolbox()
+    tbx.generation_engine = "xla"
+    n = 128
+    key = jax.random.PRNGKey(11)
+    genome = jax.random.uniform(jax.random.fold_in(key, 1), (n, DIM),
+                                jnp.float32, -5.12, 5.12)
+    p = Population(genome, Fitness.empty(n, (-1.0,)))
+
+    off_f = var_or(key, p, tb, n, 0.0, 0.0)
+    off_t = var_or(key, p, tbx, n, 0.0, 0.0)
+    assert np.array_equal(np.asarray(off_f.genome).view(np.uint32),
+                          np.asarray(off_t.genome).view(np.uint32))
+    assert not bool(np.asarray(off_f.fitness.valid).any())
+
+    cxpb, mutpb = 0.5, 0.3
+    off_f = var_or(key, p, tb, n, cxpb, mutpb)
+    off_t = var_or(key, p, tbx, n, cxpb, mutpb)
+    ks = jax.random.split(key, 7)
+    u = np.asarray(jax.random.uniform(ks[0], (n,)))
+    repro = u >= cxpb + mutpb
+    assert repro.any()
+    assert np.array_equal(np.asarray(off_f.genome)[repro],
+                          np.asarray(off_t.genome)[repro])
+    cx = u < cxpb
+    i1 = np.asarray(jax.random.randint(ks[1], (n,), 0, n))
+    i2 = (i1 + np.asarray(jax.random.randint(ks[2], (n,), 1, n))) % n
+    child = np.asarray(off_f.genome)
+    a, b = np.asarray(genome)[i1], np.asarray(genome)[i2]
+    from_parents = (child == a) | (child == b)
+    assert from_parents[cx].all()
+    # mutation rows perturb ~indpb of the genes of their key-law parent
+    mut = (~cx) & (u < cxpb + mutpb)
+    im = np.asarray(jax.random.randint(ks[4], (n,), 0, n))
+    changed = child[mut] != np.asarray(genome)[im][mut]
+    frac = changed.mean()
+    assert 0.005 < frac < 0.2, frac
+
+
+def test_fused_var_or_executors_bitwise_equal():
+    """The two var_or executors — the Pallas tile kernel (interpret
+    mode off-TPU) and the same tile function as traced XLA ops — are
+    one program: bitwise-equal offspring."""
+    from deap_tpu.ops.generation_pallas import fused_var_or
+    tb = _mega_toolbox()
+    n = 64
+    key = jax.random.PRNGKey(13)
+    genome = jax.random.uniform(jax.random.fold_in(key, 1), (n, DIM),
+                                jnp.float32, -5.12, 5.12)
+    p = Population(genome, Fitness.empty(n, (-1.0,)))
+    off_x = fused_var_or(key, p, tb, n, 0.6, 0.3, vary_exec="xla")
+    off_p = fused_var_or(key, p, tb, n, 0.6, 0.3, vary_exec="pallas")
+    assert np.array_equal(np.asarray(off_x.genome).view(np.uint32),
+                          np.asarray(off_p.genome).view(np.uint32))
+
+
+def test_ea_mu_plus_lambda_megakernel_engine_end_to_end():
+    """The (mu+lambda) loop runs whole on the fused var_or engine —
+    var_or traces inside the generation scan, offspring evaluate, and
+    the pool selection sees valid fitness everywhere."""
+    from deap_tpu.algorithms import ea_mu_plus_lambda
+    tb = _mega_toolbox()
+    key = jax.random.PRNGKey(2)
+    genome = jax.random.uniform(jax.random.fold_in(key, 1), (64, DIM),
+                                jnp.float32, -5.12, 5.12)
+    p = Population(genome, Fitness.empty(64, (-1.0,)))
+    out, _ = ea_mu_plus_lambda(key, p, tb, 64, 64, 0.6, 0.3, ngen=4)
+    assert out.genome.shape == (64, DIM)
+    assert bool(np.asarray(out.fitness.valid).all())
+    assert np.isfinite(np.asarray(out.fitness.values)).all()
+
+
+# ---------------------------------------------------------------------------
+# NSGA-II generation through the fused variation pass
+# ---------------------------------------------------------------------------
+
+
+def _nsga2_mega_toolbox():
+    from deap_tpu.ops.emo import sel_nsga2
+    tb = base.Toolbox()
+    tb.register("evaluate",
+                lambda g: (jnp.sum(g * g), jnp.sum((g - 1.0) ** 2)))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=0.3,
+                indpb=0.05)
+    tb.register("select", sel_nsga2, front_chunk=32)
+    tb.generation_engine = "megakernel"
+    return tb
+
+
+def test_nsga2_fused_generation_matches_sel_nsga2():
+    """The NSGA-II head keeps the registered selection law: with noop
+    variation the fused generation IS ``genome[sel_nsga2(...)]`` bit
+    for bit, under ``ea_ask``'s own key split."""
+    from deap_tpu.algorithms import ea_ask, evaluate_population
+    from deap_tpu.ops.emo import sel_nsga2
+    tb = _nsga2_mega_toolbox()
+    key = jax.random.PRNGKey(21)
+    genome = jax.random.uniform(jax.random.fold_in(key, 1), (64, 8),
+                                jnp.float32, -1.0, 1.0)
+    pop = Population(genome, Fitness.empty(64, (-1.0, -1.0)))
+    pop, _ = evaluate_population(tb, pop)
+    _, off = ea_ask(key, pop, tb, 0.0, 0.0)
+    _, k_sel, _ = jax.random.split(key, 3)
+    idx = sel_nsga2(k_sel, pop.fitness, 64, front_chunk=32)
+    assert np.array_equal(np.asarray(off.genome),
+                          np.asarray(genome[idx]))
+    assert not bool(np.asarray(off.fitness.valid).any())
+
+
+def test_nsga2_fused_generation_step_evolves():
+    """End to end: ``ea_step`` on the NSGA-II megakernel toolbox
+    reevaluates everything and keeps the population finite across
+    generations."""
+    from deap_tpu.algorithms import evaluate_population
+    tb = _nsga2_mega_toolbox()
+    key = jax.random.PRNGKey(22)
+    genome = jax.random.uniform(jax.random.fold_in(key, 1), (64, 8),
+                                jnp.float32, -1.0, 1.0)
+    pop = Population(genome, Fitness.empty(64, (-1.0, -1.0)))
+    pop, _ = evaluate_population(tb, pop)
+    for _ in range(3):
+        key, pop, nevals = ea_step(key, pop, tb, 0.8, 0.2)
+        assert int(nevals) == 64
+    assert np.isfinite(np.asarray(pop.fitness.values)).all()

@@ -337,6 +337,56 @@ def test_pop_sharded_session_bitwise_parity():
         np.testing.assert_array_equal(g, w)
 
 
+def test_megakernel_session_engine_swap_bitwise_parity():
+    """A megakernel-flagship session at the shard threshold gets its
+    engine promoted to ``megakernel_sharded`` on the service mesh (the
+    tenant toolbox is never touched) and its trajectory stays bitwise
+    identical to the same session on the single-device path — the serve
+    layer inherits the kernel's device-count invariance.  The session
+    tiles the 8x32-row sharding quantum so the padded selection law is
+    the identity on both paths."""
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda g: (jnp.sum(g ** 2),))
+    tb.register("mate", crossover.cx_two_point)
+    tb.register("mutate", mutation.mut_gaussian, mu=0.0, sigma=0.3,
+                indpb=0.05)
+    tb.register("select", selection.sel_tournament, tournsize=3,
+                tie_break="rank")
+    tb.generation_engine = "megakernel"
+    key = jax.random.PRNGKey(9)
+
+    def pop():
+        g = jax.random.uniform(key, (256, 8), jnp.float32, -2.0, 2.0)
+        return base.Population(genome=g,
+                               fitness=base.Fitness.empty(256, (-1.0,)))
+
+    with EvolutionService(max_batch=2, shard_threshold=64) as svc:
+        s = svc.open_session(key, pop(), tb,
+                             cxpb=0.7, mutpb=0.3, evaluate_initial=False)
+        assert s.sharded and s.bucket.rows % 8 == 0
+        for f in s.step(3):
+            f.result(timeout=300)
+        sharded = _final(s)
+        counters = svc.stats().counters
+        assert counters["steps_sharded"] == 3
+        assert counters["compiles_step"] == 1   # one bucket, one program
+        assert svc.stats().gauges["sharded_sessions"] == 1
+        # the swap is a shadow: the tenant toolbox keeps its engine
+        assert tb.generation_engine == "megakernel"
+        assert getattr(tb, "generation_mesh", None) is None
+
+    with EvolutionService(max_batch=2) as svc:
+        s = svc.open_session(key, pop(), tb,
+                             cxpb=0.7, mutpb=0.3, evaluate_initial=False)
+        assert not s.sharded
+        for f in s.step(3):
+            f.result(timeout=300)
+        single = _final(s)
+
+    for g, w in zip(sharded, single):
+        np.testing.assert_array_equal(g, w)
+
+
 def test_pop_sharded_below_threshold_slot_packs():
     """Sessions below the threshold keep the ordinary slot-packed path
     (sharding is opt-in per size, not a mode switch)."""

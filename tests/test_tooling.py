@@ -418,7 +418,14 @@ def test_bench_megakernel_schema_canfail():
             "result": {"xla_f32": {"per_gen_ms": 250.0},
                        "mega_f32": {"per_gen_ms": 180.0},
                        "mega_bf16": {"per_gen_ms": 178.0},
+                       "sharded_f32": {"per_gen_ms": 210.0,
+                                       "n_devices": 8,
+                                       "bitwise_identical": True},
+                       "mupl_xla_f32": {"per_gen_ms": 300.0},
+                       "mupl_f32": {"per_gen_ms": 200.0},
                        "speedup_mega_f32": 1.4,
+                       "speedup_sharded_f32": 1.19,
+                       "speedup_mupl_f32": 1.5,
                        "bf16_traffic_savings_frac": 0.49}}
     assert _schema_errors("megakernel", good) == []
     bad = json.loads(json.dumps(good))
@@ -431,13 +438,34 @@ def test_bench_megakernel_schema_canfail():
     zero["result"]["mega_f32"]["per_gen_ms"] = 0
     assert any("per_gen_ms" in e
                for e in _schema_errors("megakernel", zero))
+    # the sharded leg is the device-count-invariance proof: a diverged
+    # (or unproven) leg must not be committable, nor a "sharded" leg
+    # timed on a single device
+    diverged = json.loads(json.dumps(good))
+    diverged["result"]["sharded_f32"]["bitwise_identical"] = False
+    assert any("bitwise_identical" in e
+               for e in _schema_errors("megakernel", diverged))
+    onedev = json.loads(json.dumps(good))
+    onedev["result"]["sharded_f32"]["n_devices"] = 1
+    assert any("n_devices" in e
+               for e in _schema_errors("megakernel", onedev))
+    nolegs = json.loads(json.dumps(good))
+    del nolegs["result"]["sharded_f32"]
+    del nolegs["result"]["mupl_f32"]
+    errs = _schema_errors("megakernel", nolegs)
+    assert any("sharded_f32" in e for e in errs)
+    assert any("mupl_f32" in e for e in errs)
     with open(os.path.join(REPO, "BENCH_MEGAKERNEL.json")) as f:
         committed = json.load(f)
     assert _schema_errors("megakernel", committed) == []
     # the committed artifact IS the acceptance evidence: fused beats the
-    # XLA scan wall and bf16 cuts the argument traffic >= 40%
+    # XLA scan wall, bf16 cuts the argument traffic >= 40%, and the
+    # sharded leg committed its bitwise proof with real walls
     assert committed["result"]["speedup_mega_f32"] > 1.0
     assert committed["result"]["bf16_traffic_savings_frac"] >= 0.4
+    assert committed["result"]["sharded_f32"]["bitwise_identical"] is True
+    assert committed["result"]["sharded_f32"]["n_devices"] >= 2
+    assert committed["result"]["mupl_f32"]["per_gen_ms"] > 0
 
 
 def test_probe_ga_schema_canfail():
@@ -470,12 +498,18 @@ def test_megakernel_ledger_rows_wired():
     savings metric carries the 0.4 absolute acceptance floor."""
     with open(os.path.join(REPO, "PERF_LEDGER.json")) as f:
         doc = json.load(f)
-    for name in ("megakernel_gens_per_sec", "bf16_traffic_savings_frac"):
+    for name in ("megakernel_gens_per_sec", "bf16_traffic_savings_frac",
+                 "megakernel_sharded_gens_per_sec",
+                 "mupl_megakernel_gens_per_sec"):
         spec = doc["metrics"][name]
         assert spec["artifact"] == "BENCH_MEGAKERNEL.json"
         assert spec["direction"] == "higher"
         assert spec["provenance"].strip()
     assert doc["metrics"]["bf16_traffic_savings_frac"]["min_value"] == 0.4
+    assert (doc["metrics"]["megakernel_sharded_gens_per_sec"]["path"]
+            == "result.sharded_f32.gens_per_sec")
+    assert (doc["metrics"]["mupl_megakernel_gens_per_sec"]["path"]
+            == "result.mupl_f32.gens_per_sec")
 
 
 def test_megakernel_entries_in_committed_budgets():
@@ -486,7 +520,10 @@ def test_megakernel_entries_in_committed_budgets():
     with open(os.path.join(REPO, "tools", "memory_budget.json")) as f:
         mem = json.load(f)["budget"]
     for name in ("ga_generation_megakernel",
-                 "ga_generation_megakernel_bf16"):
+                 "ga_generation_megakernel_bf16",
+                 "ga_generation_megakernel_sharded",
+                 "mupl_generation_megakernel",
+                 "nsga2_generation_megakernel"):
         assert name in prog, f"{name} missing from program budget"
         assert name in mem, f"{name} missing from memory budget"
         for key in ("peak_bytes", "large_intermediates",
@@ -495,3 +532,14 @@ def test_megakernel_entries_in_committed_budgets():
     # the deterministic traffic claim, from the committed rows
     assert mem["ga_generation_megakernel_bf16"]["bytes_moved"] < \
         0.6 * mem["ga_generation_megakernel"]["bytes_moved"]
+    # the sharded exchange's committed collective inventory: two
+    # all-gathers (fitness table + genome rows), zero psums in the
+    # generation itself (the single all-reduce is the canonical scan's
+    # best-fitness reporting), no permute chain
+    sharded = prog["ga_generation_megakernel_sharded"]
+    assert sharded.get("all-gather") == 2
+    assert sharded.get("all-reduce", 0) <= 1
+    assert "collective-permute" not in sharded
+    # the single-device megakernel heads stay collective-free
+    assert prog["mupl_generation_megakernel"] == {}
+    assert prog["nsga2_generation_megakernel"] == {}

@@ -18,6 +18,19 @@ generations, all inputs donated):
 * ``mega_int8``   — megakernel + int8 symmetric quantization over the
   rastrigin domain (±5.12).
 
+Plus the engine-routing legs of this PR's widening:
+
+* ``sharded_f32``  — the mesh-sharded fused generation
+  (``build_megakernel_sharded_scan``: compacted fitness table + genome
+  rows exchanged in two all-gathers per generation, variation at
+  global row coordinates); ``bitwise_identical`` is a measured
+  small-shape oracle — winner indices AND output genome bits equal to
+  the single-device fused path (itself index-pinned to the XLA path)
+  at the same keys and ``rows`` tiling;
+* ``mupl_xla_f32`` / ``mupl_f32`` — the (mu+lambda) generation scan
+  (``build_mupl_megakernel_scan``) with ``var_or`` traced vs routed
+  through the fused variation kernel (``fused_var_or``).
+
 Measurement discipline (the bench-harness standard): the four compiled
 programs are timed **interleaved** — one dispatch of each per repeat
 round, min-of-repeats kept — so a timeshared-host drift hits every leg
@@ -42,7 +55,11 @@ enforced by the ``bench-json`` lint pass, trajectory gated by
 
 Env: BENCH_MK_POP (default 65536), BENCH_MK_DIM (100), BENCH_MK_NGEN
 (4), BENCH_MK_REPEATS (4), BENCH_MK_WEAK_POPS ("16384,65536,262144";
-empty string skips the sweep).
+empty string skips the sweep), BENCH_MK_DEVS (8: virtual host devices
+forced before jax initializes so the sharded leg has its mesh; only
+affects the CPU platform — on real multi-chip backends the devices
+are whatever the runtime exposes, and the sharded leg auto-skips
+below 8).
 """
 
 import json
@@ -51,6 +68,12 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEVS = int(os.environ.get("BENCH_MK_DEVS", 8))
+if DEVS > 1:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={DEVS}").strip()
 
 POP = int(os.environ.get("BENCH_MK_POP", 65536))
 DIM = int(os.environ.get("BENCH_MK_DIM", 100))
@@ -116,11 +139,44 @@ def leg_costs(compiled, ngen) -> dict:
     return out
 
 
+def sharded_bitwise_check():
+    """The sharded leg's committed oracle, run at a small canonical
+    shape in the same process: winner indices AND output genome bits of
+    the mesh-sharded fused generation must equal the single-device
+    fused path (whose indices are themselves pinned bitwise-equal to
+    the XLA ``sel_tournament`` path) at the same keys and ``rows``
+    tiling — device count is a pure layout choice or the leg does not
+    commit."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deap_tpu.analysis.inventory import require_mesh
+    from deap_tpu.ops.generation_pallas import (GenomeStorage,
+                                                fused_generation)
+    from deap_tpu.ops.generation_sharded import fused_generation_sharded
+    mesh = require_mesh()
+    pop, dim = 256, 8
+    key = jax.random.PRNGKey(3)
+    k_sel, k_var, k0 = jax.random.split(key, 3)
+    g = jax.random.uniform(k0, (pop, dim), jnp.float32, -5.12, 5.12)
+    wv = jax.random.uniform(jax.random.fold_in(k0, 1), (pop, 1),
+                            jnp.float32)
+    kw = dict(dim=dim, cxpb=0.9, mutpb=0.5, mut_sigma=0.3, indpb=0.05,
+              tournsize=3, storage=GenomeStorage(), rows=32)
+    g1, w1 = fused_generation(k_sel, k_var, g, wv, **kw)
+    g2, w2 = fused_generation_sharded(k_sel, k_var, g, wv, mesh=mesh,
+                                      **kw)
+    return bool(jnp.all(w1 == w2)) and bool(np.array_equal(
+        np.asarray(g1).view(np.uint32), np.asarray(g2).view(np.uint32)))
+
+
 def main():
     import jax
 
     from deap_tpu.analysis.inventory import (build_ga_scan,
-                                             build_megakernel_scan)
+                                             build_megakernel_scan,
+                                             build_megakernel_sharded_scan,
+                                             build_mupl_megakernel_scan)
 
     builders = {
         "xla_f32": (build_ga_scan, {}),
@@ -128,7 +184,13 @@ def main():
         "mega_bf16": (build_megakernel_scan,
                       {"storage_dtype": "bfloat16"}),
         "mega_int8": (build_megakernel_scan, {"storage_dtype": "int8"}),
+        "mupl_xla_f32": (build_mupl_megakernel_scan, {"engine": "xla"}),
+        "mupl_f32": (build_mupl_megakernel_scan,
+                     {"engine": "megakernel"}),
     }
+    n_devices = len(jax.devices())
+    if n_devices >= 8:
+        builders["sharded_f32"] = (build_megakernel_sharded_scan, {})
     legs = {name: compile_leg(b, POP, NGEN, **kw)
             for name, (b, kw) in builders.items()}
     result = {"pop": POP, "dim": DIM, "ngen": NGEN, "repeats": REPEATS,
@@ -143,6 +205,14 @@ def main():
         x["per_gen_ms"] / m["per_gen_ms"], 3)
     result["speedup_mega_bf16"] = round(
         x["per_gen_ms"] / result["mega_bf16"]["per_gen_ms"], 3)
+    result["speedup_mupl_f32"] = round(
+        result["mupl_xla_f32"]["per_gen_ms"]
+        / result["mupl_f32"]["per_gen_ms"], 3)
+    if "sharded_f32" in result:
+        result["sharded_f32"]["n_devices"] = min(n_devices, 8)
+        result["sharded_f32"]["bitwise_identical"] = sharded_bitwise_check()
+        result["speedup_sharded_f32"] = round(
+            x["per_gen_ms"] / result["sharded_f32"]["per_gen_ms"], 3)
 
     def arg_traffic(leg):
         """Population argument residency (memory_analysis): the genome +
@@ -201,7 +271,15 @@ def main():
         "reads and rewrites per generation); the whole-program "
         "cost_analysis cut rides alongside as "
         "bf16_bytes_accessed_savings_frac and is deliberately small "
-        "(f32 compute intermediates are the contract, not a leak)")
+        "(f32 compute intermediates are the contract, not a leak).  "
+        "sharded_f32 is the mesh-sharded fused generation over "
+        "n_devices (two all-gathers per generation); its "
+        "bitwise_identical field is a same-process small-shape oracle "
+        "(winner indices + genome bits vs the single-device fused "
+        "path), and on a virtual-device CPU mesh its speedup is a "
+        "protocol-correctness figure, not a hardware claim — the "
+        "8-way 'mesh' timeshares one host.  mupl legs time the same "
+        "(mu+lambda) loop body with var_or traced vs fused")
     print(json.dumps({"cmd": "python tools/bench_megakernel.py",
                       "result": result}))
 

@@ -569,6 +569,59 @@ def probe_hoststream(rows=8192):
                eff_gbps=round(gb * 2 / sec, 1))
 
 
+def recommend_defaults(records, platform):
+    """Fold the measured stage walls into the megakernel's executor
+    defaults — the ``{gather, vary_exec}`` pair ``fused_generation``
+    (and its sharded form) would pick on this backend, with the probe
+    rows that decided each choice recorded as the basis.
+
+    Off TPU the composition is static: the Pallas interpreter is an
+    emulator, not a measurement, so the host-gather + traced-XLA
+    executor pair is the bitwise oracle and the only honest default.
+    On TPU the round-4 decision — per-row ``make_async_copy`` DMA
+    gather vs XLA's row gather — falls out of the two probes' measured
+    effective bandwidths; ``vary_exec`` stays on the Pallas tile pass
+    unless the in-kernel RNG probe failed on this backend (recorded in
+    ``errors``, e.g. a TPU generation without ``prng_random_bits``)."""
+    by = {r["probe"]: r for r in records}
+    failed = {e["probe"] for e in _ERRORS}
+    rec = {"platform": platform, "gather": "host", "vary_exec": "xla",
+           "basis": []}
+    if platform != "tpu":
+        rec["basis"].append(
+            "non-TPU backend: interpreter walls are emulation, not "
+            "measurement -- host-gather + traced-XLA executor is the "
+            "bitwise-oracle composition and the static default")
+        return rec
+    rec["gather"], rec["vary_exec"] = "dma", "pallas"
+    dma = next((by[n] for n in by if n.startswith("pallas_dmagather_")),
+               None)
+    xla = by.get("xla_grow_pib_d128") or by.get("xla_grow_pib_d100")
+    if dma and xla and dma.get("eff_gbps") and xla.get("eff_gbps"):
+        d, x = float(dma["eff_gbps"]), float(xla["eff_gbps"])
+        rec["gather"] = "dma" if d >= x else "host"
+        rec["basis"].append(
+            f"round-4 gather wall: {dma['probe']} {d} GB/s vs "
+            f"{xla['probe']} {x} GB/s -> gather={rec['gather']!r}")
+    else:
+        rec["basis"].append(
+            "gather probes not in this run subset -> gather='dma' "
+            "(the flagship default) unmeasured")
+    if "rng" in failed:
+        rec["vary_exec"] = "xla"
+        rec["basis"].append(
+            "in-kernel RNG probe failed on this backend -> "
+            "vary_exec='xla' (the traced executor needs no "
+            "prng_random_bits)")
+    else:
+        rng = by.get("pallas_rng_normal_1m_x128")
+        rec["basis"].append(
+            "in-kernel RNG "
+            + (f"measured at {rng['ms']} ms" if rng else "not probed")
+            + " -> vary_exec='pallas' (the fused tile pass)")
+    return rec
+
+
 PROBES = {
     "sort": probe_sort,
     "gidx": probe_gidx,
@@ -598,6 +651,11 @@ def main(argv):
                          "document (per-probe walls + derived rates + "
                          "backend errors) — the committed, schema-gated "
                          "form of the stage budget")
+    ap.add_argument("--recommend", action="store_true",
+                    help="fold the measured walls into the megakernel's "
+                         "recommended {gather, vary_exec} executor "
+                         "defaults for this backend (printed, and "
+                         "carried as result.recommend in --json)")
     ap.add_argument("--pop", type=int, default=POP,
                     help=f"population (default {POP})")
     ap.add_argument("--dim", type=int, default=DIM,
@@ -621,6 +679,12 @@ def main(argv):
             _ERRORS.append(err)
             print(json.dumps(err), flush=True)
 
+    recommend = None
+    if args.recommend:
+        recommend = recommend_defaults(_RECORDS,
+                                       jax.devices()[0].platform)
+        print(json.dumps({"recommend": recommend}), flush=True)
+
     if args.json:
         doc = {"cmd": "python tools/pallas_probe_ga.py "
                       + " ".join(argv if argv is not None
@@ -634,6 +698,8 @@ def main(argv):
                                    "own byte accounting; errors record "
                                    "probes the active backend cannot "
                                    "run (never fabricated numbers)")}}
+        if recommend is not None:
+            doc["result"]["recommend"] = recommend
         with open(args.json, "w") as f:
             json.dump(doc, f, indent=1, sort_keys=True)
             f.write("\n")
