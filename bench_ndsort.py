@@ -17,6 +17,15 @@ timing like bench.py) for each (regime, n, method).  Not driver-run; this
 is the measurement behind the ``method="auto"`` dispatch in
 ``deap_tpu/ops/emo.py`` and the numbers quoted in its docstring.
 
+With ≥ 2 devices (or the virtual-device CPU mesh) the ``dtlz2_3d``
+regime also measures the SHARDED engines — ``peel_sharded`` /
+``grid_sharded`` (``nondominated_ranks_sharded``, r07) — and each
+sharded row reports ``collective_ops_in_hlo``: HLO *instruction
+definition* counts from the one canonical rule in
+``deap_tpu.analysis.hlo`` (the number the committed budgets gate), not
+legacy substring hits.  ``--update-budget`` delegates to
+``tools/check_collective_budget.py`` exactly like bench_weakscaling.
+
 Env: BENCH_SIZES (comma list, default "10000,100000"), BENCH_PRNG.
 """
 
@@ -67,7 +76,54 @@ def time_call(fn, w):
     return time.perf_counter() - t0
 
 
+def sharded_rows(n: int, w, key):
+    """``peel_sharded`` / ``grid_sharded`` rows for the dtlz2_3d regime:
+    wall-clock plus ``collective_ops_in_hlo`` — the instruction-level
+    inventory from :mod:`deap_tpu.analysis.hlo` (the canonical counting
+    rule the committed budgets gate on), taken from the very executable
+    being timed."""
+    import jax
+    from jax.sharding import Mesh
+    from deap_tpu.analysis.hlo import collective_ops
+    from deap_tpu.parallel.emo_sharded import nondominated_ranks_sharded
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        return []
+    mesh = Mesh(devs, ("pop",))
+    rows = []
+    for method in ("peel", "grid"):
+        if method == "peel" and n > 20_000:
+            rows.append(dict(regime="dtlz2_3d", n=n,
+                             method="peel_sharded", seconds=None,
+                             note="skipped: projected O(MN^2) minutes "
+                                  "(see n=10000)"))
+            continue
+        fn = jax.jit(lambda w, m=method: nondominated_ranks_sharded(
+            w, mesh, method=m))
+        txt = fn.lower(w).compile().as_text()
+        secs = time_call(fn, w)
+        nf = int(fn(w)[1])
+        rows.append(dict(regime="dtlz2_3d", n=n,
+                         method=f"{method}_sharded",
+                         seconds=round(secs, 4), n_fronts=nf,
+                         n_devices=len(devs),
+                         collective_ops_in_hlo=collective_ops(txt)))
+        print(f"# dtlz2_3d n={n} {method}_sharded: {secs:.4f}s "
+              f"({nf} fronts) {collective_ops(txt)}",
+              file=sys.stderr, flush=True)
+    return rows
+
+
 def main():
+    if "--update-budget" in sys.argv[1:]:
+        # the collective inventory this bench reports is gated by the
+        # same committed budget as bench_weakscaling's; delegate
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import check_collective_budget
+        raise SystemExit(check_collective_budget.main(["--update-budget"]))
+
     import jax
     if os.environ.get("BENCH_PRNG", "rbg") == "rbg":
         try:
@@ -112,6 +168,8 @@ def main():
                                     seconds=round(secs, 4), n_fronts=nf))
                 print(f"# {regime} n={n} {method}: {secs:.4f}s "
                       f"({nf} fronts)", file=sys.stderr, flush=True)
+            if regime == "dtlz2_3d":
+                results.extend(sharded_rows(n, w, key))
     print(json.dumps({
         "metric": "nondominated_ranks_front_depth_scaling",
         "platform": jax.devices()[0].platform,

@@ -37,6 +37,12 @@ separate programs — the only shape the axon backend runs at pool ≥
 the scanned form (same law; deterministic selection); the cost is one
 extra dispatch per generation.
 
+r07: ``extra.collective_ops_in_hlo`` reports the HLO collective
+*instruction* inventory of the timed executable (the canonical counting
+rule in ``deap_tpu.analysis.hlo`` — the same number the committed
+budgets gate; empty on one device), and ``--update-budget`` delegates to
+``tools/check_collective_budget.py`` like bench_weakscaling.
+
 Env overrides: BENCH_POP (default 100_000), BENCH_NGEN (3 timed gens),
 BENCH_SELECT (nsga2 | nsga3 | spea2), BENCH_PROBLEM (zdt1 | dtlz2),
 BENCH_ND (auto | peel | staircase | sweep2d | grid — the
@@ -173,6 +179,21 @@ def run_tpu():
     pop = base.Population(genome, base.Fitness.empty(POP, weights))
     pop, _ = evaluate_population(tb, pop)
 
+    # collective inventory of the program actually being timed —
+    # instruction definitions via the one canonical counting rule
+    # (deap_tpu.analysis.hlo.collective_ops), not substring hits.  Empty
+    # on a single device; the sharded serving path is gated separately
+    # by tools/check_collective_budget.py.
+    from deap_tpu.analysis.hlo import collective_ops
+    if STAGED:
+        ops = collective_ops(stage_a.lower(key, pop).compile().as_text())
+        txt_b = stage_b.lower(*stage_a(key, pop)[1:]).compile().as_text()
+        for name, cnt in collective_ops(txt_b).items():
+            ops[name] = ops.get(name, 0) + cnt
+    else:
+        ops = collective_ops(
+            make_run(NGEN).lower(key, pop).compile().as_text())
+
     def timed(ngen):
         run = make_run(ngen)
         _, best = run(key, pop)
@@ -186,7 +207,7 @@ def run_tpu():
     t2, best = timed(2 * NGEN)
     ratio = t2 / t1
     marginal = (t2 - t1) / NGEN
-    return 1.0 / marginal, ratio, best, jax.devices()[0].platform
+    return 1.0 / marginal, ratio, best, jax.devices()[0].platform, ops
 
 
 def measured_baseline():
@@ -208,7 +229,14 @@ def measured_baseline():
 
 
 def main():
-    gens_per_sec, ratio, best, platform = run_tpu()
+    if "--update-budget" in sys.argv[1:]:
+        # collective inventories are gated by the one committed budget;
+        # delegate to the gate (same plumbing as bench_weakscaling)
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import check_collective_budget
+        raise SystemExit(check_collective_budget.main(["--update-budget"]))
+    gens_per_sec, ratio, best, platform, collectives = run_tpu()
     linear_ok = 1.5 <= ratio <= 2.7
     baseline = measured_baseline()
     vs = (gens_per_sec / baseline) if (baseline and linear_ok) else -1.0
@@ -226,6 +254,7 @@ def main():
                                  "ok": linear_ok},
             "best_f1_end": best,
             "stock_deap_projected_gens_per_sec": baseline,
+            "collective_ops_in_hlo": collectives,
         },
     }))
 

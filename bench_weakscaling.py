@@ -32,6 +32,14 @@ Three layouts, matching the framework's parallel axes (SURVEY §2.6):
   O(N²) dominance counting column-sharded against a once-gathered
   resident population, with the front peel exchanging compacted int32
   index payloads (r06 collective-lean protocol: zero reductions).
+* ``mo_grid``: the same selector with the r07 sub-quadratic lex-grid
+  ranks engine (``ranks="grid"``, slab-group-sharded band passes) and
+  the sharded crowding tail; the committed row also records
+  ``bitwise_identical`` — the sharded selection compared element-wise
+  against single-chip ``sel_nsga2(nd="grid")`` on the same cloud.
+* ``hv``: ``hypervolume_sharded`` (deap_tpu/ops/hypervolume.py) — the
+  blocked 3-D sweep with prefix slabs partitioned over the mesh (1
+  all-gather + 1 psum); the row also records ``pts_per_sec``.
 
 Collective counts are FIRST-CLASS metrics here, reported two ways per
 layout: ``collectives_in_hlo`` (legacy substring count over the compiled
@@ -121,8 +129,9 @@ def build(layout: str, n_dev: int, pop_per_dev: int = None,
     mesh = Mesh(np.array(jax.devices()[:n_dev]), ("d",))
     sh = NamedSharding(mesh, P("d"))
 
-    if layout == "mo":
+    if layout in ("mo", "mo_grid"):
         from deap_tpu.parallel.emo_sharded import sel_nsga2_sharded
+        ranks = "grid" if layout == "mo_grid" else "peel"
         k_sel = mo_pop // 2
         x = jax.random.uniform(key, (mo_pop, 3))
         w = -jnp.stack([x[:, 0], x[:, 1] * (1.5 - x[:, 0]),
@@ -139,7 +148,7 @@ def build(layout: str, n_dev: int, pop_per_dev: int = None,
             # add rounds away bitwise: |acc|*1e-30 << f32 ulp of w)
             wc, acc = carry
             idx = sel_nsga2_sharded(None, wc, k_sel, mesh, axis="d",
-                                    front_chunk=fc)
+                                    front_chunk=fc, ranks=ranks)
             acc = acc + jnp.sum(idx)
             wc = wc + acc.astype(wc.dtype) * 1e-30
             return (wc, acc), None
@@ -153,6 +162,28 @@ def build(layout: str, n_dev: int, pop_per_dev: int = None,
             return r
 
         return run, (w,)
+
+    if layout == "hv":
+        from deap_tpu.ops.hypervolume import hypervolume_sharded
+        pts = jax.random.uniform(key, (mo_pop, 3))
+        pts = jax.device_put(pts, NamedSharding(mesh, P("d", None)))
+        ref = jnp.ones((3,), jnp.float32)
+
+        def hv_step(carry, _):
+            p, acc = carry
+            acc = acc + hypervolume_sharded(p, ref, mesh, axis="d")
+            p = p + acc * 1e-30            # same anti-hoist perturbation
+            return (p, acc), None
+
+        def run(ncalls):
+            @jax.jit
+            def r(p):
+                (p, acc), _ = lax.scan(hv_step, (p, jnp.float32(0.0)),
+                                       None, length=ncalls)
+                return p, acc[None]
+            return r
+
+        return run, (pts,)
 
     if layout == "pop":
         pop_size = pop_per_dev * n_groups        # total fixed, mesh varies
@@ -263,6 +294,31 @@ def _marginal_gated(run, args, ngen, max_ngen=512):
         ngen *= 2
 
 
+def grid_bitwise_identical(mo_pop: int = None) -> bool:
+    """``sel_nsga2_sharded(ranks="grid")`` compared element-wise against
+    single-chip ``sel_nsga2(nd="grid")`` on the bench cloud — the
+    identity the committed ``mo_grid`` row records and the bench-json
+    lint requires to be true."""
+    mo_pop = MO_POP if mo_pop is None else mo_pop
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from deap_tpu.parallel.emo_sharded import sel_nsga2_sharded
+    from deap_tpu.ops.emo import sel_nsga2
+    key = jax.random.PRNGKey(0)
+    x = jax.random.uniform(key, (mo_pop, 3))
+    w = -jnp.stack([x[:, 0], x[:, 1] * (1.5 - x[:, 0]),
+                    x[:, 2] * (1.5 - x[:, 0])], axis=1)
+    mesh = Mesh(np.array(jax.devices()[:N_DEV]), ("d",))
+    a = np.asarray(sel_nsga2(None, w, mo_pop // 2, nd="grid"))
+    b = np.asarray(sel_nsga2_sharded(
+        None, jax.device_put(w, NamedSharding(mesh, P("d", None))),
+        mo_pop // 2, mesh, axis="d",
+        front_chunk=max(64, mo_pop // 16), ranks="grid"))
+    return bool((a == b).all())
+
+
 def measure(layout: str, n_dev: int):
     """Marginal per-generation time + collective counts for ``layout``
     partitioned over an ``n_dev``-device mesh."""
@@ -296,11 +352,11 @@ def main():
                     "partitioner-inserted collectives + duplicated work; "
                     "real-pod efficiency ~ 1/overhead"),
            "layouts": {}}
-    for layout in ("pop", "island", "mo"):
+    for layout in ("pop", "island", "mo", "mo_grid", "hv"):
         t1, r1, s1, n1, _, _ = measure(layout, 1)
         tn, rn, sn, nn, colls, ops = measure(layout, N_DEV)
         ok = (1.5 <= r1 <= 2.7) and (1.5 <= rn <= 2.7)
-        out["layouts"][layout] = {
+        row = {
             "t1dev_per_gen_ms": round(t1 * 1e3, 2),
             f"t{N_DEV}dev_per_gen_ms": round(tn * 1e3, 2),
             "overhead_factor": round(tn / t1, 3) if ok else -1,
@@ -311,6 +367,11 @@ def main():
             "collectives_in_hlo": colls,
             "collective_ops_in_hlo": ops,
         }
+        if layout == "mo_grid":
+            row["bitwise_identical"] = grid_bitwise_identical()
+        if layout == "hv":
+            row["pts_per_sec"] = round(MO_POP / tn, 1) if ok else -1
+        out["layouts"][layout] = row
     print(json.dumps(out))
 
 

@@ -530,9 +530,11 @@ def _build_serve_nsga2_sharded(variant: int = 0):
     return fn, (state,)
 
 
-def _build_nsga2_sharded(exchange: str, variant: int = 0):
+def _build_nsga2_sharded(exchange: str, ranks: str = "peel",
+                         variant: int = 0):
     """Standalone sharded NSGA-II selection (``exchange="indices"`` is
-    the r06 collective-lean default; ``"rows"`` the legacy protocol)."""
+    the r06 collective-lean default, ``"rows"`` the legacy protocol;
+    ``ranks="grid"`` the r07 slab-group-sharded lex-grid engine)."""
     from ..parallel.emo_sharded import sel_nsga2_sharded
     mesh = require_mesh()
     key = jax.random.PRNGKey(11 + variant)
@@ -543,8 +545,39 @@ def _build_nsga2_sharded(exchange: str, variant: int = 0):
 
     def sel(w_):
         return sel_nsga2_sharded(None, w_, MO_POP // 2, mesh, axis="pop",
-                                 front_chunk=32, exchange=exchange)
+                                 front_chunk=32, exchange=exchange,
+                                 ranks=ranks)
     return sel, (w,)
+
+
+HV_PTS = 256
+
+
+def _build_hypervolume(variant: int = 0):
+    """The blocked 3-D hypervolume sweep (device XLA form) over a
+    DTLZ2-shaped cloud — the jit-able quality-metric shape."""
+    from ..ops.hypervolume import hypervolume_3d
+    key = jax.random.PRNGKey(17 + variant)
+    pts = jax.random.uniform(key, (HV_PTS, 3))
+
+    def hv(p):
+        return hypervolume_3d(p, jnp.ones((3,), p.dtype), block=64)
+    return hv, (pts,)
+
+
+def _build_hypervolume_sharded(variant: int = 0):
+    """The mesh-sharded point-partitioned hypervolume driver (the
+    ``toolbox.hypervolume`` slot of pop-sharded serve sessions)."""
+    from ..ops.hypervolume import hypervolume_sharded
+    mesh = require_mesh()
+    key = jax.random.PRNGKey(17 + variant)
+    pts = jax.random.uniform(key, (HV_PTS, 3))
+    pts = jax.device_put(pts, NamedSharding(mesh, P("pop", None)))
+
+    def hv(p):
+        return hypervolume_sharded(p, jnp.ones((3,), p.dtype), mesh,
+                                   axis="pop", block=64)
+    return hv, (pts,)
 
 
 def _build_gp_interp(variant: int = 0):
@@ -740,6 +773,30 @@ INVENTORY: Tuple[ProgramEntry, ...] = (
         donate_waiver="pure selection: returns indices, no state to "
                       "donate into",
         doc="sharded NSGA-II selection, legacy row-gather protocol"),
+    ProgramEntry(
+        name="nsga2_sharded_grid",
+        anchor="deap_tpu/parallel/emo_sharded.py",
+        build=partial(_build_nsga2_sharded, "indices", "grid"),
+        mesh=True, budget=True,
+        donate_waiver="pure selection: returns indices, no state to "
+                      "donate into",
+        doc="sharded NSGA-II selection, r07 slab-group-sharded lex-grid "
+            "ranks + sharded crowding tail"),
+    ProgramEntry(
+        name="hypervolume_blocked",
+        anchor="deap_tpu/ops/hypervolume.py",
+        build=_build_hypervolume, budget=True,
+        donate_waiver="pure metric: reduces a front to one scalar, no "
+                      "state to donate into",
+        doc="blocked 3-D hypervolume sweep (device XLA form)"),
+    ProgramEntry(
+        name="hypervolume_sharded",
+        anchor="deap_tpu/ops/hypervolume.py",
+        build=_build_hypervolume_sharded, mesh=True, budget=True,
+        donate_waiver="pure metric: reduces a front to one scalar, no "
+                      "state to donate into",
+        doc="mesh-sharded point-partitioned hypervolume (pop-sharded "
+            "session toolbox slot)"),
     ProgramEntry(
         name="gp_interp", anchor="deap_tpu/gp/interp.py",
         build=_build_gp_interp,

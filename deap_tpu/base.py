@@ -63,11 +63,19 @@ class Toolbox:
     * ``map`` — builtin ``map``.  Replacing this slot is still the
       parallelization boundary: :func:`deap_tpu.parallel.tpu_map` is the
       sharded vmap equivalent of registering ``multiprocessing.Pool.map``.
+
+    One slot goes beyond the reference: ``hypervolume`` defaults to the
+    per-dimension device/host router of
+    :func:`deap_tpu.ops.hypervolume.hypervolume` (the reference keeps its
+    hypervolume in a C extension with no operator slot at all); sharded
+    serving sessions re-register it with the mesh-partitioned driver.
     """
 
     def __init__(self):
         self.register("clone", lambda x: x)
         self.register("map", map)
+        from .ops.hypervolume import hypervolume
+        self.register("hypervolume", hypervolume)
 
     def register(self, alias: str, function: Callable, *args, **kargs) -> None:
         pfunc = partial(function, *args, **kargs)

@@ -47,7 +47,10 @@ def bench_json_targets(repo: Path) -> List[Tuple[str, Path]]:
                 "BENCH_OOC.json": "ooc",
                 "BENCH_PROBE_GA.json": "probe_ga"}
     for p in sorted(repo.glob("BENCH_*.json")):
-        out.append((_SPECIAL.get(p.name, "bench"), p))
+        if p.name.startswith("BENCH_WEAKSCALING"):
+            out.append(("weakscaling", p))
+        else:
+            out.append((_SPECIAL.get(p.name, "bench"), p))
     for p in sorted(repo.glob("MULTICHIP_*.json")):
         out.append(("multichip", p))
     budget = repo / "tools" / "collective_budget.json"
@@ -382,6 +385,70 @@ def _schema_errors(kind: str, doc) -> List[str]:
                             or not isinstance(row.get("error"), str):
                         errors.append(f"result.errors[{i}] must be "
                                       "{'probe': str, 'error': str}")
+    elif kind == "weakscaling":
+        # BENCH_WEAKSCALING_r*.json: the partition-overhead study from
+        # bench_weakscaling.py — per-layout same-total-size walls on a
+        # 1- vs N-device mesh plus the compiled collective inventory.
+        # The perfgate rows mo_weak_scaling_overhead / mo_grid_overhead /
+        # hypervolume_pts_per_sec read the LATEST artifact by glob, so a
+        # malformed commit breaks the perf gate; -1 is the harness
+        # convention for a failed linearity gate (never fabricate a
+        # number), everything else must be finite and positive
+        require("cmd", str, "a string")
+        res = doc.get("result")
+        if not isinstance(res, dict):
+            errors.append("key 'result' must be an object")
+        else:
+            layouts = res.get("layouts")
+            if not isinstance(layouts, dict) or not layouts:
+                errors.append("result.layouts must be a non-empty object "
+                              "{layout: row}")
+                layouts = {}
+            for name, row in layouts.items():
+                if not isinstance(row, dict):
+                    errors.append(f"result.layouts[{name!r}] must be an "
+                                  "object")
+                    continue
+                for k, v in row.items():
+                    if not (k.endswith("_per_gen_ms")
+                            or k in ("overhead_factor", "pts_per_sec")):
+                        continue
+                    bad = (isinstance(v, bool)
+                           or not isinstance(v, (int, float))
+                           or not math.isfinite(float(v)))
+                    if not bad and k.endswith("_per_gen_ms"):
+                        bad = v <= 0
+                    elif not bad:
+                        bad = v <= 0 and v != -1
+                    if bad:
+                        errors.append(
+                            f"result.layouts[{name!r}].{k} must be a "
+                            "finite positive number (or the harness "
+                            "convention -1 for a failed linearity gate "
+                            "on derived metrics)")
+                for ck in ("collectives_in_hlo", "collective_ops_in_hlo"):
+                    ops = row.get(ck)
+                    if ops is None:
+                        continue
+                    if not isinstance(ops, dict):
+                        errors.append(f"result.layouts[{name!r}].{ck} "
+                                      "must be an object "
+                                      "{collective: count}")
+                        continue
+                    for op, count in ops.items():
+                        if isinstance(count, bool) \
+                                or not isinstance(count, int) or count < 0:
+                            errors.append(
+                                f"result.layouts[{name!r}].{ck}[{op!r}] "
+                                "must be a non-negative integer")
+                if name == "mo_grid" \
+                        and row.get("bitwise_identical") is not True:
+                    errors.append(
+                        "result.layouts['mo_grid'].bitwise_identical "
+                        "must be true -- the committed grid leg doubles "
+                        "as the sharded==single-chip index proof; "
+                        "anything else means the sharded grid selection "
+                        "diverged and must not be committed")
     elif kind == "perf_ledger":
         # PERF_LEDGER.json: the perf-regression ledger deap-tpu-perfgate
         # enforces — one schema, two gates (deap_tpu.perfledger is the

@@ -1,0 +1,317 @@
+"""Device-native blocked hypervolume kernels — the last reference-native
+metric moved on chip.
+
+The reference's hypervolume indicator is its only C extension
+(``deap/tools/_hypervolume/_hv.c``, the Fonseca–Paquete–López-Ibáñez
+dimension sweep); :mod:`deap_tpu.ops.hv` carries the host-side contract
+(numpy staircase, optional native sweep, WFG fallback).  This module is
+the *device* tier: exact hypervolume as fixed-shape XLA (and, on TPU, a
+Pallas kernel), jit-able inside quality-metric scans, plus a
+mesh-sharded point-partitioned driver for pop-sharded serving sessions.
+
+Algorithm (``d == 3``, implicit minimization, reference point ``ref``):
+the FPL-style dimension sweep sliced along the third objective.  Sort
+the clipped points by ``z``; the dominated volume is
+
+    HV = sum_k (z_{k+1} - z_k) * A_k,         z_{n+1} = ref_z,
+
+where ``A_k`` is the 2-D staircase area (w.r.t. ``(ref_x, ref_y)``) of
+the first ``k`` points.  Every prefix area is one masked running-min
+over the x-sorted view — points outside the prefix are masked to
+``+inf`` so they contribute no height — and the prefixes are processed
+in ``block``-sized slabs: one ``(block, n)`` masked prefix-min +
+strip-sum per slab, O(n²/block) slabs of VMEM-bounded work instead of a
+data-dependent recursion (the WFG/fpli shape XLA cannot compile).
+Clipping to ``ref`` subsumes the reference's strict-dominance filter
+exactly: a point at or beyond ``ref`` on any axis contributes zero
+width, height, or depth to every strip it touches.
+
+Precision: the kernels compute in the input dtype.  Under
+``jax.experimental.enable_x64`` the XLA form matches the numpy/WFG
+reference to ≤1e-12 on analytic fronts (pinned in
+``tests/test_hv.py``); the TPU Pallas variant runs f32 (TPU has no
+native f64) and is pinned against the f32 XLA form.
+
+Sharding: :func:`hypervolume_sharded` gathers the point set once and
+partitions the prefix *slabs* over the mesh axis — each device sweeps
+its contiguous ``k``-range and one psum combines the partial volumes
+(collective budget: 1 all-gather + 1 all-reduce, committed as the
+``hypervolume_sharded`` inventory entry).
+
+``d == 2`` reuses the closed-form staircase
+(:func:`deap_tpu.ops.hv.hypervolume_2d`); ``d >= 4`` stays host-side
+(:func:`deap_tpu.ops.hv.hypervolume`) — the host dispatcher
+:func:`hypervolume` routes per dimension and is the default
+``toolbox.hypervolume`` slot.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .hv import hypervolume_2d, hypervolume as hypervolume_host
+
+__all__ = ["hypervolume_3d", "hypervolume_3d_pallas", "hypervolume_device",
+           "hypervolume_sharded", "hypervolume"]
+
+
+def _hv3d_prep(pts, ref):
+    """Shared sweep precomputation on the clipped point set: z-sorted
+    strip depths and the x-sorted staircase view.  Returns
+    ``(xs, ys, zr, dz, width)`` where ``zr[j]`` is the z-rank of the
+    point at x-position ``j`` (the prefix-membership key: x-position
+    ``j`` belongs to prefix ``k`` iff ``zr[j] < k``) and ``width[j]``
+    is the strip ``x_{j+1} - x_j`` (last strip runs to ``ref_x``)."""
+    p = pts[jnp.argsort(pts[:, 2])]                   # z-ascending
+    z = p[:, 2]
+    dz = jnp.concatenate([z[1:], ref[2:3]]) - z       # (n,) >= 0
+    xord = jnp.argsort(p[:, 0])                       # x-ascending view
+    xs = p[xord, 0]
+    ys = p[xord, 1]
+    zr = xord.astype(jnp.int32)                       # z-rank per x-slot
+    width = jnp.concatenate([xs[1:], ref[0:1]]) - xs  # (n,) >= 0
+    return xs, ys, zr, dz, width
+
+
+def _prefix_areas(ys, zr, width, ref_y, k0, blk):
+    """2-D staircase areas ``A_k`` for the ``blk`` prefixes
+    ``k = k0+1 .. k0+blk``: one masked inclusive prefix-min over the
+    x-sorted heights per prefix (points with z-rank >= k mask to +inf),
+    then the strip sum.  ``(blk, n)`` intermediates — the VMEM-sized
+    block of the module docstring."""
+    ks = k0 + 1 + jnp.arange(blk, dtype=jnp.int32)    # prefix sizes
+    masked = jnp.where(zr[None, :] < ks[:, None], ys[None, :], jnp.inf)
+    ymin = lax.associative_scan(jnp.minimum, masked, axis=1)
+    h = jnp.maximum(ref_y - ymin, 0.0)
+    return jnp.sum(h * width[None, :], axis=1)        # (blk,)
+
+
+@partial(jax.jit, static_argnames=("block",))
+def hypervolume_3d(points, ref, block: int = 128):
+    """Exact 3-D hypervolume, jit-able (see module docstring): blocked
+    prefix-staircase sweep, O(n²/block) slabs of ``(block, n)`` work.
+    Points at or beyond ``ref`` contribute exactly their clipped part
+    (zero when nothing of them dominates the box)."""
+    pts = jnp.asarray(points)
+    ref = jnp.asarray(ref, pts.dtype)
+    pts = jnp.minimum(pts, ref)
+    n = pts.shape[0]
+    xs, ys, zr, dz, width = _hv3d_prep(pts, ref)
+    blk = min(block, n)
+    nb = -(-n // blk)
+    dz_pad = jnp.concatenate(
+        [dz, jnp.zeros((nb * blk - n,), dz.dtype)])   # k > n: zero depth
+
+    def slab(acc, b):
+        a = _prefix_areas(ys, zr, width, ref[1], b * blk, blk)
+        return acc + jnp.sum(a * lax.dynamic_slice(dz_pad, (b * blk,),
+                                                   (blk,))), None
+
+    acc, _ = lax.scan(slab, jnp.zeros((), pts.dtype),
+                      jnp.arange(nb, dtype=jnp.int32))
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU variant
+# ---------------------------------------------------------------------------
+
+_LANE = 128
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+@partial(jax.jit, static_argnames=("blk", "interpret"))
+def _hv3d_pallas_call(ys, zr, width, dz, ref_y, blk: int,
+                      interpret: bool = False):
+    """One kernel instance per prefix slab: the ``(blk, n_pad)`` masked
+    prefix-min runs as a log2(n_pad) shift-and-min doubling (Pallas has
+    no associative_scan; the Hillis–Steele form is ~7 vector passes at
+    n=2¹⁴), heights and strip widths reduce to the slab's partial
+    volume.  All row buffers live in VMEM; ``ref_y`` is an SMEM scalar."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_pad = ys.shape[1]
+    G = dz.shape[1] // blk
+
+    def kernel(ys_ref, zr_ref, w_ref, dz_ref, refy_ref, out_ref):
+        g = pl.program_id(0)
+        ks = g * blk + 1 + lax.broadcasted_iota(jnp.int32, (blk, 1), 0)
+        mask = zr_ref[0, :][None, :] < ks              # (blk, n_pad)
+        m = jnp.where(mask, ys_ref[0, :][None, :], jnp.inf)
+        s = 1
+        while s < n_pad:                               # inclusive prefix-min
+            shifted = jnp.concatenate(
+                [jnp.full((blk, s), jnp.inf, m.dtype), m[:, :-s]], axis=1)
+            m = jnp.minimum(m, shifted)
+            s *= 2
+        h = jnp.maximum(refy_ref[0] - m, 0.0)
+        a = jnp.sum(h * w_ref[0, :][None, :], axis=1)  # (blk,)
+        out_ref[0, 0] = jnp.sum(a * dz_ref[0, :])
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(G,),
+        in_specs=[
+            pl.BlockSpec((1, n_pad), lambda g: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_pad), lambda g: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_pad), lambda g: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk), lambda g: (0, g),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1,), lambda g: (0,),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda g: (g, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((G, 1), ys.dtype),
+        interpret=interpret,
+    )(ys, zr, width, dz, ref_y)
+    return jnp.sum(out)
+
+
+def hypervolume_3d_pallas(points, ref, block: int = 128,
+                          interpret: bool | None = None):
+    """TPU form of :func:`hypervolume_3d` (f32 — TPU has no native f64):
+    XLA does the two sorts, the Pallas kernel does the O(n²/block)
+    blocked staircase sweep.  Lane-pads the point axis to 128 with inert
+    columns (zero width, +inf height, unreachable z-rank) and the slab
+    axis with zero-depth prefixes.  Equality with the XLA form is pinned
+    by ``tests/test_hv.py`` in interpret mode."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    pts = jnp.asarray(points, jnp.float32)
+    ref = jnp.asarray(ref, jnp.float32)
+    pts = jnp.minimum(pts, ref)
+    n = pts.shape[0]
+    xs, ys, zr, dz, width = _hv3d_prep(pts, ref)
+    del xs
+    blk = max(8, min(block, _round_up(n, 8)))
+    n_pad = _round_up(n, _LANE)
+    n_k = _round_up(n, blk)
+    pad_cols = n_pad - n
+
+    ys = jnp.concatenate([ys, jnp.full((pad_cols,), jnp.inf, ys.dtype)])
+    zr = jnp.concatenate(
+        [zr, jnp.full((pad_cols,), np.iinfo(np.int32).max, zr.dtype)])
+    width = jnp.concatenate([width, jnp.zeros((pad_cols,), width.dtype)])
+    dz = jnp.concatenate([dz, jnp.zeros((n_k - n,), dz.dtype)])
+    return _hv3d_pallas_call(ys[None], zr[None], width[None], dz[None],
+                             jnp.asarray(ref)[1:2], blk=blk,
+                             interpret=interpret)
+
+
+def hypervolume_device(points, ref, block: int = 128):
+    """Jit-able device hypervolume for 2/3 objectives: the closed-form
+    staircase at ``d == 2``, the blocked sweep at ``d == 3`` (Pallas on
+    TPU, XLA elsewhere).  ``d >= 4`` has no fixed-shape device form —
+    use :func:`hypervolume` (host) instead."""
+    d = jnp.asarray(points).shape[-1]
+    if d == 2:
+        return hypervolume_2d(points, ref)
+    if d == 3:
+        if jax.default_backend() == "tpu":
+            return hypervolume_3d_pallas(points, ref, block=block)
+        return hypervolume_3d(points, ref, block=block)
+    raise ValueError(
+        f"hypervolume_device supports 2 or 3 objectives, got {d}; use "
+        "deap_tpu.ops.hypervolume.hypervolume (host WFG) for d >= 4")
+
+
+# ---------------------------------------------------------------------------
+# mesh-sharded driver
+# ---------------------------------------------------------------------------
+
+# local import keeps this module importable without the parallel package
+# initialized (the shard_map version shim lives there)
+
+
+@partial(jax.jit, static_argnames=("mesh", "axis", "block"))
+def hypervolume_sharded(points, ref, mesh: Mesh, axis: str = "pop",
+                        block: int = 128):
+    """Mesh-sharded exact hypervolume: one population all-gather, then
+    each device sweeps a contiguous range of prefix slabs (``d == 3``)
+    and one psum combines the partial volumes — the point-partitioned
+    driver pop-sharded serve sessions swap in as ``toolbox.hypervolume``.
+    ``d == 2`` computes the replicated staircase after the gather (the
+    O(n log n) tail is noise at sharding scales).  Rows are padded to
+    the mesh with ``ref`` copies, which clip to zero contribution."""
+    pts = jnp.asarray(points)
+    ref = jnp.asarray(ref, pts.dtype)
+    n, d = pts.shape
+    if d not in (2, 3):
+        raise ValueError(
+            f"hypervolume_sharded supports 2 or 3 objectives, got {d}")
+    from ..parallel.emo_sharded import shard_map_compat
+    D = int(mesh.shape[axis])
+    n_loc = -(-n // D)
+    n_pad = n_loc * D
+    ptsp = jnp.concatenate(
+        [pts, jnp.broadcast_to(ref, (n_pad - n, d))], 0)
+    blk = min(block, n_loc)
+    nb_loc = -(-n_loc // blk)                         # slabs per device
+
+    def kernel(p_local):
+        p_full = lax.all_gather(p_local, axis, axis=0, tiled=True)
+        p_full = jnp.minimum(p_full, ref)
+        if d == 2:
+            return hypervolume_2d(p_full, ref)[None]
+        xs, ys, zr, dz, width = _hv3d_prep(p_full, ref)
+        del xs
+        dz_pad = jnp.concatenate(
+            [dz, jnp.zeros((D * nb_loc * blk - n_pad,), dz.dtype)])
+        base = lax.axis_index(axis).astype(jnp.int32) * (nb_loc * blk)
+
+        def slab(acc, b):
+            k0 = base + b * blk
+            a = _prefix_areas(ys, zr, width, ref[1], k0, blk)
+            return acc + jnp.sum(
+                a * lax.dynamic_slice(dz_pad, (k0,), (blk,))), None
+
+        acc, _ = lax.scan(slab, jnp.zeros((), p_full.dtype),
+                          jnp.arange(nb_loc, dtype=jnp.int32))
+        return lax.psum(acc, axis)[None]
+
+    # the kernel output is replicated by construction (the d==3 psum /
+    # the d==2 replicated staircase), so declare it P(): extracting one
+    # element of a P(axis) output would cost a broadcast all-reduce
+    out = shard_map_compat(kernel, mesh=mesh, in_specs=(P(axis, None),),
+                           out_specs=P())(ptsp)
+    return out[0]
+
+
+# ---------------------------------------------------------------------------
+# host dispatcher (the default toolbox.hypervolume slot)
+# ---------------------------------------------------------------------------
+
+
+def hypervolume(pointset, ref, block: int = 128) -> float:
+    """Exact hypervolume with per-dimension routing — the contract of
+    :func:`deap_tpu.ops.hv.hypervolume` (and the reference's
+    ``hv.hypervolume``), device-accelerated where a device kernel exists
+    at full precision: ``d == 2`` stays on the host staircase
+    (microseconds, no recompile per front size), ``d == 3`` runs the
+    blocked device sweep when f64 is available (``jax_enable_x64``,
+    matching the reference ≤1e-12) and falls back to the host reference
+    otherwise, ``d >= 4`` runs the host WFG/native sweep."""
+    pts = np.asarray(pointset, np.float64)
+    if pts.ndim == 1:
+        pts = pts.reshape(1, -1)
+    elif pts.ndim != 2:
+        pts = pts.reshape(-1, pts.shape[-1])
+    if (pts.shape[1] == 3 and len(pts)
+            and jax.config.read("jax_enable_x64")):
+        return float(hypervolume_3d(pts, np.asarray(ref, np.float64),
+                                    block=block))
+    return hypervolume_host(pts, ref)

@@ -36,16 +36,28 @@ Design (``shard_map`` over one mesh axis, default ``"pop"``):
   where a replicated ``(n_pad, m)`` buffer is unaffordable; its two
   per-front psums (survivor count in ``body``, front count in
   ``subtract_front``) are fused into ONE stacked psum per front.
-* **cheap tail replicated** — crowding distance and the final
-  (rank, -crowding) lexsort are O(N log N) on data that already fits on
-  every device; they run as ordinary global ops outside the shard_map
-  so the result is bit-identical to the unsharded selector.
+* **sharded lex-grid ranks** (``method="grid"`` /
+  ``sel_nsga2_sharded(ranks="grid")``) — the sub-quadratic grid
+  decomposition of :func:`deap_tpu.ops.emo._grid_dominator_counts`
+  (the engine that beats the single-chip peel ~7× at converged steady
+  state) distributed under the same indices discipline: grid views and
+  the O(N + B^m) histogram region replicated from the resident
+  ``w_full``, the dominant O(N·m·T) band passes split by slab group
+  with ONE stacked int32 band payload all-gather per counts call, and
+  the hybrid thin/fat front peel exchanging only compacted index
+  payloads.  Zero psums, bitwise rank-identical to both single-chip
+  engines (see :func:`_make_grid_kernel`).
+* **sharded crowding tail** (``tail="sharded"``, the default) — the
+  per-objective crowding programs are partitioned over the mesh and
+  merged in objective order from one stacked payload all-gather, which
+  reproduces the replicated tail's float-add association exactly
+  (:func:`_crowding_tail_sharded`); ``tail="replicated"`` keeps the
+  pre-r07 constraint-replicated tail selectable for cross-checking.
 
-Equivalence to :func:`deap_tpu.ops.emo.sel_nsga2` with ``nd="peel"`` is
-*exact* in both exchange modes (integer counts, same front sequence,
-same crowding program): ``tests/test_parallel.py`` pins index-identity
-on an 8-device mesh, including the adversarial one-point-per-front
-``line`` regime.
+Equivalence to :func:`deap_tpu.ops.emo.sel_nsga2` is *exact* in every
+mode (integer counts, same front sequence, same crowding program):
+``tests/test_parallel.py`` pins index-identity on an 8-device mesh,
+including the adversarial one-point-per-front ``line`` regime.
 
 Reference anchor: ``deap/tools/emo.py:15-50`` (selNSGA2) — the reference
 has no distributed selection at all (its parallelism is ``toolbox.map``
@@ -66,7 +78,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.emo import _wv_values, _rows_dominate_counts, assign_crowding_dist
+from ..ops.emo import (_wv_values, _rows_dominate_counts,
+                       assign_crowding_dist, _grid_views)
 
 # jax >= 0.6 promotes shard_map to jax.shard_map; 0.4.x still ships it
 # under experimental, where the replication checker has no rule for
@@ -171,24 +184,281 @@ def dominance_counts_sharded(w: jax.Array, mesh: Mesh, axis: str = "pop",
     return counts[:n]
 
 
+def _make_grid_kernel(axis: str, D: int, n: int, n_loc: int, n_pad: int,
+                      c: int, stop: int, dom_counts, B: int, T: int,
+                      sc: int, pad_g: int):
+    """Sharded lex-grid ranks kernel — the distributed form of
+    :func:`deap_tpu.ops.emo._grid_recount_ranks`.
+
+    Work split (one population all-gather, then int32 payloads only):
+
+    * **grid views replicated** — the per-axis lex-tie-broken sort
+      orders, buckets, and duplicate groups are built ONCE *outside*
+      the manual region under a replicated sharding constraint and
+      enter the kernel as ``P()`` inputs (the replicated population
+      operand doubles as the resident ``w_full``, so the kernel itself
+      gathers no row data).  They cannot be built inside: GSPMD's
+      sharding propagation mis-types the unused sorted-key outputs of
+      ``jnp.lexsort``'s tuple sorts when they sit under the fully
+      nested manual peel (mixed ``{replicated, manual}`` tuple
+      shardings) and bridges them with partition-0 broadcast
+      all-reduces; hoisting the loop-invariant sort work keeps the
+      compiled selection all-reduce-free.  The views are identical on
+      every device by construction — the same replicated-by-constraint
+      discipline the pre-r07 crowding tail used — built from the
+      resident ``w_full`` (O(N log N), the part that is cheap and must
+      agree bit-for-bit everywhere).
+    * **histogram region replicated, band passes sharded** — the
+      ``B^m`` histogram + suffix cumsum is O(N + B^m) and runs
+      replicated with only the local queries' cells looked up; the
+      O(N·m·T) same-slab tile×tile band passes — the dominant term —
+      are split by *slab group*: each device scans ``ceil(G/D)`` of the
+      ``G = B/sc`` groups per axis and ships one stacked
+      ``(m, G_loc·sc·T)`` int32 band payload per counts call.  The
+      gathered payload is position-aligned by construction (device d
+      owns groups ``[d·G_loc, (d+1)·G_loc)``), so every device unsorts
+      its own queries' band counts with a plain gather.
+    * **hybrid peel, indices discipline** — fronts subtract exactly like
+      the ``exchange="indices"`` peel (compacted int32 index payloads
+      against the resident ``w_full``, zero psums); a *fat* front
+      (global width ≥ ``4·c·D``) skips the per-block subtraction and
+      instead recomputes counts against the surviving active set with
+      one sharded grid pass — ``lax.cond`` cannot carry collectives
+      under shard_map on every supported jax, so the recompute runs as
+      a data-uniform 0/1-trip while_loop (the proven
+      collective-in-loop shape).
+
+    Exactness: integer dominator counts are exact under BOTH update
+    rules and for ANY bucket count, and the mesh's -inf padding rows
+    are exact duplicates of each other (and of all-(-inf) invalid rows),
+    which the duplicate-group subtraction already handles — so the
+    peeled front sequence restricted to real rows, hence the ranks, is
+    bitwise identical to the single-chip grid AND peel engines."""
+    recount_min = 4 * c * D
+
+    def kernel(w_local, w_full, views):
+        # w_local: (n_loc, m) per device.  w_full: (n_pad, m) and the
+        # grid views enter replicated (``P()`` inputs) — see docstring.
+        m = w_local.shape[1]
+        vary = _vary_fn(axis)
+        d_idx = lax.axis_index(axis).astype(jnp.int32)
+        d_off = d_idx * n_loc
+        G = B // sc                               # slab groups per axis
+        G_loc = -(-G // D)
+        G_pad = G_loc * D
+
+        def pad_groups(x, fill):
+            g = x.reshape((G, sc, T) + x.shape[1:])
+            if G_pad == G:
+                return g
+            return jnp.concatenate(
+                [g, jnp.full((G_pad - G,) + g.shape[1:], fill, g.dtype)],
+                0)
+
+        # loop-invariant views, sliced to this device's slab groups /
+        # query rows (hoisted out of every counts call)
+        tpP = [lax.dynamic_slice_in_dim(pad_groups(views["Pv"][cx], -1),
+                                        d_idx * G_loc, G_loc, 0)
+               for cx in range(m)]
+        tpB = [lax.dynamic_slice_in_dim(pad_groups(views["Bv"][cx], -1),
+                                        d_idx * G_loc, G_loc, 0)
+               for cx in range(m)]
+        lin_up_loc = lax.dynamic_slice(views["lin_up"], (d_off,), (n_loc,))
+        pos_loc = [lax.dynamic_slice(views["pos"][cx], (d_off,), (n_loc,))
+                   for cx in range(m)]
+        inv_loc = lax.dynamic_slice(views["inv_full"], (d_off,), (n_loc,))
+
+        def grid_counts_local(src):
+            """Exact dominator counts among ``src`` (replicated bool
+            ``(n_pad,)``) for this device's query rows — the sharded
+            body of :func:`deap_tpu.ops.emo._grid_counts_from_views`.
+            ONE stacked int32 all-gather (the band payload), no psums."""
+            with jax.named_scope("obs:grid_counts"):
+                # strictly-greater-bucket region: replicated histogram +
+                # suffix cumsum, local cell lookups
+                hist = jax.ops.segment_sum(
+                    src.astype(jnp.int32), views["lin"],
+                    num_segments=B ** m)
+                H = hist.reshape((B,) * m)
+                for ax2 in range(m):
+                    H = jnp.flip(jnp.cumsum(jnp.flip(H, ax2), ax2), ax2)
+                Hp = jnp.pad(H, [(0, 1)] * m)
+                counts = Hp.reshape(-1)[lin_up_loc].astype(jnp.int32)
+
+                # same-slab bands: this device's groups only
+                bands = []
+                for cx in range(m):
+                    Sv = jnp.concatenate(
+                        [src[views["perm"][cx]],
+                         jnp.zeros((pad_g,), bool)])
+                    Sg = lax.dynamic_slice_in_dim(
+                        pad_groups(Sv, False), d_idx * G_loc, G_loc, 0)
+
+                    def band_step(_, tiles, cx=cx):
+                        tp, tb, ts = tiles
+                        ge = jnp.all(
+                            tp[:, None, :, :] >= tp[:, :, None, :], -1)
+                        first = jnp.ones_like(ge)
+                        for c2 in range(cx):
+                            first &= (tb[:, None, :, c2]
+                                      != tb[:, :, None, c2])
+                        cnt = jnp.sum(ge & first & ts[:, None, :], axis=2)
+                        return None, cnt
+
+                    _, band = lax.scan(band_step, None,
+                                       (tpP[cx], tpB[cx], Sg))
+                    bands.append(band.reshape(-1))
+                payload = jnp.stack(bands)        # (m, G_loc*sc*T) int32
+                gband = lax.all_gather(payload, axis, axis=1, tiled=True)
+                for cx in range(m):
+                    counts = counts + gband[cx][pos_loc[cx]]
+
+                # duplicates: exact-equal rows never dominate (this is
+                # also what neutralizes the -inf mesh padding: pad rows
+                # are duplicates of each other and of invalid rows)
+                s_sorted = src[views["full_ord"]].astype(jnp.int32)
+                pref = jnp.cumsum(s_sorted)
+                gtotal = jax.ops.segment_sum(
+                    s_sorted, views["gid"],
+                    num_segments=n_pad)[views["gid"]]
+                base = lax.cummax(
+                    jnp.where(views["is_start"], pref - s_sorted, 0))
+                suffix_ge = gtotal - (pref - base) + s_sorted
+                return counts - suffix_ge[inv_loc]
+
+        # -inf sentinel row at global index n_pad (indices discipline)
+        w_full_s = jnp.concatenate(
+            [w_full, jnp.full((1, m), -jnp.inf, w_full.dtype)], 0)
+
+        def subtract_front_grid(counts, front, active_full):
+            """Hybrid front subtraction: per-block exact subtraction for
+            thin fronts (identical to the ``exchange="indices"`` peel),
+            one sharded grid recompute for fat ones.  The fat flag comes
+            from the FIRST sub-round's gathered payload, so it is
+            uniform across devices by construction.  Returns
+            ``(counts, active_full, front_total)``."""
+            def sub_cond(s):
+                return s[2]
+
+            def sub_round(s):
+                counts, todo, _, t, front_total, fat, active_full = s
+                idx = jnp.nonzero(todo, size=c, fill_value=n_loc)[0]
+                idx = idx.astype(jnp.int32)
+                n_rem = jnp.sum(todo, dtype=jnp.int32)
+                gidx = jnp.where(idx < n_loc, idx + d_off, n_pad)
+                payload = jnp.concatenate([n_rem[None], gidx])
+                g = lax.all_gather(payload, axis, axis=0,
+                                   tiled=True).reshape(D, c + 1)
+                rem = g[:, 0]
+                front_total = jnp.where(t == 0, jnp.sum(rem), front_total)
+                fat = jnp.where(t == 0, front_total >= recount_min, fat)
+                flat = g[:, 1:].reshape(-1)
+                active_full = active_full.at[flat].set(False, mode="drop")
+                pos2 = jnp.nonzero(flat < n_pad, size=D * c,
+                                   fill_value=D * c)[0]
+                flat_s = jnp.concatenate(
+                    [flat, jnp.full((1,), n_pad, jnp.int32)])
+                cidx = flat_s[pos2]               # real rows first
+                n_real = jnp.sum(jnp.minimum(rem, c))
+                n_blocks = jnp.where(fat, 0, -(-n_real // c))
+
+                def blk_cond(s2):
+                    return s2[1] < n_blocks
+
+                def blk(s2):
+                    counts2, b = s2
+                    rows = w_full_s[
+                        lax.dynamic_slice(cidx, (b * c,), (c,))]
+                    counts2 = counts2 - dom_counts(
+                        rows, w_local).astype(jnp.int32)
+                    return counts2, b + 1
+
+                counts, _ = lax.while_loop(blk_cond, blk,
+                                           (counts, jnp.int32(0)))
+                todo = todo.at[idx].set(False, mode="drop")
+                return (counts, todo, jnp.any(rem > c), t + 1,
+                        front_total, fat, active_full)
+
+            counts, _, _, _, front_total, fat, active_full = \
+                lax.while_loop(
+                    sub_cond, sub_round,
+                    (counts, front, vary(jnp.bool_(True)), jnp.int32(0),
+                     vary(jnp.int32(0)), vary(jnp.bool_(False)),
+                     active_full))
+
+            def rec_cond(s):
+                return s[1] < jnp.where(fat, 1, 0)
+
+            def rec_body(s):
+                _, i = s
+                return grid_counts_local(active_full), i + 1
+
+            counts, _ = lax.while_loop(rec_cond, rec_body,
+                                       (counts, jnp.int32(0)))
+            return counts, active_full, front_total
+
+        counts0 = grid_counts_local(vary(jnp.ones((n_pad,), bool)))
+
+        def body(state):
+            ranks, counts, active_full, r, n_active = state
+            act_loc = lax.dynamic_slice(active_full, (d_off,), (n_loc,))
+            front = act_loc & (counts == 0)
+            ranks = jnp.where(front, r, ranks)
+            counts, active_full, front_total = subtract_front_grid(
+                counts, front, active_full)
+            return (ranks, counts, active_full, r + 1,
+                    n_active - front_total)
+
+        def cond(state):
+            n_active = state[4]
+            return (n_active > 0) & (n_pad - n_active < stop)
+
+        with jax.named_scope("obs:front_peel"):
+            ranks0 = vary(jnp.full((n_loc,), n, jnp.int32))
+            active0 = vary(jnp.ones((n_pad,), bool))
+            ranks, _, _, nf, _ = lax.while_loop(
+                cond, body,
+                (ranks0, counts0, active0, jnp.int32(0),
+                 vary(jnp.int32(n_pad))))
+        return ranks, nf[None]
+
+    return kernel
+
+
 @partial(jax.jit, static_argnames=("mesh", "axis", "front_chunk",
-                                   "row_chunk", "stop_at_k", "exchange"))
+                                   "row_chunk", "stop_at_k", "exchange",
+                                   "method"))
 def nondominated_ranks_sharded(w: jax.Array, mesh: Mesh, axis: str = "pop",
                                front_chunk: int = 256, row_chunk: int = 1024,
                                stop_at_k: int | None = None,
-                               exchange: str = "indices"):
+                               exchange: str = "indices",
+                               method: str = "peel"):
     """Pareto-front ranks with the dominance work sharded over
     ``mesh.shape[axis]`` devices.  Same contract as
-    :func:`deap_tpu.ops.emo.nondominated_ranks` (``method="peel"``):
-    returns ``(ranks, n_fronts)`` with unpeeled rows at sentinel ``n``.
+    :func:`deap_tpu.ops.emo.nondominated_ranks`: returns
+    ``(ranks, n_fronts)`` with unpeeled rows at sentinel ``n``.
 
     Rows are padded to the device count with ``-inf`` (which dominates
     nothing and is dominated by everything, so padding can never enter a
     peeled front before real rows are exhausted); the returned ranks are
     sliced back to ``n``.
 
-    ``exchange`` selects the front-subtraction protocol (identical
-    results, different collectives — see the module docstring):
+    ``method`` selects the counts engine:
+
+    * ``"peel"`` (default): O(M·N²/D) pairwise dominance counting — the
+      column-sharded count-peel, exact ranks for any input.
+    * ``"grid"``: the sub-quadratic lex-grid decomposition of
+      :func:`deap_tpu.ops.emo._grid_dominator_counts` with the band
+      passes slab-group-sharded over the mesh (see the module
+      docstring).  Bitwise rank-identical to the single-chip
+      ``method="grid"`` AND to ``"peel"`` (both engines produce exact
+      integer dominator counts, so the peeled front sequence — hence
+      the ranks — cannot differ).  Always uses the indices-discipline
+      collectives; ``exchange`` is ignored.
+
+    ``exchange`` selects the front-subtraction protocol of the
+    ``"peel"`` method (identical results, different collectives — see
+    the module docstring):
 
     * ``"indices"`` (default): all-gather ``front_chunk`` compacted
       ``int32`` indices + a count per device per round, look rows up in
@@ -207,7 +477,32 @@ def nondominated_ranks_sharded(w: jax.Array, mesh: Mesh, axis: str = "pop",
     rc = min(row_chunk, n_pad)
     if exchange not in ("indices", "rows"):
         raise ValueError(f"unknown exchange {exchange!r}")
+    if method not in ("peel", "grid"):
+        raise ValueError(f"unknown method {method!r}")
     dom_counts = _dom_counts_fn()
+
+    if method == "grid":
+        # loop-invariant grid views: replicated by constraint OUTSIDE
+        # the manual region (see _make_grid_kernel's docstring for why
+        # they cannot be built inside), one up-front population gather
+        with jax.named_scope("obs:grid_views"):
+            rep = NamedSharding(mesh, P())
+            wp_r = lax.with_sharding_constraint(wp, rep)
+            views = _grid_views(wp_r)
+        gv = {k: views[k] for k in
+              ("perm", "pos", "lin", "lin_up", "Pv", "Bv",
+               "full_ord", "gid", "inv_full", "is_start")}
+        kernel = _make_grid_kernel(axis, D, n, n_loc, n_pad, c, stop,
+                                   dom_counts, views["B"], views["T"],
+                                   views["sc"], views["pad"])
+        spec = P(axis)
+        # nf is replicated by construction (every device derives it from
+        # the same gathered payloads) — declare it P(): stitching it
+        # P(axis) and extracting [0] would cost a broadcast all-reduce
+        ranks_pad, nf = _shard_map(
+            kernel, mesh=mesh, in_specs=(spec, P(), P()),
+            out_specs=(spec, P()))(wp, wp_r, gv)
+        return ranks_pad[:n], nf[0]
 
     def kernel(w_local):                          # (n_loc, m) per device
         vary = _vary_fn(axis)
@@ -360,41 +655,139 @@ def nondominated_ranks_sharded(w: jax.Array, mesh: Mesh, axis: str = "pop",
         return ranks, nf[None]                        # nf: per-shard copy
 
     spec = P(axis)
+    # nf replicated by construction (derived from gathered payloads on
+    # every device) — P() avoids a broadcast all-reduce at extraction
     ranks_pad, nf = _shard_map(
-        kernel, mesh=mesh, in_specs=(spec,), out_specs=(spec, P(axis)))(wp)
+        kernel, mesh=mesh, in_specs=(spec,), out_specs=(spec, P()))(wp)
     return ranks_pad[:n], nf[0]
+
+
+def _crowding_tail_sharded(ranks: jax.Array, values: jax.Array,
+                           mesh: Mesh, axis: str):
+    """Crowding distance + the final (rank, -crowding) lexsort with the
+    per-objective work partitioned over the mesh — bitwise
+    order-identical to the replicated
+    ``assign_crowding_dist`` + ``lexsort`` tail.
+
+    Each device computes the full crowding program (lexsort, neighbor
+    gaps, segment min/max) for ``ceil(nobj/D)`` of the objectives over
+    the gathered population, then ships its per-row contribution and
+    boundary-flag vectors as ONE stacked float payload; every device
+    accumulates the gathered contributions **in objective order** — the
+    exact float-add association of the replicated program's
+    ``j = 0..nobj-1`` scatter-add loop — so the distances, hence the
+    final order, match bit for bit.  Three all-gathers total (ranks,
+    values, payload: one more than the replicated tail's two constraint
+    reshardings), zero all-reduces.
+
+    Padding rows (``n → n_pad``) carry the rank sentinel ``n``: they
+    join the unranked segment, which can never reach ``order[:k]``
+    because ``stop_at_k=k`` guarantees ≥ k ranked rows, and segments
+    ``< n`` see identical inputs — so ranked rows' crowding values are
+    unchanged by padding."""
+    n, nobj = values.shape
+    D = int(mesh.shape[axis])
+    n_loc = -(-n // D)
+    n_pad = n_loc * D
+    ranks_p = _pad_rows(ranks, n_pad, n)          # sentinel = unranked
+    values_p = _pad_rows(values, n_pad, 0.0)
+    m_loc = -(-nobj // D)                         # objectives per device
+
+    def kernel(r_local, v_local):
+        r_full = lax.all_gather(r_local, axis, axis=0, tiled=True)
+        v_full = lax.all_gather(v_local, axis, axis=0, tiled=True)
+        d_idx = lax.axis_index(axis).astype(jnp.int32)
+        # this device's objective slice; devices past the objective
+        # count redo the last objective (their payload rows are ignored
+        # by the accumulation below)
+        rows = []
+        for jj in range(m_loc):
+            j = jnp.minimum(d_idx * m_loc + jj, nobj - 1)
+            v = jnp.take(v_full, j, axis=1)
+            order = jnp.lexsort((v, r_full))
+            rv = r_full[order]
+            vv = v[order]
+            is_first = jnp.concatenate(
+                [jnp.ones(1, bool), rv[1:] != rv[:-1]])
+            is_last = jnp.concatenate(
+                [rv[1:] != rv[:-1], jnp.ones(1, bool)])
+            prev = jnp.concatenate([vv[:1], vv[:-1]])
+            nxt = jnp.concatenate([vv[1:], vv[-1:]])
+            seg_max = jax.ops.segment_max(v, r_full, num_segments=n + 1)
+            seg_min = jax.ops.segment_min(v, r_full, num_segments=n + 1)
+            norm = nobj * (seg_max - seg_min)
+            norm_row = norm[rv]
+            contrib = jnp.where(norm_row > 0, (nxt - prev) / norm_row,
+                                0.0)
+            # unsort to row space through the permutation (unique
+            # indices: set == the replicated program's scatter-add)
+            zero = jnp.zeros((n_pad,), v.dtype)
+            rows.append(zero.at[order].set(contrib))
+            rows.append(zero.at[order].set(
+                (is_first | is_last).astype(v.dtype)))
+        payload = jnp.stack(rows)                 # (2*m_loc, n_pad)
+        gp = lax.all_gather(payload, axis, axis=0,
+                            tiled=True).reshape(D, 2 * m_loc, n_pad)
+        # replicated accumulation in objective order: bitwise the same
+        # float-add association as the replicated tail's j-loop
+        dist = jnp.zeros((n_pad,), v_full.dtype)
+        boundary = jnp.zeros((n_pad,), jnp.int32)
+        for j in range(nobj):
+            dev, jj = divmod(j, m_loc)
+            dist = dist + gp[dev, 2 * jj]
+            boundary = jnp.maximum(
+                boundary, (gp[dev, 2 * jj + 1] > 0).astype(jnp.int32))
+        dist = jnp.where(boundary > 0, jnp.inf, dist)
+        order = jnp.lexsort((-dist, r_full))
+        return lax.dynamic_slice(order, (d_idx * n_loc,), (n_loc,))
+
+    order = _shard_map(kernel, mesh=mesh,
+                       in_specs=(P(axis), P(axis, None)),
+                       out_specs=P(axis))(ranks_p, values_p)
+    return order
 
 
 def sel_nsga2_sharded(key, fitness, k, mesh: Mesh, axis: str = "pop",
                       front_chunk: int = 256, row_chunk: int = 1024,
-                      exchange: str = "indices"):
+                      exchange: str = "indices", ranks: str = "peel",
+                      tail: str = "sharded"):
     """NSGA-II selection with dominance counting sharded over
     ``mesh.shape[axis]`` devices — index-identical to
-    :func:`deap_tpu.ops.emo.sel_nsga2` with ``nd="peel"`` (reference
-    selNSGA2, emo.py:15-50).  ``key`` unused (deterministic).
+    :func:`deap_tpu.ops.emo.sel_nsga2` (reference selNSGA2,
+    emo.py:15-50) for every ``ranks``/``tail``/``exchange``
+    combination.  ``key`` unused (deterministic).
 
-    The O(M·N²) ranks come from :func:`nondominated_ranks_sharded`
-    (``exchange`` selects the collective protocol; the default
-    ``"indices"`` peel issues one small int32 all-gather per front round
-    and no reductions at all); the O(N log N) crowding + final sort run
-    replicated (they are noise at the populations where sharding
-    matters)."""
+    The ranks come from :func:`nondominated_ranks_sharded`:
+    ``ranks="peel"`` is the O(M·N²/D) count-peel (``exchange`` selects
+    its collective protocol; the default ``"indices"`` peel issues one
+    small int32 all-gather per front round and no reductions at all);
+    ``ranks="grid"`` is the sub-quadratic sharded lex-grid engine —
+    bitwise index-identical output, ~7× less pair work at converged
+    steady state (the single-chip margin, BENCH_NDSORT).
+
+    ``tail="sharded"`` (default) partitions the per-objective crowding
+    programs over the mesh (:func:`_crowding_tail_sharded`, one extra
+    all-gather, zero all-reduces, bitwise order-identical);
+    ``tail="replicated"`` keeps the pre-r07 constraint-replicated
+    tail, selectable for cross-checking."""
     del key
+    if tail not in ("sharded", "replicated"):
+        raise ValueError(f"unknown tail {tail!r}")
     w, values = _wv_values(fitness)
-    ranks, _ = nondominated_ranks_sharded(
+    ranks_arr, _ = nondominated_ranks_sharded(
         w, mesh, axis=axis, front_chunk=front_chunk, row_chunk=row_chunk,
-        stop_at_k=int(k), exchange=exchange)
+        stop_at_k=int(k), exchange=exchange, method=ranks)
     with jax.named_scope("obs:crowding_tail"):
-        # the tail is replicated BY CONSTRAINT, not by hope: without the
-        # explicit resharding GSPMD partitions the crowding lexsorts and
-        # segment reductions over the pop axis and inserts ~10 all-reduces
-        # of its own (measured on the 8-device CPU mesh) — two up-front
-        # all-gathers (the int32 ranks and, when the caller's fitness
-        # lives sharded, the (N, nobj) float32 values) are the whole cost
-        # of keeping the O(N log N) tail reduction-free
-        rep = NamedSharding(mesh, P())
-        ranks = lax.with_sharding_constraint(ranks, rep)
-        values = lax.with_sharding_constraint(values, rep)
-        dist = assign_crowding_dist(values, ranks)
-        order = jnp.lexsort((-dist, ranks))
+        if tail == "sharded":
+            order = _crowding_tail_sharded(ranks_arr, values, mesh, axis)
+        else:
+            # replicated BY CONSTRAINT, not by hope: without the explicit
+            # resharding GSPMD partitions the crowding lexsorts and
+            # segment reductions over the pop axis and inserts ~10
+            # all-reduces of its own (measured on the 8-device CPU mesh)
+            rep = NamedSharding(mesh, P())
+            ranks_arr = lax.with_sharding_constraint(ranks_arr, rep)
+            values = lax.with_sharding_constraint(values, rep)
+            dist = assign_crowding_dist(values, ranks_arr)
+            order = jnp.lexsort((-dist, ranks_arr))
     return order[:k]

@@ -782,8 +782,13 @@ class EvolutionService:
 
         * an NSGA-II ``select`` is swapped for
           :func:`deap_tpu.parallel.sel_nsga2_sharded` on the service mesh
-          (bitwise index-identical to the single-device ``nd="peel"``
-          path, pinned by tests), and
+          (bitwise index-identical to the single-device path, pinned by
+          tests; a tenant-declared ``nd="grid"`` carries over as
+          ``ranks="grid"``),
+        * a default ``hypervolume`` slot (the host/device router of
+          :func:`deap_tpu.ops.hypervolume.hypervolume`) is swapped for
+          the mesh-partitioned
+          :func:`deap_tpu.ops.hypervolume.hypervolume_sharded`, and
         * a declared ``generation_engine = "megakernel"`` with the
           flagship tournament select is promoted to
           ``"megakernel_sharded"`` targeting the service mesh, so the
@@ -800,14 +805,24 @@ class EvolutionService:
             sel = getattr(toolbox, "select", None)
             from ..engines import resolve_engine
             from ..ops.emo import sel_nsga2
+            from ..ops.hypervolume import (
+                hypervolume as _hypervolume_default, hypervolume_sharded)
             from ..ops.selection import sel_tournament
             from ..parallel.emo_sharded import sel_nsga2_sharded
             if getattr(sel, "func", sel) is sel_nsga2:
                 shadow = copy.copy(toolbox)
                 kw = {k: v for k, v in getattr(sel, "keywords", {}).items()
                       if k in ("front_chunk",)}
+                if getattr(sel, "keywords", {}).get("nd") == "grid":
+                    kw["ranks"] = "grid"
                 shadow.register("select", sel_nsga2_sharded,
                                 mesh=self.mesh(), **kw)
+            hv = getattr(toolbox, "hypervolume", None)
+            if getattr(hv, "func", hv) is _hypervolume_default:
+                if shadow is toolbox:
+                    shadow = copy.copy(toolbox)
+                shadow.register("hypervolume", hypervolume_sharded,
+                                mesh=self.mesh())
             if (resolve_engine(toolbox) == "megakernel"
                     and getattr(sel, "func", sel) is sel_tournament):
                 if shadow is toolbox:

@@ -54,6 +54,9 @@ PAGES = {
                        ["deap_tpu.ops.constraint"]),
     "ops.indicator": ("Quality indicators (deap_tpu.ops.indicator, .hv)",
                       ["deap_tpu.ops.indicator", "deap_tpu.ops.hv"]),
+    "ops.hypervolume": (
+        "Device-native blocked hypervolume (deap_tpu.ops.hypervolume)",
+        ["deap_tpu.ops.hypervolume"]),
     "gp": ("Genetic programming (deap_tpu.gp)",
            ["deap_tpu.gp", "deap_tpu.gp.pset", "deap_tpu.gp.generate",
             "deap_tpu.gp.interp", "deap_tpu.gp.interp_pallas",

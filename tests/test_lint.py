@@ -424,6 +424,46 @@ def test_bench_json_memory_schema(tmp_path):
     assert [f for f in r.findings if f.path == "BENCH_MEMORY.json"] == []
 
 
+def test_bench_json_weakscaling_schema(tmp_path):
+    """BENCH_WEAKSCALING_r*.json schema (can-fail): finite positive
+    walls, non-negative collective counts, and the mo_grid leg's
+    bitwise_identical proof pinned true (ISSUE 20 satellite)."""
+    (tmp_path / "BENCH_WEAKSCALING_r99.json").write_text(json.dumps({
+        "cmd": "python bench_weakscaling.py",
+        "result": {"layouts": {
+            "pop": {"t1dev_per_gen_ms": 0,           # wall must be > 0
+                    "collective_ops_in_hlo": {"all-gather": -2}},
+            "mo_grid": {"t1dev_per_gen_ms": 4.0,
+                        "t8dev_per_gen_ms": 6.0,
+                        "overhead_factor": 1.5,
+                        "bitwise_identical": False},  # broken proof
+            "hv": {"pts_per_sec": -3.0},   # only -1 encodes a failed gate
+        }}}))
+    r = _findings(tmp_path, "bench-json")
+    msgs = [f.message for f in r.findings
+            if f.path == "BENCH_WEAKSCALING_r99.json"]
+    assert any("'pop'].t1dev_per_gen_ms" in m for m in msgs)
+    assert any("non-negative integer" in m for m in msgs)
+    assert any("bitwise_identical" in m and "must be true" in m
+               for m in msgs)
+    assert any("'hv'].pts_per_sec" in m for m in msgs)
+    # an r06-shaped artifact (no mo_grid/hv legs) and the harness's -1
+    # linearity convention are both clean
+    (tmp_path / "BENCH_WEAKSCALING_r99.json").write_text(json.dumps({
+        "cmd": "python bench_weakscaling.py",
+        "result": {"layouts": {
+            "mo": {"t1dev_per_gen_ms": 415.06,
+                   "t8dev_per_gen_ms": 526.62,
+                   "overhead_factor": 1.269,
+                   "collective_ops_in_hlo": {"all-gather": 4}},
+            "mo_grid": {"overhead_factor": -1,
+                        "bitwise_identical": True},
+        }}}))
+    r = _findings(tmp_path, "bench-json")
+    assert [f for f in r.findings
+            if f.path == "BENCH_WEAKSCALING_r99.json"] == []
+
+
 # ---------------------------------------------------------------------------
 # lock-order (static deadlock lint)
 

@@ -1,7 +1,8 @@
 #!/usr/bin/env python
-"""Collective-budget gate: lower the three weak-scaling layouts
-(``pop``, ``island``, ``mo`` — bench_weakscaling.py's programs, built by
-the same ``build()`` the bench times) on an 8-virtual-device CPU mesh
+"""Collective-budget gate: lower the weak-scaling layouts
+(``pop``, ``island``, ``mo``, ``mo_grid``, ``hv`` — bench_weakscaling.py's
+programs, built by the same ``build()`` the bench times) on an
+8-virtual-device CPU mesh
 and FAIL when any layout's HLO collective instruction count exceeds the
 committed budget (``tools/collective_budget.json``).
 
@@ -43,7 +44,7 @@ GATE_NGEN = 2
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BUDGET_PATH = os.path.join(_REPO, "tools", "collective_budget.json")
-LAYOUTS = ("pop", "island", "mo")
+LAYOUTS = ("pop", "island", "mo", "mo_grid", "hv")
 
 
 def _init_devices():
@@ -62,7 +63,7 @@ def _init_devices():
 
 
 def measure_counts() -> dict:
-    """{layout: {collective: instruction count}} for the three layouts
+    """{layout: {collective: instruction count}} for the gated layouts
     at the gate shapes, via bench_weakscaling's shared builder."""
     sys.path.insert(0, _REPO)
     import bench_weakscaling
@@ -91,7 +92,7 @@ def compare(counts: dict, budget: dict) -> list:
 def update_budget(path: str = BUDGET_PATH) -> dict:
     counts = measure_counts()
     doc = {
-        "_note": ("HLO collective instruction budget for the three "
+        "_note": ("HLO collective instruction budget for the "
                   "weak-scaling layouts, gated tier-1 by "
                   "tools/check_collective_budget.py; regenerate with "
                   "--update-budget (also reachable as "

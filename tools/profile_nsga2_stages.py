@@ -16,13 +16,24 @@ full selection — each marginal-timed; collective attribution parses the
 compiled HLO's ``op_name`` metadata, where ``jax.named_scope`` leaves
 the phase labels.  Env: PROF_POP (default 8192 sharded), PROF_DEVICES.
 
+r07: ``--sharded`` also profiles the GRID ranks path
+(``ranks="grid"``), whose phases key on its scopes — the outer
+``obs:grid_views`` (loop-invariant view build, outside the manual
+region), the in-kernel ``obs:grid_counts`` + ``obs:front_peel`` (not
+separable by subtraction: one while loop), and the shared
+``obs:crowding_tail``.  ``--json`` prints ONE machine-readable document
+(progress rows go to stderr) instead of line-per-probe output.
+
 Same scan-marginal timing as tools/pallas_probe_ga.py.
 """
 
+import contextlib
 import json
 import os
 import re
 import sys
+
+JSON_OUT = "--json" in sys.argv
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -40,11 +51,29 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from pallas_probe_ga import marginal, report
+from pallas_probe_ga import marginal, report, _RECORDS
 
 POP = int(os.environ.get("PROF_POP", 100_000))
 NDIM, NOBJ = 12, 3
 K = int(os.environ.get("PROF_K", 4))
+
+
+def emit(name, sec, ratio, **extra):
+    """report() a probe row; under ``--json`` the per-probe line goes to
+    stderr (progress only) and the row is collected into the single
+    final document via pallas_probe_ga._RECORDS."""
+    if JSON_OUT:
+        with contextlib.redirect_stdout(sys.stderr):
+            report(name, sec, ratio, **extra)
+    else:
+        report(name, sec, ratio, **extra)
+
+
+def emit_doc(doc):
+    """A sub-document: its own stdout line normally, collected under
+    ``--json``."""
+    if not JSON_OUT:
+        print(json.dumps(doc), flush=True)
 
 
 def main():
@@ -91,9 +120,9 @@ def main():
     pool = pop.concat(off)
     w = pool.fitness.masked_wvalues()
     ranks, nf = jax.jit(nondominated_ranks)(w)
-    print(json.dumps({"pool": int(w.shape[0]),
-                      "n_fronts": int(nf),
-                      "front0": int(jnp.sum(ranks == 0))}), flush=True)
+    pool_info = {"pool": int(w.shape[0]), "n_fronts": int(nf),
+                 "front0": int(jnp.sum(ranks == 0))}
+    emit_doc(pool_info)
 
     def perturb(x, out):
         return x * (1.0 + 1e-12 * (out.astype(jnp.float32) % 3))
@@ -105,7 +134,7 @@ def main():
             return perturb(ww, cnt[0]), cnt[0]
         return lambda x: lax.scan(body, x, None, length=n)
     sec, r = marginal(make_counts, w, k=K)
-    report("grid_counts", sec, r)
+    emit("grid_counts", sec, r)
 
     # (b) full nondominated ranks (counts + peel rounds)
     def make_ranks(n):
@@ -114,7 +143,7 @@ def main():
             return perturb(ww, rk[0]), rk[0]
         return lambda x: lax.scan(body, x, None, length=n)
     sec, r = marginal(make_ranks, w, k=K)
-    report("nondominated_ranks_full", sec, r)
+    emit("nondominated_ranks_full", sec, r)
 
     # (b2) ranks with the selection's stop_at_k (what sel_nsga2 pays)
     def make_ranks_stop(n):
@@ -123,7 +152,7 @@ def main():
             return perturb(ww, rk[0]), rk[0]
         return lambda x: lax.scan(body, x, None, length=n)
     sec, r = marginal(make_ranks_stop, w, k=K)
-    report("ranks_stop_at_k", sec, r)
+    emit("ranks_stop_at_k", sec, r)
 
     # (b3) the exact count-peel at the same stop (round-4 baseline)
     def make_ranks_peel(n):
@@ -132,7 +161,7 @@ def main():
             return perturb(ww, rk[0]), rk[0]
         return lambda x: lax.scan(body, x, None, length=n)
     sec, r = marginal(make_ranks_peel, w, k=K)
-    report("ranks_stop_at_k_peel", sec, r)
+    emit("ranks_stop_at_k_peel", sec, r)
 
     # (c) crowding given ranks
     vals = pool.fitness.values
@@ -144,7 +173,7 @@ def main():
             return (perturb(vv, d[0] < 1e30), rk), d[0]
         return lambda x: lax.scan(body, x, None, length=n)
     sec, r = marginal(make_crowd, (vals, ranks), k=K)
-    report("crowding", sec, r)
+    emit("crowding", sec, r)
 
     # (d) full sel_nsga2
     def make_sel(n):
@@ -153,7 +182,7 @@ def main():
             return perturb(ww, idx[0]), idx[0]
         return lambda x: lax.scan(body, x, None, length=n)
     sec, r = marginal(make_sel, w, k=K)
-    report("sel_nsga2_full", sec, r)
+    emit("sel_nsga2_full", sec, r)
 
     # (e) variation + evaluation + concat
     def make_var(n):
@@ -166,10 +195,12 @@ def main():
             return (g2,), offp.fitness.values[0, 0]
         return lambda x: lax.scan(body, x, jnp.arange(n))
     sec, r = marginal(make_var, (pop.genome,), k=K)
-    report("vary_plus_eval", sec, r)
+    emit("vary_plus_eval", sec, r)
+    return {"pool_info": pool_info}
 
 
-NAMED_SCOPES = ("obs:dominance_count", "obs:front_peel",
+NAMED_SCOPES = ("obs:dominance_count", "obs:grid_views",
+                "obs:grid_counts", "obs:front_peel",
                 "obs:crowding_tail")
 
 
@@ -241,12 +272,45 @@ def main_sharded():
             return perturb(ww, idx[0]), idx[0]
         return lambda v: lax.scan(body, v, None, length=n)
 
+    # grid path (r07): the view build is the only host-expressible
+    # pre-phase — it runs OUTSIDE the manual region (obs:grid_views) on
+    # the replicated population; grid_counts + front_peel share one
+    # while loop inside the kernel and are not separable by subtraction
+    from deap_tpu.ops.emo import _grid_views
+
+    def make_views(n):
+        def body(ww, _):
+            gid = _grid_views(ww)["gid"]
+            return perturb(ww, gid[0]), gid[0]
+        return lambda v: lax.scan(body, v, None, length=n)
+
+    def make_ranks_grid(n):
+        def body(ww, _):
+            rk, _ = nondominated_ranks_sharded(ww, mesh, front_chunk=fc,
+                                               stop_at_k=k_sel,
+                                               method="grid")
+            return perturb(ww, rk[0]), rk[0]
+        return lambda v: lax.scan(body, v, None, length=n)
+
+    def make_sel_grid(n):
+        def body(ww, _):
+            idx = sel_nsga2_sharded(None, ww, k_sel, mesh,
+                                    front_chunk=fc, ranks="grid")
+            return perturb(ww, idx[0]), idx[0]
+        return lambda v: lax.scan(body, v, None, length=n)
+
     sec_c, r_c = marginal(make_counts, w, k=K)
-    report("sharded_dominance_counts", sec_c, r_c)
+    emit("sharded_dominance_counts", sec_c, r_c)
     sec_r, r_r = marginal(make_ranks, w, k=K)
-    report("sharded_ranks_stop_at_k", sec_r, r_r)
+    emit("sharded_ranks_stop_at_k", sec_r, r_r)
     sec_s, r_s = marginal(make_sel, w, k=K)
-    report("sharded_sel_nsga2_full", sec_s, r_s)
+    emit("sharded_sel_nsga2_full", sec_s, r_s)
+    sec_v, r_v = marginal(make_views, w, k=K)
+    emit("sharded_grid_views", sec_v, r_v)
+    sec_rg, r_rg = marginal(make_ranks_grid, w, k=K)
+    emit("sharded_ranks_grid_stop_at_k", sec_rg, r_rg)
+    sec_sg, r_sg = marginal(make_sel_grid, w, k=K)
+    emit("sharded_sel_nsga2_grid_full", sec_sg, r_sg)
 
     def phase(sec, *ratios):
         """A phase is a DIFFERENCE of independently timed programs, so
@@ -260,7 +324,8 @@ def main_sharded():
     txt = (jax.jit(lambda v: sel_nsga2_sharded(None, v, k_sel, mesh,
                                                front_chunk=fc))
            .lower(w).compile().as_text())
-    print(json.dumps({
+    peel_doc = {
+        "ranks": "peel",
         "phase_ms": {
             "obs:dominance_count": phase(sec_c, r_c),
             "obs:front_peel": phase(sec_r - sec_c, r_c, r_r),
@@ -274,18 +339,45 @@ def main_sharded():
                  "are HLO instructions attributed via named-scope "
                  "op_name metadata"),
         "collectives_by_scope": collectives_by_scope(txt),
-    }), flush=True)
+    }
+    emit_doc(peel_doc)
+    txt_g = (jax.jit(lambda v: sel_nsga2_sharded(None, v, k_sel, mesh,
+                                                 front_chunk=fc,
+                                                 ranks="grid"))
+             .lower(w).compile().as_text())
+    grid_doc = {
+        "ranks": "grid",
+        "phase_ms": {
+            "obs:grid_views": phase(sec_v, r_v),
+            "obs:grid_counts+obs:front_peel":
+                phase(sec_rg - sec_v, r_v, r_rg),
+            "obs:crowding_tail": phase(sec_sg - sec_rg, r_rg, r_sg),
+        },
+        "linearity": {"views": round(r_v, 2), "ranks": round(r_rg, 2),
+                      "sel": round(r_sg, 2), "gate": [1.5, 2.7]},
+        "note": ("grid_counts and front_peel share one while loop in "
+                 "the kernel: their walls are not separable by program "
+                 "subtraction, only their collectives are (by scope)"),
+        "collectives_by_scope": collectives_by_scope(txt_g),
+    }
+    emit_doc(grid_doc)
+    return {"peel": peel_doc, "grid": grid_doc}
 
 
 if __name__ == "__main__":
     if "--sharded" in sys.argv:
-        print(json.dumps({"platform": jax.devices()[0].platform,
-                          "pop": int(os.environ.get("PROF_POP", 8192)),
-                          "n_devices": int(os.environ.get("PROF_DEVICES",
-                                                          8)),
-                          "mode": "sharded"}), flush=True)
-        main_sharded()
+        header = {"platform": jax.devices()[0].platform,
+                  "pop": int(os.environ.get("PROF_POP", 8192)),
+                  "n_devices": int(os.environ.get("PROF_DEVICES", 8)),
+                  "mode": "sharded"}
+        emit_doc(header)
+        extra = main_sharded()
     else:
-        print(json.dumps({"platform": jax.devices()[0].platform,
-                          "pop": POP}), flush=True)
-        main()
+        header = {"platform": jax.devices()[0].platform, "pop": POP}
+        emit_doc(header)
+        extra = main()
+    if JSON_OUT:
+        # the one machine-readable document --json promises: header,
+        # every probe row, and the per-path phase sub-documents
+        print(json.dumps(dict(header, probes=list(_RECORDS), **extra)),
+              flush=True)
